@@ -1,0 +1,46 @@
+"""accelerate-tpu: TPU-native training orchestration (JAX/XLA/pjit/pallas-first).
+
+A brand-new framework with the capabilities of HuggingFace Accelerate
+(reference: yao-matrix/accelerate), designed for TPU from the start: parallelism is
+expressed as shardings over a named device mesh, collectives are compiler-inserted
+or explicit ``jax.lax`` primitives, and the hot path is one jitted train step.
+"""
+
+__version__ = "0.1.0"
+
+from .parallelism_config import ParallelismConfig
+from .state import AcceleratorState, GradientState, PartialState
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedType,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    PrecisionType,
+    ProjectConfiguration,
+)
+
+__all__ = [
+    "AcceleratorState",
+    "DataLoaderConfiguration",
+    "DistributedType",
+    "GradientAccumulationPlugin",
+    "GradientState",
+    "MixedPrecisionPolicy",
+    "ParallelismConfig",
+    "PartialState",
+    "PrecisionType",
+    "ProjectConfiguration",
+]
+
+
+def __getattr__(name):
+    # Lazy to keep `import accelerate_tpu` light and avoid import cycles.
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name == "notebook_launcher":
+        from .launchers import notebook_launcher
+
+        return notebook_launcher
+    raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -1,0 +1,211 @@
+"""N-D parallelism configuration → JAX device mesh.
+
+TPU-native counterpart of the reference's ``parallelism_config.py``
+(``/root/reference/src/accelerate/parallelism_config.py:33-386``): same canonical
+axis order ``("dp_replicate", "dp_shard", "cp", "sp", "tp")`` (``:262``, torchtitan
+convention), same flattened joint axes ``dp``, ``dp_shard_cp``, ``dp_cp``
+(``build_device_mesh :211-239``), same total-size == world-size validation
+(``_validate_accelerator :350-386``), plus a first-class ``ep`` axis (the reference
+only reaches expert parallelism through Megatron/DeepSpeed engines).
+
+On TPU the mesh maps onto the physical interconnect: inner (rightmost) axes ride
+ICI, the outer ``dp_replicate`` axis is the one to place across DCN slices. Device
+order comes from ``mesh_utils.create_device_mesh`` so collectives ride ICI rings.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# Canonical axis order — mirror of reference parallelism_config.py:262.
+MESH_AXIS_NAMES = ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep")
+
+# Flattened logical axes: PartitionSpec accepts tuples of mesh axis names, so the
+# reference's flattened sub-meshes (``dp``, ``dp_shard_cp``, ``dp_cp``) become spec
+# aliases rather than separately-constructed meshes.
+DP_AXES = ("dp_replicate", "dp_shard")
+DP_SHARD_CP_AXES = ("dp_shard", "cp")
+DP_CP_AXES = ("dp_replicate", "dp_shard", "cp")
+BATCH_AXES = ("dp_replicate", "dp_shard", "cp", "sp")  # axes a global batch is split over
+
+
+@dataclass
+class ParallelismConfig:
+    """Sizes for each mesh axis. ``dp_shard_size=-1`` infers from the device count.
+
+    Mirrors reference ``ParallelismConfig`` fields (``parallelism_config.py:61-66``):
+    dp_replicate/dp_shard/cp/sp/tp, with ``ep`` added. ``cp_rotate_method`` mirrors
+    ``TorchContextParallelConfig.set_rotate_method`` (``utils/dataclasses.py:2186``):
+    ``"allgather"`` gathers KV once, ``"ring"`` (= reference ``alltoall``) rotates KV
+    blocks with ``lax.ppermute``.
+    """
+
+    dp_replicate_size: int = 1
+    dp_shard_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    tp_size: int = 1
+    ep_size: int = 1
+    cp_rotate_method: str = "allgather"  # "allgather" | "ring"
+
+    def __post_init__(self):
+        for name in ("dp_replicate_size", "cp_size", "sp_size", "tp_size", "ep_size"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
+        if self.dp_shard_size == 0 or self.dp_shard_size < -1:
+            raise ValueError(f"dp_shard_size must be -1 (infer) or >= 1, got {self.dp_shard_size}")
+        if self.cp_size > 1 and self.sp_size > 1:
+            # Reference makes CP and SP mutually exclusive (parallelism_config.py:323-329).
+            raise ValueError("cp_size and sp_size cannot both be > 1 (pick ring-CP or Ulysses-SP)")
+        if self.cp_rotate_method not in ("allgather", "ring"):
+            raise ValueError(f"cp_rotate_method must be 'allgather' or 'ring', got {self.cp_rotate_method}")
+
+    # -- size/enabled properties (reference parallelism_config.py properties) ----
+    @property
+    def non_dp_shard_size(self) -> int:
+        return self.dp_replicate_size * self.cp_size * self.sp_size * self.tp_size * self.ep_size
+
+    def infer_dp_shard(self, num_devices: int) -> int:
+        if self.dp_shard_size != -1:
+            return self.dp_shard_size
+        rest = self.non_dp_shard_size
+        if num_devices % rest != 0:
+            raise ValueError(
+                f"cannot infer dp_shard_size: {num_devices} devices not divisible by "
+                f"product of other axes {rest}"
+            )
+        return num_devices // rest
+
+    def total_size(self, num_devices: Optional[int] = None) -> int:
+        dp_shard = self.dp_shard_size
+        if dp_shard == -1:
+            if num_devices is None:
+                raise ValueError("dp_shard_size=-1 needs num_devices to infer")
+            dp_shard = self.infer_dp_shard(num_devices)
+        return self.non_dp_shard_size * dp_shard
+
+    @property
+    def dp_enabled(self) -> bool:
+        return self.dp_replicate_size > 1 or self.dp_shard_size == -1 or self.dp_shard_size > 1
+
+    @property
+    def fsdp_enabled(self) -> bool:
+        return self.dp_shard_size == -1 or self.dp_shard_size > 1
+
+    @property
+    def hsdp_enabled(self) -> bool:
+        return self.fsdp_enabled and self.dp_replicate_size > 1
+
+    @property
+    def tp_enabled(self) -> bool:
+        return self.tp_size > 1
+
+    @property
+    def cp_enabled(self) -> bool:
+        return self.cp_size > 1
+
+    @property
+    def sp_enabled(self) -> bool:
+        return self.sp_size > 1
+
+    @property
+    def ep_enabled(self) -> bool:
+        return self.ep_size > 1
+
+    # -- env protocol (reference parallelism_config.py:269-284 reads
+    #    PARALLELISM_CONFIG_* written by utils/launch.py:396-420) ---------------
+    @classmethod
+    def from_env(cls) -> "ParallelismConfig":
+        def _get(name: str, default: int) -> int:
+            return int(os.environ.get(f"PARALLELISM_CONFIG_{name}", default))
+
+        return cls(
+            dp_replicate_size=_get("DP_REPLICATE_SIZE", 1),
+            dp_shard_size=_get("DP_SHARD_SIZE", 1),
+            cp_size=_get("CP_SIZE", 1),
+            sp_size=_get("SP_SIZE", 1),
+            tp_size=_get("TP_SIZE", 1),
+            ep_size=_get("EP_SIZE", 1),
+            cp_rotate_method=os.environ.get("PARALLELISM_CONFIG_CP_ROTATE_METHOD", "allgather"),
+        )
+
+    def to_env(self) -> dict[str, str]:
+        return {
+            "PARALLELISM_CONFIG_DP_REPLICATE_SIZE": str(self.dp_replicate_size),
+            "PARALLELISM_CONFIG_DP_SHARD_SIZE": str(self.dp_shard_size),
+            "PARALLELISM_CONFIG_CP_SIZE": str(self.cp_size),
+            "PARALLELISM_CONFIG_SP_SIZE": str(self.sp_size),
+            "PARALLELISM_CONFIG_TP_SIZE": str(self.tp_size),
+            "PARALLELISM_CONFIG_EP_SIZE": str(self.ep_size),
+            "PARALLELISM_CONFIG_CP_ROTATE_METHOD": self.cp_rotate_method,
+        }
+
+    # -- mesh construction (reference build_device_mesh :211-239) ---------------
+    def mesh_shape(self, num_devices: int) -> tuple[int, ...]:
+        dp_shard = self.infer_dp_shard(num_devices)
+        shape = (
+            self.dp_replicate_size,
+            dp_shard,
+            self.cp_size,
+            self.sp_size,
+            self.tp_size,
+            self.ep_size,
+        )
+        total = int(np.prod(shape))
+        if total != num_devices:
+            raise ValueError(
+                f"mesh {dict(zip(MESH_AXIS_NAMES, shape))} has size {total} but "
+                f"{num_devices} devices are available"
+            )
+        return shape
+
+    def build_mesh(self, devices=None):
+        """Build a ``jax.sharding.Mesh`` with canonical axis names.
+
+        Device placement uses ``mesh_utils.create_device_mesh`` so that inner mesh
+        axes map to physically-adjacent chips (ICI rings); falls back to a plain
+        reshape of ``jax.devices()`` order (fine for CPU/virtual meshes).
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        shape = self.mesh_shape(len(devices))
+        try:
+            from jax.experimental import mesh_utils
+
+            device_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except Exception:
+            device_array = np.asarray(devices).reshape(shape)
+        return Mesh(device_array, axis_names=MESH_AXIS_NAMES)
+
+    def describe(self, num_devices: Optional[int] = None) -> str:
+        if num_devices is not None:
+            shape = self.mesh_shape(num_devices)
+        else:
+            shape = (
+                self.dp_replicate_size,
+                self.dp_shard_size,
+                self.cp_size,
+                self.sp_size,
+                self.tp_size,
+                self.ep_size,
+            )
+        return " x ".join(f"{n}={s}" for n, s in zip(MESH_AXIS_NAMES, shape))
+
+
+def get_1d_dp_config(num_devices: int) -> ParallelismConfig:
+    """Pure data parallelism over every device (the reference's DDP default)."""
+    return ParallelismConfig(dp_replicate_size=num_devices)
+
+
+def get_fsdp_config(num_devices: int) -> ParallelismConfig:
+    """Full parameter sharding over every device (reference FSDP full_shard)."""
+    return ParallelismConfig(dp_shard_size=num_devices)

@@ -1,0 +1,328 @@
+"""Core enums and configuration dataclasses.
+
+TPU-native counterpart of the reference's ``utils/dataclasses.py``
+(``/root/reference/src/accelerate/utils/dataclasses.py`` — ``DistributedType:600``,
+``PrecisionType:765``, ``RNGType:781``, ``DataLoaderConfiguration:814``,
+``ProjectConfiguration:909``, ``GradientAccumulationPlugin:972``,
+``ProfileKwargs:484``, ``LoggerType:737``). Engine-specific plugins (DeepSpeed /
+Megatron / FSDP-torch) collapse into sharding configuration — see
+``accelerate_tpu/parallel/`` and ``parallelism_config.py``.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Optional
+
+from .environment import parse_flag_from_env
+
+
+class BaseEnum(str, enum.Enum):
+    def __str__(self) -> str:  # so f-strings print the bare value
+        return self.value
+
+    @classmethod
+    def list(cls) -> list[str]:
+        return [v.value for v in cls]
+
+
+class DistributedType(BaseEnum):
+    """How this process participates in distributed execution.
+
+    Unlike the reference (``utils/dataclasses.py:600`` — one value per engine:
+    MULTI_GPU / DEEPSPEED / FSDP / MEGATRON_LM / XLA), a JAX program has exactly one
+    execution model: SPMD over a device mesh. The interesting structure (dp/fsdp/tp/
+    cp/sp sizes) lives in :class:`~accelerate_tpu.parallelism_config.ParallelismConfig`.
+    """
+
+    NO = "NO"  # single device
+    SPMD = "SPMD"  # >1 device, single- or multi-host, via mesh + GSPMD
+    MULTI_HOST = "MULTI_HOST"  # SPMD spanning multiple processes/hosts
+
+
+class PrecisionType(BaseEnum):
+    """Mixed-precision modes (reference ``utils/dataclasses.py:765``).
+
+    On TPU bf16 needs no loss scaling (MXU-native); fp16 is supported for parity but
+    bf16 is the recommended mode. fp8 uses XLA fp8 dot_general / Pallas kernels.
+    """
+
+    NO = "no"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    FP8 = "fp8"
+
+
+class RNGType(BaseEnum):
+    """RNG streams that can be synchronized/checkpointed (reference ``:781``)."""
+
+    JAX = "jax"  # explicit jax.random key held by the Accelerator
+    NUMPY = "numpy"
+    PYTHON = "python"
+    TORCH = "torch"  # host-side torch generators used by interop dataloaders
+    GENERATOR = "generator"
+
+
+class LoggerType(BaseEnum):
+    ALL = "all"
+    TENSORBOARD = "tensorboard"
+    WANDB = "wandb"
+    MLFLOW = "mlflow"
+    COMETML = "comet_ml"
+    AIM = "aim"
+    CLEARML = "clearml"
+    DVCLIVE = "dvclive"
+    SWANLAB = "swanlab"
+    TRACKIO = "trackio"
+    JSONL = "jsonl"  # built-in dependency-free tracker
+
+
+class SaveFormat(BaseEnum):
+    MSGPACK = "msgpack"  # flax serialization
+    SAFETENSORS = "safetensors"
+    NUMPY = "npz"
+    ORBAX = "orbax"
+
+
+@dataclass
+class KwargsHandler:
+    """Base for kwargs passthrough dataclasses (reference ``:68``)."""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()}
+
+    def to_kwargs(self) -> dict[str, Any]:
+        from dataclasses import fields
+
+        default = self.__class__()
+        return {
+            f.name: getattr(self, f.name)
+            for f in fields(self)
+            if getattr(self, f.name) != getattr(default, f.name)
+        }
+
+
+@dataclass
+class InitProcessGroupKwargs(KwargsHandler):
+    """Options for ``jax.distributed.initialize`` (reference ``:273`` wraps
+    ``torch.distributed.init_process_group``)."""
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[list[int]] = None
+    initialization_timeout: timedelta = field(default_factory=lambda: timedelta(seconds=300))
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Reference ``utils/dataclasses.py:972``. ``adjust_scheduler`` multiplies
+    scheduler steps; ``sync_with_dataloader`` forces a sync step at end-of-epoch."""
+
+    num_steps: int = 1
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+    def __post_init__(self):
+        if self.num_steps < 1:
+            raise ValueError(f"gradient accumulation steps must be >= 1, got {self.num_steps}")
+
+
+@dataclass
+class DataLoaderConfiguration(KwargsHandler):
+    """Reference ``utils/dataclasses.py:814``.
+
+    ``dispatch_batches``: process 0 reads batches and broadcasts (DataLoaderDispatcher,
+    reference ``data_loader.py:704``); default per-process sharded reads.
+    ``even_batches``: wrap around to equalize final batches (static shapes make this
+    the strongly-recommended default under XLA).
+    """
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    non_blocking: bool = True
+    use_stateful_dataloader: bool = False
+    data_seed: Optional[int] = None
+
+
+@dataclass
+class ProjectConfiguration(KwargsHandler):
+    """Checkpoint/artifact layout (reference ``utils/dataclasses.py:909``)."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: Optional[str] = None) -> None:
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        if self.logging_dir is None:
+            self.logging_dir = self.project_dir
+
+
+@dataclass
+class JitConfig(KwargsHandler):
+    """Compilation options — the moral twin of ``TorchDynamoPlugin`` (reference
+    ``utils/dataclasses.py:1024``). Under JAX, jit is default-on; these knobs tune it.
+
+    ``donate_params``: donate param/opt-state buffers to the train step (halves HBM
+    for the update). ``persistent_cache_dir`` enables the XLA compilation cache so the
+    reference's "regional compilation" compile-latency win (``benchmarks/torch.compile``)
+    is matched by cache reuse. ``remat_policy`` names a jax.checkpoint policy for
+    activation rematerialisation.
+    """
+
+    disable_jit: bool = field(
+        default_factory=lambda: parse_flag_from_env("ACCELERATE_TPU_DISABLE_JIT", False)
+    )
+    donate_params: bool = True
+    persistent_cache_dir: Optional[str] = field(
+        default_factory=lambda: os.environ.get("ACCELERATE_TPU_COMPILE_CACHE")
+    )
+    remat_policy: Optional[str] = None  # e.g. "nothing_saveable", "dots_saveable"
+
+    def apply(self) -> None:
+        import jax
+
+        if self.persistent_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", self.persistent_cache_dir)
+        if self.disable_jit:
+            jax.config.update("jax_disable_jit", True)
+
+
+@dataclass
+class ProfileConfig(KwargsHandler):
+    """``jax.profiler`` trace configuration — counterpart of ``ProfileKwargs``
+    (reference ``utils/dataclasses.py:484-599`` builds ``torch.profiler.profile``).
+
+    ``output_trace_dir`` receives a TensorBoard/Perfetto-compatible trace; the
+    reference exports per-rank Chrome traces (``accelerator.py:4148-4205``).
+    """
+
+    output_trace_dir: Optional[str] = None
+    create_perfetto_link: bool = False
+    create_perfetto_trace: bool = True
+    host_tracer_level: int = 2
+    python_tracer_level: int = 0
+    device_tracer_level: int = 1
+
+    def build_options(self):
+        import jax
+
+        return jax.profiler.ProfileOptions()
+
+
+@dataclass
+class AutocastConfig(KwargsHandler):
+    """Scoped opt-out of the bf16 compute policy (reference ``AutocastKwargs:113``)."""
+
+    enabled: bool = True
+    cache_enabled: bool = True
+
+
+@dataclass
+class GradScalerConfig(KwargsHandler):
+    """fp16 loss-scaling settings (reference ``GradScalerKwargs:241``). Only used for
+    ``mixed_precision="fp16"``; bf16 on TPU needs no scaler. Implemented with a
+    DynamicScale-style state threaded through the train step."""
+
+    init_scale: float = 2.0**15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision policy
+
+
+@dataclass(frozen=True)
+class MixedPrecisionPolicy:
+    """dtype policy for params / compute / output, jmp-style.
+
+    The reference wraps forward in ``torch.autocast`` + ``convert_outputs_to_fp32``
+    (``accelerator.py:1778-1789``); under JAX we cast inputs/params at well-defined
+    boundaries instead, which XLA then fuses.
+    """
+
+    param_dtype: Any = None  # jnp dtype or None = float32
+    compute_dtype: Any = None
+    output_dtype: Any = None
+
+    @classmethod
+    def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
+        import jax.numpy as jnp
+
+        precision = PrecisionType(str(precision))
+        if precision == PrecisionType.NO:
+            return cls(jnp.float32, jnp.float32, jnp.float32)
+        if precision == PrecisionType.BF16:
+            return cls(jnp.float32, jnp.bfloat16, jnp.float32)
+        if precision == PrecisionType.FP16:
+            return cls(jnp.float32, jnp.float16, jnp.float32)
+        if precision == PrecisionType.FP8:
+            # fp8 applies per-matmul via Pallas/XLA recipes; activations stay bf16.
+            return cls(jnp.float32, jnp.bfloat16, jnp.float32)
+        raise ValueError(f"unknown precision {precision}")
+
+    def cast_to_compute(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        if self.compute_dtype is None:
+            return tree
+
+        def _cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.compute_dtype)
+            return x
+
+        return jax.tree_util.tree_map(_cast, tree)
+
+    def cast_to_param(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        if self.param_dtype is None:
+            return tree
+
+        def _cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.param_dtype)
+            return x
+
+        return jax.tree_util.tree_map(_cast, tree)
+
+    def cast_to_output(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        if self.output_dtype is None:
+            return tree
+
+        def _cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.output_dtype)
+            return x
+
+        return jax.tree_util.tree_map(_cast, tree)
+
+
+def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
+    raise NotImplementedError(
+        "Megatron-LM is a CUDA engine; its TP/PP/EP capabilities are provided natively "
+        "via ParallelismConfig mesh axes on TPU."
+    )

@@ -1,0 +1,83 @@
+"""Environment-variable parsing and process-environment helpers.
+
+TPU-native counterpart of the reference's ``utils/environment.py``
+(``/root/reference/src/accelerate/utils/environment.py:83`` ``parse_flag_from_env``,
+``:376`` ``patch_environment``). All framework configuration flows through
+``ACCELERATE_*`` env vars written by the launcher and read by dataclass defaults,
+mirroring the reference's env-var channel (``utils/launch.py:197-420``).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+_FALSE = {"0", "false", "no", "n", "off", ""}
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string to 1/0, raising on unrecognized values."""
+    value = value.lower().strip()
+    if value in _TRUE:
+        return 1
+    if value in _FALSE:
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, None)
+    if value is None:
+        return default
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        raise ValueError(f"If set, {key} must be yes or no, got {value!r}.")
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_int_from_env(keys: list[str] | tuple[str, ...], default: int) -> int:
+    """Return the first env var among ``keys`` that is set, as an int."""
+    if isinstance(keys, str):
+        keys = [keys]
+    for key in keys:
+        value = os.environ.get(key, None)
+        if value is not None:
+            return int(value)
+    return default
+
+
+@contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set env vars (upper-cased keys), restoring previous values on exit.
+
+    Mirrors reference ``utils/environment.py:376``. ``None`` values unset the var.
+    """
+    saved: dict[str, str | None] = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        saved[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return the subset of ``library_names`` already imported in this process."""
+    import sys
+
+    return [name for name in library_names if name in sys.modules]

@@ -1,0 +1,139 @@
+"""Capability probes: which optional libraries / hardware are available.
+
+TPU-native counterpart of the reference's ``utils/imports.py``
+(``/root/reference/src/accelerate/utils/imports.py:62-426`` — ~50 ``is_*_available``
+probes). Here the compute stack is always JAX; probes cover optional integrations
+(trackers, orbax, flax, torch-interop) and the accelerator platform itself.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+
+
+@functools.lru_cache(maxsize=None)
+def _package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def is_flax_available() -> bool:
+    return _package_available("flax")
+
+
+def is_optax_available() -> bool:
+    return _package_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return _package_available("orbax")
+
+
+def is_chex_available() -> bool:
+    return _package_available("chex")
+
+
+def is_torch_available() -> bool:
+    return _package_available("torch")
+
+
+def is_transformers_available() -> bool:
+    return _package_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return _package_available("datasets")
+
+
+def is_safetensors_available() -> bool:
+    return _package_available("safetensors")
+
+
+def is_tensorboard_available() -> bool:
+    return _package_available("tensorboard") or _package_available("tensorboardX")
+
+
+def is_wandb_available() -> bool:
+    return _package_available("wandb")
+
+
+def is_mlflow_available() -> bool:
+    return _package_available("mlflow")
+
+
+def is_comet_ml_available() -> bool:
+    return _package_available("comet_ml")
+
+
+def is_clearml_available() -> bool:
+    return _package_available("clearml")
+
+
+def is_aim_available() -> bool:
+    return _package_available("aim")
+
+
+def is_dvclive_available() -> bool:
+    return _package_available("dvclive")
+
+
+def is_swanlab_available() -> bool:
+    return _package_available("swanlab")
+
+
+def is_trackio_available() -> bool:
+    return _package_available("trackio")
+
+
+def is_rich_available() -> bool:
+    return _package_available("rich")
+
+
+def is_tqdm_available() -> bool:
+    return _package_available("tqdm")
+
+
+def is_pandas_available() -> bool:
+    return _package_available("pandas")
+
+
+def is_pytest_available() -> bool:
+    return _package_available("pytest")
+
+
+@functools.lru_cache(maxsize=None)
+def is_tpu_available() -> bool:
+    """True when the default JAX backend is a TPU (incl. tunneled/virtual TPUs)."""
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def is_gpu_available() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() == "gpu"
+    except Exception:
+        return False
+
+
+def is_cpu_only() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def is_pallas_available() -> bool:
+    """Pallas ships with jax; TPU lowering needs a TPU backend, CPU uses interpret mode."""
+    return _package_available("jax")
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

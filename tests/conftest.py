@@ -1,0 +1,31 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-process-without-a-cluster strategy
+(``/root/reference/tests`` + SURVEY.md §4) but better: XLA's
+``--xla_force_host_platform_device_count`` gives a real 8-device mesh in ONE
+process, so sharding/collective semantics are tested without subprocesses.
+"""
+
+import os
+
+# Must run before jax initializes its backends.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Each test gets fresh state singletons (reference tests use _reset_state too)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,105 @@
+"""Tests for state singletons + mesh construction (reference: tests exercise
+PartialState via scripts, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import (
+    AcceleratorState,
+    DistributedType,
+    GradientState,
+    ParallelismConfig,
+    PartialState,
+)
+from accelerate_tpu.parallelism_config import MESH_AXIS_NAMES
+from accelerate_tpu.utils import patch_environment
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_devices == 8
+    assert a.process_index == 0
+    assert a.is_main_process
+    assert a.distributed_type == DistributedType.SPMD
+
+
+def test_split_between_processes_single_process():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_parallelism_config_validation():
+    with pytest.raises(ValueError):
+        ParallelismConfig(tp_size=0)
+    with pytest.raises(ValueError):
+        ParallelismConfig(cp_size=2, sp_size=2)
+    with pytest.raises(ValueError):
+        ParallelismConfig(dp_replicate_size=3).mesh_shape(8)
+
+
+def test_parallelism_config_infer_dp_shard():
+    pc = ParallelismConfig(dp_shard_size=-1, tp_size=2)
+    assert pc.infer_dp_shard(8) == 4
+    assert pc.mesh_shape(8) == (1, 4, 1, 1, 2, 1)
+    assert pc.fsdp_enabled and pc.tp_enabled and not pc.cp_enabled
+
+
+def test_build_mesh_axes():
+    pc = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, tp_size=2)
+    mesh = pc.build_mesh()
+    assert mesh.axis_names == MESH_AXIS_NAMES
+    assert mesh.shape["dp_replicate"] == 2
+    assert mesh.shape["dp_shard"] == 2
+    assert mesh.shape["tp"] == 2
+    assert np.prod(list(mesh.shape.values())) == 8
+
+
+def test_parallelism_config_env_round_trip():
+    pc = ParallelismConfig(dp_shard_size=4, tp_size=2, cp_rotate_method="ring")
+    with patch_environment(**pc.to_env()):
+        loaded = ParallelismConfig.from_env()
+    assert loaded == pc
+
+
+def test_accelerator_state_mesh_default_dp():
+    state = AcceleratorState()
+    assert state.mesh.shape["dp_replicate"] == 8
+    assert state.num_devices == 8
+    assert str(state.mixed_precision) == "no"
+
+
+def test_accelerator_state_env_parallelism():
+    with patch_environment(PARALLELISM_CONFIG_DP_SHARD_SIZE=8, PARALLELISM_CONFIG_DP_REPLICATE_SIZE=1):
+        state = AcceleratorState(mixed_precision="bf16")
+        assert state.mesh.shape["dp_shard"] == 8
+        assert str(state.mixed_precision) == "bf16"
+
+
+def test_gradient_state():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert not gs.in_dataloader
+    assert gs.remainder == -1
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def fn(x):
+        calls.append(x)
+        return x
+
+    assert fn(3) == 3
+    assert calls == [3]
+
+
+def test_main_process_first_noop_single():
+    state = PartialState()
+    with state.main_process_first():
+        pass
