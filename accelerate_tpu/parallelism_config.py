@@ -182,7 +182,14 @@ class ParallelismConfig:
             device_array = mesh_utils.create_device_mesh(
                 shape, devices=devices, allow_split_physical_axes=True
             )
-        except Exception:
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            import warnings
+
+            warnings.warn(
+                f"mesh_utils.create_device_mesh failed ({e}); falling back to plain "
+                "device-order reshape — collectives may not ride optimal ICI rings.",
+                stacklevel=2,
+            )
             device_array = np.asarray(devices).reshape(shape)
         return Mesh(device_array, axis_names=MESH_AXIS_NAMES)
 

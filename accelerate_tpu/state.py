@@ -87,7 +87,9 @@ class PartialState:
 
         self.num_processes = jax.process_count()
         self.process_index = jax.process_index()
-        self.local_process_index = self.process_index  # one process per host on TPU-VM
+        # One process per host on TPU-VM → every process is its host's local main.
+        # (A LOCAL_RANK-style env override is honored for exotic multi-proc-per-host.)
+        self.local_process_index = int(os.environ.get("ACCELERATE_LOCAL_PROCESS_INDEX", 0))
         self.devices = jax.devices()
         self.local_devices = jax.local_devices()
         self.num_devices = len(self.devices)

@@ -221,7 +221,14 @@ class ProfileConfig(KwargsHandler):
     def build_options(self):
         import jax
 
-        return jax.profiler.ProfileOptions()
+        options = jax.profiler.ProfileOptions()
+        for attr in ("host_tracer_level", "python_tracer_level", "device_tracer_level"):
+            value = getattr(self, attr)
+            try:
+                setattr(options, attr, value)
+            except (AttributeError, ValueError):  # older jax ProfileOptions surface
+                pass
+        return options
 
 
 @dataclass
@@ -268,7 +275,7 @@ class MixedPrecisionPolicy:
 
         precision = PrecisionType(str(precision))
         if precision == PrecisionType.NO:
-            return cls(jnp.float32, jnp.float32, jnp.float32)
+            return cls(None, None, None)  # "no" = never touch dtypes
         if precision == PrecisionType.BF16:
             return cls(jnp.float32, jnp.bfloat16, jnp.float32)
         if precision == PrecisionType.FP16:

@@ -1,0 +1,383 @@
+"""Backend-polymorphic pytree collectives and tensor utilities.
+
+TPU-native counterpart of the reference's ``utils/operations.py``
+(``/root/reference/src/accelerate/utils/operations.py`` — ``recursively_apply:85``,
+``send_to_device:136``, ``gather:419``, ``gather_object:445``, ``broadcast:539``,
+``broadcast_object_list:560``, ``pad_across_processes:632``, ``reduce:728``,
+``verify_operation:364``).
+
+Two regimes exist on TPU:
+
+1. **Inside jit** — collectives are either compiler-inserted (GSPMD, from shardings)
+   or explicit ``jax.lax.psum/all_gather/ppermute``; nothing here is needed.
+2. **Host level** (metrics, logging, object exchange) — these helpers. With a single
+   process and a global ``jax.Array`` input, gathering is just resharding to
+   replicated; across processes we use ``jax.experimental.multihost_utils``.
+
+There is no ``mark_step`` anywhere: the reference's XLA graph-cut discipline
+(``operations.py:301-313, 748-756``) is an artifact of lazy-tensor mode and
+disappears under jit.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..state import PartialState
+from .environment import parse_flag_from_env
+
+
+class DistributedOperationException(Exception):
+    """Raised when an operation cannot proceed consistently across processes
+    (reference ``utils/operations.py:37``)."""
+
+
+def _is_jax_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def _is_tensorlike(x) -> bool:
+    return _is_jax_array(x) or isinstance(x, np.ndarray)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = _is_tensorlike,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to all leaves of ``data`` that pass ``test_type``
+    (reference ``operations.py:85``). Containers (list/tuple/dict/namedtuple) are
+    rebuilt with their original type."""
+    if isinstance(data, (list, tuple)):
+        cls = type(data)
+        mapped = [
+            recursively_apply(
+                func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+            )
+            for o in data
+        ]
+        if hasattr(data, "_fields"):  # namedtuple
+            return cls(*mapped)
+        return cls(mapped)
+    if isinstance(data, dict):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for k, v in data.items()
+            }
+        )
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to a collective op — only nested "
+            "list/tuple/dict of arrays are supported."
+        )
+    return data
+
+
+def send_to_device(tree, device=None, non_blocking: bool = True, skip_keys=None):
+    """Place all array leaves on ``device`` — a ``jax.Device``, ``Sharding`` or
+    ``None`` for the default device (reference ``operations.py:136``)."""
+    import jax
+
+    if skip_keys and isinstance(tree, dict):
+        if isinstance(skip_keys, str):
+            skip_keys = [skip_keys]
+        return type(tree)(
+            {
+                k: (v if k in skip_keys else send_to_device(v, device, non_blocking))
+                for k, v in tree.items()
+            }
+        )
+
+    def _put(x):
+        return jax.device_put(x, device)
+
+    return recursively_apply(_put, tree)
+
+
+def _replicate_global_array(x):
+    """Reshard a (possibly sharded) global jax.Array to fully-replicated — the SPMD
+    meaning of "gather": every device/host ends up with the full value."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = x.sharding
+    if getattr(sharding, "mesh", None) is not None:
+        target = NamedSharding(sharding.mesh, PartitionSpec())
+        return jax.device_put(x, target)
+    return x
+
+
+def gather(tree):
+    """Gather array leaves so every process holds the full value
+    (reference ``gather:419`` — there: concat along dim0 across ranks).
+
+    - global sharded ``jax.Array`` → resharded to fully-replicated (ICI allgather)
+    - host-local numpy (multi-process) → ``process_allgather`` concat along dim 0
+    """
+    state = PartialState()
+
+    def _gather(x):
+        if _is_jax_array(x):
+            x = _replicate_global_array(x)
+            if not x.is_fully_addressable:  # pragma: no cover - multihost only
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.process_allgather(x, tiled=True)
+            return x
+        if state.num_processes > 1:  # pragma: no cover - multihost only
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x, tiled=True)
+        return x
+
+    return recursively_apply(_gather, tree)
+
+
+def gather_object(obj: Any) -> list[Any]:
+    """Gather arbitrary picklable objects from all processes into a list
+    (reference ``gather_object:445``)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return [obj]
+    # pragma: no cover - multihost only
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
+    max_size = int(sizes.max())
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded, tiled=False)
+    return [
+        pickle.loads(gathered[i, : int(sizes[i])].tobytes()) for i in range(state.num_processes)
+    ]
+
+
+def broadcast(tree, from_process: int = 0):
+    """Broadcast array leaves from ``from_process`` to all processes
+    (reference ``broadcast:539``). Single-process: identity."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return tree
+    # pragma: no cover - multihost only
+    from jax.experimental import multihost_utils
+
+    def _bcast(x):
+        return multihost_utils.broadcast_one_to_all(x, is_source=state.process_index == from_process)
+
+    return recursively_apply(_bcast, tree)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
+    """Broadcast a list of picklable objects (reference ``broadcast_object_list:560``)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return object_list
+    # pragma: no cover - multihost only
+    from jax.experimental import multihost_utils
+
+    is_source = state.process_index == from_process
+    payload = np.frombuffer(pickle.dumps(object_list), dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
+    buf = np.zeros(int(size[0]), dtype=np.uint8)
+    if is_source:
+        buf[:] = payload
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    result = pickle.loads(buf.tobytes())
+    object_list[:] = result
+    return object_list
+
+
+def reduce(tree, reduction: str = "mean", scale: float = 1.0):
+    """Reduce array leaves across the batch axes of the mesh / across processes
+    (reference ``reduce:728``). Host-level: gathers then reduces; inside jit use
+    ``jax.lax.psum`` directly."""
+    import jax.numpy as jnp
+
+    state = PartialState()
+
+    def _reduce(x):
+        if _is_jax_array(x) and getattr(x.sharding, "mesh", None) is not None:
+            mesh = x.sharding.mesh
+            spec = x.sharding.spec
+            # a sharded leaf: sum the per-shard values along sharded axes == global sum
+            # For host-level semantics we interpret reduce as "combine the per-device
+            # batch shards", which for a replicated array is identity.
+            if all(s is None for s in spec):
+                out = x * scale
+                if reduction == "sum" and state.num_processes > 1:
+                    out = out * state.num_processes
+                return out
+            gathered = _replicate_global_array(x)
+            return gathered * scale
+        if state.num_processes > 1:  # pragma: no cover - multihost only
+            from jax.experimental import multihost_utils
+
+            stacked = multihost_utils.process_allgather(np.asarray(x), tiled=False)
+            if reduction == "mean":
+                return stacked.mean(axis=0) * scale
+            if reduction == "sum":
+                return stacked.sum(axis=0) * scale
+            return np.asarray(x) * scale
+        return jnp.asarray(x) * scale if _is_jax_array(x) else np.asarray(x) * scale
+
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
+    return recursively_apply(_reduce, tree)
+
+
+def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad array leaves to the max size along ``dim`` across processes
+    (reference ``pad_across_processes:632``). Needed before ``gather`` when
+    per-process batch sizes differ."""
+    state = PartialState()
+
+    def _pad(x):
+        arr = np.asarray(x)
+        if dim >= arr.ndim:
+            return x
+        if state.num_processes == 1:
+            return x
+        # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
+
+        sizes = multihost_utils.process_allgather(np.array([arr.shape[dim]]), tiled=False).reshape(-1)
+        max_size = int(sizes.max())
+        if max_size == arr.shape[dim]:
+            return x
+        pad_width = [(0, 0)] * arr.ndim
+        pad_width[dim] = (max_size - arr.shape[dim], 0) if pad_first else (0, max_size - arr.shape[dim])
+        return np.pad(arr, pad_width, constant_values=pad_index)
+
+    return recursively_apply(_pad, tree)
+
+
+def pad_input_tensors(tree, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad a batch so it divides evenly across processes by repeating final rows
+    (reference ``pad_input_tensors:687``)."""
+
+    def _pad(x):
+        arr = np.asarray(x) if not _is_jax_array(x) else x
+        size = arr.shape[dim]
+        if size % num_processes == 0:
+            return x
+        target = ((size // num_processes) + 1) * num_processes
+        extra = target - size
+        idx = [slice(None)] * arr.ndim
+        idx[dim] = slice(size - 1, size)
+        tail = arr[tuple(idx)]
+        reps = [1] * arr.ndim
+        reps[dim] = extra
+        if _is_jax_array(arr):
+            import jax.numpy as jnp
+
+            return jnp.concatenate([arr, jnp.tile(tail, reps)], axis=dim)
+        return np.concatenate([arr, np.tile(tail, reps)], axis=dim)
+
+    return recursively_apply(_pad, tree)
+
+
+def slice_tensors(data, tensor_slice, process_index: Optional[int] = None, num_processes: Optional[int] = None):
+    """Slice all array leaves (reference ``slice_tensors:581``)."""
+
+    def _slice(x):
+        return x[tensor_slice]
+
+    return recursively_apply(_slice, data)
+
+
+def concatenate(data: list, dim: int = 0):
+    """Concatenate the leaves of a list of same-structure pytrees
+    (reference ``concatenate:601``)."""
+    import jax.numpy as jnp
+
+    first = data[0]
+    if isinstance(first, (list, tuple)):
+        return type(first)(concatenate([d[i] for d in data], dim=dim) for i in range(len(first)))
+    if isinstance(first, dict):
+        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first})
+    if _is_jax_array(first):
+        return jnp.concatenate(data, axis=dim)
+    return np.concatenate(data, axis=dim)
+
+
+def find_batch_size(data) -> Optional[int]:
+    """First dimension of the first array leaf (reference ``find_batch_size:238``)."""
+    if isinstance(data, (list, tuple)):
+        for o in data:
+            result = find_batch_size(o)
+            if result is not None:
+                return result
+        return None
+    if isinstance(data, dict):
+        for v in data.values():
+            result = find_batch_size(v)
+            if result is not None:
+                return result
+        return None
+    if _is_tensorlike(data) and getattr(data, "ndim", 0) >= 1:
+        return int(data.shape[0])
+    return None
+
+
+def get_data_structure(data):
+    """Shape/dtype skeleton of a pytree, for dispatch-mode metadata exchange
+    (reference ``get_data_structure:188``)."""
+    import jax
+
+    def _describe(x):
+        return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype if not _is_jax_array(x) else x.dtype)
+
+    return recursively_apply(_describe, data)
+
+
+def initialize_tensors(structure):
+    """Materialize zeros matching a skeleton from :func:`get_data_structure`
+    (reference ``initialize_tensors:224``)."""
+
+    def _init(x):
+        return np.zeros(x.shape, dtype=x.dtype)
+
+    import jax
+
+    return recursively_apply(_init, structure, test_type=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+
+def verify_operation(function: Callable) -> Callable:
+    """Debug-mode wrapper: before a collective, check shapes match across processes
+    and raise :class:`DistributedOperationException` on mismatch (reference
+    ``verify_operation:364``, enabled by ``ACCELERATE_DEBUG_MODE``)."""
+
+    def wrapper(tree, *args, **kwargs):
+        state = PartialState()
+        if state.num_processes > 1 and (
+            getattr(state, "debug", False) or parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+        ):  # pragma: no cover - multihost only
+            shapes = recursively_apply(lambda x: tuple(np.shape(x)), tree, test_type=_is_tensorlike)
+            all_shapes = gather_object(shapes)
+            if any(s != all_shapes[0] for s in all_shapes[1:]):
+                raise DistributedOperationException(
+                    f"Shapes mismatch across processes in {function.__name__}: {all_shapes}"
+                )
+        return function(tree, *args, **kwargs)
+
+    wrapper.__name__ = function.__name__
+    return wrapper
+
+
+gather = verify_operation(gather)
+broadcast = verify_operation(broadcast)
+reduce_ = reduce  # alias to avoid shadowing builtins at import sites

@@ -1,0 +1,135 @@
+"""Seeding and cross-process RNG synchronization.
+
+TPU-native counterpart of the reference's ``utils/random.py``
+(``/root/reference/src/accelerate/utils/random.py`` — ``set_seed:39``,
+``synchronize_rng_state:78``, ``synchronize_rng_states:154``).
+
+JAX's explicit ``PRNGKey`` makes most of this trivial: device RNG is a value you
+hold, fork, and checkpoint. What still needs care is the *host-side* RNG used by
+samplers/shuffles (python/numpy/torch), which must agree across processes so every
+host draws the same permutation — the reference broadcasts rank-0 state per epoch
+(``data_loader.py:559-560``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..state import PartialState
+from .dataclasses import RNGType
+from .imports import is_torch_available
+from .operations import broadcast_object_list
+
+
+_GLOBAL_KEY = None  # module-level default jax PRNG key set by set_seed
+
+
+def get_rng_key():
+    """The framework-global jax PRNG key (set by :func:`set_seed`), or None."""
+    return _GLOBAL_KEY
+
+
+def next_rng_key():
+    """Split the global key and return a fresh subkey."""
+    global _GLOBAL_KEY
+    import jax
+
+    if _GLOBAL_KEY is None:
+        set_seed(0)
+    _GLOBAL_KEY, sub = jax.random.split(_GLOBAL_KEY)
+    return sub
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> None:
+    """Seed python/numpy/torch(host)/jax (reference ``set_seed:39``).
+
+    ``device_specific`` offsets the seed by process index so each host draws
+    different data-augmentation randomness while model init stays synced.
+    """
+    global _GLOBAL_KEY
+    import jax
+
+    if device_specific:
+        seed = seed + PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+    _GLOBAL_KEY = jax.random.PRNGKey(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None) -> None:
+    """Broadcast rank-0's RNG state for one stream to all processes
+    (reference ``synchronize_rng_state:78``)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    rng_type = RNGType(str(rng_type)) if rng_type is not None else RNGType.NUMPY
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        payload = broadcast_object_list(payload)
+        random.setstate(payload[0])
+    elif rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        payload = broadcast_object_list(payload)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.TORCH and is_torch_available():
+        import torch
+
+        payload = [torch.get_rng_state()]
+        payload = broadcast_object_list(payload)
+        torch.set_rng_state(payload[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.get_state() if hasattr(generator, "get_state") else None]
+        payload = broadcast_object_list(payload)
+        if payload[0] is not None:
+            generator.set_state(payload[0])
+    elif rng_type == RNGType.JAX:
+        global _GLOBAL_KEY
+        payload = [None if _GLOBAL_KEY is None else np.asarray(_GLOBAL_KEY)]
+        payload = broadcast_object_list(payload)
+        if payload[0] is not None:
+            import jax
+
+            _GLOBAL_KEY = jax.numpy.asarray(payload[0])
+
+
+def synchronize_rng_states(rng_types: Iterable[str | RNGType], generator=None) -> None:
+    """Synchronize several streams at once (reference ``synchronize_rng_states:154``)."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(str(rng_type)), generator=generator)
+
+
+def capture_rng_states(include_torch: bool = True) -> dict:
+    """Snapshot all host RNG streams + the global jax key, for checkpointing
+    (reference ``checkpointing.py:153-176``)."""
+    states = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "jax_key": None if _GLOBAL_KEY is None else np.asarray(_GLOBAL_KEY),
+    }
+    if include_torch and is_torch_available():
+        import torch
+
+        states["torch"] = torch.get_rng_state()
+    return states
+
+
+def restore_rng_states(states: dict) -> None:
+    """Inverse of :func:`capture_rng_states` (reference ``checkpointing.py:287-309``)."""
+    global _GLOBAL_KEY
+    random.setstate(states["python"])
+    np.random.set_state(states["numpy"])
+    if states.get("jax_key") is not None:
+        import jax.numpy as jnp
+
+        _GLOBAL_KEY = jnp.asarray(states["jax_key"])
+    if "torch" in states and is_torch_available():
+        import torch
+
+        torch.set_rng_state(states["torch"])

@@ -1,0 +1,121 @@
+"""Tests for host-level collectives (reference: test_utils/scripts/test_ops.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu import ParallelismConfig
+from accelerate_tpu.utils.operations import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    find_batch_size,
+    gather,
+    gather_object,
+    get_data_structure,
+    initialize_tensors,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+
+
+def test_recursively_apply_preserves_structure():
+    data = {"a": np.ones(3), "b": [np.zeros(2), "keep"], "c": (np.ones(1),)}
+    out = recursively_apply(lambda x: x + 1, data)
+    assert out["b"][1] == "keep"
+    assert isinstance(out["c"], tuple)
+    np.testing.assert_array_equal(out["a"], np.full(3, 2.0))
+
+
+def test_gather_replicates_sharded_array():
+    mesh = ParallelismConfig(dp_shard_size=8).build_mesh()
+    x = jax.device_put(jnp.arange(16.0).reshape(16, 1), NamedSharding(mesh, P("dp_shard")))
+    out = gather({"x": x})["x"]
+    assert out.sharding.spec == P()
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0).reshape(16, 1))
+
+
+def test_gather_object_single_process():
+    assert gather_object({"rank": 0}) == [{"rank": 0}]
+
+
+def test_broadcast_single_process_identity():
+    data = {"x": np.ones(2)}
+    out = broadcast(data)
+    np.testing.assert_array_equal(out["x"], data["x"])
+    objs = broadcast_object_list([1, "a"])
+    assert objs == [1, "a"]
+
+
+def test_reduce_mean_on_replicated():
+    mesh = ParallelismConfig(dp_replicate_size=8).build_mesh()
+    x = jax.device_put(jnp.full((4,), 3.0), NamedSharding(mesh, P()))
+    out = reduce({"x": x}, reduction="mean")["x"]
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), 3.0))
+
+
+def test_reduce_invalid_reduction():
+    with pytest.raises(ValueError):
+        reduce(np.ones(2), reduction="max")
+
+
+def test_pad_input_tensors():
+    batch = {"x": np.arange(10).reshape(5, 2)}
+    out = pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    np.testing.assert_array_equal(out["x"][5], out["x"][4])
+    even = pad_input_tensors({"x": np.ones((8, 2))}, batch_size=8, num_processes=4)
+    assert even["x"].shape == (8, 2)
+
+
+def test_slice_and_concatenate_and_batch_size():
+    data = [{"x": np.arange(6).reshape(6, 1)}, {"x": np.arange(6, 12).reshape(6, 1)}]
+    sliced = slice_tensors(data[0], slice(0, 2))
+    assert sliced["x"].shape == (2, 1)
+    cat = concatenate(data)
+    assert cat["x"].shape == (12, 1)
+    assert find_batch_size(data[0]) == 6
+    assert find_batch_size({"a": "str", "b": np.ones((3, 2))}) == 3
+
+
+def test_structure_round_trip():
+    data = {"x": np.ones((2, 3), dtype=np.float32), "y": [np.zeros(4, dtype=np.int32)]}
+    skeleton = get_data_structure(data)
+    rebuilt = initialize_tensors(skeleton)
+    assert rebuilt["x"].shape == (2, 3) and rebuilt["x"].dtype == np.float32
+    assert rebuilt["y"][0].shape == (4,) and rebuilt["y"][0].dtype == np.int32
+
+
+def test_send_to_device_with_sharding():
+    mesh = ParallelismConfig(dp_shard_size=8).build_mesh()
+    sharding = NamedSharding(mesh, P("dp_shard"))
+    out = send_to_device({"x": np.zeros((8, 2)), "skip": np.ones(1)}, sharding, skip_keys="skip")
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].sharding == sharding
+    assert isinstance(out["skip"], np.ndarray)
+
+
+def test_rng_set_seed_and_capture():
+    from accelerate_tpu.utils.random import (
+        capture_rng_states,
+        next_rng_key,
+        restore_rng_states,
+        set_seed,
+    )
+
+    set_seed(42)
+    a = np.random.rand(3)
+    k1 = next_rng_key()
+    states = capture_rng_states()
+    b = np.random.rand(3)
+    k2 = next_rng_key()
+    restore_rng_states(states)
+    np.testing.assert_array_equal(np.random.rand(3), b)
+    np.testing.assert_array_equal(np.asarray(next_rng_key()), np.asarray(k2))
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
