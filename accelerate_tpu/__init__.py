@@ -20,7 +20,11 @@ from .utils import (
 )
 
 __all__ = [
+    "Accelerator",
+    "AcceleratedOptimizer",
+    "AcceleratedScheduler",
     "AcceleratorState",
+    "DataLoader",
     "DataLoaderConfiguration",
     "DistributedType",
     "GradientAccumulationPlugin",
@@ -39,6 +43,18 @@ def __getattr__(name):
         from .accelerator import Accelerator
 
         return Accelerator
+    if name == "AcceleratedOptimizer":
+        from .optimizer import AcceleratedOptimizer
+
+        return AcceleratedOptimizer
+    if name == "AcceleratedScheduler":
+        from .scheduler import AcceleratedScheduler
+
+        return AcceleratedScheduler
+    if name == "DataLoader":
+        from .data_loader import DataLoader
+
+        return DataLoader
     if name == "notebook_launcher":
         from .launchers import notebook_launcher
 

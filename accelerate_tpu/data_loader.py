@@ -30,7 +30,7 @@ import numpy as np
 from .parallelism_config import ParallelismConfig
 from .state import GradientState, PartialState
 from .utils.dataclasses import DataLoaderConfiguration
-from .utils.operations import concatenate, find_batch_size, recursively_apply, send_to_device
+from .utils.operations import find_batch_size, recursively_apply, send_to_device
 
 _NO_BATCH = object()
 

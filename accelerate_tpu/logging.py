@@ -1,0 +1,56 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``logging.py``
+(``/root/reference/src/accelerate/logging.py`` — ``MultiProcessAdapter:23``,
+``get_logger:87``): log lines carry rank info, fire on the main process only by
+default, optionally on all processes (``main_process_only=False``) or strictly
+``in_order`` across hosts.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, main_process_only: bool = True, in_order: bool = False, **kwargs):
+        if self.isEnabledFor(level):
+            from .state import PartialState
+
+            state = PartialState()
+            kwargs.setdefault("stacklevel", 2)
+            if in_order and state.num_processes > 1:
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kw = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kw)
+                    state.wait_for_everyone(f"log_in_order_{i}")
+                return
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a warning exactly once per unique message (reference ``:78``)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Rank-aware logger (reference ``get_logger:87``). Level from arg or
+    ``ACCELERATE_LOG_LEVEL``."""
+    logger = logging.getLogger(name)
+    level = log_level or os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    if level is not None:
+        logger.setLevel(level.upper())
+        logger.root.setLevel(level.upper())
+    return MultiProcessAdapter(logger, {})

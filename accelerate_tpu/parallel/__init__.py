@@ -1,0 +1,10 @@
+from .sharding import (
+    FSDP_AXES,
+    ShardingRules,
+    infer_param_specs,
+    llama_tp_rules,
+    replicate,
+    shard_like_params,
+    shard_params,
+    tree_specs_like,
+)

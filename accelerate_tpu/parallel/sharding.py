@@ -1,0 +1,221 @@
+"""Parameter sharding rules: pytree → PartitionSpec tree over the named mesh.
+
+This replaces the reference's per-engine "prepare = wrap the module" flows with
+"prepare = assign shardings" (SURVEY.md §7):
+
+- FSDP/HSDP — reference ``_prepare_fsdp2`` (``accelerator.py:1643-1733``) +
+  ``fsdp2_prepare_model`` (``utils/fsdp_utils.py:607-722``): params sharded on dim 0
+  over the joint ``(dp_shard, cp)`` axes (the reference's ``dp_shard_cp`` flat mesh,
+  ``parallelism_config.py:211-239``); XLA all-gathers forward, reduce-scatters
+  backward — the GSPMD twin of FSDP2's DTensor flow.
+- TP — reference ``_prepare_tp`` (``accelerator.py:1572-1626``) + transformers
+  ``tp_plan`` tables: a module-pattern → PartitionSpec rule list.
+- The optimizer state inherits param shardings (reference FSDP2's optimizer
+  param-swap trick ``utils/fsdp_utils.py:543`` becomes: optax state is a pytree of
+  param-shaped leaves, shard it with the same specs).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..parallelism_config import ParallelismConfig
+
+FSDP_AXES = ("dp_shard", "cp")  # reference joint dp_shard_cp mesh
+
+
+def _path_str(path) -> str:
+    """jax tree path → 'a/b/0/c' string for regex matching."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (pattern → PartitionSpec) table, first match wins.
+
+    The TPU-native analogue of transformers' ``tp_plan`` / Megatron's per-layer
+    parallel maps. Patterns are regexes over '/'-joined param paths.
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, Any]] = ()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def match(self, path: str):
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return None
+
+    def __add__(self, other: "ShardingRules") -> "ShardingRules":
+        merged = ShardingRules()
+        merged.rules = list(self.rules) + list(other.rules)
+        return merged
+
+
+def _merge_fsdp_into_spec(spec, shape, fsdp_axes: tuple, fsdp_size: int, axis_sizes: dict):
+    """Add FSDP axes to a (possibly TP-sharded) spec.
+
+    Strategy: shard the largest dimension not already claimed by the spec whose
+    size divides evenly by the FSDP world; if dim 0 is claimed by TP, compose FSDP
+    into the same dim tuple when the joint product divides. Non-divisible params
+    stay as-is (replicated over the FSDP axes) — ``jax.device_put`` requires even
+    shards outside jit.
+    """
+    from jax.sharding import PartitionSpec
+
+    dims = list(spec) if spec is not None else []
+    while len(dims) < len(shape):
+        dims.append(None)
+    candidates = [
+        i for i, d in enumerate(dims) if d is None and shape[i] >= 2 and shape[i] % fsdp_size == 0
+    ]
+    if not candidates:
+        # compose onto dim 0's existing axes (e.g. TP row-parallel + FSDP)
+        if dims and dims[0] is not None:
+            existing = dims[0] if isinstance(dims[0], tuple) else (dims[0],)
+            existing_size = int(np.prod([axis_sizes.get(a, 1) for a in existing]))
+            if shape[0] % (fsdp_size * existing_size) == 0:
+                dims[0] = tuple(fsdp_axes) + existing
+        return PartitionSpec(*dims)
+    target = 0 if 0 in candidates else max(candidates, key=lambda i: shape[i])
+    dims[target] = tuple(fsdp_axes) if len(fsdp_axes) > 1 else fsdp_axes[0]
+    return PartitionSpec(*dims)
+
+
+def infer_param_specs(
+    params,
+    mesh,
+    parallelism_config: Optional[ParallelismConfig] = None,
+    rules: Optional[ShardingRules] = None,
+    min_fsdp_size: int = 2**10,
+):
+    """Compute a PartitionSpec pytree for ``params``.
+
+    1. explicit ``rules`` (TP tables etc.) claim dims first;
+    2. if FSDP is enabled, shard the largest free dim over ``(dp_shard, cp)``
+       (params smaller than ``min_fsdp_size`` elements stay replicated — the
+       moral twin of FSDP auto-wrap ``min_num_params`` policy, reference
+       ``utils/dataclasses.py:1566+``);
+    3. everything else is replicated.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    pc = parallelism_config
+    fsdp_on = pc is not None and pc.fsdp_enabled
+    fsdp_axes = tuple(a for a in FSDP_AXES if mesh.shape.get(a, 1) > 1)
+    fsdp_size = int(np.prod([mesh.shape[a] for a in fsdp_axes])) if fsdp_axes else 1
+
+    def _spec(path, value):
+        path_s = _path_str(path)
+        shape = np.shape(value)
+        base = rules.match(path_s) if rules is not None else None
+        if base is None:
+            base = PartitionSpec()
+        if fsdp_on and fsdp_size > 1 and int(np.prod(shape or (1,))) >= min_fsdp_size:
+            return _merge_fsdp_into_spec(base, shape, fsdp_axes, fsdp_size, dict(mesh.shape))
+        # pad spec to rank
+        dims = list(base)
+        while len(dims) < len(shape):
+            dims.append(None)
+        return PartitionSpec(*dims)
+
+    return jax.tree_util.tree_map_with_path(_spec, params)
+
+
+def shard_params(params, mesh, specs=None, parallelism_config=None, rules=None, donate: bool = False):
+    """Place every param on the mesh per its spec (the "prepare model" moment —
+    reference ``prepare_model accelerator.py:1735`` collapses to this device_put)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if specs is None:
+        specs = infer_param_specs(params, mesh, parallelism_config, rules)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: x is None,
+    ), specs
+
+
+def tree_specs_like(tree, params, param_specs):
+    """Spec pytree for an arbitrary state tree (e.g. optax state): any subtree whose
+    structure matches the params pytree inherits ``param_specs``; all other leaves
+    are replicated (``P()``). Reference counterpart: optimizer state inheriting
+    FSDP shardings (``utils/fsdp_utils.py:543`` param-swap trick)."""
+    import jax
+    from jax.sharding import PartitionSpec
+    from jax.tree_util import default_registry
+
+    params_treedef = jax.tree_util.tree_structure(params)
+
+    def _walk(node):
+        if node is None:
+            return None
+        try:
+            if jax.tree_util.tree_structure(node) == params_treedef:
+                return param_specs
+        except Exception:
+            pass
+        if jax.tree_util.all_leaves([node]):
+            return PartitionSpec()
+        one_level = jax.tree_util.tree_structure(node, is_leaf=lambda x: x is not node)
+        children, _ = default_registry.flatten_one_level(node)
+        return jax.tree_util.tree_unflatten(one_level, [_walk(c) for c in children])
+
+    return _walk(tree)
+
+
+def shard_like_params(tree, mesh, params, param_specs):
+    """Device-put ``tree`` with shardings inherited from params where structures
+    match (see :func:`tree_specs_like`)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = tree_specs_like(tree, params, param_specs)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def replicate(tree, mesh):
+    """Fully replicate a pytree over the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+# ---------------------------------------------------------------------------
+# Canonical TP rule builders (used by models/; mirrors transformers tp_plan)
+
+
+def llama_tp_rules() -> ShardingRules:
+    """Megatron-style TP for a Llama/GPT decoder: column-parallel QKV/up, row-
+    parallel out/down, vocab-parallel embedding (reference: Megatron TP via
+    ``utils/megatron_lm.py``; transformers ``tp_plan="auto"`` validated in
+    ``accelerator.py:1856-1865``)."""
+    from jax.sharding import PartitionSpec as P
+
+    return ShardingRules(
+        [
+            (r"(wq|wk|wv|q_proj|k_proj|v_proj|qkv)/kernel", P(None, "tp")),
+            (r"(wo|o_proj|out_proj)/kernel", P("tp", None)),
+            (r"(w1|gate_proj|up_proj|w3|fc1)/kernel", P(None, "tp")),
+            (r"(w2|down_proj|fc2)/kernel", P("tp", None)),
+            (r"(embed_tokens|wte|embedding)/(embedding|kernel)", P("tp", None)),
+            (r"lm_head/kernel", P(None, "tp")),
+        ]
+    )

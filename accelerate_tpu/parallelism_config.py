@@ -175,6 +175,14 @@ class ParallelismConfig:
 
         if devices is None:
             devices = jax.devices()
+        requested = self.total_size(len(devices))
+        if requested > len(devices):
+            raise ValueError(
+                f"parallelism config needs {requested} devices but only {len(devices)} available"
+            )
+        if requested < len(devices):
+            # run on a subset (single-chip debugging on a multi-chip host)
+            devices = devices[:requested]
         shape = self.mesh_shape(len(devices))
         try:
             from jax.experimental import mesh_utils

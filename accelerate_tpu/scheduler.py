@@ -1,0 +1,72 @@
+"""LR scheduler wrapper.
+
+TPU-native counterpart of the reference's ``scheduler.py``
+(``/root/reference/src/accelerate/scheduler.py`` — ``AcceleratedScheduler:25``,
+``step:54-83``): steps only when the optimizer really stepped (gradient-
+accumulation boundaries; fp16 overflow skips), and — matching reference
+semantics when ``split_batches=False`` — advances ``num_processes``× per call so
+schedules written for single-device step counts stay correct at the same
+*sample* budget.
+
+In optax, a schedule is a pure ``step -> lr`` function that the optimizer chain
+evaluates on its internal count, so the compiled train-step path needs no
+scheduler object at all. This wrapper exists for the imperative/parity API:
+tracking ``get_last_lr`` and checkpointing the step counter.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .state import GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        schedule_fn: Callable[[int], float],  # optax schedule
+        optimizer=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+        num_processes: Optional[int] = None,
+    ):
+        self.schedule_fn = schedule_fn
+        self.optimizer = optimizer
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+        self._step_count = 0
+        if num_processes is None:
+            from .state import AcceleratorState
+
+            # scale by the data-parallel world size (dp_replicate x dp_shard), not
+            # the total device count — tp/cp/sp/ep devices see the same samples
+            state = AcceleratorState()
+            pc = state.parallelism_config
+            num_processes = pc.dp_replicate_size * pc.infer_dp_shard(state.num_devices)
+        self.num_processes = num_processes
+
+    def step(self) -> None:
+        if not self.step_with_optimizer:
+            self._step_count += 1
+            return
+        # never advance on non-boundary accumulation micro-steps (reference :62-65)
+        if not self.gradient_state.sync_gradients:
+            return
+        if self.split_batches:
+            self._step_count += 1
+        else:
+            self._step_count += self.num_processes
+
+    @property
+    def last_lr(self) -> float:
+        return float(self.schedule_fn(self._step_count))
+
+    def get_last_lr(self) -> list[float]:
+        return [self.last_lr]
+
+    def state_dict(self) -> dict:
+        return {"step_count": self._step_count}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._step_count = state["step_count"]

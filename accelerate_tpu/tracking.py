@@ -1,0 +1,300 @@
+"""Experiment-tracker abstraction + integrations.
+
+TPU-native counterpart of the reference's ``tracking.py``
+(``/root/reference/src/accelerate/tracking.py`` — ``GeneralTracker:101`` with API
+``start/store_init_configuration/log/finish:143-181``, ``on_main_process:77``,
+TensorBoard ``:182``, WandB ``:297``, MLflow ``:696``, ``filter_trackers:1262``).
+
+Always-available baseline: :class:`JSONLTracker` writes one JSON line per log call
+— dependency-free and trivially parseable (the reference's tests use log-file
+parsing for exactly this reason, ``tests/test_tracking.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import wraps
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import is_mlflow_available, is_tensorboard_available, is_wandb_available
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run only on the main process (reference ``tracking.py:77``)."""
+
+    @wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if PartialState().is_main_process:
+            return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Base tracker API (reference ``GeneralTracker tracking.py:101``)."""
+
+    main_process_only = True
+
+    name: str = "general"
+    requires_logging_directory: bool = False
+
+    def __init__(self, run_name: str, **kwargs):
+        self.run_name = run_name
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict) -> None:
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Dependency-free tracker: one JSON object per line in ``<dir>/<run>.jsonl``."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__(run_name)
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, f"{run_name}.jsonl")
+        self._file = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._file
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self._write({"_type": "config", **_jsonable(values)})
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        entry = {"_type": "log", "_time": time.time(), **_jsonable(values)}
+        if step is not None:
+            entry["step"] = step
+        self._write(entry)
+
+    def _write(self, obj: dict) -> None:
+        self._file.write(json.dumps(obj) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self._file.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """reference ``tracking.py:182``."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__(run_name)
+        try:
+            from torch.utils import tensorboard
+
+            self.writer = tensorboard.SummaryWriter(os.path.join(logging_dir, run_name), **kwargs)
+        except ImportError:
+            from tensorboardX import SummaryWriter
+
+            self.writer = SummaryWriter(os.path.join(logging_dir, run_name), **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.add_hparams(_flatten_scalars(values), metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in _flatten_scalars(values).items():
+            if isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step)
+            else:
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """reference ``tracking.py:297``."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """reference ``tracking.py:696``."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name)
+        import mlflow
+
+        mlflow.set_experiment(run_name)
+        self.run = mlflow.start_run(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import mlflow
+
+        for k, v in _flatten_scalars(values).items():
+            mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        import mlflow
+
+        mlflow.log_metrics(
+            {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)}, step=step
+        )
+
+    @on_main_process
+    def finish(self) -> None:
+        import mlflow
+
+        mlflow.end_run()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+}
+
+_AVAILABILITY = {
+    "jsonl": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+}
+
+
+def filter_trackers(
+    log_with,
+    project_name: str,
+    logging_dir: Optional[str] = None,
+    config: Optional[dict] = None,
+    init_kwargs: Optional[dict] = None,
+) -> list[GeneralTracker]:
+    """Resolve requested trackers to available instances (reference
+    ``filter_trackers:1262``)."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    names: list[str] = []
+    instances: list[GeneralTracker] = []
+    for entry in log_with:
+        if isinstance(entry, GeneralTracker):
+            instances.append(entry)
+            continue
+        value = str(entry)
+        if value == str(LoggerType.ALL):
+            names.extend(n for n in LOGGER_TYPE_TO_CLASS if _AVAILABILITY[n]())
+        else:
+            names.append(value)
+    for name in dict.fromkeys(names):
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(f"unknown tracker {name!r}; options: {sorted(LOGGER_TYPE_TO_CLASS)}")
+        if not _AVAILABILITY[name]():
+            logger.warning(f"tracker {name!r} requested but its library is unavailable; skipping")
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[name]
+        kwargs = dict((init_kwargs or {}).get(name, {}))
+        if cls.requires_logging_directory:
+            kwargs.setdefault("logging_dir", logging_dir or ".")
+        tracker = cls(project_name, **kwargs)
+        if config:
+            tracker.store_init_configuration(config)
+        instances.append(tracker)
+    return instances
+
+
+def _jsonable(values: dict) -> dict:
+    import numpy as np
+
+    def conv(v):
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+            return v.item()
+        if isinstance(v, (np.floating, np.integer)):
+            return v.item()
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            return v
+        return str(v)
+
+    return {k: conv(v) for k, v in values.items()}
+
+
+def _flatten_scalars(values: dict, prefix: str = "") -> dict:
+    flat = {}
+    for k, v in values.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten_scalars(v, prefix=f"{key}/"))
+        else:
+            v = v.item() if hasattr(v, "item") and getattr(v, "ndim", 1) == 0 else v
+            if isinstance(v, (int, float, str, bool)):
+                flat[key] = v
+    return flat
