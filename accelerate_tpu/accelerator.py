@@ -91,6 +91,7 @@ class Accelerator:
         jit_config: Optional[JitConfig] = None,
         grad_scaler_config: Optional[GradScalerConfig] = None,
         shard_rules: Optional[ShardingRules] = None,
+        rng_types: Optional[Sequence[str]] = None,
         rng_seed: Optional[int] = None,
         log_with: Optional[Any] = None,
         step_scheduler_with_optimizer: bool = True,
@@ -111,6 +112,10 @@ class Accelerator:
         self.jit_config.apply()
         self.grad_scaler_config = grad_scaler_config or GradScalerConfig()
         self.shard_rules = shard_rules
+        # host-RNG streams synchronized across processes at each epoch start
+        # (reference Accelerator rng_types, accelerator.py:278; default numpy —
+        # our samplers draw from numpy)
+        self.rng_types = list(rng_types) if rng_types is not None else ["numpy"]
         self.device_placement = device_placement
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self._models: list = []
@@ -273,6 +278,7 @@ class Accelerator:
             dispatch_batches=cfg.dispatch_batches,
             data_seed=cfg.data_seed,
             use_seedable_sampler=cfg.use_seedable_sampler,
+            rng_types=self.rng_types if self.num_processes > 1 else None,
         )
         self._dataloaders.append(prepared)
         return prepared
@@ -355,17 +361,11 @@ class Accelerator:
             # Dynamic loss scaling (reference GradScaler semantics,
             # utils/dataclasses.py:241): opt_state is extended to
             # (inner_state, scale, growth_count); backoff on overflow, grow after
-            # growth_interval consecutive finite steps.
-            if optimizer.opt_state is not None and not (
-                isinstance(optimizer.opt_state, tuple)
-                and len(optimizer.opt_state) == 3
-                and getattr(optimizer.opt_state[1], "ndim", None) == 0
-            ):
-                optimizer.opt_state = (
-                    optimizer.opt_state,
-                    jnp.float32(scaler.init_scale),
-                    jnp.int32(0),
-                )
+            # growth_interval consecutive finite steps. If the optimizer is not
+            # yet initialized, the wrap happens inside its init().
+            optimizer._fp16_scaler_config = scaler
+            if optimizer.opt_state is not None:
+                optimizer._wrap_loss_scale_state()
 
             def train_step(params, opt_state, batch):
                 inner_state, scale, growth_count = opt_state

@@ -48,6 +48,12 @@ def flatten_pytree(tree) -> dict[str, np.ndarray]:
         key = "/".join(
             str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path
         )
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # multi-host sharded leaf: reshard to replicated first (the ZeRO-3
+            # gather-on-save, reference accelerator.py:3947)
+            from .utils.operations import _replicate_global_array
+
+            leaf = _replicate_global_array(leaf)
         flat[key or "_root"] = np.asarray(leaf)
     return flat
 
@@ -94,6 +100,12 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
             raise ValueError("pass output_dir or enable automatic_checkpoint_naming")
     if pc.automatic_checkpoint_naming:
         folder = os.path.join(output_dir, f"checkpoint_{pc.iteration}")
+        # every process checks (raising only on main would leave the others hung
+        # at the save barrier); the iteration counter is process-consistent
+        if os.path.isdir(folder):
+            raise FileExistsError(
+                f"Checkpoint {folder} already exists — iteration was not advanced"
+            )
         if accelerator.is_main_process:
             # rotation (reference accelerator.py:3567-3593)
             if pc.total_limit is not None and os.path.isdir(output_dir):
@@ -104,10 +116,6 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
                 while len(existing) + 1 > pc.total_limit:
                     victim = existing.pop(0)
                     shutil.rmtree(os.path.join(output_dir, victim), ignore_errors=True)
-            if os.path.isdir(folder):
-                raise FileExistsError(
-                    f"Checkpoint {folder} already exists — iteration was not advanced"
-                )
         output_dir = folder
     return output_dir
 
@@ -207,6 +215,13 @@ def load_accelerator_state(
                 dl.load_state_dict(json.load(f))
     for i, obj in enumerate(accelerator._custom_objects):
         _load_custom(obj, os.path.join(input_dir, f"{CUSTOM_NAME}_{i}.npz"))
+
+    # restore the automatic-naming iteration counter so the next save does not
+    # collide with an existing checkpoint_<i> after a process restart
+    folder = os.path.basename(os.path.normpath(input_dir))
+    match = re.fullmatch(r"checkpoint_(\d+)", folder)
+    if match:
+        accelerator.project_configuration.iteration = int(match.group(1)) + 1
 
     rng_file = os.path.join(input_dir, f"{RNG_NAME}_{accelerator.process_index}.pkl")
     if os.path.exists(rng_file):

@@ -510,21 +510,39 @@ class DataLoaderShard:
     def __len__(self) -> int:
         return len(self.base_dataloader) - self.skip_batches
 
+    def _find_stateful_sampler(self):
+        """Walk the sampler chain (possibly _InterleavedBatchSampler →
+        BatchSamplerShard → BatchSampler → SeedableRandomSampler) to the innermost
+        object exposing ``state_dict``."""
+        seen = set()
+        node = getattr(self.base_dataloader, "batch_sampler", None)
+        while node is not None and id(node) not in seen:
+            seen.add(id(node))
+            if hasattr(node, "state_dict"):
+                return node
+            for attr in ("sampler", "batch_sampler"):
+                child = getattr(node, attr, None)
+                if child is not None:
+                    node = child
+                    break
+            else:
+                shards = getattr(node, "shards", None)
+                node = shards[0] if shards else None
+        return None
+
     def state_dict(self) -> dict:
         """Resume info (reference ``DataLoaderAdapter`` state_dict ``:463-497``)."""
         state = {"batches_seen": self._batches_seen, "iteration": self.iteration}
-        sampler = getattr(self.base_dataloader, "batch_sampler", None)
-        sampler = getattr(sampler, "sampler", sampler)
-        if hasattr(sampler, "state_dict"):
+        sampler = self._find_stateful_sampler()
+        if sampler is not None:
             state["sampler"] = sampler.state_dict()
         return state
 
     def load_state_dict(self, state: dict) -> None:
         self.skip_batches = state.get("batches_seen", 0)
         self.iteration = state.get("iteration", 0)
-        sampler = getattr(self.base_dataloader, "batch_sampler", None)
-        sampler = getattr(sampler, "sampler", sampler)
-        if hasattr(sampler, "load_state_dict") and "sampler" in state:
+        sampler = self._find_stateful_sampler()
+        if sampler is not None and "sampler" in state:
             sampler.load_state_dict(state["sampler"])
 
     def _sync_rng(self):
@@ -704,30 +722,49 @@ def prepare_data_loader(
 
         if isinstance(dataloader, tud.DataLoader):
             dataset = dataloader.dataset
-            if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
-                shuffle = isinstance(
-                    getattr(dataloader, "sampler", None), tud.RandomSampler
+            custom_batch_sampler = (
+                dataloader.batch_size is None  # torch sets None iff batch_sampler given
+            )
+            sampler = getattr(dataloader, "sampler", None)
+            custom_sampler = sampler is not None and not isinstance(
+                sampler, (tud.RandomSampler, tud.SequentialSampler)
+            )
+            if custom_batch_sampler or custom_sampler or not (
+                hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__")
+            ):
+                # custom sampling we cannot faithfully reshard: iterate the torch
+                # loader as-is (each batch = one per-dp-row block is NOT implied;
+                # fall back to dispatch-style semantics) and warn loudly
+                import warnings
+
+                warnings.warn(
+                    "torch DataLoader with a custom sampler/batch_sampler or "
+                    "iterable dataset cannot be resharded; iterating it as-is. "
+                    "Each yielded batch is treated as the per-host block.",
+                    stacklevel=2,
                 )
-                native = DataLoader(
-                    dataset,
-                    batch_size=dataloader.batch_size or 1,
-                    shuffle=shuffle,
-                    seed=data_seed or 0,
-                    drop_last=getattr(dataloader, "drop_last", False),
-                    collate_fn=_torch_collate_to_numpy(dataloader.collate_fn),
-                )
-                return prepare_data_loader(
-                    native,
-                    state=state,
-                    mesh=mesh,
-                    parallelism_config=parallelism_config,
-                    device_placement=device_placement,
-                    split_batches=split_batches,
-                    even_batches=even_batches,
-                    dispatch_batches=dispatch_batches,
-                    rng_types=rng_types,
-                    seq_dim=seq_dim,
-                )
+                return cls(dataloader, assembler=assembler, rng_types=rng_types)
+            shuffle = isinstance(sampler, tud.RandomSampler)
+            native = DataLoader(
+                dataset,
+                batch_size=dataloader.batch_size,
+                shuffle=shuffle,
+                seed=data_seed or 0,
+                drop_last=getattr(dataloader, "drop_last", False),
+                collate_fn=_torch_collate_to_numpy(dataloader.collate_fn),
+            )
+            return prepare_data_loader(
+                native,
+                state=state,
+                mesh=mesh,
+                parallelism_config=parallelism_config,
+                device_placement=device_placement,
+                split_batches=split_batches,
+                even_batches=even_batches,
+                dispatch_batches=dispatch_batches,
+                rng_types=rng_types,
+                seq_dim=seq_dim,
+            )
     except ImportError:
         pass
 

@@ -1,0 +1,71 @@
+"""Notebook/debug launchers.
+
+TPU-native counterpart of the reference's ``launchers.py``
+(``/root/reference/src/accelerate/launchers.py`` — ``notebook_launcher:41``,
+``debug_launcher:276``). The reference must fork ``num_processes`` python
+processes (Colab TPU via ``xmp.spawn``, one per core); under SPMD **one process
+drives every local chip**, so launching from a notebook is simply calling the
+function — with env setup for multi-host when a coordinator is given.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from .utils.environment import patch_environment
+
+
+def notebook_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: Optional[str] = None,
+    node_rank: int = 0,
+    num_nodes: int = 1,
+    **kwargs: Any,
+):
+    """Run ``function(*args)`` with the accelerate env configured
+    (reference ``notebook_launcher launchers.py:41``).
+
+    Single host: direct call — jit already uses every local chip; no forking
+    (the reference's per-core ``xmp.spawn`` is an artifact of non-SPMD torch-xla).
+    Multi-host notebooks: pass ``master_addr``/``num_nodes``/``node_rank`` and the
+    coordinator env is set before the call.
+    """
+    env: dict[str, Any] = {"ACCELERATE_MIXED_PRECISION": mixed_precision}
+    if num_nodes > 1:
+        if master_addr is None:
+            raise ValueError("multi-node notebook launch needs master_addr")
+        env.update(
+            ACCELERATE_COORDINATOR_ADDRESS=f"{master_addr}:{use_port}",
+            ACCELERATE_NUM_PROCESSES=num_nodes,
+            ACCELERATE_PROCESS_ID=node_rank,
+        )
+    with patch_environment(**env):
+        return function(*args)
+
+
+def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2):
+    """Run ``function`` on a virtual ``num_processes``-device CPU mesh
+    (reference ``debug_launcher:276`` forks CPU processes; here XLA fakes the
+    devices in-process, which exercises real sharding semantics).
+
+    Must be called before JAX initializes its backends.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={num_processes}"
+        ).strip()
+    import jax
+
+    if not getattr(jax._src.xla_bridge, "_backends", None):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    with patch_environment(ACCELERATE_USE_CPU="yes"):
+        return function(*args)

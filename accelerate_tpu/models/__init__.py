@@ -1,0 +1,12 @@
+from .transformer import (
+    BertConfig,
+    LlamaConfig,
+    bert_forward,
+    bert_loss,
+    bert_shard_rules,
+    init_bert,
+    init_llama,
+    llama_forward,
+    llama_loss,
+    llama_shard_rules,
+)

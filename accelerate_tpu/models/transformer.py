@@ -1,0 +1,338 @@
+"""Transformer model family, pure-JAX, TPU-first.
+
+These are the acceptance workloads for the framework (reference examples:
+``examples/nlp_example.py`` BERT-base MRPC — the north star —,
+``examples/cv_example.py``, LM fine-tunes in ``benchmarks/fsdp2``; SURVEY.md §2.5).
+They are intentionally *plain pytrees + pure functions*, not a module framework:
+
+- params are nested dicts → sharding rules are path regexes, checkpoints are
+  flat path→array maps, and every parallelism axis composes;
+- per-layer params are **stacked on a leading axis and iterated with
+  ``lax.scan``** → compile time is O(1) in depth and FSDP sharding of the stack
+  is one spec (a deliberate TPU-first departure from the reference's per-module
+  python structure);
+- attention routes through ``ops.attention`` so CP/SP/flash kernels swap in
+  without touching model code.
+
+``LlamaModel`` (decoder, RoPE/RMSNorm/SwiGLU/GQA) is the flagship;
+``BertClassifier`` (encoder + pooled classification head) is the MRPC
+north-star workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import dot_product_attention, make_padding_mask
+
+
+# ---------------------------------------------------------------------------
+# init helpers
+
+
+def _dense_init(key, in_dim, out_dim, scale=None):
+    scale = scale if scale is not None else 1.0 / np.sqrt(in_dim)
+    return (jax.random.normal(key, (in_dim, out_dim)) * scale).astype(jnp.float32)
+
+
+def rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def layer_norm(x, scale, bias, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out.astype(x.dtype) * scale) + bias
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_seq)
+    freqs = np.outer(t, inv)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions=None) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [max_seq, D/2]."""
+    seq = x.shape[1]
+    if positions is None:
+        cos_s = cos[:seq][None, :, None, :]
+        sin_s = sin[:seq][None, :, None, :]
+    else:
+        cos_s = cos[positions][:, :, None, :]
+        sin_s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos_s = cos_s.astype(x.dtype)
+    sin_s = sin_s.astype(x.dtype)
+    return jnp.concatenate([x1 * cos_s - x2 * sin_s, x2 * cos_s + x1 * sin_s], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Llama-style decoder (flagship)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    ffn_dim: Optional[int] = None  # default 8/3 * dim rounded to 256
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def hidden_dim(self) -> int:
+        if self.ffn_dim is not None:
+            return self.ffn_dim
+        return int(np.ceil(self.dim * 8 / 3 / 256) * 256)
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2, max_seq_len=256)
+
+
+def init_llama(config: LlamaConfig, key) -> dict:
+    """Stacked-layer param pytree: every per-layer tensor has leading dim L."""
+    keys = jax.random.split(key, 8)
+    L, D, H = config.n_layers, config.dim, config.hidden_dim
+    Dq = config.n_heads * config.head_dim
+    Dkv = config.n_kv_heads * config.head_dim
+
+    def stack(k, in_dim, out_dim):
+        ks = jax.random.split(k, L)
+        return jnp.stack([_dense_init(ks[i], in_dim, out_dim) for i in range(L)])
+
+    params = {
+        "embed_tokens": {"embedding": _dense_init(keys[0], config.vocab_size, D, scale=0.02)},
+        "layers": {
+            "attn_norm": {"scale": jnp.ones((L, D))},
+            "wq": {"kernel": stack(keys[1], D, Dq)},
+            "wk": {"kernel": stack(keys[2], D, Dkv)},
+            "wv": {"kernel": stack(keys[3], D, Dkv)},
+            "wo": {"kernel": stack(keys[4], Dq, D)},
+            "mlp_norm": {"scale": jnp.ones((L, D))},
+            "w1": {"kernel": stack(keys[5], D, H)},
+            "w3": {"kernel": stack(keys[6], D, H)},
+            "w2": {"kernel": stack(keys[7], H, D)},
+        },
+        "final_norm": {"scale": jnp.ones(D)},
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(keys[0], D, config.vocab_size, scale=0.02)}
+    return params
+
+
+def llama_forward(
+    params: dict,
+    input_ids: jax.Array,  # [B, S]
+    config: LlamaConfig,
+    attention_impl: str = "auto",
+    attention_fn=None,
+    remat: bool = False,
+) -> jax.Array:
+    """Return logits [B, S, vocab]. ``attention_fn`` overrides the attention op
+    (ring attention for CP plugs in here)."""
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    h = params["embed_tokens"]["embedding"][input_ids]
+    B, S, D = h.shape
+
+    def layer(h, layer_params):
+        x = rms_norm(h, layer_params["attn_norm"]["scale"], config.norm_eps)
+        q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
+        k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+        v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if attention_fn is not None:
+            attn = attention_fn(q, k, v, causal=True)
+        else:
+            attn = dot_product_attention(q, k, v, causal=True, impl=attention_impl)
+        h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
+        x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
+        gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
+        up = x @ layer_params["w3"]["kernel"]
+        h = h + (gate * up) @ layer_params["w2"]["kernel"]
+        return h, None
+
+    if remat:
+        layer = jax.checkpoint(layer)
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    h = rms_norm(h, params["final_norm"]["scale"], config.norm_eps)
+    if config.tie_embeddings:
+        return h @ params["embed_tokens"]["embedding"].T
+    return h @ params["lm_head"]["kernel"]
+
+
+def llama_loss(params: dict, batch: dict, config: LlamaConfig, **fwd_kwargs) -> jax.Array:
+    """Next-token cross entropy. ``batch``: input_ids [B, S] (labels shifted
+    internally), optional loss_mask [B, S]."""
+    ids = batch["input_ids"]
+    logits = llama_forward(params, ids[:, :-1], config, **fwd_kwargs)
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def llama_shard_rules():
+    """TP rules for the stacked-layer layout: dim 0 is the layer-stack axis, so TP
+    shards dim 1 (in) / dim 2 (out). Embeddings/head are 2-D."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import ShardingRules
+
+    return ShardingRules(
+        [
+            (r"layers/(wq|wk|wv|w1|w3)/kernel", P(None, None, "tp")),  # column-parallel
+            (r"layers/(wo|w2)/kernel", P(None, "tp", None)),  # row-parallel
+            (r"embed_tokens/embedding", P("tp", None)),  # vocab-parallel
+            (r"lm_head/kernel", P(None, "tp")),
+            (r"norm", P()),
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# BERT-style encoder + classifier (north-star MRPC workload)
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=1024, dim=128, n_layers=2, n_heads=4, ffn_dim=256, max_seq_len=128)
+
+
+def init_bert(config: BertConfig, key) -> dict:
+    keys = jax.random.split(key, 12)
+    L, D, F = config.n_layers, config.dim, config.ffn_dim
+
+    def stack(k, a, b):
+        ks = jax.random.split(k, L)
+        return jnp.stack([_dense_init(ks[i], a, b, scale=0.02) for i in range(L)])
+
+    return {
+        "embeddings": {
+            "word": {"embedding": _dense_init(keys[0], config.vocab_size, D, 0.02)},
+            "position": {"embedding": _dense_init(keys[1], config.max_seq_len, D, 0.02)},
+            "token_type": {"embedding": _dense_init(keys[2], config.type_vocab_size, D, 0.02)},
+            "norm": {"scale": jnp.ones(D), "bias": jnp.zeros(D)},
+        },
+        "layers": {
+            "wq": {"kernel": stack(keys[3], D, D), "bias": jnp.zeros((L, D))},
+            "wk": {"kernel": stack(keys[4], D, D), "bias": jnp.zeros((L, D))},
+            "wv": {"kernel": stack(keys[5], D, D), "bias": jnp.zeros((L, D))},
+            "wo": {"kernel": stack(keys[6], D, D), "bias": jnp.zeros((L, D))},
+            "attn_norm": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+            "fc1": {"kernel": stack(keys[7], D, F), "bias": jnp.zeros((L, F))},
+            "fc2": {"kernel": stack(keys[8], F, D), "bias": jnp.zeros((L, D))},
+            "mlp_norm": {"scale": jnp.ones((L, D)), "bias": jnp.zeros((L, D))},
+        },
+        "pooler": {"kernel": _dense_init(keys[9], D, D, 0.02), "bias": jnp.zeros(D)},
+        "classifier": {"kernel": _dense_init(keys[10], D, config.num_labels, 0.02), "bias": jnp.zeros(config.num_labels)},
+    }
+
+
+def bert_forward(params: dict, batch: dict, config: BertConfig, attention_impl: str = "auto") -> jax.Array:
+    """Return classification logits [B, num_labels]. batch: input_ids,
+    attention_mask, token_type_ids (all [B, S])."""
+    ids = batch["input_ids"]
+    B, S = ids.shape
+    emb = params["embeddings"]
+    h = (
+        emb["word"]["embedding"][ids]
+        + emb["position"]["embedding"][jnp.arange(S)][None]
+        + emb["token_type"]["embedding"][batch.get("token_type_ids", jnp.zeros_like(ids))]
+    )
+    h = layer_norm(h, emb["norm"]["scale"], emb["norm"]["bias"], config.norm_eps)
+    attn_mask = batch.get("attention_mask")
+    mask = make_padding_mask(attn_mask, S) if attn_mask is not None else None
+
+    def layer(h, lp):
+        q = (h @ lp["wq"]["kernel"] + lp["wq"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        k = (h @ lp["wk"]["kernel"] + lp["wk"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        v = (h @ lp["wv"]["kernel"] + lp["wv"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        attn = dot_product_attention(q, k, v, mask=mask, impl=attention_impl).reshape(B, S, -1)
+        h = layer_norm(
+            h + attn @ lp["wo"]["kernel"] + lp["wo"]["bias"],
+            lp["attn_norm"]["scale"],
+            lp["attn_norm"]["bias"],
+            config.norm_eps,
+        )
+        x = jax.nn.gelu(h @ lp["fc1"]["kernel"] + lp["fc1"]["bias"])
+        h = layer_norm(
+            h + x @ lp["fc2"]["kernel"] + lp["fc2"]["bias"],
+            lp["mlp_norm"]["scale"],
+            lp["mlp_norm"]["bias"],
+            config.norm_eps,
+        )
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    pooled = jnp.tanh(h[:, 0] @ params["pooler"]["kernel"] + params["pooler"]["bias"])
+    return pooled @ params["classifier"]["kernel"] + params["classifier"]["bias"]
+
+
+def bert_loss(params: dict, batch: dict, config: BertConfig, **kwargs) -> jax.Array:
+    logits = bert_forward(params, batch, config, **kwargs)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def bert_shard_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import ShardingRules
+
+    return ShardingRules(
+        [
+            (r"layers/(wq|wk|wv|fc1)/kernel", P(None, None, "tp")),
+            (r"layers/(wo|fc2)/kernel", P(None, "tp", None)),
+            (r"embeddings/word/embedding", P("tp", None)),
+            (r"(norm|bias|pooler|classifier)", P()),
+        ]
+    )

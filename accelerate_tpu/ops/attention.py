@@ -1,0 +1,92 @@
+"""Attention ops with pluggable implementations.
+
+The compute core every model routes through — and the swap point for
+long-context parallelism (ring attention over ``cp``, Ulysses over ``sp``) and
+Pallas flash kernels. The reference reaches flash/SDPA kernels through
+transformers (SURVEY.md §2.3); here the kernel boundary is explicit.
+
+Layouts: ``q,k,v: [batch, seq, heads, head_dim]`` (BSHD). GQA supported via
+``num_kv_heads <= num_heads`` with head repetition folded into the einsum.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(hidden: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] (GQA broadcast)."""
+    if n_rep == 1:
+        return hidden
+    b, s, h, d = hidden.shape
+    return jnp.broadcast_to(hidden[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,  # [B, 1|H, Sq, Skv] additive or bool
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Standard softmax attention, BSHD layout.
+
+    ``impl``: "xla" (einsum, fused by XLA on the MXU), "flash" (Pallas kernel,
+    TPU), "auto" (flash on TPU when shapes allow, else xla).
+    """
+    if impl == "auto":
+        impl = "flash" if _flash_supported(q, k) else "xla"
+    if impl == "flash":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+
+
+def _flash_supported(q, k) -> bool:
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    # flash kernel wants seq multiples of its block size
+    return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)
+
+
+def _xla_attention(q, k, v, *, causal, mask, scale):
+    *_, sq, hq, d = q.shape
+    skv = k.shape[1]
+    hkv = k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = _repeat_kv(k, rep)
+        v = _repeat_kv(v, rep)
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    # compute logits in f32 for stability, inputs may be bf16
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), k=skv - sq)
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        if mask.dtype == bool:
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def make_padding_mask(attention_mask: jax.Array, sq: int) -> jax.Array:
+    """[B, Skv] 1/0 padding mask -> [B, 1, Sq, Skv] bool mask."""
+    return jnp.broadcast_to(
+        attention_mask[:, None, None, :].astype(bool),
+        (attention_mask.shape[0], 1, sq, attention_mask.shape[1]),
+    )

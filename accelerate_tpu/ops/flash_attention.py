@@ -1,0 +1,68 @@
+"""Flash attention for TPU: Pallas-kernel path with XLA fallback.
+
+The reference reaches flash/SDPA CUDA kernels through transformers + torch
+(SURVEY.md §2.3 "flash attention / SDPA kernels"); the TPU-native equivalent is
+the Pallas flash kernel that ships with JAX
+(``jax.experimental.pallas.ops.tpu.flash_attention``) — blocked online-softmax
+attention that streams KV through VMEM instead of materializing the [S, S]
+score matrix in HBM. We wrap it behind the framework's BSHD layout and GQA
+conventions so models/CP kernels can swap implementations freely.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Pallas flash attention (TPU), BSHD in/out. Falls back to the XLA einsum
+    path off-TPU or for unsupported shapes."""
+    if jax.default_backend() != "tpu":
+        from .attention import _xla_attention
+
+        return _xla_attention(q, k, v, causal=causal, mask=None, scale=scale)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention as pallas_flash,
+    )
+
+    orig_dtype = q.dtype
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        from .attention import _repeat_kv
+
+        k = _repeat_kv(k, hq // hkv)
+        v = _repeat_kv(v, hq // hkv)
+    # BSHD -> BHSD
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    sq, skv = qt.shape[2], kt.shape[2]
+    block_sizes = BlockSizes(
+        block_q=min(block_q, sq),
+        block_k_major=min(block_kv, skv),
+        block_k=min(block_kv, skv),
+        block_b=1,
+        block_q_major_dkv=min(block_q, sq),
+        block_k_major_dkv=min(block_kv, skv),
+        block_k_dkv=min(block_kv, skv),
+        block_q_dkv=min(block_q, sq),
+        block_k_major_dq=min(block_kv, skv),
+        block_k_dq=min(block_kv, skv),
+        block_q_dq=min(block_q, sq),
+    )
+    out = pallas_flash(qt, kt, vt, causal=causal, sm_scale=sm_scale, block_sizes=block_sizes)
+    return out.transpose(0, 2, 1, 3).astype(orig_dtype)

@@ -52,6 +52,7 @@ class AcceleratedOptimizer:
         self.opt_state = None
         self._mesh = None
         self._param_specs = None
+        self._fp16_scaler_config = None  # set by Accelerator.prepare_train_step (fp16)
         self.accelerator_state = None  # set by Accelerator.prepare
 
     # ------------------------------------------------------------- functional --
@@ -64,7 +65,25 @@ class AcceleratedOptimizer:
             self._mesh = mesh
             self._param_specs = param_specs
             self.opt_state = shard_like_params(self.opt_state, mesh, params, param_specs)
+        if getattr(self, "_fp16_scaler_config", None) is not None:
+            self._wrap_loss_scale_state()
         return self.opt_state
+
+    def _wrap_loss_scale_state(self) -> None:
+        """Extend opt_state to (inner, scale, growth_count) for fp16 dynamic loss
+        scaling (set up by ``Accelerator.prepare_train_step``). Idempotent."""
+        import jax.numpy as jnp
+
+        cfg = self._fp16_scaler_config
+        state = self.opt_state
+        if (
+            isinstance(state, tuple)
+            and len(state) == 3
+            and getattr(state[1], "ndim", None) == 0
+            and getattr(state[2], "ndim", None) == 0
+        ):
+            return  # already wrapped
+        self.opt_state = (state, jnp.float32(cfg.init_scale), jnp.int32(0))
 
     def update(self, grads, opt_state, params):
         """Pure optax update — safe to call inside jit."""

@@ -1,0 +1,111 @@
+"""Benchmark: BERT-base MRPC-shaped training throughput (samples/sec/chip).
+
+The driver's north-star metric (BASELINE.json): ``nlp_example.py`` (BERT-base,
+seq 128) training samples/sec/chip. Runs on whatever the default JAX backend is
+(the real TPU chip under the driver; CPU elsewhere with a tiny model), times the
+jitted train step after compilation, and prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+``vs_baseline`` anchors to ``BENCH_BASELINE.json`` (written on first TPU run) so
+round-over-round regressions are visible; the reference repo publishes no number
+for this metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def run_bench():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader
+    from accelerate_tpu.models import BertConfig, bert_loss, bert_shard_rules, init_bert
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        config = BertConfig.base()
+        batch_size = 64
+        steps = 30
+    else:
+        config = BertConfig.tiny()
+        batch_size = 16
+        steps = 10
+    seq_len = 128
+    config = type(config)(**{**config.__dict__, "max_seq_len": seq_len})
+
+    accelerator = Accelerator(mixed_precision="bf16", rng_seed=0)
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
+    from nlp_example import DictDataset, make_synthetic_mrpc
+
+    n_chips = len(jax.devices())
+    data = make_synthetic_mrpc(batch_size * n_chips * 4, seq_len, config.vocab_size, seed=0)
+    params = init_bert(config, jax.random.PRNGKey(0))
+    params, opt, dl = accelerator.prepare(
+        params,
+        optax.adamw(2e-5),
+        DataLoader(DictDataset(data), batch_size=batch_size),
+        shard_rules=bert_shard_rules(),
+    )
+    step = accelerator.prepare_train_step(lambda p, b: bert_loss(p, b, config), opt)
+    opt_state = opt.opt_state
+
+    batches = list(dl)
+    global_batch = batches[0]["labels"].shape[0]
+    # compile (value fetch, not block_until_ready: remote-tunneled TPU backends
+    # can report ready before execution completes — a host transfer cannot lie)
+    params, opt_state, m = step(params, opt_state, batches[0])
+    float(np.asarray(m["loss"]))
+    t0 = time.time()
+    for i in range(steps):
+        params, opt_state, m = step(params, opt_state, batches[i % len(batches)])
+    float(np.asarray(m["loss"]))
+    elapsed = time.time() - t0
+    samples_per_sec = steps * global_batch / elapsed
+    per_chip = samples_per_sec / n_chips
+    return {
+        "samples_per_sec": samples_per_sec,
+        "per_chip": per_chip,
+        "backend": jax.default_backend(),
+        "n_chips": n_chips,
+        "model": "bert-base" if on_tpu else "bert-tiny",
+        "final_loss": float(m["loss"]),
+    }
+
+
+def main():
+    result = run_bench()
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if result["backend"] == "tpu":
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+            if baseline.get("per_chip"):
+                vs_baseline = result["per_chip"] / baseline["per_chip"]
+        else:
+            with open(baseline_path, "w") as f:
+                json.dump({"per_chip": result["per_chip"], "model": result["model"]}, f)
+    print(
+        json.dumps(
+            {
+                "metric": f"{result['model']} mrpc-shaped train throughput ({result['backend']}, bf16)",
+                "value": round(result["per_chip"], 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
