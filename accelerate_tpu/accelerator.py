@@ -526,6 +526,32 @@ class Accelerator:
         with self.partial_state.local_main_process_first():
             yield
 
+    # ------------------------------------------------------- long context ----
+    def context_parallel_attention(self, strategy: Optional[str] = None):
+        """attention_fn for the current mesh: ring/allgather over ``cp`` or
+        Ulysses over ``sp``; plain attention otherwise. Pass it to the model's
+        ``attention_fn`` hook (the functional twin of the reference's
+        ``maybe_context_parallel`` ctx, ``accelerator.py:4056``)."""
+        from .parallel.long_context import make_context_parallel_attention
+        from .ops.attention import dot_product_attention
+
+        pc = self.parallelism_config
+        if pc.cp_enabled:
+            strategy = strategy or ("ring" if pc.cp_rotate_method == "ring" else "allgather")
+            return make_context_parallel_attention(self.mesh, strategy=strategy)
+        if pc.sp_enabled:
+            return make_context_parallel_attention(self.mesh, strategy="ulysses")
+        return lambda q, k, v, causal=True, scale=None: dot_product_attention(
+            q, k, v, causal=causal, scale=scale
+        )
+
+    @contextlib.contextmanager
+    def maybe_context_parallel(self, buffers=None, buffer_seq_dims=None, no_restore_buffers=None):
+        """API-parity shim (reference ``maybe_context_parallel:4056-4120``): torch
+        must shard buffers in-place per step; under GSPMD the dataloader already
+        yields seq-sharded global arrays and the attention_fn does the rest."""
+        yield
+
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables=None, even_batches=None):
         """Parity shim (reference ``join_uneven_inputs:1298``): with static shapes

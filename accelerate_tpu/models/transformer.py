@@ -113,7 +113,7 @@ class LlamaConfig:
 
 def init_llama(config: LlamaConfig, key) -> dict:
     """Stacked-layer param pytree: every per-layer tensor has leading dim L."""
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
     L, D, H = config.n_layers, config.dim, config.hidden_dim
     Dq = config.n_heads * config.head_dim
     Dkv = config.n_kv_heads * config.head_dim
@@ -138,7 +138,7 @@ def init_llama(config: LlamaConfig, key) -> dict:
         "final_norm": {"scale": jnp.ones(D)},
     }
     if not config.tie_embeddings:
-        params["lm_head"] = {"kernel": _dense_init(keys[0], D, config.vocab_size, scale=0.02)}
+        params["lm_head"] = {"kernel": _dense_init(keys[8], D, config.vocab_size, scale=0.02)}
     return params
 
 
@@ -186,9 +186,13 @@ def llama_forward(
 
 def llama_loss(params: dict, batch: dict, config: LlamaConfig, **fwd_kwargs) -> jax.Array:
     """Next-token cross entropy. ``batch``: input_ids [B, S] (labels shifted
-    internally), optional loss_mask [B, S]."""
+    internally), optional loss_mask [B, S].
+
+    The forward runs on the FULL sequence and logits are shifted afterwards, so
+    the attention sequence length stays divisible by cp/sp shard sizes (a
+    pre-forward ``ids[:, :-1]`` would break the seq sharding)."""
     ids = batch["input_ids"]
-    logits = llama_forward(params, ids[:, :-1], config, **fwd_kwargs)
+    logits = llama_forward(params, ids, config, **fwd_kwargs)[:, :-1]
     targets = ids[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

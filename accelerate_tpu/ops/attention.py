@@ -42,8 +42,14 @@ def dot_product_attention(
     TPU), "auto" (flash on TPU when shapes allow, else xla).
     """
     if impl == "auto":
-        impl = "flash" if _flash_supported(q, k) else "xla"
+        # the flash kernel has no arbitrary-mask support (causal only)
+        impl = "flash" if mask is None and _flash_supported(q, k) else "xla"
     if impl == "flash":
+        if mask is not None:
+            raise ValueError(
+                "impl='flash' does not support an explicit mask (causal only); "
+                "use impl='xla' for padding masks"
+            )
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -1,3 +1,4 @@
+from .long_context import make_context_parallel_attention, sequence_parallel_attention
 from .sharding import (
     FSDP_AXES,
     ShardingRules,

@@ -1,0 +1,204 @@
+"""Long-context attention parallelism: ring attention (CP) and Ulysses (SP).
+
+TPU-native counterpart of the reference's two mutually-exclusive long-context
+backends (SURVEY.md §5 "Long-context / sequence parallelism"):
+
+- **CP / ring attention** — reference ``_prepare_cp`` (``accelerator.py:1628``) +
+  ``maybe_context_parallel`` (``:4056-4120``) wrap torch's experimental
+  ``context_parallel`` with allgather/alltoall KV rotation. Here: the sequence
+  dim is sharded over the ``cp`` mesh axis; K/V blocks rotate around the ICI
+  ring with ``lax.ppermute`` inside ``shard_map`` while a flash-style online
+  softmax accumulates — O(S/cp) memory per chip, fully overlapped
+  compute/communication, differentiable end-to-end. ``rotate="allgather"``
+  instead gathers KV once (better for short rings).
+- **SP / Ulysses** — reference DeepSpeed ALST path (``accelerator.py:2344-2456``):
+  head-sharded attention via all-to-all. Here: ``lax.all_to_all`` reshards
+  seq-sharded QKV to head-sharded, runs full-sequence attention locally, and
+  reshards back.
+
+Both produce an ``attention_fn(q, k, v, causal=...)`` over GLOBAL [B, S, H, D]
+arrays, drop-in for ``models``' pluggable attention hook.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallelism_config import DP_AXES
+
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One flash block: returns (unnormalized out, row max, row sumexp).
+
+    q: [B, Hq, Sq, D]; k,v: [B, Hq, Skv, D]; mask: [Sq, Skv] bool or None.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def _merge_blocks(o, m, l, o_new, m_new, l_new):
+    """Online-softmax merge of two partial attention results."""
+    m_tot = jnp.maximum(m, m_new)
+    c_old = jnp.exp(m - m_tot)
+    c_new = jnp.exp(m_new - m_tot)
+    o = o * c_old[..., None].astype(o.dtype) + o_new * c_new[..., None].astype(o.dtype)
+    l = l * c_old + l_new * c_new
+    return o, m_tot, l
+
+
+def _local_ring_attention(q, k, v, *, axis_name: str, axis_size: int, causal: bool, scale: float):
+    """Runs INSIDE shard_map: q,k,v are the local seq shards [B, S_loc, H, D]."""
+    cp = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    # head-major layout for the block kernel
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    if kh.shape[1] != qh.shape[1]:  # GQA: replicate kv heads
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+
+    o0 = jnp.zeros_like(qh)
+    m0 = jnp.full((B, qh.shape[1], S), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, qh.shape[1], S), dtype=jnp.float32)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    rows = jnp.arange(S)
+
+    def body(carry, step):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - step) % cp  # global chunk index currently held
+        if causal:
+            # global positions: q at idx*S + row, kv at src*S + col
+            q_pos = idx * S + rows[:, None]
+            k_pos = src * S + rows[None, :]
+            mask = q_pos >= k_pos
+        else:
+            mask = None
+        o_new, m_new, l_new = _block_attn(qh, k_cur, v_cur, mask, scale)
+        o, m, l = _merge_blocks(o, m, l, o_new, m_new, l_new)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, kh, vh), jnp.arange(cp))
+    out = o / jnp.maximum(l, 1e-30)[..., None].astype(o.dtype)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _local_allgather_attention(q, k, v, *, axis_name: str, axis_size: int, causal: bool, scale: float):
+    """CP with one-shot KV allgather (reference rotate_method='allgather')."""
+    cp = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    k_full = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)  # [B, S*cp, Hkv, D]
+    v_full = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k_full.transpose(0, 2, 1, 3)
+    vh = v_full.transpose(0, 2, 1, 3)
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    mask = None
+    if causal:
+        q_pos = idx * S + jnp.arange(S)[:, None]
+        k_pos = jnp.arange(S * cp)[None, :]
+        mask = q_pos >= k_pos
+    o, m, l = _block_attn(qh, kh, vh, mask, scale)
+    out = o / jnp.maximum(l, 1e-30)[..., None].astype(o.dtype)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _local_ulysses_attention(q, k, v, *, axis_name: str, axis_size: int, causal: bool, scale: float):
+    """Runs INSIDE shard_map over the sp axis: local [B, S_loc, H, D] →
+    all-to-all → [B, S, H_loc, D] → full-seq attention → all-to-all back
+    (reference UlyssesSPAttentionHF head-sharding, accelerator.py:2344-2390)."""
+    from ..ops.attention import _xla_attention
+
+    def seq_to_head(x):
+        # split heads across the axis, concat sequence
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    q_h, k_h, v_h = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = _xla_attention(q_h, k_h, v_h, causal=causal, mask=None, scale=scale)
+    return head_to_seq(out)
+
+
+def make_context_parallel_attention(
+    mesh,
+    strategy: str = "ring",  # "ring" | "allgather" | "ulysses"
+    axis_name: Optional[str] = None,
+    batch_axes: tuple = DP_AXES,
+    head_axis: str = "tp",
+):
+    """Build an attention_fn over GLOBAL [B, S, H, D] arrays that parallelizes the
+    sequence dim over ``cp`` (ring/allgather) or ``sp`` (ulysses).
+
+    The returned function is jit-compatible and differentiable; it is the
+    ``attention_fn`` hook of the model family (the moral twin of the reference's
+    ``maybe_context_parallel`` buffer-sharding context, ``accelerator.py:4056``).
+    """
+    from jax import shard_map
+
+    if axis_name is None:
+        axis_name = "sp" if strategy == "ulysses" else "cp"
+    axis_size = mesh.shape.get(axis_name, 1)
+    head_axis_in_mesh = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+
+    local_fn = {
+        "ring": _local_ring_attention,
+        "allgather": _local_allgather_attention,
+        "ulysses": _local_ulysses_attention,
+    }[strategy]
+
+    def attention_fn(q, k, v, causal: bool = True, scale: Optional[float] = None):
+        if axis_size <= 1:
+            from ..ops.attention import dot_product_attention
+
+            return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        scale_v = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+        if strategy == "ulysses" and (
+            q.shape[2] % axis_size != 0 or k.shape[2] % axis_size != 0
+        ):
+            raise ValueError(
+                f"Ulysses SP needs q heads ({q.shape[2]}) and kv heads ({k.shape[2]}) "
+                f"divisible by sp size ({axis_size}); use ring CP for more chips than heads"
+            )
+        spec = P(batch_axes, axis_name, head_axis_in_mesh, None)
+        fn = shard_map(
+            partial(
+                local_fn, axis_name=axis_name, axis_size=axis_size, causal=causal, scale=scale_v
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention_fn
+
+
+def sequence_parallel_attention(mesh, **kwargs):
+    """Ulysses attention_fn (reference ALST/UlyssesSP path)."""
+    return make_context_parallel_attention(mesh, strategy="ulysses", **kwargs)

@@ -40,8 +40,10 @@ def run_bench():
         config = BertConfig.tiny()
         batch_size = 16
         steps = 10
+    import dataclasses
+
     seq_len = 128
-    config = type(config)(**{**config.__dict__, "max_seq_len": seq_len})
+    config = dataclasses.replace(config, max_seq_len=seq_len)
 
     accelerator = Accelerator(mixed_precision="bf16", rng_seed=0)
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))

@@ -85,8 +85,10 @@ def training_function(args):
     if args.project_dir:
         accelerator.init_trackers("nlp_example", config=vars(args))
 
+    import dataclasses
+
     config = BertConfig.tiny() if args.model_size == "tiny" else BertConfig.base()
-    config = type(config)(**{**config.__dict__, "max_seq_len": args.seq_len, "num_labels": 2})
+    config = dataclasses.replace(config, max_seq_len=args.seq_len, num_labels=2)
     train = make_synthetic_mrpc(args.train_size, args.seq_len, config.vocab_size, seed=0)
     test = make_synthetic_mrpc(args.eval_size, args.seq_len, config.vocab_size, seed=1)
 
@@ -121,8 +123,9 @@ def training_function(args):
     for epoch in range(args.epochs):
         for step, batch in enumerate(train_dl):
             params, opt_state, metrics = train_step(params, opt_state, batch)
-            if t_start is None:  # skip compile in throughput accounting
-                jax.block_until_ready(metrics["loss"])
+            if t_start is None:  # skip compile in throughput accounting; force a
+                # host fetch (block_until_ready is unreliable on remote tunnels)
+                float(np.asarray(metrics["loss"]))
                 t_start = time.time()
             else:
                 samples += batch["labels"].shape[0]
@@ -138,7 +141,7 @@ def training_function(args):
         accelerator.print(f"epoch {epoch}: eval accuracy {acc:.3f} (loss {float(metrics['loss']):.4f})")
         if args.project_dir:
             accelerator.log({"eval_accuracy": acc, "train_loss": float(metrics["loss"])}, step=epoch)
-    jax.block_until_ready(params)
+    float(np.asarray(metrics["loss"]))  # force completion before stopping the clock
     elapsed = time.time() - t_start if t_start else float("nan")
     throughput = samples / elapsed if elapsed and samples else 0.0
     n_chips = len(jax.devices())
