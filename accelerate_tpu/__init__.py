@@ -21,6 +21,13 @@ from .utils import (
 
 __all__ = [
     "Accelerator",
+    "DispatchedParams",
+    "cpu_offload",
+    "disk_offload",
+    "dispatch_params",
+    "infer_auto_device_map",
+    "init_empty_weights",
+    "load_checkpoint_and_dispatch",
     "AcceleratedOptimizer",
     "AcceleratedScheduler",
     "AcceleratorState",
@@ -59,4 +66,32 @@ def __getattr__(name):
         from .launchers import notebook_launcher
 
         return notebook_launcher
+    if name in _BIG_MODELING:
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
+    if name in _MODELING_UTILS:
+        from .utils import modeling
+
+        return getattr(modeling, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")
+
+
+_BIG_MODELING = {
+    "DispatchedParams",
+    "cpu_offload",
+    "disk_offload",
+    "dispatch_params",
+    "init_empty_weights",
+    "init_on_device",
+    "load_checkpoint_and_dispatch",
+}
+_MODELING_UTILS = {
+    "abstract_params",
+    "compute_module_sizes",
+    "find_tied_parameters",
+    "get_balanced_memory",
+    "get_max_memory",
+    "infer_auto_device_map",
+    "load_checkpoint_in_params",
+}

@@ -1,0 +1,263 @@
+"""Big-model inference: zero-RAM init, layer→device dispatch, paged forward.
+
+TPU-native counterpart of the reference's ``big_modeling.py``
+(``/root/reference/src/accelerate/big_modeling.py`` — ``init_empty_weights:61``,
+``cpu_offload:173``, ``disk_offload:263``, ``dispatch_model:309``,
+``load_checkpoint_and_dispatch:512``).
+
+Architecture shift: the reference mutates an ``nn.Module`` in place, attaching
+``AlignDevicesHook``s that page weights per sub-forward. Here a model is
+``(stage_fns, params)``; :func:`dispatch_params` produces a
+:class:`DispatchedParams` store that materializes each stage's params on the
+compute device on demand — HBM-resident stages are free, host/disk stages are
+``device_put`` streams with one-stage-ahead prefetch
+(:class:`~accelerate_tpu.hooks.PrefetchingLoader` semantics), which overlaps
+PCIe/DMA with MXU compute instead of serializing them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from .hooks import AlignDevicesHook, _default_device
+from .utils.modeling import (
+    abstract_params,
+    clean_device_map,
+    compute_module_sizes,
+    find_tied_parameters,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_params,
+    lookup_device,
+    named_parameters,
+    unflatten_parameters,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict, save_offload_index, offload_weight
+
+# Re-export: `init_empty_weights` is the reference's name for zero-RAM init; the
+# native primitive is jax.eval_shape (reference big_modeling.py:61 monkeypatches
+# nn.Module.register_parameter to the meta device instead).
+init_empty_weights = abstract_params
+init_on_device = abstract_params
+
+
+class DispatchedParams(Mapping):
+    """Per-stage param store honouring a device map (the functional twin of a
+    hooked ``nn.Module`` after reference ``dispatch_model:309``).
+
+    ``dp[stage]`` returns that stage's params ready for compute: already-placed
+    HBM stages return their resident arrays, ``"cpu"``/``"disk"`` stages are
+    paged in via ``device_put`` (and released after :meth:`release` /
+    automatically when paged iteration advances).
+    """
+
+    def __init__(
+        self,
+        params: Mapping[str, Any],
+        device_map: Mapping[str, Union[int, str]],
+        offload_folder: Optional[str] = None,
+        execution_device=None,
+        offload_buffers: bool = False,
+    ):
+        import jax
+
+        self.device_map = dict(device_map)
+        self.execution_device = execution_device or _default_device()
+        self.offload_folder = offload_folder
+        self._jax = jax
+        accel = [d for d in jax.local_devices() if d.platform != "cpu"] or jax.local_devices()
+        self._accel = accel
+
+        flat = named_parameters(params)
+        self._resident: dict[str, Any] = {}  # HBM stages
+        self._host: dict[str, Any] = {}  # cpu-offloaded (numpy / host commit)
+        disk_state: dict[str, Any] = {}
+        for path, leaf in flat.items():
+            target = lookup_device(self.device_map, path)
+            if target == "disk":
+                disk_state[path] = leaf
+            elif target == "cpu":
+                self._host[path] = np.asarray(leaf) if leaf is not None else None
+            else:
+                if int(target) >= len(accel):
+                    raise ValueError(
+                        f"device_map places {path!r} on device {target} but only "
+                        f"{len(accel)} local devices exist"
+                    )
+                dev = accel[int(target)]
+                self._resident[path] = jax.device_put(leaf, dev) if leaf is not None else None
+        if disk_state:
+            if offload_folder is None:
+                raise ValueError("device_map contains 'disk' but no offload_folder given")
+            to_spill = {k: v for k, v in disk_state.items() if v is not None}
+            if to_spill:
+                offload_state_dict(offload_folder, to_spill)
+            self._disk = OffloadedWeightsLoader(save_folder=offload_folder)
+        else:
+            self._disk = None
+        self._stage_names = sorted(
+            {path.split("/")[0] for path in flat}
+        )
+        self._paths_by_stage: dict[str, list[str]] = {}
+        for path in flat:
+            self._paths_by_stage.setdefault(path.split("/")[0], []).append(path)
+        self._paged_cache: dict[str, Any] = {}
+        # id(host array) → device array, so tied weights transfer once
+        self._tied_map: dict[int, Any] = {}
+
+    # ----------------------------------------------------------- mapping API --
+    def __iter__(self):
+        return iter(self._stage_names)
+
+    def __len__(self):
+        return len(self._stage_names)
+
+    def __getitem__(self, stage: str):
+        paths = self._paths_by_stage.get(stage)
+        if paths is None:
+            raise KeyError(stage)
+        flat = {}
+        for path in paths:
+            flat[path[len(stage) + 1 :] if path != stage else stage] = self._leaf_on_device(path)
+        if len(flat) == 1 and stage in flat:
+            return flat[stage]
+        return unflatten_parameters(flat)
+
+    def _leaf_on_device(self, path: str):
+        if path in self._resident:
+            return self._resident[path]
+        if path in self._paged_cache:
+            return self._paged_cache[path]
+        host = self._host.get(path)
+        if host is None and self._disk is not None:
+            host = self._disk[path]
+        if host is None:
+            return None
+        # Tied-weight dedup: keyed by id(host), holding the host array in the
+        # entry so its id stays valid for the cache's lifetime (a freed array's
+        # id can be recycled by a later unrelated load).
+        key = id(host)
+        entry = self._tied_map.get(key)
+        if entry is not None and entry[0] is host:
+            placed = entry[1]
+        else:
+            placed = self._jax.device_put(host, self.execution_device)
+            self._tied_map[key] = (host, placed)
+        self._paged_cache[path] = placed
+        return placed
+
+    def prefetch(self, stage: str) -> None:
+        """Start async H2D for a stage's offloaded params (device_put returns
+        before the copy completes — call for stage i+1 while i computes)."""
+        for path in self._paths_by_stage.get(stage, []):
+            self._leaf_on_device(path)
+
+    def release(self, stage: Optional[str] = None) -> None:
+        """Drop paged-in copies (reference ``post_forward`` re-offload,
+        ``hooks.py:377-407``)."""
+        if stage is None:
+            self._paged_cache.clear()
+            self._tied_map.clear()
+            return
+        for path in self._paths_by_stage.get(stage, []):
+            self._paged_cache.pop(path, None)
+        self._tied_map.clear()
+
+    def materialize(self) -> dict:
+        """Full tree with every leaf on the execution device (small models /
+        debugging)."""
+        out = {}
+        for stage in self._stage_names:
+            out[stage] = self[stage]
+        self.release()
+        return out
+
+    # ------------------------------------------------------------- execution --
+    def run(self, stages: Sequence[tuple[str, Callable]], x, prefetch: bool = True):
+        """Run ``x`` through ``[(stage_name, fn(params, x))…]`` with paged
+        params and one-stage-ahead prefetch (the hot loop of reference §3.4)."""
+        names = [n for n, _ in stages]
+        for i, (name, fn) in enumerate(stages):
+            if prefetch and i + 1 < len(stages):
+                self.prefetch(names[i + 1])
+            params = self[name]
+            x = fn(params, x)
+            self.release(name)
+        return x
+
+
+def attach_align_device_hook(params, execution_device=None, weights_map=None) -> AlignDevicesHook:
+    """Build the paging hook for a params subtree (reference
+    ``attach_align_device_hook:464``)."""
+    return AlignDevicesHook(execution_device=execution_device, weights_map=weights_map)
+
+
+def dispatch_params(
+    params: Mapping[str, Any],
+    device_map: Optional[Mapping[str, Union[int, str]]] = None,
+    max_memory: Optional[dict] = None,
+    no_split_module_patterns: Optional[list[str]] = None,
+    offload_folder: Optional[str] = None,
+    execution_device=None,
+    dtype=None,
+) -> DispatchedParams:
+    """Place a param tree per a (possibly inferred) device map (reference
+    ``dispatch_model:309``; ``device_map="auto"`` ≙ ``infer_auto_device_map``)."""
+    if device_map is None or device_map == "auto":
+        device_map = infer_auto_device_map(
+            params, max_memory=max_memory, no_split_module_patterns=no_split_module_patterns, dtype=dtype
+        )
+    elif device_map == "balanced":
+        balanced = get_balanced_memory(params, max_memory, no_split_module_patterns, dtype)
+        device_map = infer_auto_device_map(
+            params, max_memory=balanced, no_split_module_patterns=no_split_module_patterns, dtype=dtype
+        )
+    return DispatchedParams(
+        params, device_map, offload_folder=offload_folder, execution_device=execution_device
+    )
+
+
+def cpu_offload(params, execution_device=None) -> DispatchedParams:
+    """Everything on host, paged per stage (reference ``cpu_offload:173``)."""
+    return DispatchedParams(params, {"": "cpu"}, execution_device=execution_device)
+
+
+def disk_offload(params, offload_dir: str, execution_device=None) -> DispatchedParams:
+    """Everything spilled to disk memmaps (reference ``disk_offload:263``)."""
+    os.makedirs(offload_dir, exist_ok=True)
+    return DispatchedParams(
+        params, {"": "disk"}, offload_folder=offload_dir, execution_device=execution_device
+    )
+
+
+def load_checkpoint_and_dispatch(
+    abstract_tree,
+    checkpoint: str,
+    device_map: Optional[Union[str, Mapping[str, Any]]] = "auto",
+    max_memory: Optional[dict] = None,
+    no_split_module_patterns: Optional[list[str]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+) -> DispatchedParams:
+    """Infer a map over the *abstract* tree, then stream the checkpoint straight
+    to the mapped devices (reference ``load_checkpoint_and_dispatch:512`` —
+    never materializes the full model in host RAM)."""
+    if device_map in ("auto", "balanced", None):
+        mem = (
+            get_balanced_memory(abstract_tree, max_memory, no_split_module_patterns, dtype)
+            if device_map == "balanced"
+            else max_memory
+        )
+        device_map = infer_auto_device_map(
+            abstract_tree, max_memory=mem, no_split_module_patterns=no_split_module_patterns, dtype=dtype
+        )
+    tree, _ = load_checkpoint_in_params(
+        abstract_tree, checkpoint, device_map=device_map, offload_folder=offload_folder, dtype=dtype
+    )
+    # tensors already sit on their devices; DispatchedParams must not re-place
+    # them — pass through resident leaves, page host/disk ones
+    return DispatchedParams(tree, device_map, offload_folder=offload_folder)

@@ -1,0 +1,251 @@
+"""Hook runtime for staged/paged execution.
+
+TPU-native counterpart of the reference's ``hooks.py``
+(``/root/reference/src/accelerate/hooks.py`` — ``ModelHook:43``,
+``add_hook_to_module:132``, ``AlignDevicesHook:227``, ``SequentialHook``,
+``CpuOffload:693``, ``LayerwiseCastingHook:757``).
+
+Architecture shift: torch hooks monkeypatch ``module.forward``; jax models are
+``fn(params, x)`` stage functions, so a hook wraps the *call* — it can reshape,
+re-place or substitute the params the stage sees and post-process its outputs.
+The paging hooks pull per-stage params from an :class:`~accelerate_tpu.utils.offload.
+OffloadedWeightsLoader`-style mapping and ``jax.device_put`` them to the compute
+device; ``device_put`` is async, so :class:`AlignDevicesHook` can prefetch stage
+``i+1`` while stage ``i`` computes — double-buffering the reference's
+synchronous page-in loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class ModelHook:
+    """Pre/post hooks around one stage call (reference ``ModelHook:43``)."""
+
+    def init_hook(self, stage_name: str, params):
+        """Called once when the hook is attached; may transform stored params."""
+        return params
+
+    def pre_forward(self, params, *args, **kwargs):
+        """Return (params, args, kwargs) the stage should actually see."""
+        return params, args, kwargs
+
+    def post_forward(self, params, output):
+        """Return the (possibly transformed) output."""
+        return output
+
+    def detach_hook(self, params):
+        return params
+
+
+class SequentialHook(ModelHook):
+    """Compose hooks in order (reference ``SequentialHook:112``)."""
+
+    def __init__(self, *hooks: ModelHook):
+        self.hooks = list(hooks)
+
+    def init_hook(self, stage_name, params):
+        for h in self.hooks:
+            params = h.init_hook(stage_name, params)
+        return params
+
+    def pre_forward(self, params, *args, **kwargs):
+        for h in self.hooks:
+            params, args, kwargs = h.pre_forward(params, *args, **kwargs)
+        return params, args, kwargs
+
+    def post_forward(self, params, output):
+        for h in self.hooks:
+            output = h.post_forward(params, output)
+        return output
+
+    def detach_hook(self, params):
+        for h in self.hooks:
+            params = h.detach_hook(params)
+        return params
+
+
+def add_hook_to_fn(fn: Callable, hook: ModelHook, stage_name: str = "") -> Callable:
+    """Wrap ``fn(params, *args, **kwargs)`` with a hook (reference
+    ``add_hook_to_module:132`` replaces ``module.forward``). The wrapped fn
+    carries ``_at_hook`` so :func:`remove_hook_from_fn` can unwrap."""
+    if getattr(fn, "_at_hook", None) is not None:
+        hook = SequentialHook(fn._at_hook, hook)
+        fn = fn._at_original
+
+    @functools.wraps(fn)
+    def wrapped(params, *args, **kwargs):
+        params, args, kwargs = hook.pre_forward(params, *args, **kwargs)
+        output = fn(params, *args, **kwargs)
+        return hook.post_forward(params, output)
+
+    wrapped._at_hook = hook
+    wrapped._at_original = fn
+    wrapped._at_stage = stage_name
+    return wrapped
+
+
+def remove_hook_from_fn(fn: Callable) -> Callable:
+    """Unwrap (reference ``remove_hook_from_module:196``)."""
+    return getattr(fn, "_at_original", fn)
+
+
+class AlignDevicesHook(ModelHook):
+    """Page a stage's params onto the execution device before the call and drop
+    the HBM copy afterwards (reference ``AlignDevicesHook:227``:
+    ``pre_forward:331`` loads from ``weights_map``, ``post_forward:377``
+    re-offloads). ``weights_map`` is any mapping ``path → np/jax array`` (e.g.
+    ``OffloadedWeightsLoader``); paths are relative to the stage subtree."""
+
+    def __init__(
+        self,
+        execution_device=None,
+        offload: bool = True,
+        weights_map: Optional[Mapping[str, Any]] = None,
+        tied_params_map: Optional[dict[int, Any]] = None,
+    ):
+        import jax
+
+        self.execution_device = (
+            execution_device if execution_device is not None else _default_device()
+        )
+        self.offload = offload
+        self.weights_map = weights_map
+        # id(host array) → device copy, shared across hooks so tied weights are
+        # transferred once (reference tied_params_map, hooks.py:258-266)
+        self.tied_params_map = tied_params_map if tied_params_map is not None else {}
+        self._jax = jax
+
+    def init_hook(self, stage_name, params):
+        self.stage_name = stage_name
+        return params
+
+    def _put(self, leaf):
+        if leaf is None:
+            return None
+        # hold the host array in the entry so its id cannot be recycled while
+        # the cache is alive (ids of freed arrays are reused by CPython)
+        key = id(leaf)
+        entry = self.tied_params_map.get(key)
+        if entry is not None and entry[0] is leaf:
+            return entry[1]
+        placed = self._jax.device_put(leaf, self.execution_device)
+        self.tied_params_map[key] = (leaf, placed)
+        return placed
+
+    def pre_forward(self, params, *args, **kwargs):
+        from .utils.modeling import named_parameters, unflatten_parameters
+
+        flat = named_parameters(params)
+        loaded = {}
+        for path, leaf in flat.items():
+            if leaf is None and self.weights_map is not None:
+                leaf = self.weights_map[path]
+            loaded[path] = self._put(leaf)
+        args = tuple(
+            self._jax.device_put(a, self.execution_device) if _is_arraylike(a) else a for a in args
+        )
+        if isinstance(params, Mapping):
+            return unflatten_parameters(loaded), args, kwargs
+        # bare-leaf params flatten to {'': leaf}
+        return loaded.get("", loaded), args, kwargs
+
+    def post_forward(self, params, output):
+        if self.offload:
+            self.tied_params_map.clear()
+        return output
+
+
+class PrefetchingLoader:
+    """Iterate ``(stage_name, stage_fn, host_params)`` triples yielding
+    device-resident params one stage ahead of compute. ``jax.device_put`` is
+    async: the H2D copy of stage i+1 overlaps stage i's math — the
+    double-buffered upgrade of the reference's page-in loop
+    (``hooks.py:331-376``)."""
+
+    def __init__(self, stages: Sequence[tuple], execution_device=None):
+        self.stages = list(stages)
+        self.execution_device = execution_device or _default_device()
+
+    def __iter__(self):
+        import jax
+
+        pending = None
+        for i, (name, fn, host_params) in enumerate(self.stages):
+            placed = pending if pending is not None else jax.device_put(
+                host_params, self.execution_device
+            )
+            if i + 1 < len(self.stages):
+                pending = jax.device_put(self.stages[i + 1][2], self.execution_device)
+            else:
+                pending = None
+            yield name, fn, placed
+
+
+class CpuOffloadHook(ModelHook):
+    """Keep params on host between calls; page to device per call (reference
+    ``CpuOffload:693``). With ``prev_hook`` chaining, offload of stage i-1
+    happens when stage i starts."""
+
+    def __init__(self, execution_device=None, prev_hook: Optional["CpuOffloadHook"] = None):
+        self.execution_device = execution_device or _default_device()
+        self.prev_hook = prev_hook
+        self._device_copy = None
+
+    def pre_forward(self, params, *args, **kwargs):
+        import jax
+
+        if self.prev_hook is not None:
+            self.prev_hook.release()
+        self._device_copy = jax.device_put(params, self.execution_device)
+        return self._device_copy, args, kwargs
+
+    def release(self):
+        self._device_copy = None
+
+
+class LayerwiseCastingHook(ModelHook):
+    """Store params in ``storage_dtype``; upcast to ``compute_dtype`` per call
+    (reference ``LayerwiseCastingHook:757`` — fp8/bf16 storage, bf16/fp32
+    compute)."""
+
+    def __init__(self, storage_dtype, compute_dtype):
+        self.storage_dtype = storage_dtype
+        self.compute_dtype = compute_dtype
+
+    def init_hook(self, stage_name, params):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.storage_dtype) if _is_floating(x) else x, params
+        )
+
+    def pre_forward(self, params, *args, **kwargs):
+        import jax
+
+        cast = jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype) if _is_floating(x) else x, params
+        )
+        return cast, args, kwargs
+
+
+def _default_device():
+    import jax
+
+    accel = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return accel[0] if accel else jax.local_devices()[0]
+
+
+def _is_arraylike(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _is_floating(x) -> bool:
+    try:
+        return np.issubdtype(np.asarray(x).dtype, np.floating) or "bfloat16" in str(x.dtype)
+    except Exception:
+        return False

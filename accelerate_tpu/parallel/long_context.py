@@ -75,29 +75,33 @@ def _local_ring_attention(q, k, v, *, axis_name: str, axis_size: int, causal: bo
         kh = jnp.repeat(kh, rep, axis=1)
         vh = jnp.repeat(vh, rep, axis=1)
 
-    o0 = jnp.zeros_like(qh)
-    m0 = jnp.full((B, qh.shape[1], S), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((B, qh.shape[1], S), dtype=jnp.float32)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     rows = jnp.arange(S)
 
+    def _mask_for(src):
+        if not causal:
+            return None
+        # global positions: q at idx*S + row, kv at src*S + col
+        q_pos = idx * S + rows[:, None]
+        k_pos = src * S + rows[None, :]
+        return q_pos >= k_pos
+
+    # step 0 is the resident (diagonal) block: no rotation needed, and doing it
+    # first means the scan issues exactly cp-1 ppermutes — the final rotation
+    # would only restore the starting layout, which nobody reads.
+    o, m, l = _block_attn(qh, kh, vh, _mask_for(idx), scale)
+
     def body(carry, step):
         o, m, l, k_cur, v_cur = carry
-        src = (idx - step) % cp  # global chunk index currently held
-        if causal:
-            # global positions: q at idx*S + row, kv at src*S + col
-            q_pos = idx * S + rows[:, None]
-            k_pos = src * S + rows[None, :]
-            mask = q_pos >= k_pos
-        else:
-            mask = None
-        o_new, m_new, l_new = _block_attn(qh, k_cur, v_cur, mask, scale)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (idx - step) % cp  # global chunk index held after `step` rotations
+        o_new, m_new, l_new = _block_attn(qh, k_cur, v_cur, _mask_for(src), scale)
         o, m, l = _merge_blocks(o, m, l, o_new, m_new, l_new)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, m, l, k_nxt, v_nxt), None
+        return (o, m, l, k_cur, v_cur), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, kh, vh), jnp.arange(cp))
+    if cp > 1:
+        (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, kh, vh), jnp.arange(1, cp))
     out = o / jnp.maximum(l, 1e-30)[..., None].astype(o.dtype)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

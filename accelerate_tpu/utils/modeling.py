@@ -1,0 +1,513 @@
+"""Modeling utils: abstract params, size accounting, device-map inference.
+
+TPU-native counterpart of the reference's ``utils/modeling.py``
+(``/root/reference/src/accelerate/utils/modeling.py`` — ``compute_module_sizes:651``,
+``get_max_memory:744``, ``get_balanced_memory:918``, ``infer_auto_device_map:1278``,
+``find_tied_parameters:554``, ``load_state_dict:1620``,
+``load_checkpoint_in_model:1788``, ``dtype_byte_size``/``convert_file_size_to_int``).
+
+Architecture shift: the reference analyzes ``nn.Module`` trees on the meta
+device; here a "model" is a nested param pytree and the zero-RAM analogue of the
+meta device is a tree of ``jax.ShapeDtypeStruct`` obtained from ``jax.eval_shape``
+(:func:`abstract_params`). A *module* is a subtree (a '/'-joined path prefix);
+device-map inference walks top-level subtrees and splits them when they do not
+fit — the same greedy algorithm, guarantees included (largest-layer reserve on
+the main device so offloaded layers can always be paged back in).
+
+Device-map values: ``int`` (index into ``jax.local_devices()``), ``"cpu"``
+(host RAM, paged to HBM per forward), ``"disk"`` (memmap spill via
+``utils/offload.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import OrderedDict, defaultdict
+from typing import Any, Mapping, Optional, Union
+
+import numpy as np
+
+from .offload import load_offload_index, offload_weight, save_offload_index
+
+WEIGHTS_NAME = "model.safetensors"
+WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+
+
+# ------------------------------------------------------------------ pytrees --
+def named_parameters(tree, prefix: str = "", sep: str = "/") -> "OrderedDict[str, Any]":
+    """Flatten a nested param pytree to ``{'a/b/c': leaf}`` (insertion order)."""
+    out: OrderedDict[str, Any] = OrderedDict()
+
+    def _walk(node, path):
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                _walk(v, f"{path}{sep}{k}" if path else str(k))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                _walk(v, f"{path}{sep}{i}" if path else str(i))
+        else:
+            out[path] = node
+
+    _walk(tree, prefix)
+    return out
+
+
+def unflatten_parameters(flat: Mapping[str, Any], sep: str = "/") -> dict:
+    """Inverse of :func:`named_parameters` (list/tuple structure becomes dicts
+    with stringified integer keys — device maps only need subtree grouping)."""
+    root: dict = {}
+    for path, leaf in flat.items():
+        parts = path.split(sep)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+def abstract_params(init_fn, *args, **kwargs):
+    """Zero-memory model "construction": shapes/dtypes only, no allocation
+    (reference ``init_empty_weights`` ``big_modeling.py:61`` monkeypatches
+    meta-device registration; ``jax.eval_shape`` is the native primitive)."""
+    import jax
+
+    return jax.eval_shape(init_fn, *args, **kwargs)
+
+
+# -------------------------------------------------------------------- sizes --
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element, fractional for sub-byte dtypes (reference
+    ``dtype_byte_size`` handles int4/fp8 the same way)."""
+    name = str(np.dtype(dtype)) if not isinstance(dtype, str) else dtype
+    name = name.replace("jax.numpy.", "")
+    if name in ("int4", "uint4"):
+        return 0.5
+    if "float8" in name or name in ("int8", "uint8", "bool"):
+        return 1
+    bits = re.search(r"[^\d](\d+)(_.*)?$", name)
+    if bits is None:
+        # e.g. 'bfloat16' via ml_dtypes
+        try:
+            import ml_dtypes  # noqa: F401
+
+            return np.dtype(name).itemsize
+        except Exception as e:
+            raise ValueError(f"`dtype` is not a valid dtype: {name}") from e
+    return int(bits.group(1)) // 8
+
+
+def convert_file_size_to_int(size: Union[int, str]) -> int:
+    """``"6GB"``/``"200MiB"``/int → bytes (reference ``convert_file_size_to_int``)."""
+    if isinstance(size, int):
+        return size
+    mem_size = str(size).upper().strip()
+    units = [("GIB", 2**30), ("MIB", 2**20), ("KIB", 2**10), ("GB", 10**9), ("MB", 10**6), ("KB", 10**3)]
+    for suffix, mult in units:
+        if mem_size.endswith(suffix):
+            return int(float(mem_size[: -len(suffix)]) * mult)
+    if mem_size.isdigit():
+        return int(mem_size)
+    raise ValueError(f"size {size!r} is not in a valid format (e.g. '6GB', '200MiB', 4096)")
+
+
+def _leaf_size(leaf, dtype=None, path: str = "", special_dtypes: Optional[dict] = None) -> int:
+    shape = getattr(leaf, "shape", ())
+    numel = int(np.prod(shape)) if shape else 1
+    leaf_dtype = getattr(leaf, "dtype", np.float32)
+    if special_dtypes is not None and path in special_dtypes:
+        leaf_dtype = special_dtypes[path]
+    elif dtype is not None:
+        # reference: loading dtype never upcasts storage (modeling.py:672-678)
+        leaf_dtype = dtype if dtype_byte_size(dtype) < dtype_byte_size(leaf_dtype) else leaf_dtype
+    return int(np.ceil(numel * dtype_byte_size(leaf_dtype)))
+
+
+def compute_parameter_sizes(tree, dtype=None, special_dtypes=None) -> "OrderedDict[str, int]":
+    return OrderedDict(
+        (path, _leaf_size(leaf, dtype, path, special_dtypes))
+        for path, leaf in named_parameters(tree).items()
+    )
+
+
+def compute_module_sizes(tree, dtype=None, special_dtypes=None) -> dict[str, int]:
+    """Size of every subtree prefix, '' = whole model (reference
+    ``compute_module_sizes:651``)."""
+    sizes: dict[str, int] = defaultdict(int)
+    for path, size in compute_parameter_sizes(tree, dtype, special_dtypes).items():
+        parts = path.split("/")
+        for i in range(len(parts) + 1):
+            sizes["/".join(parts[:i])] += size
+    return dict(sizes)
+
+
+def total_byte_size(tree, dtype=None) -> int:
+    return compute_module_sizes(tree, dtype)[""]
+
+
+def find_tied_parameters(tree) -> list[list[str]]:
+    """Groups of param paths sharing the SAME underlying array (reference
+    ``find_tied_parameters:554``; torch ties by object identity — jax arrays tie
+    the same way when a model reuses e.g. the embedding table as lm head)."""
+    by_id: dict[int, list[str]] = defaultdict(list)
+    for path, leaf in named_parameters(tree).items():
+        if leaf is not None and not np.isscalar(leaf):
+            by_id[id(leaf)].append(path)
+    return sorted(group for group in by_id.values() if len(group) > 1)
+
+
+def retie_parameters(tree, tied_groups: list[list[str]]):
+    """Point every path in a tied group at one shared array (reference
+    ``retie_parameters:609``). Returns a new tree (pytrees are immutable-ish)."""
+    flat = named_parameters(tree)
+    for group in tied_groups:
+        sources = [p for p in group if flat.get(p) is not None]
+        if not sources:
+            continue
+        src = flat[sources[0]]
+        for path in group:
+            flat[path] = src
+    return unflatten_parameters(flat)
+
+
+# ------------------------------------------------------------------- memory --
+def get_max_memory(max_memory: Optional[dict] = None) -> "OrderedDict[Union[int, str], int]":
+    """Per-accelerator HBM + host RAM budget (reference ``get_max_memory:744``
+    probes CUDA/XPU/NPU; here: ``device.memory_stats()['bytes_limit']`` for each
+    local TPU/accelerator, /proc/meminfo for the host)."""
+    import jax
+
+    if max_memory is not None:
+        out: OrderedDict = OrderedDict()
+        for key, val in max_memory.items():
+            out[key] = convert_file_size_to_int(val) if not isinstance(val, int) else val
+        return out
+
+    out = OrderedDict()
+    accel = [d for d in jax.local_devices() if d.platform != "cpu"]
+    for i, dev in enumerate(accel):
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            pass
+        limit = stats.get("bytes_limit")
+        if limit is None:
+            limit = 16 * 2**30  # conservative HBM default when stats are absent
+        out[i] = int(0.9 * (limit - stats.get("bytes_in_use", 0)))
+    if not accel:
+        # CPU backend: each "device" is the host; expose one budget slot
+        out[0] = _host_ram_bytes() // 2
+    out["cpu"] = _host_ram_bytes()
+    return out
+
+
+def _host_ram_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 * 2**30
+
+
+def get_balanced_memory(
+    tree,
+    max_memory: Optional[dict] = None,
+    no_split_module_patterns: Optional[list[str]] = None,
+    dtype=None,
+    special_dtypes=None,
+    low_zero: bool = False,
+) -> "OrderedDict[Union[int, str], int]":
+    """Cap per-device budgets so layers spread evenly instead of filling device
+    0 first (reference ``get_balanced_memory:918``; ``low_zero`` leaves room on
+    device 0 for generate-time buffers)."""
+    max_memory = get_max_memory(max_memory)
+    num_devices = len([d for d in max_memory if isinstance(d, int) and max_memory[d] > 0])
+    if num_devices == 0:
+        return max_memory
+    if num_devices == 1:
+        if low_zero:
+            raise ValueError("low_zero requires at least 2 accelerator devices")
+        return max_memory
+
+    module_sizes = compute_module_sizes(tree, dtype, special_dtypes)
+    per_device = module_sizes[""] // (num_devices - 1 if low_zero else num_devices)
+
+    # Buffer: mean + stddev of the leaf-module sizes (reference :975-991) so the
+    # last device absorbs rounding without spilling to cpu.
+    leaves = [
+        size
+        for name, size in module_sizes.items()
+        if name and not any(other.startswith(name + "/") for other in module_sizes)
+    ]
+    buffer = int(np.mean(leaves) + np.std(leaves)) if leaves else 0
+    no_split = no_split_module_patterns or []
+    if no_split:
+        split_caps = [
+            size for name, size in module_sizes.items() if name and _matches_any(name, no_split)
+        ]
+        buffer = max(buffer, max(split_caps) if split_caps else 0)
+    per_device += buffer
+
+    out = OrderedDict()
+    for key, val in max_memory.items():
+        if isinstance(key, int):
+            cap = per_device if not (low_zero and key == 0) else per_device // 4
+            out[key] = min(val, cap)
+        else:
+            out[key] = val
+    return out
+
+
+def _matches_any(name: str, patterns: list[str]) -> bool:
+    tail = name.split("/")[-1]
+    return any(re.search(p, name) or re.search(p, tail) for p in patterns)
+
+
+# ------------------------------------------------------- device-map inference --
+def infer_auto_device_map(
+    tree,
+    max_memory: Optional[dict] = None,
+    no_split_module_patterns: Optional[list[str]] = None,
+    dtype=None,
+    special_dtypes=None,
+    clean_result: bool = True,
+    verbose: bool = False,
+) -> "OrderedDict[str, Union[int, str]]":
+    """Greedy module→device allocation, accelerators first then cpu then disk
+    (reference ``infer_auto_device_map:1278``). Invariants preserved:
+
+    - never exceed any device budget;
+    - on *main* devices keep headroom for the largest unsplittable layer so an
+      offloaded layer can always be paged back in for compute;
+    - modules holding tied weights are placed together;
+    - a module that doesn't fit is split into its children unless it matches
+      ``no_split_module_patterns``.
+    """
+    max_memory = get_max_memory(max_memory)
+    # a user map that omits "cpu" must still cap the host tier at real RAM so
+    # oversized models spill to disk instead of exhausting memory
+    max_memory.setdefault("cpu", _host_ram_bytes())
+    no_split = no_split_module_patterns or []
+    devices = [d for d in max_memory if isinstance(d, int)] + ["cpu", "disk"]
+    main_devices = [devices[0]] if devices else []
+    if "cpu" in max_memory and devices[0] != "cpu":
+        main_devices.append("cpu")
+
+    module_sizes = compute_module_sizes(tree, dtype, special_dtypes)
+    tied_parameters = find_tied_parameters(tree)
+
+    if not isinstance(tree, Mapping):
+        raise TypeError("infer_auto_device_map expects a nested dict param pytree")
+    modules_to_treat: list[str] = list(tree.keys())
+    flat_tree = named_parameters(tree)
+    children_of: dict[str, list[str]] = defaultdict(list)
+    for name in module_sizes:
+        if name:
+            parent = "/".join(name.split("/")[:-1])
+            children_of[parent].append(name)
+
+    def _is_leaf_module(name: str) -> bool:
+        return name in flat_tree or not children_of.get(name)
+
+    def _max_layer_size(queue: list[str]) -> int:
+        """Largest unsplittable unit still to place (reference
+        ``get_max_layer_size``)."""
+        best = 0
+        for name in queue:
+            if _is_leaf_module(name) or _matches_any(name, no_split):
+                best = max(best, module_sizes[name])
+            else:
+                best = max(best, _max_layer_size(children_of[name]))
+        return best
+
+    device_map: OrderedDict[str, Union[int, str]] = OrderedDict()
+    current_device = 0
+    used = {device: 0 for device in devices}
+
+    def _tied_companions(name: str) -> list[str]:
+        """Unplaced top-level queue entries tied to params inside ``name``."""
+        inside = {p for p in flat_tree if p == name or p.startswith(name + "/")}
+        out = []
+        for group in tied_parameters:
+            group_in = [p for p in group if p in inside]
+            group_out = [p for p in group if p not in inside]
+            if group_in and group_out:
+                for p in group_out:
+                    for queued in modules_to_treat:
+                        if (p == queued or p.startswith(queued + "/")) and queued not in out:
+                            out.append(queued)
+        return out
+
+    while modules_to_treat:
+        name = modules_to_treat.pop(0)
+        module_size = module_sizes[name]
+        device = devices[current_device]
+        budget = max_memory.get(device) if device != "disk" else None
+
+        reserve = _max_layer_size(modules_to_treat) if device in main_devices else 0
+        companions = _tied_companions(name)
+        size_with_ties = module_size + sum(module_sizes[c] for c in companions)
+
+        fits = budget is None or used[device] + size_with_ties + reserve <= budget
+        if fits:
+            if verbose:
+                print(f"putting {name} (+{companions}) size={size_with_ties} on {device}")
+            device_map[name] = device
+            used[device] += size_with_ties
+            for c in companions:
+                device_map[c] = device
+                modules_to_treat.remove(c)
+            continue
+
+        kids = children_of.get(name, [])
+        splittable = kids and not _matches_any(name, no_split) and not companions
+        if splittable:
+            if verbose:
+                print(f"splitting {name} into {len(kids)} children")
+            modules_to_treat[0:0] = kids
+        else:
+            if verbose:
+                print(f"{name} does not fit on {device}, advancing")
+            modules_to_treat.insert(0, name)
+            current_device += 1
+            if current_device >= len(devices):
+                raise RuntimeError(f"module {name} fits nowhere — even disk failed?")
+
+    if clean_result:
+        device_map = clean_device_map(device_map)
+    return device_map
+
+
+def clean_device_map(device_map: "OrderedDict[str, Union[int, str]]", module_prefix: str = "") -> OrderedDict:
+    """Collapse children that share a device onto their parent prefix
+    (reference ``clean_device_map``)."""
+    prefixes = sorted({k.split("/")[0] if not module_prefix else k for k in device_map})
+    values = set(device_map.values())
+    if module_prefix == "" and len(values) == 1:
+        return OrderedDict({"": device_map[next(iter(device_map))]})
+    out: OrderedDict = OrderedDict()
+    for prefix in prefixes:
+        sub = OrderedDict(
+            (k, v) for k, v in device_map.items() if k == prefix or k.startswith(prefix + "/")
+        )
+        if len(set(sub.values())) == 1:
+            out[prefix] = next(iter(sub.values()))
+        else:
+            out.update(sub)
+    return out
+
+
+def lookup_device(device_map: Mapping[str, Any], path: str):
+    """Most-specific device-map entry covering ``path``."""
+    if path in device_map:
+        return device_map[path]
+    parts = path.split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        prefix = "/".join(parts[:i])
+        if prefix in device_map:
+            return device_map[prefix]
+    raise KeyError(f"{path} not covered by device_map (keys={list(device_map)[:8]}…)")
+
+
+# -------------------------------------------------------- checkpoint loading --
+def load_state_dict(checkpoint_file: str, device_map: Optional[dict] = None) -> dict:
+    """Load a safetensors/npz file as flat ``{name: np.ndarray}``, lazily
+    (reference ``load_state_dict:1620`` — safetensors framework='numpy')."""
+    if checkpoint_file.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(checkpoint_file)
+    if checkpoint_file.endswith((".npz", ".npy")):
+        with np.load(checkpoint_file, allow_pickle=False) as data:
+            return {k: data[k] for k in data.files}
+    raise ValueError(f"unsupported checkpoint format: {checkpoint_file}")
+
+
+def load_checkpoint_in_params(
+    abstract_tree,
+    checkpoint: str,
+    device_map: Optional[Mapping[str, Any]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    strict: bool = True,
+):
+    """Stream a (possibly sharded) checkpoint into a placed param tree
+    (reference ``load_checkpoint_in_model:1788``): each tensor goes straight to
+    its mapped device — HBM ``device_put``, host numpy, or disk memmap — without
+    ever materializing the whole model in host RAM.
+
+    ``checkpoint`` is a safetensors file, an index json, or a directory holding
+    either. Returns ``(tree, offload_index)``.
+    """
+    import jax
+
+    shard_files = _resolve_checkpoint_files(checkpoint)
+    expected = named_parameters(abstract_tree)
+    device_map = device_map or {"": 0}
+    disk_index: dict = {}
+    accel = [d for d in jax.local_devices() if d.platform != "cpu"] or jax.local_devices()
+
+    flat_out: dict[str, Any] = {}
+    for shard in shard_files:
+        state = load_state_dict(shard)
+        for name, value in state.items():
+            if name not in expected:
+                if strict:
+                    raise KeyError(f"checkpoint tensor {name!r} not in model")
+                continue
+            if dtype is not None:
+                value = value.astype(dtype)
+            target = lookup_device(device_map, name)
+            if target == "disk":
+                if offload_folder is None:
+                    raise ValueError("device_map contains 'disk' but no offload_folder given")
+                os.makedirs(offload_folder, exist_ok=True)
+                disk_index = offload_weight(value, name, offload_folder, disk_index)
+                flat_out[name] = None
+            elif target == "cpu":
+                flat_out[name] = value
+            else:
+                if int(target) >= len(accel):
+                    raise ValueError(
+                        f"device_map places {name!r} on device {target} but only "
+                        f"{len(accel)} local devices exist"
+                    )
+                flat_out[name] = jax.device_put(value, accel[int(target)])
+    if offload_folder and disk_index:
+        save_offload_index(disk_index, offload_folder)
+    missing = [k for k in expected if k not in flat_out]
+    if missing and strict:
+        raise KeyError(f"checkpoint is missing tensors: {missing[:5]}…")
+    return unflatten_parameters(flat_out), (load_offload_index(offload_folder) if offload_folder else {})
+
+
+def _resolve_checkpoint_files(checkpoint: str) -> list[str]:
+    import json as _json
+
+    if os.path.isdir(checkpoint):
+        index = os.path.join(checkpoint, WEIGHTS_INDEX_NAME)
+        single = os.path.join(checkpoint, WEIGHTS_NAME)
+        if os.path.isfile(index):
+            checkpoint = index
+        elif os.path.isfile(single):
+            return [single]
+        else:
+            shards = sorted(
+                os.path.join(checkpoint, f)
+                for f in os.listdir(checkpoint)
+                if f.endswith((".safetensors", ".npz"))
+            )
+            if not shards:
+                raise FileNotFoundError(f"no checkpoint files under {checkpoint}")
+            return shards
+    if checkpoint.endswith(".index.json") or checkpoint.endswith("index.json"):
+        folder = os.path.dirname(checkpoint)
+        with open(checkpoint) as f:
+            index_data = _json.load(f)
+        files = sorted(set(index_data["weight_map"].values()))
+        return [os.path.join(folder, f) for f in files]
+    return [checkpoint]
