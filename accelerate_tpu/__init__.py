@@ -28,6 +28,9 @@ __all__ = [
     "infer_auto_device_map",
     "init_empty_weights",
     "load_checkpoint_and_dispatch",
+    "LocalSGD",
+    "find_executable_batch_size",
+    "release_memory",
     "AcceleratedOptimizer",
     "AcceleratedScheduler",
     "AcceleratorState",
@@ -66,6 +69,18 @@ def __getattr__(name):
         from .launchers import notebook_launcher
 
         return notebook_launcher
+    if name == "LocalSGD":
+        from .local_sgd import LocalSGD
+
+        return LocalSGD
+    if name in ("find_executable_batch_size", "release_memory", "clear_device_cache"):
+        from .utils import memory
+
+        return getattr(memory, name)
+    if name == "tqdm":
+        from .utils.tqdm import tqdm
+
+        return tqdm
     if name in _BIG_MODELING:
         from . import big_modeling
 

@@ -1,0 +1,111 @@
+"""OOM-recovery utilities.
+
+TPU-native counterpart of the reference's ``utils/memory.py``
+(``/root/reference/src/accelerate/utils/memory.py`` — ``release_memory:66``,
+``should_reduce_batch_size:96``, ``find_executable_batch_size:115``,
+``clear_device_cache:39``).
+
+On TPU an OOM surfaces as an ``XlaRuntimeError`` whose message carries
+``RESOURCE_EXHAUSTED`` (HBM) — usually at compile/first-execute time of the
+jitted step, which makes the retry loop *cheaper* than on CUDA: the failed
+allocation aborts before any training state is touched.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Attempting to reserve",  # XLA allocator message
+)
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop compiled-executable and array caches (reference
+    ``clear_device_cache:39`` — there: ``torch.cuda.empty_cache`` per backend)."""
+    if garbage_collection:
+        gc.collect()
+    import jax
+
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+def release_memory(*objects):
+    """Release references + caches; returns ``None`` placeholders so callers can
+    rebind (reference ``release_memory:66``: ``a, b = release_memory(a, b)``)."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    clear_device_cache()
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Heuristic: does this exception mean the device ran out of memory?
+    (reference ``should_reduce_batch_size:96`` checks CUDA/CUDNN/CPU OOM
+    statuses; on TPU the signal is XLA's RESOURCE_EXHAUSTED.)"""
+    if isinstance(exception, MemoryError):
+        return True
+    msg = str(exception)
+    return any(marker in msg for marker in _OOM_MARKERS)
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable[[int], int]] = None,
+):
+    """Decorator: call ``function(batch_size, *args, **kwargs)``, halving
+    ``batch_size`` on OOM until it fits (reference
+    ``find_executable_batch_size:115``). Caches are cleared between attempts so
+    a failed compilation doesn't poison the next one.
+
+    Example::
+
+        @find_executable_batch_size(starting_batch_size=512)
+        def train(batch_size):
+            ...
+        train()
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+    reduce_fn = reduce_batch_size_fn or (lambda b: b // 2)
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        batch_size = starting_batch_size
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < 1 or params[0] != "batch_size":
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument, "
+                f"but its signature is ({', '.join(params)}) — it must accept `batch_size` first."
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size = reduce_fn(batch_size)
+                else:
+                    raise
+
+    return wrapper

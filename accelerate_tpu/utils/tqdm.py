@@ -1,0 +1,20 @@
+"""Rank-aware progress bars (reference ``utils/tqdm.py`` — main-process-only
+``tqdm`` so N hosts don't print N bars)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """``tqdm.auto.tqdm`` that renders only on the main process (reference
+    ``utils/tqdm.py:43``)."""
+    if not is_tqdm_available():
+        raise ImportError("tqdm is not installed; pip install tqdm")
+    from tqdm.auto import tqdm as _tqdm
+
+    from ..state import PartialState
+
+    if main_process_only:
+        kwargs.setdefault("disable", not PartialState().is_main_process)
+    return _tqdm(*args, **kwargs)

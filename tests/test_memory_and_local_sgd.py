@@ -1,0 +1,147 @@
+"""Memory-retry utilities + LocalSGD (reference ``tests/test_memory_utils.py``
+pattern: fake OOM-raising callables; LocalSGD convergence on the virtual mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.local_sgd import (
+    LocalSGD,
+    make_local_sgd_train_step,
+    replicate_for_local_sgd,
+    unstack_local_sgd,
+)
+from accelerate_tpu.utils.memory import (
+    find_executable_batch_size,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+class FakeOOM(RuntimeError):
+    pass
+
+
+class TestFindExecutableBatchSize:
+    def test_halves_until_fit(self):
+        sizes = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def train(batch_size):
+            sizes.append(batch_size)
+            if batch_size > 16:
+                raise FakeOOM("RESOURCE_EXHAUSTED: out of memory allocating")
+            return batch_size
+
+        assert train() == 16
+        assert sizes == [128, 64, 32, 16]
+
+    def test_non_oom_errors_propagate(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def train(batch_size):
+            raise ValueError("unrelated")
+
+        with pytest.raises(ValueError, match="unrelated"):
+            train()
+
+    def test_reaching_zero_raises(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def train(batch_size):
+            raise FakeOOM("OOM")
+
+        with pytest.raises(RuntimeError, match="No executable batch size"):
+            train()
+
+    def test_signature_check(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def train(not_batch):
+            return 1
+
+        with pytest.raises(TypeError, match="batch_size"):
+            train()
+
+    def test_custom_reduce_fn(self):
+        sizes = []
+
+        @find_executable_batch_size(starting_batch_size=10, reduce_batch_size_fn=lambda b: b - 3)
+        def train(batch_size):
+            sizes.append(batch_size)
+            if batch_size > 4:
+                raise MemoryError()
+            return batch_size
+
+        assert train() == 4
+        assert sizes == [10, 7, 4]
+
+    def test_should_reduce_markers(self):
+        assert should_reduce_batch_size(MemoryError())
+        assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+        assert not should_reduce_batch_size(ValueError("shape mismatch"))
+
+    def test_release_memory(self):
+        a, b = np.ones(4), np.ones(4)
+        a, b = release_memory(a, b)
+        assert a is None and b is None
+
+
+class TestLocalSGDImperative:
+    def test_single_process_noop(self):
+        acc = Accelerator()
+        params = {"w": jnp.ones((2,))}
+        with LocalSGD(acc, model=params, local_sgd_steps=2) as ls:
+            for _ in range(4):
+                out = ls.step(params)
+        assert out is params or np.allclose(np.asarray(out["w"]), 1.0)
+
+    def test_sync_flag_restored(self):
+        acc = Accelerator()
+        with LocalSGD(acc, local_sgd_steps=2):
+            pass
+        assert acc.gradient_state.sync_gradients
+
+
+class TestLocalSGDCompiled:
+    def test_replicas_diverge_then_converge(self):
+        pc = ParallelismConfig(dp_shard_size=8)
+        acc = Accelerator(parallelism_config=pc)
+        mesh = acc.mesh
+        k = 4
+
+        def loss_fn(params, batch):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        opt = optax.sgd(0.1)
+        params = {"w": jnp.zeros((4, 1))}
+        opt_state = opt.init(params)
+        params_stack = replicate_for_local_sgd(params, mesh)
+        opt_stack = replicate_for_local_sgd(opt_state, mesh)
+
+        step = make_local_sgd_train_step(loss_fn, opt, mesh, local_sgd_steps=k)
+
+        rng = np.random.default_rng(0)
+        w_true = rng.normal(size=(4, 1)).astype(np.float32)
+        losses = []
+        for i in range(2 * k):
+            x = rng.normal(size=(16, 4)).astype(np.float32)
+            batch = {"x": jnp.asarray(x), "y": jnp.asarray(x @ w_true)}
+            params_stack, opt_stack, loss = step(params_stack, opt_stack, batch, i)
+            losses.append(float(loss))
+            ws = np.asarray(params_stack["w"])
+            equal_across = all(np.allclose(ws[0], ws[j]) for j in range(1, 8))
+            if (i + 1) % k == 0:
+                assert equal_across, f"replicas should be averaged at step {i}"
+            else:
+                # each replica saw a different data shard → they drift
+                assert not equal_across, f"replicas should differ at step {i}"
+        assert losses[-1] < losses[0]
+
+    def test_unstack(self):
+        pc = ParallelismConfig(dp_shard_size=8)
+        acc = Accelerator(parallelism_config=pc)
+        stack = replicate_for_local_sgd({"w": jnp.arange(3.0)}, acc.mesh)
+        one = unstack_local_sgd(stack)
+        np.testing.assert_allclose(np.asarray(one["w"]), [0, 1, 2])
