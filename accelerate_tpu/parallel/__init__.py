@@ -1,4 +1,11 @@
 from .long_context import make_context_parallel_attention, sequence_parallel_attention
+from .moe import init_moe_ffn, moe_ffn, moe_shard_rules
+from .pipeline import (
+    make_pipeline_forward,
+    merge_microbatches,
+    split_into_stages,
+    split_microbatches,
+)
 from .sharding import (
     FSDP_AXES,
     ShardingRules,

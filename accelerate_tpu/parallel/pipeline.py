@@ -1,0 +1,149 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+TPU-native counterpart of the reference's PiPPy integration
+(``/root/reference/src/accelerate/inference.py`` — ``prepare_pippy:126``,
+``build_pipeline:75`` auto-splitting by balanced size, ``pippy_forward:101``
+with ``ScheduleGPipe`` microbatching) and of Megatron's training-side PP.
+
+Architecture shift: PiPPy traces an ``nn.Module`` into per-rank graph stages
+and moves microbatches over NCCL P2P. Here the model is ALREADY a stack of
+homogeneous stage params (leading ``pp``-sharded axis); the schedule is a
+``lax.scan`` inside ``shard_map`` whose per-tick communication is one
+``lax.ppermute`` shifting activations to the next stage over ICI. The whole
+schedule is one compiled function — differentiable end to end, so unlike the
+reference (inference-only without Megatron) the same code trains: ``jax.grad``
+through ``ppermute`` yields the reverse (backward) pipeline automatically.
+
+Composition: ``shard_map`` is manual over ``pp`` only (``axis_names={'pp'}``);
+inside a stage, arrays keep their GSPMD shardings, so tp/dp/cp compose with
+pipelining the usual way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def split_into_stages(layer_params: list, pp: int) -> Any:
+    """Stack per-layer param trees ``[L entries] → leaves [pp, L//pp, ...]``
+    (the analogue of reference ``build_pipeline``'s balanced split points,
+    ``inference.py:75-99`` — homogeneous decoder layers split evenly)."""
+    import jax
+    import jax.numpy as jnp
+
+    L = len(layer_params)
+    if L % pp != 0:
+        raise ValueError(f"{L} layers not divisible into {pp} pipeline stages")
+    per = L // pp
+
+    def _stack(*leaves):
+        stacked = jnp.stack([jnp.asarray(x) for x in leaves], axis=0)  # [L, ...]
+        return stacked.reshape((pp, per) + stacked.shape[1:])
+
+    return jax.tree_util.tree_map(_stack, *layer_params)
+
+
+def split_microbatches(batch, num_microbatches: int):
+    """[B, ...] → [M, B//M, ...] on every leaf (reference GPipe ``chunks`` arg,
+    ``inference.py:141``)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _split(x):
+        B = x.shape[0]
+        if B % num_microbatches != 0:
+            raise ValueError(f"batch {B} not divisible into {num_microbatches} microbatches")
+        return jnp.reshape(x, (num_microbatches, B // num_microbatches) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, batch)
+
+
+def merge_microbatches(batch):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.reshape(x, (x.shape[0] * x.shape[1],) + x.shape[2:]), batch
+    )
+
+
+def make_pipeline_forward(
+    stage_fn: Callable,
+    mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Build ``forward(stage_params_stack, x) -> y`` running a GPipe schedule.
+
+    ``stage_fn(stage_params, x) -> y`` is one stage's compute (e.g. a
+    ``lax.scan`` over its layer slice); activations must have the same
+    shape/dtype as inputs (transformer trunk). ``stage_params_stack`` leaves
+    carry a leading ``[pp, ...]`` axis sharded over ``pp``; ``x`` is the global
+    ``[B, ...]`` activation batch (already embedded).
+
+    The schedule runs ``M + pp - 1`` ticks; tick ``t`` has stage ``s`` compute
+    microbatch ``t - s`` (the classic GPipe trapezoid), with one ``ppermute``
+    per tick moving activations down the ring.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    pp = int(mesh.shape[axis_name])
+    M = num_microbatches
+    if pp <= 1:
+        def forward_trivial(stage_params_stack, x):
+            sp = jax.tree_util.tree_map(lambda a: a[0], stage_params_stack)
+            return stage_fn(sp, x)
+
+        return forward_trivial
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def _local(stage_params, x_micro):
+        # stage_params leaves [1, ...]; x_micro [M, Bm, ...] (replicated over pp)
+        params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        idx = jax.lax.axis_index(axis_name)
+        out_buf = jnp.zeros_like(x_micro)
+
+        def tick(carry, t):
+            cur, out_buf = carry
+            # stage 0 injects microbatch t (clamped; masked-out beyond M-1)
+            inject = x_micro[jnp.minimum(t, M - 1)]
+            stage_in = jnp.where(idx == 0, inject, cur)
+            y = stage_fn(params, stage_in)
+            # last stage records microbatch t-(pp-1) once the trapezoid fills
+            write_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+            do_write = jnp.logical_and(idx == pp - 1, t >= pp - 1)
+            out_buf = jax.lax.cond(
+                do_write,
+                lambda b: jax.lax.dynamic_update_index_in_dim(b, y, write_idx, 0),
+                lambda b: b,
+                out_buf,
+            )
+            # shift activations to the next stage (stage pp-1 sends nowhere)
+            nxt = jax.lax.ppermute(y, axis_name, fwd_perm)
+            return (nxt, out_buf), None
+
+        cur0 = jnp.zeros_like(x_micro[0])
+        (cur, out_buf), _ = jax.lax.scan(tick, (cur0, out_buf), jnp.arange(M + pp - 1))
+        # every stage returns its buffer; only the last stage's holds the result
+        # — the caller slices [-1], which fetches just that stage's shard
+        return out_buf[None]  # [1, M, Bm, ...]
+
+    sm = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(axis_name),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+
+    def forward(stage_params_stack, x):
+        x_micro = split_microbatches(x, M)
+        stacked = sm(stage_params_stack, x_micro)  # [pp, M, Bm, ...]
+        return merge_microbatches(stacked[-1])
+
+    return forward

@@ -5,12 +5,15 @@ TPU-native counterpart of the reference's ``parallelism_config.py``
 axis order ``("dp_replicate", "dp_shard", "cp", "sp", "tp")`` (``:262``, torchtitan
 convention), same flattened joint axes ``dp``, ``dp_shard_cp``, ``dp_cp``
 (``build_device_mesh :211-239``), same total-size == world-size validation
-(``_validate_accelerator :350-386``), plus a first-class ``ep`` axis (the reference
-only reaches expert parallelism through Megatron/DeepSpeed engines).
+(``_validate_accelerator :350-386``), plus first-class ``ep`` and ``pp`` axes (the
+reference only reaches expert/pipeline parallelism through Megatron/DeepSpeed/PiPPy
+engines). ``pp`` is outermost: stages are the natural unit to place across slices.
 
 On TPU the mesh maps onto the physical interconnect: inner (rightmost) axes ride
-ICI, the outer ``dp_replicate`` axis is the one to place across DCN slices. Device
-order comes from ``mesh_utils.create_device_mesh`` so collectives ride ICI rings.
+ICI; outer axes are the ones to place across DCN slices — ``pp`` first (stage
+boundaries cross slices with one activation transfer per microbatch), then
+``dp_replicate`` (one param-sized allreduce per step). Device order comes from
+``mesh_utils.create_device_mesh`` so collectives ride ICI rings.
 """
 
 from __future__ import annotations
@@ -22,7 +25,7 @@ from typing import Optional
 import numpy as np
 
 # Canonical axis order — mirror of reference parallelism_config.py:262.
-MESH_AXIS_NAMES = ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep")
+MESH_AXIS_NAMES = ("pp", "dp_replicate", "dp_shard", "cp", "sp", "tp", "ep")
 
 # Flattened logical axes: PartitionSpec accepts tuples of mesh axis names, so the
 # reference's flattened sub-meshes (``dp``, ``dp_shard_cp``, ``dp_cp``) become spec
@@ -44,6 +47,7 @@ class ParallelismConfig:
     blocks with ``lax.ppermute``.
     """
 
+    pp_size: int = 1
     dp_replicate_size: int = 1
     dp_shard_size: int = 1
     cp_size: int = 1
@@ -53,7 +57,7 @@ class ParallelismConfig:
     cp_rotate_method: str = "allgather"  # "allgather" | "ring"
 
     def __post_init__(self):
-        for name in ("dp_replicate_size", "cp_size", "sp_size", "tp_size", "ep_size"):
+        for name in ("pp_size", "dp_replicate_size", "cp_size", "sp_size", "tp_size", "ep_size"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
         if self.dp_shard_size == 0 or self.dp_shard_size < -1:
@@ -67,7 +71,8 @@ class ParallelismConfig:
     # -- size/enabled properties (reference parallelism_config.py properties) ----
     @property
     def non_dp_shard_size(self) -> int:
-        return self.dp_replicate_size * self.cp_size * self.sp_size * self.tp_size * self.ep_size
+        return (self.pp_size * self.dp_replicate_size * self.cp_size * self.sp_size
+                * self.tp_size * self.ep_size)
 
     def infer_dp_shard(self, num_devices: int) -> int:
         if self.dp_shard_size != -1:
@@ -116,6 +121,10 @@ class ParallelismConfig:
     def ep_enabled(self) -> bool:
         return self.ep_size > 1
 
+    @property
+    def pp_enabled(self) -> bool:
+        return self.pp_size > 1
+
     # -- env protocol (reference parallelism_config.py:269-284 reads
     #    PARALLELISM_CONFIG_* written by utils/launch.py:396-420) ---------------
     @classmethod
@@ -124,6 +133,7 @@ class ParallelismConfig:
             return int(os.environ.get(f"PARALLELISM_CONFIG_{name}", default))
 
         return cls(
+            pp_size=_get("PP_SIZE", 1),
             dp_replicate_size=_get("DP_REPLICATE_SIZE", 1),
             dp_shard_size=_get("DP_SHARD_SIZE", 1),
             cp_size=_get("CP_SIZE", 1),
@@ -135,6 +145,7 @@ class ParallelismConfig:
 
     def to_env(self) -> dict[str, str]:
         return {
+            "PARALLELISM_CONFIG_PP_SIZE": str(self.pp_size),
             "PARALLELISM_CONFIG_DP_REPLICATE_SIZE": str(self.dp_replicate_size),
             "PARALLELISM_CONFIG_DP_SHARD_SIZE": str(self.dp_shard_size),
             "PARALLELISM_CONFIG_CP_SIZE": str(self.cp_size),
@@ -148,6 +159,7 @@ class ParallelismConfig:
     def mesh_shape(self, num_devices: int) -> tuple[int, ...]:
         dp_shard = self.infer_dp_shard(num_devices)
         shape = (
+            self.pp_size,
             self.dp_replicate_size,
             dp_shard,
             self.cp_size,
@@ -206,6 +218,7 @@ class ParallelismConfig:
             shape = self.mesh_shape(num_devices)
         else:
             shape = (
+                self.pp_size,
                 self.dp_replicate_size,
                 self.dp_shard_size,
                 self.cp_size,

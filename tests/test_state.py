@@ -43,7 +43,7 @@ def test_parallelism_config_validation():
 def test_parallelism_config_infer_dp_shard():
     pc = ParallelismConfig(dp_shard_size=-1, tp_size=2)
     assert pc.infer_dp_shard(8) == 4
-    assert pc.mesh_shape(8) == (1, 4, 1, 1, 2, 1)
+    assert pc.mesh_shape(8) == (1, 1, 4, 1, 1, 2, 1)
     assert pc.fsdp_enabled and pc.tp_enabled and not pc.cp_enabled
 
 
