@@ -1,0 +1,9 @@
+"""Command-line interface for accelerate-tpu.
+
+TPU-native analogue of the reference CLI (``/root/reference/src/accelerate/commands/``,
+SURVEY.md §2.4): ``accelerate-tpu {config,launch,env,estimate-memory,merge-weights,
+test,tpu-config}``. The launch model differs fundamentally: the reference forks one
+process per accelerator (torchrun / xmp.spawn); we are SPMD — ONE process per host,
+with every chip on the host visible to that process, and multi-host coordination via
+``jax.distributed.initialize`` (coordinator address handed out by the launcher).
+"""

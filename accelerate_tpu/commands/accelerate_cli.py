@@ -1,0 +1,24 @@
+"""Main CLI entry point (reference ``commands/accelerate_cli.py:28-50``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from . import config, env, estimate, launch, merge, test, tpu
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    for mod in (config, launch, env, estimate, merge, test, tpu):
+        mod.register_parser(subparsers)
+    args = parser.parse_args()
+    raise SystemExit(args.func(args))
+
+
+if __name__ == "__main__":
+    main()

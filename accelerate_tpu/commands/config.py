@@ -1,0 +1,171 @@
+"""``accelerate-tpu config`` — interactive questionnaire + YAML config file.
+
+Mirrors the reference's ``commands/config/`` package (``cluster.py:58``
+``get_cluster_input``, ``config_args.py:40-77`` load/save, default path
+``~/.cache/huggingface/accelerate/default_config.yaml``) in one module: our
+config surface is smaller because SPMD collapses the per-accelerator process
+zoo — what remains is the mesh (dp_replicate/dp_shard/tp/cp/sp/ep/pp), mixed
+precision, hosts, and launch defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+default_config_dir = os.path.join(
+    os.path.expanduser(os.environ.get("XDG_CACHE_HOME", "~/.cache")), "accelerate_tpu"
+)
+default_config_file = os.path.join(default_config_dir, "default_config.yaml")
+
+
+def resolve_config_file(explicit: Optional[str] = None) -> Optional[str]:
+    """Config-file precedence: explicit flag > $ACCELERATE_TPU_CONFIG_FILE > default."""
+    if explicit:
+        return explicit
+    env = os.environ.get("ACCELERATE_TPU_CONFIG_FILE")
+    if env:
+        return env
+    if os.path.isfile(default_config_file):
+        return default_config_file
+    return None
+
+
+@dataclass
+class ClusterConfig:
+    """On-disk launch configuration (reference ``config_args.py:179`` ClusterConfig)."""
+
+    compute_environment: str = "LOCAL_MACHINE"
+    distributed_type: str = "TPU"  # TPU | MULTI_TPU_POD | CPU | NO
+    mixed_precision: str = "bf16"
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    num_processes: Optional[int] = None  # CPU-simulation device count; None = all chips
+    # Mesh axis sizes (1 = not enabled; -1 = infer remaining devices). All-1
+    # means "no mesh configured" → the runtime picks its default (pure DP).
+    dp_replicate_size: int = 1
+    dp_shard_size: int = 1
+    tp_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    ep_size: int = 1
+    pp_size: int = 1
+    cp_rotate_method: str = "allgather"
+    gradient_accumulation_steps: int = 1
+    # TPU pod metadata (for `accelerate-tpu launch --tpu_pod` / tpu-config)
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    debug: bool = False
+    use_cpu: bool = False
+    downcast_bf16: bool = False
+    main_training_function: str = "main"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            if path.endswith(".json"):
+                json.dump(self.to_dict(), f, indent=2)
+            else:
+                yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+    @classmethod
+    def load(cls, path: str) -> "ClusterConfig":
+        with open(path) as f:
+            data = json.load(f) if path.endswith(".json") else yaml.safe_load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = set(data) - known
+        if extra:
+            raise ValueError(
+                f"Unknown keys in config file {path}: {sorted(extra)}. "
+                f"Known keys: {sorted(known)}"
+            )
+        return cls(**data)
+
+
+def _ask(prompt: str, default, cast=str, choices=None):
+    suffix = f" [{default}]" if default is not None else ""
+    while True:
+        raw = input(f"{prompt}{suffix}: ").strip()
+        if not raw:
+            return default
+        try:
+            value = cast(raw)
+        except (TypeError, ValueError):
+            print(f"  could not parse {raw!r} as {cast.__name__}, try again")
+            continue
+        if choices is not None and value not in choices:
+            print(f"  pick one of {choices}")
+            continue
+        return value
+
+
+def _ask_bool(prompt: str, default: bool) -> bool:
+    raw = _ask(prompt + " (yes/no)", "yes" if default else "no")
+    return str(raw).lower() in ("yes", "y", "true", "1")
+
+
+def get_cluster_input() -> ClusterConfig:
+    """Interactive questionnaire (reference ``commands/config/cluster.py:58``)."""
+    cfg = ClusterConfig()
+    cfg.distributed_type = _ask(
+        "Compute environment (TPU = this host's chips, MULTI_TPU_POD = multi-host pod, "
+        "CPU = simulated devices, NO = single device)",
+        "TPU",
+        str,
+        ("TPU", "MULTI_TPU_POD", "CPU", "NO"),
+    )
+    if cfg.distributed_type == "MULTI_TPU_POD":
+        cfg.num_machines = _ask("How many hosts (TPU VM workers)", 2, int)
+        cfg.main_process_ip = _ask("Coordinator (worker 0) IP", None)
+        cfg.main_process_port = _ask("Coordinator port", 8476, int)
+        cfg.tpu_name = _ask("TPU name (for gcloud ssh)", None)
+        cfg.tpu_zone = _ask("TPU zone", None)
+    elif cfg.distributed_type == "CPU":
+        cfg.use_cpu = True
+        cfg.num_processes = _ask("How many simulated devices", 8, int)
+    cfg.dp_shard_size = _ask("dp_shard (FSDP) axis size (-1 = all remaining devices)", -1, int)
+    cfg.dp_replicate_size = _ask("dp_replicate axis size", 1, int)
+    cfg.tp_size = _ask("Tensor-parallel axis size", 1, int)
+    cfg.cp_size = _ask("Context-parallel axis size", 1, int)
+    cfg.sp_size = _ask("Ulysses sequence-parallel axis size", 1, int)
+    cfg.ep_size = _ask("Expert-parallel axis size", 1, int)
+    cfg.pp_size = _ask("Pipeline-parallel axis size", 1, int)
+    cfg.mixed_precision = _ask(
+        "Mixed precision", "bf16", str, ("no", "bf16", "fp16", "fp8")
+    )
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
+    cfg.debug = _ask_bool("Enable debug mode (cross-process collective shape checks)", False)
+    return cfg
+
+
+def config_command(args) -> int:
+    if args.default:
+        cfg = ClusterConfig()
+    else:
+        cfg = get_cluster_input()
+    path = args.config_file or default_config_file
+    cfg.save(path)
+    print(f"accelerate-tpu configuration saved at {path}")
+    return 0
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("config", help="Create the launch configuration file")
+    p.add_argument("--config_file", default=None, help="Where to save (default: "
+                   f"{default_config_file})")
+    p.add_argument("--default", action="store_true",
+                   help="Write the default config without asking questions")
+    p.set_defaults(func=config_command)
+    return p

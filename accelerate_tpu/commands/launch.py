@@ -1,0 +1,226 @@
+"""``accelerate-tpu launch`` — env-var protocol + process spawn.
+
+Reference: ``commands/launch.py`` (SURVEY.md §2.4, §3.1). The reference forks one
+process per accelerator (torchrun / ``xmp.spawn``) and rendezvouses over
+MASTER_ADDR; under SPMD we spawn ONE process per host — single-host launch is
+"set env, exec the script", and multi-host launch distributes
+``ACCELERATE_COORDINATOR_ADDRESS`` / ``ACCELERATE_NUM_PROCESSES`` /
+``ACCELERATE_PROCESS_ID`` (consumed by ``state.py`` →
+``jax.distributed.initialize``), optionally fanning out over a TPU pod via
+``gcloud compute tpus tpu-vm ssh --worker=all`` (the moral twin of the
+reference's ``tpu_pod_launcher`` → ``xla_dist``, ``commands/launch.py:1117``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Optional
+
+from .config import ClusterConfig, resolve_config_file
+
+
+def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    if subparsers is not None:
+        p = subparsers.add_parser("launch", help="Launch a training script")
+    else:
+        p = argparse.ArgumentParser("accelerate-tpu launch")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("-m", "--module", action="store_true",
+                   help="Interpret the script as a python module (python -m)")
+    p.add_argument("--cpu", action="store_true",
+                   help="Run on simulated CPU devices (sets JAX_PLATFORMS=cpu)")
+    p.add_argument("--num_processes", type=int, default=None,
+                   help="With --cpu: number of simulated devices "
+                        "(XLA_FLAGS=--xla_force_host_platform_device_count)")
+    p.add_argument("--num_machines", type=int, default=None, help="Number of hosts")
+    p.add_argument("--machine_rank", type=int, default=None, help="This host's rank")
+    p.add_argument("--main_process_ip", default=None, help="Coordinator (host 0) IP")
+    p.add_argument("--main_process_port", type=int, default=None)
+    p.add_argument("--mixed_precision", default=None,
+                   choices=("no", "bf16", "fp16", "fp8"))
+    p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    p.add_argument("--debug", action="store_true",
+                   help="ACCELERATE_DEBUG_MODE: verify collective shapes across processes")
+    # Mesh axes (PARALLELISM_CONFIG_* protocol, parallelism_config.py)
+    for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        p.add_argument(f"--{axis}_size", type=int, default=None)
+    p.add_argument("--cp_rotate_method", default=None, choices=("allgather", "ring"))
+    # TPU pod fan-out
+    p.add_argument("--tpu_pod", action="store_true",
+                   help="Fan out to every TPU-VM worker via gcloud ssh")
+    p.add_argument("--tpu_name", default=None)
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--no_tpu_cluster", dest="tpu_pod", action="store_false")
+    p.add_argument("training_script", help="Path to the script (or module with -m)")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    if subparsers is not None:
+        p.set_defaults(func=launch_command)
+    return p
+
+
+def _merge_config(args) -> ClusterConfig:
+    """CLI flags override config-file values (reference ``_validate_launch_command``)."""
+    path = resolve_config_file(args.config_file)
+    cfg = ClusterConfig.load(path) if path else ClusterConfig()
+    for attr, flag in [
+        ("num_machines", args.num_machines),
+        ("machine_rank", args.machine_rank),
+        ("main_process_ip", args.main_process_ip),
+        ("main_process_port", args.main_process_port),
+        ("mixed_precision", args.mixed_precision),
+        ("num_processes", args.num_processes),
+        ("tpu_name", args.tpu_name),
+        ("tpu_zone", args.tpu_zone),
+    ]:
+        if flag is not None:
+            setattr(cfg, attr, flag)
+    for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        v = getattr(args, f"{axis}_size")
+        if v is not None:
+            setattr(cfg, f"{axis}_size", v)
+    if args.cp_rotate_method is not None:
+        cfg.cp_rotate_method = args.cp_rotate_method
+    if args.gradient_accumulation_steps is not None:
+        cfg.gradient_accumulation_steps = args.gradient_accumulation_steps
+    if args.cpu:
+        cfg.use_cpu = True
+    if args.debug:
+        cfg.debug = True
+    return cfg
+
+
+def build_launch_env(cfg: ClusterConfig) -> dict[str, str]:
+    """The env-var channel (reference ``utils/launch.py:197-420``)."""
+    env: dict[str, str] = {}
+    env["ACCELERATE_MIXED_PRECISION"] = cfg.mixed_precision
+    if cfg.gradient_accumulation_steps != 1:
+        env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(cfg.gradient_accumulation_steps)
+    if cfg.debug:
+        env["ACCELERATE_DEBUG_MODE"] = "true"
+    if cfg.use_cpu:
+        # platform selection happens via jax.config.update in PartialState —
+        # setting JAX_PLATFORMS here can hang backend init on some TPU-plugin
+        # installs, config.update never does
+        env["ACCELERATE_USE_CPU"] = "true"
+        n = cfg.num_processes or 8
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    if cfg.num_machines > 1:
+        if not cfg.main_process_ip:
+            raise ValueError("multi-host launch requires --main_process_ip (worker 0)")
+        port = cfg.main_process_port or 8476
+        env["ACCELERATE_COORDINATOR_ADDRESS"] = f"{cfg.main_process_ip}:{port}"
+        env["ACCELERATE_NUM_PROCESSES"] = str(cfg.num_machines)
+        env["ACCELERATE_PROCESS_ID"] = str(cfg.machine_rank)
+    # Mesh geometry → PARALLELISM_CONFIG_* (reference utils/launch.py:396-420)
+    mesh_flags = {
+        "PARALLELISM_CONFIG_DP_REPLICATE_SIZE": cfg.dp_replicate_size,
+        "PARALLELISM_CONFIG_DP_SHARD_SIZE": cfg.dp_shard_size,
+        "PARALLELISM_CONFIG_TP_SIZE": cfg.tp_size,
+        "PARALLELISM_CONFIG_CP_SIZE": cfg.cp_size,
+        "PARALLELISM_CONFIG_SP_SIZE": cfg.sp_size,
+        "PARALLELISM_CONFIG_EP_SIZE": cfg.ep_size,
+        "PARALLELISM_CONFIG_PP_SIZE": cfg.pp_size,
+    }
+    if any(v not in (1, None) for v in mesh_flags.values()):
+        for k, v in mesh_flags.items():
+            env[k] = str(v)
+        env["PARALLELISM_CONFIG_CP_ROTATE_METHOD"] = cfg.cp_rotate_method
+    return env
+
+
+def _script_cmd(args) -> list[str]:
+    cmd = [sys.executable]
+    if args.module:
+        cmd.append("-m")
+    cmd.append(args.training_script)
+    cmd.extend(args.training_script_args)
+    return cmd
+
+
+def simple_launcher(args, cfg: ClusterConfig) -> int:
+    """Single-host launch: set env, run the script (reference ``simple_launcher:986``)."""
+    env = {**os.environ, **build_launch_env(cfg)}
+    # make accelerate_tpu importable in the child even for uninstalled checkouts
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_parent, env.get("PYTHONPATH")) if p
+    )
+    proc = subprocess.run(_script_cmd(args), env=env)
+    return proc.returncode
+
+
+def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
+    """Fan out to every pod worker over gcloud ssh (reference ``tpu_pod_launcher:1117``).
+
+    Each worker re-invokes ``accelerate-tpu launch`` WITHOUT --tpu_pod and with its
+    own ``--machine_rank``; jax.distributed handles rendezvous at the coordinator.
+    """
+    if not cfg.tpu_name:
+        raise ValueError("--tpu_pod requires --tpu_name (and usually --tpu_zone)")
+    if not cfg.main_process_ip:
+        # every worker must agree on ONE coordinator — resolving it per-worker
+        # (e.g. hostname -i) would rendezvous nowhere
+        raise ValueError(
+            "--tpu_pod requires --main_process_ip set to worker 0's internal IP "
+            "(gcloud compute tpus tpu-vm describe <name> --format='value("
+            "networkEndpoints[0].ipAddress)')"
+        )
+    inner = [
+        "accelerate-tpu", "launch",
+        "--num_machines", str(cfg.num_machines),
+        "--main_process_ip", cfg.main_process_ip,
+        "--main_process_port", str(cfg.main_process_port or 8476),
+        "--mixed_precision", cfg.mixed_precision,
+        "--gradient_accumulation_steps", str(cfg.gradient_accumulation_steps),
+        "--cp_rotate_method", cfg.cp_rotate_method,
+    ]
+    for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        inner += [f"--{axis}_size", str(getattr(cfg, f"{axis}_size"))]
+    if cfg.debug:
+        inner.append("--debug")
+    if args.module:
+        inner.append("-m")
+    inner.append(args.training_script)
+    inner.extend(args.training_script_args)
+    # gcloud sets no rank env; each worker reads its index from the TPU
+    # metadata server (the xla_dist-equivalent rank channel).
+    rank_probe = (
+        "RANK=$(curl -s -H 'Metadata-Flavor: Google' "
+        "http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number); "
+    )
+    remote = rank_probe + shlex.join(inner) + " --machine_rank=$RANK"
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg.tpu_name,
+        "--worker=all", f"--command={remote}",
+    ]
+    if cfg.tpu_zone:
+        cmd.insert(6, f"--zone={cfg.tpu_zone}")
+    print("Running:", shlex.join(cmd))
+    return subprocess.run(cmd).returncode
+
+
+def launch_command(args) -> int:
+    cfg = _merge_config(args)
+    if args.tpu_pod:
+        return tpu_pod_launcher(args, cfg)
+    return simple_launcher(args, cfg)
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    return launch_command_parser(subparsers)
+
+
+def main():
+    parser = launch_command_parser()
+    args = parser.parse_args()
+    raise SystemExit(launch_command(args))
+
+
+if __name__ == "__main__":
+    main()

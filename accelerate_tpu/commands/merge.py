@@ -1,0 +1,71 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded checkpoint into one file.
+
+Reference: ``commands/merge.py`` → ``merge_fsdp_weights`` (``utils/fsdp_utils.py:360``)
+turns a torch DCP sharded dir into a single state dict. Our sharded artifacts are
+(a) ``save_model`` output dirs (``model-00001-of-000NN.safetensors`` + index.json)
+and (b) ``save_state`` checkpoint dirs (``model.npz``). Output: one
+``model.safetensors`` (or ``.npz`` with ``--unsafe_serialization``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _load_flat_dir(path: str) -> dict:
+    import numpy as np
+
+    flat: dict = {}
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.isfile(index):
+        from safetensors.numpy import load_file
+
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        for shard in sorted(set(weight_map.values())):
+            flat.update(load_file(os.path.join(path, shard)))
+        return flat
+    for f in sorted(os.listdir(path)):
+        full = os.path.join(path, f)
+        if f.endswith(".safetensors") and f.startswith("model"):
+            from safetensors.numpy import load_file
+
+            flat.update(load_file(full))
+        elif f == "model.npz":
+            with np.load(full) as z:
+                flat.update({k: z[k] for k in z.files})
+    if not flat:
+        raise FileNotFoundError(f"no model shards (safetensors/npz) found in {path}")
+    return flat
+
+
+def merge_command(args) -> int:
+    flat = _load_flat_dir(args.checkpoint_dir)
+    os.makedirs(args.output_path, exist_ok=True)
+    if args.unsafe_serialization:
+        import numpy as np
+
+        out = os.path.join(args.output_path, "model.npz")
+        np.savez(out, **flat)
+    else:
+        from safetensors.numpy import save_file
+
+        from ..checkpointing import _safetensors_compat
+
+        out = os.path.join(args.output_path, "model.safetensors")
+        save_file(_safetensors_compat(flat), out)
+    print(f"merged {len(flat)} tensors from {args.checkpoint_dir} into {out}")
+    return 0
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("merge-weights",
+                              help="Merge sharded model weights into a single file")
+    p.add_argument("checkpoint_dir", help="Directory holding model shards")
+    p.add_argument("output_path", help="Directory to write the merged file into")
+    p.add_argument("--unsafe_serialization", action="store_true",
+                   help="Write .npz instead of safetensors")
+    p.set_defaults(func=merge_command)
+    return p

@@ -1,0 +1,35 @@
+"""``accelerate-tpu test`` — run the bundled sanity script through the launcher
+(reference ``commands/test.py`` → ``test_utils/scripts/test_script.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def test_command(args) -> int:
+    import accelerate_tpu.test_utils as tu
+
+    script = os.path.join(os.path.dirname(tu.__file__), "scripts", "test_script.py")
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch"]
+    if args.config_file:
+        cmd += ["--config_file", args.config_file]
+    if args.cpu:
+        cmd += ["--cpu", "--num_processes", str(args.num_processes)]
+    cmd.append(script)
+    print("Running:", " ".join(cmd))
+    rc = subprocess.run(cmd).returncode
+    if rc == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return rc
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("test", help="Run the bundled end-to-end sanity check")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--cpu", action="store_true", help="Run on a simulated CPU mesh")
+    p.add_argument("--num_processes", type=int, default=8)
+    p.set_defaults(func=test_command)
+    return p

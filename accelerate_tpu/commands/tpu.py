@@ -1,0 +1,49 @@
+"""``accelerate-tpu tpu-config`` — run setup commands on every TPU pod worker
+(reference ``commands/tpu.py:29-157``: gcloud ssh fan-out of install/setup lines)."""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+
+from .config import ClusterConfig, resolve_config_file
+
+
+def tpu_command(args) -> int:
+    cfg_path = resolve_config_file(args.config_file)
+    cfg = ClusterConfig.load(cfg_path) if cfg_path else ClusterConfig()
+    tpu_name = args.tpu_name or cfg.tpu_name
+    tpu_zone = args.tpu_zone or cfg.tpu_zone
+    if not tpu_name:
+        raise SystemExit("--tpu_name required (or set tpu_name in the config file)")
+    commands = list(args.command or [])
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands += [line.strip() for line in f if line.strip()]
+    if args.install_accelerate:
+        commands.insert(0, "pip install accelerate-tpu")
+    if not commands:
+        raise SystemExit("nothing to run: pass --command/--command_file/--install_accelerate")
+    remote = "; ".join(commands)
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+           "--worker=all", f"--command={remote}"]
+    if tpu_zone:
+        cmd.append(f"--zone={tpu_zone}")
+    print("Running:", shlex.join(cmd))
+    if args.debug:
+        return 0
+    return subprocess.run(cmd).returncode
+
+
+def register_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("tpu-config", help="Run setup commands on all pod workers")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--tpu_name", default=None)
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--command", action="append", default=None)
+    p.add_argument("--command_file", default=None)
+    p.add_argument("--install_accelerate", action="store_true")
+    p.add_argument("--debug", action="store_true", help="Print the gcloud command, don't run")
+    p.set_defaults(func=tpu_command)
+    return p

@@ -1,0 +1,16 @@
+"""Test harness shipped with the package (reference ``src/accelerate/test_utils/``,
+SURVEY.md §4): capability-gated decorators, backend probe, and launchable
+assertion scripts under ``scripts/`` so any install can self-verify with
+``accelerate-tpu test``."""
+
+from .testing import (
+    assert_allclose_tree,
+    get_backend,
+    require_cpu,
+    require_multi_device,
+    require_pallas,
+    require_single_device,
+    require_tpu,
+    skip,
+    slow,
+)

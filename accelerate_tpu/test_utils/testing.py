@@ -1,0 +1,88 @@
+"""Capability gating + helpers (reference ``test_utils/testing.py:83-616``:
+``get_backend``, ``require_*`` decorators, ``slow``)."""
+
+from __future__ import annotations
+
+import functools
+import os
+import unittest
+
+from ..utils.imports import is_pallas_available, is_tpu_available
+
+
+def get_backend() -> tuple[str, int]:
+    """(platform, device_count) — device-agnostic probe (reference
+    ``testing.py:83-108`` returns (device, count, memory-fn))."""
+    import jax
+
+    return jax.default_backend(), jax.device_count()
+
+
+def skip(reason: str):
+    return unittest.skip(reason)
+
+
+def _require(flag: bool, reason: str):
+    def deco(fn):
+        return unittest.skipUnless(flag, reason)(fn)
+
+    return deco
+
+
+def require_tpu(fn):
+    return _require(is_tpu_available(), "test requires a TPU backend")(fn)
+
+
+def require_cpu(fn):
+    import jax
+
+    return _require(jax.default_backend() == "cpu", "test requires CPU backend")(fn)
+
+
+def require_single_device(fn):
+    import jax
+
+    return _require(jax.device_count() == 1, "test requires exactly 1 device")(fn)
+
+
+def require_multi_device(fn):
+    import jax
+
+    return _require(jax.device_count() > 1, "test requires multiple devices")(fn)
+
+
+def require_pallas(fn):
+    return _require(is_pallas_available(), "test requires pallas (TPU backend)")(fn)
+
+
+def slow(fn):
+    """Gated behind RUN_SLOW=1 (reference ``testing.py`` ``slow``)."""
+    run_slow = os.environ.get("RUN_SLOW", "0").lower() in ("1", "true", "yes")
+    return unittest.skipUnless(run_slow, "slow test — set RUN_SLOW=1")(fn)
+
+
+def assert_allclose_tree(a, b, rtol: float = 1e-5, atol: float = 1e-6, err_msg: str = ""):
+    """Tree-wise ``np.testing.assert_allclose``."""
+    import jax
+    import numpy as np
+
+    la, treedef_a = jax.tree_util.tree_flatten(a)
+    lb, treedef_b = jax.tree_util.tree_flatten(b)
+    assert treedef_a == treedef_b, f"tree structure mismatch: {treedef_a} vs {treedef_b}"
+    for xa, xb in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=rtol, atol=atol,
+                                   err_msg=err_msg)
+
+
+def memory_allocated_mb() -> float:
+    """Best-effort live-buffer accounting on the default backend."""
+    import jax
+
+    total = 0
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+            total += stats.get("bytes_in_use", 0)
+        except Exception:
+            pass
+    return total / 1e6

@@ -1,0 +1,131 @@
+"""CLI tests (reference ``tests/test_cli.py``: runs accelerate {config,launch,env,
+estimate} against config fixtures)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*argv, **kw):
+    env = {**os.environ, "PYTHONPATH": REPO}
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", *argv],
+        capture_output=True, text=True, env=env, timeout=300, **kw,
+    )
+
+
+def test_config_default_roundtrip(tmp_path):
+    path = tmp_path / "cfg.yaml"
+    r = run_cli("config", "--default", "--config_file", str(path))
+    assert r.returncode == 0, r.stderr
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    cfg = ClusterConfig.load(str(path))
+    assert cfg.mixed_precision == "bf16"
+    # all-1 mesh = "not configured" → launch emits no PARALLELISM_CONFIG_* and
+    # the runtime default (pure DP) applies
+    assert cfg.dp_shard_size == 1
+
+
+def test_config_rejects_unknown_keys(tmp_path):
+    path = tmp_path / "bad.yaml"
+    path.write_text("mixed_precision: bf16\nnot_a_real_key: 3\n")
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    with pytest.raises(ValueError, match="not_a_real_key"):
+        ClusterConfig.load(str(path))
+
+
+def test_env_command():
+    r = run_cli("env")
+    assert r.returncode == 0, r.stderr
+    assert "accelerate-tpu" in r.stdout
+    assert "JAX" in r.stdout
+
+
+def test_estimate_memory_builtin():
+    r = run_cli("estimate-memory", "llama", "--json",
+                "--hidden_size", "1024", "--num_layers", "4", "--num_heads", "8",
+                "--vocab_size", "1000")
+    assert r.returncode == 0, r.stderr
+    sizes = json.loads(r.stdout.strip().splitlines()[-1])
+    assert sizes["bfloat16"]["inference_bytes"] * 2 == sizes["float32"]["inference_bytes"]
+    assert sizes["float32"]["adam_training_bytes"] == 4 * sizes["float32"]["inference_bytes"]
+
+
+def test_estimate_memory_checkpoint_dir(tmp_path):
+    np.savez(tmp_path / "model.npz", w=np.zeros((10, 10), np.float32))
+    r = run_cli("estimate-memory", str(tmp_path), "--json")
+    assert r.returncode == 0, r.stderr
+    sizes = json.loads(r.stdout.strip().splitlines()[-1])
+    assert sizes["float32"]["inference_bytes"] == 400
+
+
+def test_merge_weights(tmp_path):
+    # build a sharded safetensors dir in-process (CPU platform via conftest)
+    from accelerate_tpu.checkpointing import save_model
+
+    params = {"a": {"w": np.ones((64, 64), np.float32)},
+              "b": {"w": np.full((32,), 7.0, np.float32)}}
+    shard_dir = tmp_path / "shards"
+    written = save_model(params, str(shard_dir), max_shard_size="10KB")
+    assert len(written) > 1  # actually sharded
+    out_dir = tmp_path / "merged"
+    r = run_cli("merge-weights", str(shard_dir), str(out_dir))
+    assert r.returncode == 0, r.stderr
+    from safetensors.numpy import load_file
+
+    merged = load_file(out_dir / "model.safetensors")
+    np.testing.assert_allclose(merged["a/w"], np.ones((64, 64)))
+    np.testing.assert_allclose(merged["b/w"], np.full((32,), 7.0))
+
+
+def test_launch_env_protocol(tmp_path):
+    """launch must write the env-var channel the runtime reads."""
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: v for k, v in os.environ.items()\n"
+        "                  if k.startswith(('ACCELERATE_', 'PARALLELISM_'))}))\n"
+    )
+    r = run_cli("launch", "--cpu", "--num_processes", "4", "--mixed_precision", "bf16",
+                "--dp_shard_size", "2", "--tp_size", "2",
+                "--gradient_accumulation_steps", "3", "--debug", str(probe))
+    assert r.returncode == 0, r.stderr
+    env = json.loads(r.stdout.strip().splitlines()[-1])
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_USE_CPU"] == "true"
+    assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "3"
+    assert env["ACCELERATE_DEBUG_MODE"] == "true"
+    assert env["PARALLELISM_CONFIG_DP_SHARD_SIZE"] == "2"
+    assert env["PARALLELISM_CONFIG_TP_SIZE"] == "2"
+
+
+def test_launch_module_mode(tmp_path):
+    r = run_cli("launch", "--cpu", "-m", "json.tool", "--help")
+    assert r.returncode == 0
+
+
+@pytest.mark.slow
+def test_bundled_test_script():
+    r = run_cli("test", "--cpu", "--num_processes", "8")
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "All tests passed!" in r.stdout
+
+
+def test_launch_no_mesh_flags_emits_no_parallelism_env(tmp_path):
+    """A plain launch must not flip the runtime into FSDP (all-1 mesh = unset)."""
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os, json\n"
+        "print(json.dumps([k for k in os.environ if k.startswith('PARALLELISM_')]))\n"
+    )
+    r = run_cli("launch", "--cpu", str(probe))
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1]) == []
