@@ -84,6 +84,8 @@ class ClusterConfig:
     def load(cls, path: str) -> "ClusterConfig":
         with open(path) as f:
             data = json.load(f) if path.endswith(".json") else yaml.safe_load(f)
+        if data is None:  # empty/comment-only YAML
+            data = {}
         known = {f.name for f in dataclasses.fields(cls)}
         extra = set(data) - known
         if extra:

@@ -186,15 +186,16 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
         inner.append("--debug")
     if args.module:
         inner.append("-m")
-    inner.append(args.training_script)
-    inner.extend(args.training_script_args)
+    script_part = [args.training_script, *args.training_script_args]
     # gcloud sets no rank env; each worker reads its index from the TPU
-    # metadata server (the xla_dist-equivalent rank channel).
+    # metadata server (the xla_dist-equivalent rank channel). --machine_rank
+    # must precede the script positional or REMAINDER swallows it.
     rank_probe = (
         "RANK=$(curl -s -H 'Metadata-Flavor: Google' "
         "http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number); "
     )
-    remote = rank_probe + shlex.join(inner) + " --machine_rank=$RANK"
+    remote = (rank_probe + shlex.join(inner) + " --machine_rank=$RANK "
+              + shlex.join(script_part))
     cmd = [
         "gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg.tpu_name,
         "--worker=all", f"--command={remote}",

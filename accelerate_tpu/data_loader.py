@@ -274,12 +274,25 @@ class IterableDatasetShard:
 
 
 def default_collate(samples: list[Any]):
-    """Stack a list of samples (dicts/tuples/arrays/scalars) into a batch."""
+    """Stack a list of samples (dicts/tuples/arrays/scalars) into a batch.
+
+    Large fixed-shape leaves go through the native C++ memcpy team
+    (``native.parallel_collate`` — the torch-C++-collate equivalent); small or
+    ragged ones use ``np.stack``.
+    """
     first = samples[0]
     if isinstance(first, dict):
         return type(first)({k: default_collate([s[k] for s in samples]) for k in first})
     if isinstance(first, (list, tuple)) and not isinstance(first, str):
         return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    arr0 = np.asarray(first)
+    if arr0.nbytes * len(samples) >= (1 << 20):
+        from .native import is_native_ready, parallel_collate
+
+        # only if the library is already loaded — never compile on the hot path
+        # (DataLoader.__init__ warms the build in the background)
+        if is_native_ready():
+            return parallel_collate(samples)
     return np.stack([np.asarray(s) for s in samples])
 
 
@@ -303,6 +316,11 @@ class DataLoader:
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate
+        # start the (cached after first time) native-library build off-thread
+        # so the first big collate finds it ready instead of compiling inline
+        from .native import warm_build
+
+        warm_build()
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", None)

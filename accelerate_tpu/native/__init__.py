@@ -1,0 +1,264 @@
+"""Native host-side data pipeline (C++ via ctypes).
+
+The reference's hot host paths live in external C++ engines — torch's
+DataLoader worker pool, pinned-memory collation (SURVEY.md §2.3). This package
+is the TPU-native equivalent: ``pipeline.cc`` does record IO, shuffling, and
+batch assembly off the GIL; Python sees numpy arrays ready for
+``jax.device_put``. Everything degrades to a pure-numpy fallback when no
+compiler is available (``is_native_available()`` reports which path is live).
+
+Public surface:
+- ``parallel_collate(samples) -> np.ndarray`` — stack N same-shape samples.
+- ``gather_rows(src, indices) -> np.ndarray`` — shuffled batch gather.
+- ``TokenDataset(path, seq_len, dtype)`` — memory-mapped fixed-length record
+  shard (LM pretraining format).
+- ``NativeDataLoader(dataset, batch_size, ...)`` — threaded prefetching batch
+  iterator over a TokenDataset.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_lib = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("ACCELERATE_TPU_DISABLE_NATIVE", "").lower() in ("1", "true", "yes"):
+        return None
+    from .build import build_library
+
+    path = build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.atpu_abi_version.restype = ctypes.c_int32
+    if lib.atpu_abi_version() != 1:
+        return None
+    lib.atpu_collate.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int32,
+    ]
+    lib.atpu_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.atpu_dataset_open.restype = ctypes.c_void_p
+    lib.atpu_dataset_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.atpu_dataset_len.restype = ctypes.c_int64
+    lib.atpu_dataset_len.argtypes = [ctypes.c_void_p]
+    lib.atpu_dataset_close.argtypes = [ctypes.c_void_p]
+    lib.atpu_loader_new.restype = ctypes.c_void_p
+    lib.atpu_loader_new.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.atpu_loader_num_batches.restype = ctypes.c_int64
+    lib.atpu_loader_num_batches.argtypes = [ctypes.c_void_p]
+    lib.atpu_loader_next.restype = ctypes.c_int64
+    lib.atpu_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.atpu_loader_next_epoch.argtypes = [ctypes.c_void_p]
+    lib.atpu_loader_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def is_native_available() -> bool:
+    return _load() is not None
+
+
+def is_native_ready() -> bool:
+    """True only if the library is already loaded — never triggers a build.
+    Hot paths (collate) use this so batch 0 never blocks on a g++ compile."""
+    return _lib is not None
+
+
+def warm_build() -> None:
+    """Kick off the (possibly slow) first-time compile on a background thread.
+    Called from DataLoader/Accelerator construction so the library is ready by
+    the time the hot path asks for it."""
+    if _lib_tried:
+        return
+    import threading
+
+    threading.Thread(target=_load, name="atpu-native-build", daemon=True).start()
+
+
+# ------------------------------------------------------------------ collate --
+def parallel_collate(samples: list, num_threads: int = 4) -> np.ndarray:
+    """Stack N same-shape/same-dtype arrays into (N, *shape). Native memcpy
+    team when available; ``np.stack`` otherwise."""
+    first = np.ascontiguousarray(samples[0])
+    lib = _load()
+    if lib is None:
+        return np.stack([np.asarray(s) for s in samples])
+    arrs = [np.ascontiguousarray(s) for s in samples]
+    # native path only for uniform shape AND dtype — mixed dtypes must get
+    # np.stack's type promotion, not a silent cast to samples[0]'s dtype
+    if any(a.shape != first.shape or a.dtype != first.dtype for a in arrs):
+        return np.stack(arrs)
+    out = np.empty((len(arrs),) + first.shape, dtype=first.dtype)
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs]
+    )
+    lib.atpu_collate(ptrs, len(arrs), first.nbytes,
+                     out.ctypes.data_as(ctypes.c_void_p), num_threads)
+    return out
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """``src[indices]`` for 2D+ contiguous src — native strided memcpy."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    lib = _load()
+    # numpy handles empty/negative/out-of-range with proper IndexError
+    # semantics; the native memcpy would read arbitrary memory
+    if lib is None or len(src) == 0 or len(idx) == 0 or idx.min() < 0 or idx.max() >= len(src):
+        return src[idx]
+    row_bytes = src[0].nbytes
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    lib.atpu_gather_rows(src.ctypes.data_as(ctypes.c_void_p),
+                         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                         len(idx), row_bytes, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+# ------------------------------------------------------------------ dataset --
+class TokenDataset:
+    """Memory-mapped shard of fixed-length token records: a flat binary file of
+    ``seq_len`` tokens per record (the standard LM-pretraining pack format).
+
+    Native path mmaps in C++; fallback uses ``np.memmap``.
+    """
+
+    def __init__(self, path: str, seq_len: int, dtype=np.uint16):
+        self.path = path
+        self.seq_len = int(seq_len)
+        self.dtype = np.dtype(dtype)
+        self.record_bytes = self.seq_len * self.dtype.itemsize
+        self._lib = _load()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.atpu_dataset_open(
+                path.encode(), self.record_bytes
+            )
+        if self._handle:
+            self._len = self._lib.atpu_dataset_len(self._handle)
+            self._mm = None
+        else:
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r")
+            self._len = self._mm.shape[0] // self.seq_len
+            self._mm = self._mm[: self._len * self.seq_len].reshape(self._len, self.seq_len)
+
+    def __len__(self) -> int:
+        return int(self._len)
+
+    def _view(self) -> np.ndarray:
+        """Lazy numpy view for random access (native mode mmaps in C++ for the
+        loader but python-side __getitem__ still wants an array view)."""
+        if self._mm is None:
+            mm = np.memmap(self.path, dtype=self.dtype, mode="r")
+            self._mm = mm[: self._len * self.seq_len].reshape(self._len, self.seq_len)
+        return self._mm
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return np.asarray(self._view()[i])
+
+    def close(self):
+        if self._handle and self._lib is not None:
+            self._lib.atpu_dataset_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeDataLoader:
+    """Prefetching batch iterator over a :class:`TokenDataset`.
+
+    Worker threads assemble shuffled batches into a bounded reorder window in
+    C++; iteration yields ``np.ndarray`` of shape ``(batch, seq_len)`` in a
+    deterministic order given ``seed``. Falls back to synchronous numpy
+    assembly without the native library.
+    """
+
+    def __init__(self, dataset: TokenDataset, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = True, num_workers: int = 2,
+                 prefetch_depth: int = 4):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.num_workers = num_workers
+        self.prefetch_depth = prefetch_depth
+        self._lib = _load()
+        self._loader = None
+        self._epoch = 0
+        self._started = False
+        if self._lib is not None and dataset._handle:
+            self._loader = self._lib.atpu_loader_new(
+                dataset._handle, self.batch_size, int(shuffle), seed,
+                int(drop_last), num_workers, prefetch_depth,
+            )
+
+    def __len__(self) -> int:
+        if self._loader:
+            return int(self._lib.atpu_loader_num_batches(self._loader))
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self):
+        # epoch state advances at iterator START, not on generator completion:
+        # an abandoned partially-consumed iterator (e.g. a peek) must not leak
+        # mid-epoch position into the next epoch
+        if self._started:
+            self._epoch += 1
+            if self._loader:
+                self._lib.atpu_loader_next_epoch(self._loader)
+        self._started = True
+        if self._loader:
+            out = np.empty((self.batch_size, self.dataset.seq_len), self.dataset.dtype)
+            for _ in range(len(self)):
+                got = self._lib.atpu_loader_next(
+                    self._loader, out.ctypes.data_as(ctypes.c_void_p)
+                )
+                if got < 0:
+                    break
+                yield out.copy()
+            return
+        # fallback: synchronous numpy
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+        for b in range(len(self)):
+            pos = (np.arange(b * self.batch_size, (b + 1) * self.batch_size)) % n
+            yield gather_rows(np.asarray(self.dataset._view()), order[pos])
+
+    def close(self):
+        if self._loader and self._lib is not None:
+            self._lib.atpu_loader_free(self._loader)
+            self._loader = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,61 @@
+"""Lazy native-library build: compile ``src/pipeline.cc`` with g++ on first use,
+cache the .so next to the package, fall back silently (callers use the
+pure-Python path) when no toolchain is available."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src", "pipeline.cc")
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_LIB = os.path.join(_LIB_DIR, "libatpu_pipeline.so")
+_lock = threading.Lock()
+
+
+def _needs_build() -> bool:
+    if not os.path.isfile(_LIB):
+        return True
+    return os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+
+
+def build_library(verbose: bool = False) -> str | None:
+    """Return the path to the compiled library, building it if stale. None if
+    the build fails (no compiler, sandboxed, …)."""
+    with _lock:
+        if not _needs_build():
+            return _LIB
+        try:
+            os.makedirs(_LIB_DIR, exist_ok=True)
+            # build to a temp name then rename: concurrent importers never see
+            # a half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
+            os.close(fd)
+        except OSError as e:  # read-only install → silent numpy fallback
+            if verbose:
+                print(f"native build unavailable: {e}")
+            return None
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", tmp,
+        ]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+            if res.returncode != 0:
+                if verbose:
+                    print(f"native build failed:\n{res.stderr}")
+                os.unlink(tmp)
+                return None
+            os.replace(tmp, _LIB)
+            return _LIB
+        except (OSError, subprocess.SubprocessError) as e:
+            if verbose:
+                print(f"native build failed: {e}")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None

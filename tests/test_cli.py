@@ -129,3 +129,44 @@ def test_launch_no_mesh_flags_emits_no_parallelism_env(tmp_path):
     r = run_cli("launch", "--cpu", str(probe))
     assert r.returncode == 0, r.stderr
     assert json.loads(r.stdout.strip().splitlines()[-1]) == []
+
+
+def test_empty_config_file_is_defaults(tmp_path):
+    path = tmp_path / "empty.yaml"
+    path.write_text("# nothing here\n")
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    cfg = ClusterConfig.load(str(path))
+    assert cfg.mixed_precision == "bf16"
+
+
+def test_tpu_pod_machine_rank_precedes_script(monkeypatch):
+    """--machine_rank must be injected before the script positional, or argparse
+    REMAINDER swallows it and every worker runs rank 0."""
+    import accelerate_tpu.commands.launch as L
+
+    captured = {}
+
+    def fake_run(cmd, **kw):
+        captured["cmd"] = cmd
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    monkeypatch.setattr(L.subprocess, "run", fake_run)
+    parser = L.launch_command_parser()
+    args = parser.parse_args([
+        "--tpu_pod", "--tpu_name", "t", "--num_machines", "2",
+        "--main_process_ip", "10.0.0.2", "train.py", "--lr", "1e-3",
+    ])
+    L.launch_command(args)
+    remote = next(a for a in captured["cmd"] if a.startswith("--command="))
+    assert "--machine_rank=$RANK train.py" in remote
+    # and the re-parsed inner command assigns the rank to launch, not the script
+    inner = remote.split("; ", 1)[1].replace("$RANK", "3").split()
+    assert inner[:2] == ["accelerate-tpu", "launch"]
+    inner_args = parser.parse_args(inner[2:])
+    assert inner_args.machine_rank == 3
+    assert inner_args.training_script == "train.py"
