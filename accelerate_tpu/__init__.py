@@ -44,6 +44,11 @@ __all__ = [
     "PartialState",
     "PrecisionType",
     "ProjectConfiguration",
+    "QuantizationConfig",
+    "QuantizedArray",
+    "load_and_quantize_model",
+    "quantize_params",
+    "dequantize_params",
 ]
 
 
@@ -89,6 +94,10 @@ def __getattr__(name):
         from .utils import modeling
 
         return getattr(modeling, name)
+    if name in _QUANTIZATION:
+        from .utils import quantization
+
+        return getattr(quantization, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")
 
 
@@ -109,4 +118,11 @@ _MODELING_UTILS = {
     "get_max_memory",
     "infer_auto_device_map",
     "load_checkpoint_in_params",
+}
+_QUANTIZATION = {
+    "QuantizationConfig",
+    "QuantizedArray",
+    "load_and_quantize_model",
+    "quantize_params",
+    "dequantize_params",
 }

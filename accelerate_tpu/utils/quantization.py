@@ -1,0 +1,58 @@
+"""High-level quantized model loading — twin of ``utils/bnb.py``
+(``load_and_quantize_model:44``), built on :mod:`accelerate_tpu.ops.quantization`.
+
+The reference flow is: empty-init → replace nn.Linear with bnb layers → load
+checkpoint shard-by-shard → move to device. Ours: stream the checkpoint into
+the abstract param tree (``load_checkpoint_in_params``), quantize matching
+leaves as they land, leave skip-listed leaves (lm_head/embeddings) dense.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+from ..ops.quantization import (
+    QuantizationConfig,
+    QuantizedArray,
+    dequantize_params,
+    quantize_params,
+    quantized_byte_size,
+)
+
+__all__ = [
+    "QuantizationConfig",
+    "QuantizedArray",
+    "load_and_quantize_model",
+    "quantize_params",
+    "dequantize_params",
+    "quantized_byte_size",
+]
+
+
+def load_and_quantize_model(
+    params_or_template,
+    quantization_config: QuantizationConfig,
+    checkpoint: Optional[str] = None,
+    device_map: Optional[Mapping[str, Any]] = None,
+    offload_folder: Optional[str] = None,
+):
+    """Load (optionally) then quantize a param tree.
+
+    - ``params_or_template``: concrete params, or an abstract tree
+      (``jax.eval_shape`` output) when ``checkpoint`` is given.
+    - ALWAYS returns ``(quantized_params, offload_index)``; the index is ``{}``
+      unless a ``device_map`` spilled leaves to disk (those leaves are ``None``
+      in the tree and resolvable through the index, mirroring
+      ``load_checkpoint_in_params``).
+    """
+    if checkpoint is not None:
+        from .modeling import load_checkpoint_in_params
+
+        params, offload_index = load_checkpoint_in_params(
+            params_or_template, checkpoint, device_map=device_map,
+            offload_folder=offload_folder,
+        )
+    else:
+        params, offload_index = params_or_template, {}
+    quantized = quantize_params(params, quantization_config)
+    return quantized, offload_index or {}

@@ -1,0 +1,244 @@
+"""Quantization tests (reference ``tests/test_quantization.py`` asserts
+memory-footprint reduction, skip-module handling, and generation quality; here:
+round-trip error bounds, footprint, pytree/jit transparency, int8 MXU matmul
+accuracy, quantized end-to-end forward)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.quantization import (
+    QuantizationConfig,
+    QuantizedArray,
+    dequantize_params,
+    int8_dynamic_matmul,
+    quantize,
+    quantize_blockwise_4bit,
+    quantize_blockwise_int8,
+    quantize_int8_matmul_weight,
+    quantize_params,
+    quantized_byte_size,
+)
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape) * scale
+
+
+class TestBlockwise:
+    def test_int8_roundtrip_error(self):
+        w = _rand((128, 256))
+        cfg = QuantizationConfig(load_in_8bit=True, block_size=64)
+        q = quantize(w, cfg)
+        err = jnp.abs(q.dequantize(jnp.float32) - w)
+        # absmax int8: error bounded by scale/2 = absmax/254 per block
+        assert float(err.max()) < float(jnp.abs(w).max()) / 100
+        rel = float(jnp.linalg.norm(err) / jnp.linalg.norm(w))
+        assert rel < 0.01
+
+    def test_nf4_roundtrip_error(self):
+        w = _rand((128, 256))
+        cfg = QuantizationConfig(load_in_4bit=True, quant_type="nf4", block_size=64)
+        q = quantize(w, cfg)
+        rel = float(jnp.linalg.norm(q.dequantize(jnp.float32) - w) / jnp.linalg.norm(w))
+        assert rel < 0.12  # 4-bit: ~8% typical for gaussian weights
+
+    def test_nf4_beats_fp4_on_gaussian(self):
+        w = _rand((256, 256))
+        e = {}
+        for qt in ("nf4", "fp4"):
+            cfg = QuantizationConfig(load_in_4bit=True, quant_type=qt)
+            q = quantize(w, cfg)
+            e[qt] = float(jnp.linalg.norm(q.dequantize(jnp.float32) - w))
+        assert e["nf4"] < e["fp4"]
+
+    def test_non_divisible_block(self):
+        w = _rand((7, 9))  # 63 elems, block 64 → padding path
+        cfg = QuantizationConfig(load_in_8bit=True, block_size=64, min_size=1)
+        q = quantize(w, cfg)
+        assert q.dequantize().shape == (7, 9)
+
+    def test_exact_zero_block(self):
+        codes, scales = quantize_blockwise_int8(jnp.zeros((64,)), 64)
+        assert float(jnp.abs(codes).max()) == 0
+        packed, scales4 = quantize_blockwise_4bit(jnp.zeros((64,)), 64)
+        assert np.isfinite(np.asarray(scales4)).all()
+
+
+class TestQuantizedArray:
+    def test_footprint(self):
+        w = _rand((256, 256))
+        q8 = quantize(w, QuantizationConfig(load_in_8bit=True))
+        q4 = quantize(w, QuantizationConfig(load_in_4bit=True))
+        dense = 256 * 256 * 4
+        assert q8.nbytes_quantized < dense / 3  # int8 + scales < 1/3 fp32
+        assert q4.nbytes_quantized < dense / 6
+
+    def test_jax_array_protocol(self):
+        """x @ q works unchanged — the bnb 'replace linear layer' moment."""
+        w = _rand((64, 32))
+        x = _rand((8, 64), seed=1)
+        q = quantize(w, QuantizationConfig(load_in_8bit=True))
+        out = x @ q
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), atol=0.1, rtol=0.1)
+
+    def test_pytree_through_jit(self):
+        """Quantized leaves cross the jit boundary as int8 — no host dequant."""
+        w = _rand((64, 64))
+        q = quantize(w, QuantizationConfig(load_in_8bit=True))
+
+        @jax.jit
+        def f(q, x):
+            return x @ q
+
+        x = _rand((4, 64), seed=2)
+        out = f(q, x)
+        assert out.shape == (4, 64)
+        leaves = jax.tree_util.tree_leaves(q)
+        assert any(l.dtype == jnp.int8 for l in leaves)
+
+
+class TestQuantizeParams:
+    def _params(self):
+        return {
+            "embed": {"embedding": _rand((512, 64))},
+            "layer": {"wq": {"kernel": _rand((64, 64), 1)},
+                      "norm": {"scale": jnp.ones((64,))}},
+            "lm_head": {"kernel": _rand((64, 512), 2)},
+        }
+
+    def test_skip_modules_and_small_leaves(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params(self._params(), cfg)
+        assert isinstance(q["layer"]["wq"]["kernel"], QuantizedArray)
+        assert not isinstance(q["embed"]["embedding"], QuantizedArray)  # skip "embed"
+        assert not isinstance(q["lm_head"]["kernel"], QuantizedArray)   # skip lm_head
+        assert not isinstance(q["layer"]["norm"]["scale"], QuantizedArray)  # small
+
+    def test_dequantize_params_roundtrip(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        p = self._params()
+        d = dequantize_params(quantize_params(p, cfg), jnp.float32)
+        np.testing.assert_allclose(np.asarray(d["layer"]["wq"]["kernel"]),
+                                   np.asarray(p["layer"]["wq"]["kernel"]),
+                                   atol=0.05)
+
+    def test_nothing_quantized_raises(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=10**9)
+        with pytest.raises(ValueError, match="nothing was quantized"):
+            quantize_params(self._params(), cfg)
+
+    def test_byte_size_accounting(self):
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        p = self._params()
+        q = quantize_params(p, cfg)
+        from accelerate_tpu.utils.modeling import total_byte_size
+
+        assert quantized_byte_size(q) < total_byte_size(p)
+
+
+class TestInt8Matmul:
+    def test_kblock_matmul_close_to_dense(self):
+        w = _rand((256, 128))
+        x = _rand((16, 256), seed=3)
+        qw = quantize_int8_matmul_weight(w, block_size=64)
+        out = int8_dynamic_matmul(x, qw, preferred_dtype=jnp.float32)
+        ref = x @ w
+        rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.02
+
+    def test_kblock_dequantize(self):
+        w = _rand((100, 40))  # k not divisible by block
+        qw = quantize_int8_matmul_weight(w, block_size=64)
+        rel = float(jnp.linalg.norm(qw.dequantize(jnp.float32) - w) / jnp.linalg.norm(w))
+        assert rel < 0.01
+
+    def test_fallback_for_flat_layout(self):
+        w = _rand((64, 32))
+        q = quantize(w, QuantizationConfig(load_in_8bit=True))
+        out = int8_dynamic_matmul(_rand((4, 64), 5), q)
+        assert out.shape == (4, 32)
+
+
+class TestEndToEnd:
+    def test_quantized_llama_forward(self):
+        from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
+
+        config = LlamaConfig.tiny()
+        params = init_llama(config, jax.random.PRNGKey(0))
+        ids = np.zeros((2, 16), dtype=np.int32)
+        ref = np.asarray(llama_forward(params, ids, config, attention_impl="xla"),
+                         dtype=np.float32)
+        for kw in ({"load_in_8bit": True}, {"load_in_4bit": True}):
+            cfg = QuantizationConfig(min_size=4096, **kw)
+            qparams = quantize_params(params, cfg)
+            # quantized leaves feed the forward DIRECTLY (stacked layers are
+            # scanned — children slice per layer, __jax_array__ dequantizes)
+            out = llama_forward(qparams, ids, config, attention_impl="xla")
+            jout = jax.jit(
+                lambda p, i: llama_forward(p, i, config, attention_impl="xla")
+            )(qparams, ids)
+            assert out.shape == ref.shape
+            out = np.asarray(out, dtype=np.float32)
+            assert np.isfinite(out).all()
+            rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+            assert rel < (0.1 if kw.get("load_in_8bit") else 0.5)
+            np.testing.assert_allclose(np.asarray(jout, np.float32), out, atol=1e-2)
+
+    def test_load_and_quantize_model(self, tmp_path):
+        from accelerate_tpu.checkpointing import save_model
+        from accelerate_tpu.utils.quantization import load_and_quantize_model
+
+        params = {"blk": {"w": _rand((128, 128))}, "norm": {"s": jnp.ones((8,))}}
+        save_model(params, str(tmp_path))
+        template = jax.eval_shape(lambda: params)
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q, offload_index = load_and_quantize_model(template, cfg, checkpoint=str(tmp_path))
+        assert offload_index == {}
+        assert isinstance(q["blk"]["w"], QuantizedArray)
+        np.testing.assert_allclose(np.asarray(q["blk"]["w"].dequantize(jnp.float32)),
+                                   np.asarray(params["blk"]["w"]), atol=0.05)
+
+
+class TestStackedLeaves:
+    """Stacked per-layer leaves must stay scannable after quantization
+    (lax.scan slices pytree children along dim 0; static shape aux can't follow)."""
+
+    def test_stacked_2d_vector_scan(self):
+        L, D = 4, 2048
+        stacked = {"kern": _rand((L, 64, 64)), "vec": _rand((L, D), seed=9)}
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params({"layers": stacked}, cfg)["layers"]
+        assert isinstance(q["vec"], QuantizedArray)
+
+        def layer(carry, p):
+            return carry + jnp.sum(jnp.asarray(p["vec"])) + jnp.sum(jnp.asarray(p["kern"])), None
+
+        total, _ = jax.lax.scan(layer, jnp.float32(0), q)
+        ref = float(jnp.sum(stacked["vec"]) + jnp.sum(stacked["kern"]))
+        np.testing.assert_allclose(float(total), ref, rtol=0.02)
+
+    def test_stacked_4d_scan_dequant(self):
+        L = 3
+        w = _rand((L, 8, 16, 33))  # per-layer 4224 elems, not block-multiple
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params({"w": w}, cfg)["w"]
+
+        def layer(carry, p):
+            return carry, p["w"].dequantize(jnp.float32)
+
+        _, per_layer = jax.lax.scan(layer, 0, {"w": q})
+        np.testing.assert_allclose(np.asarray(per_layer), np.asarray(w), atol=0.05)
+
+    def test_none_and_host_leaves_pass_through(self):
+        import numpy as onp
+
+        params = {"a": {"w": _rand((128, 128))}, "disk": {"w": None},
+                  "host": {"w": onp.zeros((8, 8), onp.float32)}}
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params(params, cfg)
+        assert q["disk"]["w"] is None
+        assert isinstance(q["host"]["w"], onp.ndarray)  # untouched, not device_put
+        assert isinstance(q["a"]["w"], QuantizedArray)
