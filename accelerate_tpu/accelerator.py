@@ -210,21 +210,27 @@ class Accelerator:
         ``_prepare_one:1395``): params pytrees get shardings assigned and are
         placed on the mesh; optax transforms become :class:`AcceleratedOptimizer`
         with state sharded like the params; dataloaders are resharded."""
-        results = []
+        _todo = object()
+        results = [_todo] * len(args)
         params_seen = None
-        for obj in args:
-            if _is_dataloader(obj):
-                results.append(self.prepare_data_loader(obj))
-            elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
-                results.append(self.prepare_optimizer(obj))
-            elif isinstance(obj, AcceleratedScheduler):
-                results.append(self.prepare_scheduler(obj))
-            elif _is_param_pytree(obj):
+        # models first regardless of argument order: optimizer preparation can
+        # depend on the registered params (fp8 meta partitioning, state sharding)
+        for i, obj in enumerate(args):
+            if _is_param_pytree(obj):
                 prepared = self.prepare_model(obj, shard_rules=shard_rules)
                 params_seen = prepared
-                results.append(prepared)
+                results[i] = prepared
+        for i, obj in enumerate(args):
+            if results[i] is not _todo:
+                continue
+            if _is_dataloader(obj):
+                results[i] = self.prepare_data_loader(obj)
+            elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
+                results[i] = self.prepare_optimizer(obj)
+            elif isinstance(obj, AcceleratedScheduler):
+                results[i] = self.prepare_scheduler(obj)
             else:
-                results.append(obj)
+                results[i] = obj
         # late-bind optimizer state sharding to the prepared params
         if params_seen is not None:
             for opt in self._optimizers:
@@ -246,6 +252,15 @@ class Accelerator:
 
     def prepare_optimizer(self, optimizer) -> AcceleratedOptimizer:
         if not isinstance(optimizer, AcceleratedOptimizer):
+            # fp8 models carry delayed-scaling meta in the param tree; partition
+            # the optimizer so meta leaves are replaced by their updated
+            # histories instead of being "optimized" (reference: TE recipe wrap,
+            # utils/transformer_engine.py apply_fp8_autowrap)
+            if self.mixed_precision == PrecisionType.FP8 and self._models:
+                from .ops.fp8 import has_fp8_meta, make_fp8_optimizer
+
+                if has_fp8_meta(self._models[-1]):
+                    optimizer = make_fp8_optimizer(optimizer, self._models[-1])
             optimizer = AcceleratedOptimizer(
                 optimizer, accumulation_steps=self.gradient_accumulation_steps
             )

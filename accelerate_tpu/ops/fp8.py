@@ -1,0 +1,222 @@
+"""FP8 training: delayed-scaling fp8 matmul (e4m3 forward / e5m2 backward).
+
+Reference surface: the fp8 recipe stack — ``utils/transformer_engine.py``
+(``apply_fp8_autowrap:186``), ``utils/ao.py`` (``convert_model_to_fp8_ao``),
+``AORecipeKwargs``/``TERecipeKwargs`` (``utils/dataclasses.py:311/359``) —
+all thin shims over CUDA engines.
+
+TPU redesign: XLA lowers ``dot_general`` on ``float8_e4m3fn``/``float8_e5m2``
+operands natively, so the whole recipe is expressible in-graph:
+
+- **Delayed scaling** (TE semantics): each tensor role (input / weight / grad)
+  keeps an amax history; the quantization scale for step N comes from the
+  history of steps < N, so quantize-and-dot needs no extra pass over the data.
+- **State threading** (the functional twist): the backward pass is where grad
+  amax is observed, but a ``custom_vjp`` can't side-effect. Following the
+  established JAX fp8 pattern, the meta (scales/histories) is passed as a
+  *differentiable input* whose "cotangent" IS the updated meta; an optax
+  partition (:func:`make_fp8_optimizer`) applies ``new - old`` as the update
+  for meta leaves, so the standard ``params = params + updates`` step installs
+  the fresh histories while real params get the real optimizer.
+
+Use :func:`fp8_dense_init` / :func:`fp8_dense_apply` for a drop-in linear, or
+:func:`fp8_dot` directly inside a model.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+META_KEY = "fp8_meta"  # param-tree key marking fp8 state leaves
+
+
+@dataclass(frozen=True)
+class FP8Recipe:
+    """Twin of ``TERecipeKwargs`` (``utils/dataclasses.py:359``)."""
+
+    margin: int = 0
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"  # "max" | "most_recent"
+    # HYBRID: e4m3 for fwd tensors (x, w), e5m2 for grads — the TE default
+    fp8_format: str = "HYBRID"
+
+    def __post_init__(self):
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError(f"unknown amax_compute_algo {self.amax_compute_algo!r}")
+        if self.fp8_format not in ("HYBRID", "E4M3"):
+            raise ValueError(f"unknown fp8_format {self.fp8_format!r}")
+
+    @property
+    def grad_dtype(self):
+        return jnp.float8_e5m2 if self.fp8_format == "HYBRID" else jnp.float8_e4m3fn
+
+    @property
+    def grad_max(self) -> float:
+        return E5M2_MAX if self.fp8_format == "HYBRID" else E4M3_MAX
+
+
+def init_fp8_meta(recipe: FP8Recipe = FP8Recipe()) -> dict:
+    """Fresh per-dot-site meta: one amax history per tensor role."""
+    h = recipe.amax_history_len
+    return {
+        "x_hist": jnp.zeros((h,), jnp.float32),
+        "w_hist": jnp.zeros((h,), jnp.float32),
+        "g_hist": jnp.zeros((h,), jnp.float32),
+    }
+
+
+def _scale_from_history(hist, fp8_max: float, recipe: FP8Recipe):
+    amax = jnp.max(hist) if recipe.amax_compute_algo == "max" else hist[0]
+    safe = jnp.where(amax > 0, amax, fp8_max)
+    return (fp8_max / safe) * (2.0 ** -recipe.margin)
+
+
+def _quantize(x, scale, fp8_max: float, dtype):
+    scaled = x.astype(jnp.float32) * scale
+    return jnp.clip(scaled, -fp8_max, fp8_max).astype(dtype)
+
+
+def _push(hist, amax):
+    return jnp.concatenate([amax[None].astype(jnp.float32), hist[:-1]])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fp8_dot(x, w, meta, recipe: FP8Recipe = FP8Recipe()):
+    """``x @ w`` computed in fp8 with delayed scaling.
+
+    x: (..., k); w: (k, n); meta: :func:`init_fp8_meta` leaves. Differentiate
+    through (x, w, meta) — meta's cotangent is its UPDATED value (see module
+    docstring); train with :func:`make_fp8_optimizer` so it lands in params.
+    """
+    out, _ = _fp8_dot_fwd(x, w, meta, recipe)
+    return out
+
+
+def _fp8_dot_fwd(x, w, meta, recipe: FP8Recipe):
+    sx = _scale_from_history(meta["x_hist"], E4M3_MAX, recipe)
+    sw = _scale_from_history(meta["w_hist"], E4M3_MAX, recipe)
+    qx = _quantize(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _quantize(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+    x2 = qx.reshape(-1, x.shape[-1])
+    out = jax.lax.dot_general(
+        x2, qw, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) / (sx * sw)
+    out = out.reshape(*x.shape[:-1], w.shape[-1]).astype(x.dtype)
+    # zero-size sentinels carry the primal dtypes through the residual pytree
+    # (raw dtypes aren't valid jax types)
+    res = (qx, qw, sx, sw, meta, jnp.max(jnp.abs(x)), jnp.max(jnp.abs(w)),
+           jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+    return out, res
+
+
+def _fp8_dot_bwd(recipe: FP8Recipe, res, g):
+    qx, qw, sx, sw, meta, amax_x, amax_w, x_sent, w_sent = res
+    x_dtype, w_dtype = x_sent.dtype, w_sent.dtype
+    sg = _scale_from_history(meta["g_hist"], recipe.grad_max, recipe)
+    qg = _quantize(g, sg, recipe.grad_max, recipe.grad_dtype)
+    g2 = qg.reshape(-1, qg.shape[-1])
+    x2 = qx.reshape(-1, qx.shape[-1])
+    # dx = g @ w.T ; dw = x.T @ g — both in fp8 with f32 accumulation
+    dx = jax.lax.dot_general(
+        g2, qw, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) / (sg * sw)
+    dw = jax.lax.dot_general(
+        x2, g2, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) / (sx * sg)
+    dx = dx.reshape(qx.shape).astype(x_dtype)
+    dw = dw.astype(w_dtype)
+    # meta cotangent = UPDATED meta (histories rolled with this step's amax)
+    dmeta = {
+        "x_hist": _push(meta["x_hist"], amax_x),
+        "w_hist": _push(meta["w_hist"], amax_w),
+        "g_hist": _push(meta["g_hist"], jnp.max(jnp.abs(g))),
+    }
+    return dx, dw, dmeta
+
+
+fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+# ------------------------------------------------------------ dense helper --
+def fp8_dense_init(key, in_dim: int, out_dim: int,
+                   recipe: FP8Recipe = FP8Recipe(), scale: Optional[float] = None) -> dict:
+    """Params for a drop-in fp8 linear: {"kernel", "bias", META_KEY}."""
+    scale = scale if scale is not None else 1.0 / np.sqrt(in_dim)
+    return {
+        "kernel": jax.random.normal(key, (in_dim, out_dim)) * scale,
+        "bias": jnp.zeros((out_dim,)),
+        META_KEY: init_fp8_meta(recipe),
+    }
+
+
+def fp8_dense_apply(params: dict, x, recipe: FP8Recipe = FP8Recipe()):
+    out = fp8_dot(x, params["kernel"], params[META_KEY], recipe)
+    if "bias" in params:
+        out = out + params["bias"]
+    return out
+
+
+# ----------------------------------------------------- optimizer partition --
+def fp8_param_labels(params):
+    """Label tree for ``optax.multi_transform``: "fp8_meta" under any META_KEY
+    subtree, "default" elsewhere."""
+    def walk(node, in_meta):
+        if isinstance(node, dict):
+            return {k: walk(v, in_meta or k == META_KEY) for k, v in node.items()}
+        return "fp8_meta" if in_meta else "default"
+
+    return walk(params, False)
+
+
+def _meta_replace_transform():
+    """Updates for meta leaves = (new - old), so apply_updates installs the
+    fresh histories delivered as cotangents."""
+    import optax
+
+    def init(params):
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fp8 meta update needs params")
+        updates = jax.tree_util.tree_map(lambda new, old: new - old, grads, params)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_fp8_optimizer(inner, params):
+    """Partition the optimizer: real params get ``inner``, fp8 meta leaves get
+    replace-with-cotangent (see module docstring). ``params`` fixes the tree
+    structure for labeling."""
+    import optax
+
+    labels = fp8_param_labels(params)
+    return optax.multi_transform(
+        {"default": inner, "fp8_meta": _meta_replace_transform()}, labels
+    )
+
+
+def has_fp8_meta(params) -> bool:
+    found = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == META_KEY:
+                    found.append(True)
+                else:
+                    walk(v)
+
+    walk(params)
+    return bool(found)

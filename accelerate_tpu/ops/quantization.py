@@ -78,11 +78,14 @@ def _lead(shape) -> int:
 
 
 def quantize_blockwise_int8(arr, block_size: int = 64):
-    """Absmax int8 per contiguous block of the (per-slice) flattened array →
+    """Absmax int8 per contiguous block of the (per-row) flattened array →
     (codes, scales); scale = absmax/127, codes = round(x/scale) ∈ [-127, 127].
 
-    2D-or-less input → flat 1D codes (bnb storage parity); ndim ≥ 3 → codes
-    shaped (lead, -1) with per-slice blocks.
+    Layout: 1D input → flat 1D codes; ndim ≥ 2 → codes shaped (d0, -1) with
+    blocks contained in each leading-axis slice (this diverges from bnb's flat
+    stream on purpose: the leading axis stays real, so stacked-per-layer
+    leaves slice under ``lax.scan`` and shard along dim 0; the cost is
+    per-slice padding when the slice size is not a block multiple).
     """
     arr = jnp.asarray(arr)
     lead = _lead(arr.shape)
@@ -95,7 +98,7 @@ def quantize_blockwise_int8(arr, block_size: int = 64):
     scales = (absmax / 127.0).astype(jnp.float32)
     codes = jnp.round(blocks / jnp.where(scales > 0, scales, 1.0))
     codes = jnp.clip(codes, -127, 127).astype(jnp.int8)
-    if lead == 1:
+    if arr.ndim < 2:
         return codes.reshape(-1), scales.reshape(-1)
     return codes.reshape(lead, -1), scales.reshape(lead, -1)
 
@@ -134,7 +137,7 @@ def quantize_blockwise_4bit(arr, block_size: int = 64, quant_type: str = "nf4"):
     idx = jnp.argmin(jnp.abs(normed[..., None] - code[None, None, None, :]), axis=-1)
     idx = idx.reshape(lead, -1).astype(jnp.uint8)
     packed = (idx[:, 0::2] << 4) | idx[:, 1::2]
-    if lead == 1:
+    if arr.ndim < 2:
         return packed.reshape(-1), scales.reshape(-1)
     return packed, scales.reshape(lead, -1)
 
@@ -200,14 +203,11 @@ class QuantizedArray:
     def _sliced_shape(self):
         """None for intact leaves; the per-layer shape when ``lax.scan`` has
         sliced the children along the stacked axis (children lose dim 0, the
-        static aux shape can't follow — detected by the actual code count)."""
-        shape = self.shape
-        if _lead(shape) > 1:
-            per_slice = int(np.prod(shape[1:]))
-            padded = -(-per_slice // self.block_size) * self.block_size
-            unit = padded if self.bits == 8 else padded // 2
-            if int(self.codes.size) == unit:
-                return shape[1:]
+        static aux shape can't follow). Detected structurally: ndim ≥ 2 leaves
+        store 2D codes, so 1D codes mean one slice — works for any stack
+        length including L=1."""
+        if len(self.shape) >= 2 and self.codes.ndim == 1:
+            return self.shape[1:]
         return None
 
     def dequantize(self, dtype=None):
@@ -266,30 +266,42 @@ def quantize_params(params, config: QuantizationConfig):
     through (reference ``replace_with_bnb_layers`` replaces nn.Linear modules;
     our params are pytrees so the unit is the leaf).
     """
-    from ..utils.modeling import named_parameters, unflatten_parameters
+    counter = [0]
 
-    flat = named_parameters(params)
-    out = {}
-    quantized = 0
-    for path, leaf in flat.items():
+    def _path_str(path) -> str:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    def _maybe_quantize(path, leaf):
         # inspect WITHOUT converting: offloaded host leaves must not be
         # device_put just to be skipped, and disk-offloaded leaves are None
         if leaf is None:
-            out[path] = None
-            continue
+            return None
         dtype = getattr(leaf, "dtype", None)
         ndim = getattr(leaf, "ndim", 0)
         size = int(getattr(leaf, "size", 0))
-        skip = any(s in path for s in config.skip_modules)
+        skip = any(s in _path_str(path) for s in config.skip_modules)
         is_float = dtype is not None and jnp.issubdtype(dtype, jnp.floating)
         if skip or not is_float or ndim < 2 or size < config.min_size:
-            out[path] = leaf
-        else:
-            out[path] = quantize(jnp.asarray(leaf), config)
-            quantized += 1
-    if quantized == 0:
+            return leaf
+        counter[0] += 1
+        return quantize(jnp.asarray(leaf), config)
+
+    # tree_map preserves the container types (lists/tuples/dicts) exactly —
+    # the result must stay structure-compatible with optimizer/sharding trees
+    out = jax.tree_util.tree_map_with_path(
+        _maybe_quantize, params, is_leaf=lambda x: x is None
+    )
+    if counter[0] == 0:
         raise ValueError("nothing was quantized — check skip_modules/min_size")
-    return unflatten_parameters(out)
+    return out
 
 
 def dequantize_params(params, dtype=None):

@@ -292,12 +292,23 @@ class MixedPrecisionPolicy:
         if self.compute_dtype is None:
             return tree
 
-        def _cast(x):
+        from ..ops.quantization import QuantizedArray
+
+        def _cast(path, x):
+            # quantized leaves (int8 codes + f32 scales) and fp8 delayed-scaling
+            # meta must pass through untouched — casting their f32 scales to
+            # bf16 silently degrades accuracy
+            if isinstance(x, QuantizedArray):
+                return x
+            if any(getattr(k, "key", None) == "fp8_meta" for k in path):
+                return x
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
                 return x.astype(self.compute_dtype)
             return x
 
-        return jax.tree_util.tree_map(_cast, tree)
+        return jax.tree_util.tree_map_with_path(
+            _cast, tree, is_leaf=lambda x: isinstance(x, QuantizedArray)
+        )
 
     def cast_to_param(self, tree):
         import jax

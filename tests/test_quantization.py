@@ -242,3 +242,32 @@ class TestStackedLeaves:
         assert q["disk"]["w"] is None
         assert isinstance(q["host"]["w"], onp.ndarray)  # untouched, not device_put
         assert isinstance(q["a"]["w"], QuantizedArray)
+
+
+class TestStructurePreservation:
+    def test_list_nodes_survive(self):
+        params = {"layers": [_rand((64, 64), 0), _rand((64, 64), 1)]}
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params(params, cfg)
+        assert isinstance(q["layers"], list)  # NOT converted to a dict
+        assert isinstance(q["layers"][0], QuantizedArray)
+
+    def test_single_layer_stack_scans(self):
+        w = {"w": _rand((1, 64, 64))}  # L=1 stacked model
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params(w, cfg)
+
+        def layer(c, p):
+            return c + jnp.sum(jnp.asarray(p["w"])), None
+
+        total, _ = jax.lax.scan(layer, jnp.float32(0), q)
+        np.testing.assert_allclose(float(total), float(jnp.sum(w["w"])), rtol=0.02)
+
+    def test_cast_to_compute_preserves_scales(self):
+        from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+        cfg = QuantizationConfig(load_in_8bit=True, min_size=1024)
+        q = quantize_params({"w": _rand((64, 64))}, cfg)
+        policy = MixedPrecisionPolicy.from_precision("bf16")
+        cast = policy.cast_to_compute(q)
+        assert cast["w"].scales.dtype == jnp.float32  # NOT truncated to bf16
