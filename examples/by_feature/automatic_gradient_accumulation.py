@@ -1,0 +1,51 @@
+"""Feature: automatic gradient accumulation (reference
+``examples/by_feature/automatic_gradient_accumulation.py``): pick the
+accumulation factor from a target GLOBAL batch size and the per-step batch the
+hardware can hold.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/automatic_gradient_accumulation.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator
+
+    per_step = args.batch_size
+    accumulation = max(args.target_global_batch // per_step, 1)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=accumulation,
+        cpu=args.cpu, rng_seed=args.seed,
+    )
+    accelerator.print(
+        f"target global batch {args.target_global_batch} = "
+        f"{per_step} per step x {accumulation} accumulation"
+    )
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    for epoch in range(args.epochs):
+        for batch in setup["train_dl"]:
+            params, opt_state, _ = step(params, opt_state, batch)
+        acc = evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+        accelerator.print(f"epoch {epoch}: accuracy {acc:.3f}")
+    return {"eval_accuracy": acc}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--target-global-batch", type=int, default=128)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

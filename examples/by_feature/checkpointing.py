@@ -1,0 +1,58 @@
+"""Feature: checkpoint/resume (reference ``examples/by_feature/checkpointing.py``):
+save the full resumable state (params, optimizer, scheduler, sampler, RNG) each
+epoch with rotation, then restore and continue.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/checkpointing.py --cpu --output-dir /tmp/ckpt_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    import numpy as np
+
+    from accelerate_tpu import Accelerator, ProjectConfiguration
+
+    pc = ProjectConfiguration(project_dir=args.output_dir,
+                              automatic_checkpoint_naming=True, total_limit=2)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision,
+                              project_config=pc, cpu=args.cpu, rng_seed=args.seed)
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+
+    for epoch in range(args.epochs):
+        for batch in setup["train_dl"]:
+            params, opt_state, _ = step(params, opt_state, batch)
+        path = accelerator.save_state(params=params)
+        accelerator.print(f"epoch {epoch}: checkpoint at {path}")
+    acc_before = evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+
+    # resume: fresh params, restore the last checkpoint, verify parity
+    restored = accelerator.load_state(path, params=params)
+    opt_state = accelerator._optimizers[-1].opt_state
+    acc_after = evaluate_accuracy(accelerator, eval_step, restored, setup["eval_dl"])
+    assert abs(acc_before - acc_after) < 1e-6, (acc_before, acc_after)
+    accelerator.print(f"resume parity OK: accuracy {acc_after:.3f}")
+    # rotation kept at most total_limit checkpoints
+    kept = [d for d in os.listdir(os.path.join(args.output_dir, "checkpoints"))
+            if d.startswith("checkpoint_")]
+    assert len(kept) <= 2, kept
+    return {"eval_accuracy": acc_after}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--output-dir", default="/tmp/accelerate_tpu_ckpt_demo")
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

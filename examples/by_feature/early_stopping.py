@@ -1,0 +1,66 @@
+"""Feature: early stopping across processes (reference
+``examples/by_feature/early_stopping.py``): any process may trip the trigger
+(``set_trigger``); ``check_trigger`` all-reduces the flag so every process
+stops on the same step — no desync hangs.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/early_stopping.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+class EarlyStopper:
+    def __init__(self, patience: int = 2, min_delta: float = 1e-4):
+        self.patience, self.min_delta = patience, min_delta
+        self.best, self.bad = float("inf"), 0
+
+    def should_stop(self, loss: float) -> bool:
+        if loss < self.best - self.min_delta:
+            self.best, self.bad = loss, 0
+            return False
+        self.bad += 1
+        return self.bad >= self.patience
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    stopper = EarlyStopper(patience=args.patience)
+    stopped = False
+    for epoch in range(args.epochs):
+        for batch in setup["train_dl"]:
+            params, opt_state, metrics = step(params, opt_state, batch)
+            if stopper.should_stop(float(metrics["loss"])):
+                accelerator.set_trigger()
+            # collective: either every process breaks here or none does
+            if accelerator.check_trigger():
+                accelerator.print(f"early stop inside epoch {epoch}")
+                stopped = True
+                break
+        if stopped:
+            break
+    acc = evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+    accelerator.print(f"final accuracy {acc:.3f} (stopped={stopped})")
+    return {"eval_accuracy": acc, "stopped": stopped}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--patience", type=int, default=3)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

@@ -1,0 +1,65 @@
+"""Feature: fp8 training (reference ``examples/torch_native_parallelism/
+fsdp2_fp8.py`` + the fp8 benchmark scripts): e4m3/e5m2 matmuls with TE-style
+delayed scaling, amax histories threaded through the optimizer partition.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/fp8_training.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, maybe_force_cpu
+
+
+def training_function(args):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.ops.fp8 import META_KEY, fp8_dense_apply, fp8_dense_init
+
+    accelerator = Accelerator(mixed_precision="fp8", cpu=args.cpu, rng_seed=args.seed)
+    k = jax.random.split(jax.random.PRNGKey(args.seed), 3)
+    params = {
+        "l1": fp8_dense_init(k[0], 64, 256),
+        "l2": fp8_dense_init(k[1], 256, 64),
+        "head": fp8_dense_init(k[2], 64, 1),
+    }
+    optimizer = optax.adam(args.lr)
+    params, optimizer = accelerator.prepare(params, optimizer)
+
+    W = jax.random.normal(jax.random.PRNGKey(7), (64, 1))
+    X = jax.random.normal(jax.random.PRNGKey(8), (args.train_size, 64))
+    Y = X @ W
+
+    def loss_fn(p, batch):
+        h = jax.nn.gelu(fp8_dense_apply(p["l1"], batch["x"]))
+        h = jax.nn.gelu(fp8_dense_apply(p["l2"], h))
+        return jnp.mean((fp8_dense_apply(p["head"], h) - batch["y"]) ** 2)
+
+    step = accelerator.prepare_train_step(loss_fn, optimizer)
+    opt_state = optimizer.opt_state
+    first = None
+    for i in range(args.steps):
+        params, opt_state, metrics = step(params, opt_state, {"x": X, "y": Y})
+        if first is None:
+            first = float(metrics["loss"])
+    final = float(metrics["loss"])
+    hist = params["l1"][META_KEY]["x_hist"]
+    accelerator.print(f"fp8 loss {first:.4f} -> {final:.4f}; "
+                      f"amax history head {float(hist[0]):.3f}")
+    return {"first_loss": first, "final_loss": final}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--steps", type=int, default=100)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

@@ -1,0 +1,44 @@
+"""Feature: LocalSGD (reference ``examples/by_feature/local_sgd.py``): run K
+purely-local optimizer steps per process, then average params — cuts collective
+traffic Kx for communication-bound links (DCN cross-slice, not ICI).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/local_sgd.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator, LocalSGD
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    with LocalSGD(accelerator, model=params,
+                  local_sgd_steps=args.local_sgd_steps) as local_sgd:
+        for epoch in range(args.epochs):
+            for batch in setup["train_dl"]:
+                params, opt_state, _ = step(params, opt_state, batch)
+                params = local_sgd.step(params)  # averages every K steps
+    acc = evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+    accelerator.print(f"accuracy {acc:.3f} (K={args.local_sgd_steps})")
+    return {"eval_accuracy": acc}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--local-sgd-steps", type=int, default=8)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

@@ -1,0 +1,50 @@
+"""Feature: OOM-safe batch-size search (reference ``examples/by_feature/memory.py``):
+``find_executable_batch_size`` retries the decorated function with a halved
+batch size whenever the device OOMs (XLA RESOURCE_EXHAUSTED), clearing caches
+between attempts.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/memory.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator, find_executable_batch_size
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def inner_training_loop(batch_size):
+        accelerator.print(f"trying batch_size={batch_size}")
+        accelerator.free_memory()
+        args.batch_size = batch_size
+        setup = build_tiny_bert_setup(args, accelerator)
+        step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+        eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+        params, opt_state = setup["params"], setup["optimizer"].opt_state
+        for epoch in range(args.epochs):
+            for batch in setup["train_dl"]:
+                params, opt_state, _ = step(params, opt_state, batch)
+        return evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+
+    acc = inner_training_loop()
+    accelerator.print(f"accuracy {acc:.3f} at batch_size={args.batch_size}")
+    return {"eval_accuracy": acc, "batch_size": args.batch_size}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--starting-batch-size", type=int, default=64)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

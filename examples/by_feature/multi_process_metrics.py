@@ -1,0 +1,56 @@
+"""Feature: correct distributed eval metrics (reference
+``examples/by_feature/multi_process_metrics.py``): ``gather_for_metrics``
+concatenates per-process shards AND drops the duplicated samples the
+even-batches wraparound added in the final batch, so metric counts match the
+true dataset size.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/multi_process_metrics.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, maybe_force_cpu
+
+
+def training_function(args):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu import Accelerator
+
+    # eval size deliberately NOT divisible by batch*devices → wraparound occurs
+    args.eval_size = args.eval_size + 7
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    for batch in setup["train_dl"]:
+        params, opt_state, _ = step(params, opt_state, batch)
+
+    all_preds, all_labels = [], []
+    for batch in setup["eval_dl"]:
+        preds = jnp.argmax(eval_step(params, batch), axis=-1)
+        g = accelerator.gather_for_metrics({"p": preds, "l": batch["labels"]})
+        all_preds.append(np.asarray(g["p"]))
+        all_labels.append(np.asarray(g["l"]))
+    preds, labels = np.concatenate(all_preds), np.concatenate(all_labels)
+    # the trimmed count equals the true dataset size — no duplicate samples
+    assert preds.shape[0] == args.eval_size, (preds.shape, args.eval_size)
+    acc = float(np.mean(preds == labels))
+    accelerator.print(f"eval on exactly {preds.shape[0]} samples: accuracy {acc:.3f}")
+    return {"eval_accuracy": acc, "eval_count": int(preds.shape[0])}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

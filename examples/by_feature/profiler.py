@@ -1,0 +1,46 @@
+"""Feature: profiling (reference ``examples/by_feature/profiler.py``):
+``accelerator.profile()`` wraps ``jax.profiler`` — the trace dir holds
+TensorBoard/Perfetto-compatible xplane dumps of the steps inside the context.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/profiler.py --cpu --trace-dir /tmp/trace_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, maybe_force_cpu
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+
+    it = iter(setup["train_dl"])
+    # warm up OUTSIDE the profile window so the trace shows steady-state steps,
+    # not the one-time XLA compile
+    params, opt_state, _ = step(params, opt_state, next(it))
+    with accelerator.profile(trace_dir=args.trace_dir):
+        for _ in range(3):
+            params, opt_state, metrics = step(params, opt_state, next(it))
+        float(metrics["loss"])  # force completion inside the window
+    produced = any(os.scandir(args.trace_dir)) if os.path.isdir(args.trace_dir) else False
+    accelerator.print(f"trace written to {args.trace_dir}: {produced}")
+    return {"trace_written": produced}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--trace-dir", default="/tmp/accelerate_tpu_trace_demo")
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

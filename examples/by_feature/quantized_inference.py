@@ -1,0 +1,60 @@
+"""Feature: quantized inference (reference ``utils/bnb.py`` usage): load a
+checkpoint 4-bit/8-bit quantized — weights live in HBM as codes+scales, the
+dequant fuses into each matmul.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/quantized_inference.py --cpu --bits 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, maybe_force_cpu
+
+
+def main_function(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu import QuantizationConfig, load_and_quantize_model
+    from accelerate_tpu.checkpointing import save_model
+    from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
+    from accelerate_tpu.ops.quantization import quantized_byte_size
+    from accelerate_tpu.utils.modeling import total_byte_size
+
+    config = LlamaConfig.tiny()
+    params = init_llama(config, jax.random.PRNGKey(args.seed))
+    with tempfile.TemporaryDirectory() as ckpt:
+        save_model(params, ckpt)
+        template = jax.eval_shape(lambda: params)
+        qcfg = QuantizationConfig(load_in_8bit=args.bits == 8,
+                                  load_in_4bit=args.bits == 4, min_size=4096)
+        qparams, _ = load_and_quantize_model(template, qcfg, checkpoint=ckpt)
+
+    dense_mb = total_byte_size(params) / 1e6
+    quant_mb = quantized_byte_size(qparams) / 1e6
+    print(f"{args.bits}-bit: {dense_mb:.2f} MB dense -> {quant_mb:.2f} MB "
+          f"({dense_mb / quant_mb:.1f}x smaller)")
+
+    ids = np.random.default_rng(0).integers(2, config.vocab_size, (2, 32)).astype(np.int32)
+    fwd = jax.jit(lambda p, i: llama_forward(p, i, config, attention_impl="xla"))
+    ref = llama_forward(params, ids, config, attention_impl="xla")
+    out = fwd(qparams, ids)
+    rel = float(jnp.linalg.norm((out - ref).astype(jnp.float32))
+                / jnp.linalg.norm(ref.astype(jnp.float32)))
+    print(f"logits relative error vs dense: {rel:.4f}")
+    return {"compression": dense_mb / quant_mb, "rel_err": rel}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--bits", type=int, default=4, choices=[4, 8])
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    main_function(args)

@@ -1,0 +1,52 @@
+"""Feature: experiment tracking (reference ``examples/by_feature/tracking.py``):
+``init_trackers`` fans config+metrics out to every enabled tracker (jsonl is
+the always-available file backend; tensorboard/wandb/mlflow activate when
+installed), all main-process-only.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/tracking.py --cpu --project-dir /tmp/track_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              log_with="jsonl", project_dir=args.project_dir,
+                              rng_seed=args.seed)
+    accelerator.init_trackers("tracking_example", config=vars(args))
+    setup = build_tiny_bert_setup(args, accelerator)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    global_step = 0
+    for epoch in range(args.epochs):
+        for batch in setup["train_dl"]:
+            params, opt_state, metrics = step(params, opt_state, batch)
+            global_step += 1
+            if global_step % 10 == 0:
+                accelerator.log({"train_loss": float(metrics["loss"])}, step=global_step)
+        acc = evaluate_accuracy(accelerator, eval_step, params, setup["eval_dl"])
+        accelerator.log({"eval_accuracy": acc}, step=global_step)
+        accelerator.print(f"epoch {epoch}: accuracy {acc:.3f}")
+    accelerator.end_training()
+    log_file = os.path.join(args.project_dir, "tracking_example", "metrics.jsonl")
+    accelerator.print(f"metrics at {log_file}: {os.path.isfile(log_file)}")
+    return {"eval_accuracy": acc}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--project-dir", default="/tmp/accelerate_tpu_track_demo")
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

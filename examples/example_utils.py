@@ -1,0 +1,122 @@
+"""Shared bits for the example scripts (synthetic datasets with the reference
+examples' tensor shapes — no network egress in CI)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class DictDataset:
+    def __init__(self, data: dict):
+        self.data = data
+
+    def __len__(self):
+        return len(next(iter(self.data.values())))
+
+    def __getitem__(self, i):
+        return {k: v[i] for k, v in self.data.items()}
+
+
+def make_synthetic_mrpc(n: int, seq_len: int, vocab: int, seed: int = 0) -> dict:
+    """MRPC-shaped learnable classification (see nlp_example.py)."""
+    rng = np.random.default_rng(seed)
+    half = seq_len // 2
+    ids = rng.integers(10, vocab, size=(n, seq_len), dtype=np.int32)
+    token_type = np.concatenate(
+        [np.zeros((n, half), np.int32), np.ones((n, seq_len - half), np.int32)], axis=1
+    )
+    keywords = rng.integers(2, 10, size=n, dtype=np.int32)
+    labels = (keywords >= 6).astype(np.int32)
+    for pos in (1, 2, 3, 4):
+        ids[:, pos] = keywords
+    ids[:, 0] = 1
+    mask = np.ones((n, seq_len), np.int32)
+    return {"input_ids": ids, "token_type_ids": token_type,
+            "attention_mask": mask, "labels": labels}
+
+
+def make_synthetic_images(n: int, size: int = 32, classes: int = 4, seed: int = 0) -> dict:
+    """Learnable image classification: class = quadrant holding a bright patch."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 0.3, size=(n, size, size, 3)).astype(np.float32)
+    labels = rng.integers(0, classes, size=n, dtype=np.int32)
+    h = size // 2
+    corners = [(0, 0), (0, h), (h, 0), (h, h)]
+    for i in range(n):
+        r, c = corners[labels[i] % 4]
+        x[i, r:r + h, c:c + h, :] += 1.5
+    return {"pixel_values": x, "labels": labels}
+
+
+def add_common_args(parser):
+    parser.add_argument("--mixed-precision", default="bf16",
+                        choices=["no", "fp16", "bf16", "fp8"])
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--train-size", type=int, default=512)
+    parser.add_argument("--eval-size", type=int, default=128)
+    return parser
+
+
+def maybe_force_cpu(args):
+    if getattr(args, "cpu", False):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_tiny_bert_setup(args, accelerator, seq_len: int = 64):
+    """Common scaffold for the by_feature scripts: tiny BERT on synthetic MRPC
+    (the reference's by_feature/* scripts all share the BERT-MRPC training body
+    and differ in ONE feature each)."""
+    import dataclasses
+
+    import jax
+    import optax
+
+    from accelerate_tpu import DataLoader
+    from accelerate_tpu.models import (
+        BertConfig, bert_forward, bert_loss, bert_shard_rules, init_bert,
+    )
+
+    config = dataclasses.replace(BertConfig.tiny(), max_seq_len=seq_len, num_labels=2)
+    train = make_synthetic_mrpc(args.train_size, seq_len, config.vocab_size, seed=0)
+    test = make_synthetic_mrpc(args.eval_size, seq_len, config.vocab_size, seed=1)
+    params = init_bert(config, jax.random.PRNGKey(args.seed))
+    optimizer = optax.adam(args.lr)
+    train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
+                          shuffle=True, seed=args.seed)
+    eval_dl = DataLoader(DictDataset(test), batch_size=args.batch_size)
+    params, optimizer, train_dl, eval_dl = accelerator.prepare(
+        params, optimizer, train_dl, eval_dl, shard_rules=bert_shard_rules()
+    )
+    return {
+        "config": config,
+        "params": params,
+        "optimizer": optimizer,
+        "train_dl": train_dl,
+        "eval_dl": eval_dl,
+        "loss_fn": lambda p, b: bert_loss(p, b, config),
+        "logits_fn": lambda p, b: bert_forward(p, b, config),
+    }
+
+
+def evaluate_accuracy(accelerator, eval_step, params, eval_dl) -> float:
+    import jax.numpy as jnp
+    import numpy as np
+
+    correct = total = 0
+    for batch in eval_dl:
+        preds = jnp.argmax(eval_step(params, batch), axis=-1)
+        g = accelerator.gather_for_metrics({"p": preds, "l": batch["labels"]})
+        correct += int(np.sum(np.asarray(g["p"]) == np.asarray(g["l"])))
+        total += int(np.asarray(g["l"]).shape[0])
+    return correct / max(total, 1)

@@ -1,0 +1,76 @@
+"""ND parallelism: DP-replicate × FSDP × TP (× CP) on one mesh (reference
+``examples/torch_native_parallelism/nd_parallel.py``: ParallelismConfig builds
+the device mesh; here the same axes drive PartitionSpecs and XLA's collectives).
+
+Run (2x2x2): XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/nd_parallel.py --cpu --dp-replicate 2 --fsdp 2 --tp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from example_utils import DictDataset, add_common_args, make_synthetic_mrpc, maybe_force_cpu
+
+
+def training_function(args):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader, ParallelismConfig
+    from accelerate_tpu.models import (
+        BertConfig, bert_forward, bert_loss, bert_shard_rules, init_bert,
+    )
+
+    pc = ParallelismConfig(
+        dp_replicate_size=args.dp_replicate,
+        dp_shard_size=args.fsdp,
+        tp_size=args.tp,
+        cp_size=args.cp,
+    )
+    accelerator = Accelerator(mixed_precision=args.mixed_precision,
+                              parallelism_config=pc, cpu=args.cpu, rng_seed=args.seed)
+    accelerator.print(f"mesh: {accelerator.mesh}")
+    accelerator.print(
+        f"ranks: dp_replicate={accelerator.parallelism_config.dp_replicate_size} "
+        f"dp_shard={accelerator.parallelism_config.dp_shard_size} "
+        f"tp={accelerator.parallelism_config.tp_size} cp={accelerator.parallelism_config.cp_size}"
+    )
+
+    config = dataclasses.replace(BertConfig.tiny(), max_seq_len=args.seq_len, num_labels=2)
+    train = make_synthetic_mrpc(args.train_size, args.seq_len, config.vocab_size, seed=0)
+    params = init_bert(config, jax.random.PRNGKey(args.seed))
+    optimizer = optax.adam(args.lr)
+    train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
+                          shuffle=True, seed=args.seed)
+    # bert_shard_rules: embeddings/attention/mlp sharded over tp, everything
+    # (additionally) over dp_shard — the ND composition is just the spec table
+    params, optimizer, train_dl = accelerator.prepare(
+        params, optimizer, train_dl, shard_rules=bert_shard_rules()
+    )
+    step = accelerator.prepare_train_step(lambda p, b: bert_loss(p, b, config), optimizer)
+    opt_state = optimizer.opt_state
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            params, opt_state, metrics = step(params, opt_state, batch)
+        accelerator.print(f"epoch {epoch}: loss {float(metrics['loss']):.4f}")
+    return {"train_loss": float(metrics["loss"])}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--dp-replicate", type=int, default=1)
+    parser.add_argument("--fsdp", type=int, default=2)
+    parser.add_argument("--tp", type=int, default=2)
+    parser.add_argument("--cp", type=int, default=1)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

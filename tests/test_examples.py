@@ -1,0 +1,175 @@
+"""Run every example end-to-end on the 8-device CPU mesh (reference
+``tests/test_examples.py`` runs each ``examples/by_feature/*`` script). Runs
+in-process with tiny sizes so the whole suite stays fast; each example's
+``training_function``/``main_function`` returns metrics we can assert on."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+sys.path.insert(0, EXAMPLES)
+
+
+def load_example(relpath):
+    path = os.path.join(EXAMPLES, relpath)
+    name = "example_" + relpath.replace("/", "_").removesuffix(".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tiny_args(mod, relpath, **overrides):
+    import argparse
+
+    from example_utils import add_common_args
+
+    parser = add_common_args(argparse.ArgumentParser())
+    defaults = {
+        "batch_size": 16, "epochs": 1, "train_size": 128, "eval_size": 64,
+        "cpu": False,  # conftest already forces the cpu platform
+    }
+    defaults.update(overrides)
+    ns, _ = parser.parse_known_args([])
+    for k, v in defaults.items():
+        setattr(ns, k, v)
+    return ns
+
+
+class TestCoreExamples:
+    def test_nlp_example(self):
+        mod = load_example("nlp_example.py")
+        ns = tiny_args(mod, "nlp_example.py")
+        ns.seq_len, ns.model_size, ns.lr = 64, "tiny", 1e-3
+        ns.gradient_accumulation_steps, ns.project_dir = 1, None
+        ns.dp, ns.fsdp, ns.tp = 0, 0, 1
+        ns.epochs = 2
+        out = mod.training_function(ns)
+        assert out["eval_accuracy"] > 0.4
+
+    def test_cv_example(self):
+        mod = load_example("cv_example.py")
+        ns = tiny_args(mod, "cv_example.py", epochs=3)
+        out = mod.training_function(ns)
+        assert out["eval_accuracy"] > 0.5  # quadrant task is easy
+
+    def test_complete_nlp_example_with_resume(self, tmp_path):
+        mod = load_example("complete_nlp_example.py")
+        ns = tiny_args(mod, "complete_nlp_example.py", epochs=1)
+        ns.seq_len, ns.gradient_accumulation_steps = 64, 1
+        ns.project_dir = str(tmp_path)
+        ns.with_tracking, ns.checkpointing_steps = True, "epoch"
+        ns.resume_from_checkpoint, ns.early_stopping_patience = None, 0
+        out = mod.training_function(ns)
+        assert "eval_accuracy" in out
+        ckpt = os.path.join(str(tmp_path), "checkpoints", "checkpoint_0")
+        assert os.path.isdir(ckpt)
+        # resume from it
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        ns2 = tiny_args(mod, "complete_nlp_example.py", epochs=2)
+        ns2.seq_len, ns2.gradient_accumulation_steps = 64, 1
+        ns2.project_dir = str(tmp_path / "resumed")
+        ns2.with_tracking, ns2.checkpointing_steps = False, None
+        ns2.resume_from_checkpoint, ns2.early_stopping_patience = ckpt, 0
+        out2 = mod.training_function(ns2)
+        assert "eval_accuracy" in out2
+
+    def test_nd_parallel(self):
+        mod = load_example("nd_parallel.py")
+        ns = tiny_args(mod, "nd_parallel.py")
+        ns.seq_len, ns.dp_replicate, ns.fsdp, ns.tp, ns.cp = 64, 2, 2, 2, 1
+        out = mod.training_function(ns)
+        assert out["train_loss"] < 1.0
+
+
+class TestByFeature:
+    def _run(self, relpath, **overrides):
+        mod = load_example(relpath)
+        ns = tiny_args(mod, relpath, **overrides)
+        return mod, ns
+
+    def test_gradient_accumulation(self):
+        mod, ns = self._run("by_feature/gradient_accumulation.py")
+        ns.gradient_accumulation_steps = 2
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_automatic_gradient_accumulation(self):
+        mod, ns = self._run("by_feature/automatic_gradient_accumulation.py")
+        ns.target_global_batch = 64
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_checkpointing(self, tmp_path):
+        mod, ns = self._run("by_feature/checkpointing.py", epochs=2)
+        ns.output_dir = str(tmp_path)
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_early_stopping(self):
+        mod, ns = self._run("by_feature/early_stopping.py", epochs=3)
+        ns.patience = 1  # trip quickly
+        out = mod.training_function(ns)
+        assert "eval_accuracy" in out
+
+    def test_local_sgd(self):
+        mod, ns = self._run("by_feature/local_sgd.py")
+        ns.local_sgd_steps = 4
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_memory(self):
+        mod, ns = self._run("by_feature/memory.py")
+        ns.starting_batch_size = 32
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_multi_process_metrics(self):
+        mod, ns = self._run("by_feature/multi_process_metrics.py")
+        out = mod.training_function(ns)
+        assert out["eval_count"] == ns.eval_size
+
+    def test_profiler(self, tmp_path):
+        mod, ns = self._run("by_feature/profiler.py")
+        ns.trace_dir = str(tmp_path / "trace")
+        out = mod.training_function(ns)
+        assert out["trace_written"]
+
+    def test_tracking(self, tmp_path):
+        mod, ns = self._run("by_feature/tracking.py")
+        ns.project_dir = str(tmp_path)
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_fsdp_training(self):
+        mod, ns = self._run("by_feature/fsdp_training.py")
+        ns.fsdp = 0
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_fp8_training(self):
+        mod, ns = self._run("by_feature/fp8_training.py")
+        ns.steps = 30
+        out = mod.training_function(ns)
+        assert out["final_loss"] < out["first_loss"]
+
+    def test_quantized_inference(self):
+        mod, ns = self._run("by_feature/quantized_inference.py")
+        ns.bits = 8
+        out = mod.main_function(ns)
+        assert out["compression"] > 2.0
+        assert out["rel_err"] < 0.1
+
+
+class TestInferenceExamples:
+    def test_distributed_inference(self):
+        mod = load_example("inference/distributed_inference.py")
+        ns = tiny_args(mod, "inference/distributed_inference.py")
+        out = mod.main_function(ns)
+        assert out["num_results"] == 37
+
+    def test_pipeline_inference(self):
+        mod = load_example("inference/pipeline_inference.py")
+        ns = tiny_args(mod, "inference/pipeline_inference.py")
+        ns.pp, ns.microbatches = 4, 4
+        out = mod.main_function(ns)
+        assert out["max_err"] < 1e-4
