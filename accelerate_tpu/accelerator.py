@@ -74,6 +74,33 @@ def _is_dataloader(obj) -> bool:
     return hasattr(obj, "__iter__") and hasattr(obj, "dataset")
 
 
+def _is_torch_module(obj) -> bool:
+    try:
+        import torch.nn as nn
+
+        return isinstance(obj, nn.Module)
+    except ImportError:
+        return False
+
+
+def _is_torch_optimizer(obj) -> bool:
+    try:
+        import torch.optim as topt
+
+        return isinstance(obj, topt.Optimizer)
+    except ImportError:
+        return False
+
+
+def _is_torch_lr_scheduler(obj) -> bool:
+    try:
+        import torch.optim.lr_scheduler as tls
+
+        return isinstance(obj, (tls.LRScheduler, tls.ReduceLROnPlateau))
+    except (ImportError, AttributeError):
+        return False
+
+
 class Accelerator:
     """Single facade for mesh setup, precision, prepare, train-step compilation,
     metrics gathering and checkpointing (reference ``accelerator.py:183``)."""
@@ -213,21 +240,28 @@ class Accelerator:
         _todo = object()
         results = [_todo] * len(args)
         params_seen = None
+        bridged_module = None
         # models first regardless of argument order: optimizer preparation can
         # depend on the registered params (fp8 meta partitioning, state sharding)
         for i, obj in enumerate(args):
-            if _is_param_pytree(obj):
+            if _is_torch_module(obj):
+                prepared = self.prepare_torch_module(obj, shard_rules=shard_rules)
+                bridged_module = prepared
+                results[i] = prepared
+            elif _is_param_pytree(obj):
                 prepared = self.prepare_model(obj, shard_rules=shard_rules)
                 params_seen = prepared
                 results[i] = prepared
         for i, obj in enumerate(args):
             if results[i] is not _todo:
                 continue
-            if _is_dataloader(obj):
+            if _is_torch_optimizer(obj):
+                results[i] = self.prepare_torch_optimizer(obj, module=bridged_module)
+            elif _is_dataloader(obj):
                 results[i] = self.prepare_data_loader(obj)
             elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
                 results[i] = self.prepare_optimizer(obj)
-            elif isinstance(obj, AcceleratedScheduler):
+            elif isinstance(obj, AcceleratedScheduler) or _is_torch_lr_scheduler(obj):
                 results[i] = self.prepare_scheduler(obj)
             else:
                 results[i] = obj
@@ -249,6 +283,72 @@ class Accelerator:
         self._param_specs = specs
         self._models.append(params)
         return params
+
+    def prepare_torch_module(self, module, shard_rules: Optional[ShardingRules] = None):
+        """Bridge a ``torch.nn.Module`` onto the TPU-native core (the north-star
+        interop path; reference ``prepare_model:1735``): params are DLPack-shared
+        into a jax pytree, sharded on the mesh like any native model, and the
+        module's math is fx-lowered to one jitted fused step on first call."""
+        from .bridge import BridgedModule
+
+        bridged = BridgedModule(module, accelerator=self)
+        rules = shard_rules or self.shard_rules
+        specs = infer_param_specs(bridged.params, self.mesh, self.parallelism_config, rules)
+        if self.device_placement:
+            from jax.sharding import PartitionSpec
+
+            bridged.params, specs = shard_params(bridged.params, self.mesh, specs)
+            bridged.buffers, _ = shard_params(  # buffers stay replicated
+                bridged.buffers, self.mesh, {k: PartitionSpec() for k in bridged.buffers}
+            )
+        self._param_specs = specs
+        self._models.append(bridged)
+        return bridged
+
+    def prepare_torch_optimizer(self, torch_optimizer, module=None):
+        """Wrap a ``torch.optim.Optimizer`` as a :class:`BridgedOptimizer` over
+        the bridged module's params (reference ``prepare_optimizer:2685``; the
+        torch optimizer becomes the live hyperparameter source so torch LR
+        schedulers keep working)."""
+        from .bridge import BridgedModule, BridgedOptimizer
+
+        if module is None:
+            bridged = [m for m in self._models if isinstance(m, BridgedModule)]
+            if not bridged:
+                raise ValueError(
+                    "prepare the torch nn.Module before (or together with) its optimizer"
+                )
+            module = bridged[-1]
+        optimizer = BridgedOptimizer(torch_optimizer, module)
+        self._optimizers.append(optimizer)
+        return optimizer
+
+    def backward(self, loss, **kwargs):
+        """torch-parity ``accelerator.backward(loss)`` (reference ``:2770``).
+
+        For bridged modules the forward already produced grads (one fused jitted
+        value_and_grad); this moves them into the bridged optimizer's
+        accumulator — several ``backward`` calls before ``optimizer.step()``
+        average, which is exactly torch's gradient-accumulation semantics. For
+        native functional loops use :meth:`prepare_train_step` /
+        :meth:`gradient_fn` instead.
+        """
+        from .bridge import BridgedModule, BridgedOptimizer
+
+        bridged = [m for m in self._models if isinstance(m, BridgedModule)]
+        if not bridged:
+            raise RuntimeError(
+                "accelerator.backward() is the torch-interop path; in native JAX "
+                "loops use prepare_train_step (grads are computed inside the "
+                "compiled step) or gradient_fn for imperative grads"
+            )
+        for model in bridged:
+            grads = model.pop_pending_grads()
+            if grads is None:
+                continue
+            for opt in self._optimizers:
+                if isinstance(opt, BridgedOptimizer) and opt.module is model:
+                    opt.accumulate_grads(grads)
 
     def prepare_optimizer(self, optimizer) -> AcceleratedOptimizer:
         if not isinstance(optimizer, AcceleratedOptimizer):
@@ -333,6 +433,10 @@ class Accelerator:
 
         def _scaled_loss(params, batch, loss_scale):
             compute_params = policy.cast_to_compute(params)
+            # float batch leaves must match the compute dtype too: ops with
+            # strict operand-dtype equality (lax.conv_general_dilated) would
+            # otherwise fail on bf16-params × f32-activations
+            batch = policy.cast_to_compute(batch)
             out = loss_fn(compute_params, batch)
             loss, aux = (out if has_aux else (out, None))
             loss = loss.astype(jnp.float32)
@@ -402,10 +506,29 @@ class Accelerator:
                 metrics["loss_scale"] = new_scale
                 return new_params, (new_inner, new_scale, new_growth), metrics
 
-        if self.jit_config.disable_jit:
-            return train_step
-        donate = self.jit_config.donate_params if donate is None else donate
-        return jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+        if not self.jit_config.disable_jit:
+            donate = self.jit_config.donate_params if donate is None else donate
+            train_step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+
+        # The functional loop threads (params, opt_state) locally while
+        # ``save_state`` reads ``optimizer.opt_state`` / ``self._models`` — and
+        # donation deletes the stale buffers those references point at. Write the
+        # fresh values back after every call so checkpointing always sees live
+        # state (the reference's optimizer mutates in place; this is the
+        # functional equivalent).
+        # with several prepared models we cannot know which one this step trains,
+        # so only track when unambiguous (callers with multiple models pass
+        # params/opt_state to save_state explicitly)
+        model_slot = 0 if len(self._models) == 1 else None
+
+        def step_and_track(params, opt_state, batch):
+            new_params, new_opt_state, metrics = train_step(params, opt_state, batch)
+            optimizer.opt_state = new_opt_state
+            if model_slot is not None:
+                self._models[model_slot] = new_params
+            return new_params, new_opt_state, metrics
+
+        return step_and_track
 
     def prepare_eval_step(self, eval_fn: Callable) -> Callable:
         """Compile an eval/forward step with the compute-dtype policy applied."""
@@ -414,7 +537,7 @@ class Accelerator:
         policy = self.state.mixed_precision_policy
 
         def eval_step(params, batch):
-            return eval_fn(policy.cast_to_compute(params), batch)
+            return eval_fn(policy.cast_to_compute(params), policy.cast_to_compute(batch))
 
         return eval_step if self.jit_config.disable_jit else jax.jit(eval_step)
 
@@ -430,7 +553,7 @@ class Accelerator:
         policy = self.state.mixed_precision_policy
 
         def _loss(params, batch):
-            out = loss_fn(policy.cast_to_compute(params), batch)
+            out = loss_fn(policy.cast_to_compute(params), policy.cast_to_compute(batch))
             return out if not has_aux else out
 
         return jax.value_and_grad(_loss, has_aux=has_aux)
@@ -594,15 +717,19 @@ class Accelerator:
                 raise ValueError(f"{obj} lacks state_dict/load_state_dict")
             self._custom_objects.append(obj)
 
-    def save_state(self, output_dir: Optional[str] = None, params=None, **kwargs) -> str:
+    def save_state(self, output_dir: Optional[str] = None, params=None, opt_state=None, **kwargs) -> str:
         from .checkpointing import save_accelerator_state
 
-        return save_accelerator_state(self, output_dir=output_dir, params=params, **kwargs)
+        return save_accelerator_state(
+            self, output_dir=output_dir, params=params, opt_state=opt_state, **kwargs
+        )
 
-    def load_state(self, input_dir: Optional[str] = None, params=None, **kwargs):
+    def load_state(self, input_dir: Optional[str] = None, params=None, opt_state=None, **kwargs):
         from .checkpointing import load_accelerator_state
 
-        return load_accelerator_state(self, input_dir=input_dir, params=params, **kwargs)
+        return load_accelerator_state(
+            self, input_dir=input_dir, params=params, opt_state=opt_state, **kwargs
+        )
 
     def save_model(self, params, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model

@@ -1,0 +1,31 @@
+"""torch-interop bridge: run torch-style training scripts on the TPU-native core.
+
+The reference's north star is that ``examples/nlp_example.py`` — a *torch*
+script built on ``Accelerator.prepare(model, optimizer, dl, scheduler)`` +
+``accelerator.backward(loss)`` (reference ``src/accelerate/accelerator.py:1735
+prepare_model``, ``:2770 backward``) — runs with minimal modification. This
+package provides that:
+
+- :mod:`dlpack` — zero-copy ``torch.Tensor`` ↔ ``jax.Array`` exchange.
+- :mod:`fx_lowering` — ``torch.fx`` graph → pure JAX function. The model's
+  *math* is re-expressed in jnp/lax and compiled by XLA; torch never executes
+  on the hot path.
+- :mod:`module` — :class:`BridgedModule` / :class:`BridgedOptimizer`: the
+  torch-style objects returned by ``prepare`` whose ``model(**batch)`` /
+  ``optimizer.step()`` drive one fused jitted forward+backward under the hood.
+"""
+
+from .dlpack import torch_to_jax, jax_to_torch, module_params_to_jax, write_back_to_module
+from .fx_lowering import lower_module, LoweringError
+from .module import BridgedModule, BridgedOptimizer
+
+__all__ = [
+    "torch_to_jax",
+    "jax_to_torch",
+    "module_params_to_jax",
+    "write_back_to_module",
+    "lower_module",
+    "LoweringError",
+    "BridgedModule",
+    "BridgedOptimizer",
+]

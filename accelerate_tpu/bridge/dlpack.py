@@ -1,0 +1,64 @@
+"""Zero-copy torch ↔ jax array exchange via DLPack.
+
+The reference moves params with ``model.to(device)`` (``accelerator.py:1833``);
+here the torch module's (host) storage is shared into JAX without a copy, then
+``device_put`` with a ``NamedSharding`` is the single H2D hop that also shards
+(the FSDP/TP "wrap" collapsed into placement — SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def torch_to_jax(tensor):
+    """torch.Tensor → jax.Array, zero-copy when host-resident and contiguous."""
+    import jax
+    import numpy as np
+    import torch
+
+    t = tensor.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        # numpy has no bf16; DLPack handles it directly
+        return jax.numpy.asarray(jax.dlpack.from_dlpack(t))
+    try:
+        return jax.dlpack.from_dlpack(t)
+    except Exception:
+        return jax.numpy.asarray(np.asarray(t))
+
+
+def jax_to_torch(array):
+    """jax.Array → torch.Tensor (zero-copy for host arrays, else D2H copy)."""
+    import jax
+    import numpy as np
+    import torch
+
+    array = jax.device_get(array) if not isinstance(array, np.ndarray) else array
+    try:
+        return torch.from_dlpack(array)
+    except Exception:
+        return torch.from_numpy(np.ascontiguousarray(array))
+
+
+def module_params_to_jax(module) -> tuple[dict[str, Any], dict[str, Any]]:
+    """Extract ``(params, buffers)`` flat pytrees (dot-path keyed) from an
+    ``nn.Module``, sharing storage via DLPack."""
+    params = {name: torch_to_jax(p) for name, p in module.named_parameters()}
+    buffers = {name: torch_to_jax(b) for name, b in module.named_buffers()}
+    return params, buffers
+
+
+def write_back_to_module(module, params: dict[str, Any]) -> None:
+    """Copy (possibly sharded) jax params back into the torch module in-place —
+    used before torch-side save/export (reference ``get_state_dict:3947``)."""
+    import torch
+
+    torch_params = dict(module.named_parameters())
+    with torch.no_grad():
+        for name, value in params.items():
+            if name in torch_params:
+                torch_params[name].copy_(jax_to_torch(value).to(torch_params[name].dtype))

@@ -124,10 +124,16 @@ def save_accelerator_state(
     accelerator,
     output_dir: Optional[str] = None,
     params=None,
+    opt_state=None,
     save_on_each_node: bool = False,
 ) -> str:
     """Save everything needed to resume (reference ``save_accelerator_state:62``
-    driven by ``accelerator.save_state:3529``)."""
+    driven by ``accelerator.save_state:3529``).
+
+    ``params``/``opt_state`` let functional training loops pass their live
+    threaded values explicitly; without them the values written back by the
+    prepared train step (``Accelerator.prepare_train_step``) are used.
+    """
     from .utils.random import capture_rng_states
 
     output_dir = _checkpoint_dir(accelerator, output_dir)
@@ -136,14 +142,17 @@ def save_accelerator_state(
         os.makedirs(output_dir, exist_ok=True)
 
     models = [params] if params is not None else accelerator._models
+    opt_states = (
+        [opt_state] if opt_state is not None else [o.opt_state for o in accelerator._optimizers]
+    )
     if is_writer:
         for i, model in enumerate(models):
             suffix = "" if i == 0 else f"_{i}"
             save_pytree(model, os.path.join(output_dir, f"{MODEL_NAME}{suffix}.npz"))
-        for i, opt in enumerate(accelerator._optimizers):
-            if opt.opt_state is not None:
+        for i, state in enumerate(opt_states):
+            if state is not None:
                 suffix = "" if i == 0 else f"_{i}"
-                save_pytree(opt.opt_state, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.npz"))
+                save_pytree(state, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.npz"))
         for i, sched in enumerate(accelerator._schedulers):
             suffix = "" if i == 0 else f"_{i}"
             with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{suffix}.json"), "w") as f:
@@ -174,10 +183,13 @@ def load_accelerator_state(
     accelerator,
     input_dir: Optional[str] = None,
     params=None,
+    opt_state=None,
     load_kwargs: Optional[dict] = None,
 ):
     """Mirror of :func:`save_accelerator_state` (reference
-    ``load_accelerator_state:180``). Returns restored params (pytree or list)."""
+    ``load_accelerator_state:180``). Returns restored params (pytree or list);
+    with ``opt_state`` given as a live template, returns
+    ``(params, opt_state)`` so functional loops can rethread both."""
     from .utils.random import restore_rng_states
 
     if input_dir is None:
@@ -196,11 +208,19 @@ def load_accelerator_state(
         suffix = "" if i == 0 else f"_{i}"
         flat = load_flat(os.path.join(input_dir, f"{MODEL_NAME}{suffix}.npz"))
         restored.append(unflatten_into(model, flat))
-    for i, opt in enumerate(accelerator._optimizers):
-        suffix = "" if i == 0 else f"_{i}"
-        path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}.npz")
-        if os.path.exists(path) and opt.opt_state is not None:
-            opt.opt_state = unflatten_into(opt.opt_state, load_flat(path))
+    restored_opt_state = None
+    if opt_state is not None:
+        path = os.path.join(input_dir, f"{OPTIMIZER_NAME}.npz")
+        if os.path.exists(path):
+            restored_opt_state = unflatten_into(opt_state, load_flat(path))
+            if accelerator._optimizers:
+                accelerator._optimizers[0].opt_state = restored_opt_state
+    else:
+        for i, opt in enumerate(accelerator._optimizers):
+            suffix = "" if i == 0 else f"_{i}"
+            path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}.npz")
+            if os.path.exists(path) and opt.opt_state is not None:
+                opt.opt_state = unflatten_into(opt.opt_state, load_flat(path))
     for i, sched in enumerate(accelerator._schedulers):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{SCHEDULER_NAME}{suffix}.json")
@@ -235,9 +255,9 @@ def load_accelerator_state(
 
     logger.info(f"loaded state from {input_dir}")
     if params is not None:
-        return restored[0]
+        return (restored[0], restored_opt_state) if opt_state is not None else restored[0]
     accelerator._models = restored
-    return restored
+    return (restored, restored_opt_state) if opt_state is not None else restored
 
 
 def _save_custom(obj, path: str) -> None:

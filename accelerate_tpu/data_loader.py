@@ -600,6 +600,10 @@ class DataLoaderShard:
             # resume-skip applies to the first (resumed) epoch only (reference
             # skip_first_batches returns a one-shot skipping loader, :1375)
             self.skip_batches = 0
+            if self.end_of_dataloader:
+                # a checkpoint taken after a COMPLETED epoch must resume at the
+                # next epoch's first batch, not skip a full epoch's worth
+                self._batches_seen = 0
 
     def _process(self, batch):
         batch = _to_numpy_batch(batch)

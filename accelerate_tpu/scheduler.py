@@ -8,15 +8,19 @@ semantics when ``split_batches=False`` — advances ``num_processes``× per call
 schedules written for single-device step counts stay correct at the same
 *sample* budget.
 
-In optax, a schedule is a pure ``step -> lr`` function that the optimizer chain
-evaluates on its internal count, so the compiled train-step path needs no
-scheduler object at all. This wrapper exists for the imperative/parity API:
-tracking ``get_last_lr`` and checkpointing the step counter.
+Two underlying kinds are supported:
+
+- an **optax schedule** (pure ``step -> lr`` fn): the compiled train-step path
+  evaluates it internally, so this wrapper only tracks ``get_last_lr`` and the
+  checkpointable step counter;
+- a **torch-style scheduler object** (has ``.step()``; e.g. the lr_scheduler a
+  torch-interop script built over its torch optimizer): we advance it so the
+  bridged optimizer observes the updated ``param_groups[...]["lr"]``.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 from .state import GradientState
 
@@ -24,13 +28,16 @@ from .state import GradientState
 class AcceleratedScheduler:
     def __init__(
         self,
-        schedule_fn: Callable[[int], float],  # optax schedule
+        schedule_fn: Union[Callable[[int], float], object],
         optimizer=None,
         step_with_optimizer: bool = True,
         split_batches: bool = False,
         num_processes: Optional[int] = None,
     ):
-        self.schedule_fn = schedule_fn
+        # a torch-style scheduler is an object with .step(); an optax schedule
+        # is a plain callable step->lr
+        self.scheduler = schedule_fn if hasattr(schedule_fn, "step") else None
+        self.schedule_fn = None if self.scheduler is not None else schedule_fn
         self.optimizer = optimizer
         self.step_with_optimizer = step_with_optimizer
         self.split_batches = split_batches
@@ -46,27 +53,46 @@ class AcceleratedScheduler:
             num_processes = pc.dp_replicate_size * pc.infer_dp_shard(state.num_devices)
         self.num_processes = num_processes
 
+    def _advance(self, times: int) -> None:
+        self._step_count += times
+        if self.scheduler is not None:
+            for _ in range(times):
+                self.scheduler.step()
+
     def step(self) -> None:
         if not self.step_with_optimizer:
-            self._step_count += 1
+            self._advance(1)
             return
         # never advance on non-boundary accumulation micro-steps (reference :62-65)
         if not self.gradient_state.sync_gradients:
             return
-        if self.split_batches:
-            self._step_count += 1
-        else:
-            self._step_count += self.num_processes
+        self._advance(1 if self.split_batches else self.num_processes)
 
     @property
     def last_lr(self) -> float:
+        if self.scheduler is not None:
+            return float(self.scheduler.get_last_lr()[0])
         return float(self.schedule_fn(self._step_count))
 
     def get_last_lr(self) -> list[float]:
+        if self.scheduler is not None:
+            return list(self.scheduler.get_last_lr())
         return [self.last_lr]
 
     def state_dict(self) -> dict:
-        return {"step_count": self._step_count}
+        state = {"step_count": self._step_count}
+        if self.scheduler is not None and hasattr(self.scheduler, "state_dict"):
+            inner = self.scheduler.state_dict()
+            # keep it JSON-serializable for checkpointing.py
+            state["scheduler"] = {
+                k: v for k, v in inner.items() if isinstance(v, (int, float, str, bool, list, type(None)))
+            }
+        return state
 
     def load_state_dict(self, state: dict) -> None:
         self._step_count = state["step_count"]
+        if self.scheduler is not None and "scheduler" in state and hasattr(self.scheduler, "load_state_dict"):
+            try:
+                self.scheduler.load_state_dict(state["scheduler"])
+            except Exception:  # partial snapshot (non-JSON fields dropped at save)
+                pass

@@ -45,6 +45,31 @@ def _is_tensorlike(x) -> bool:
     return _is_jax_array(x) or isinstance(x, np.ndarray)
 
 
+def _is_foreign_tensor(x) -> bool:
+    """torch tensors / bridge _TensorViews — accepted at op boundaries so
+    torch-interop scripts can call gather_for_metrics etc. unmodified."""
+    if type(x).__name__ == "_TensorView" and hasattr(x, "array"):
+        return True
+    try:
+        import sys
+
+        torch = sys.modules.get("torch")
+        return torch is not None and isinstance(x, torch.Tensor)
+    except Exception:
+        return False
+
+
+def _normalize_foreign(tree):
+    """Convert foreign leaves (torch / _TensorView) to jax/numpy arrays."""
+
+    def _conv(x):
+        if type(x).__name__ == "_TensorView":
+            return x.array
+        return x.detach().cpu().numpy()
+
+    return recursively_apply(_conv, tree, test_type=_is_foreign_tensor)
+
+
 def recursively_apply(
     func: Callable,
     data: Any,
@@ -127,6 +152,7 @@ def gather(tree):
     - global sharded ``jax.Array`` → resharded to fully-replicated (ICI allgather)
     - host-local numpy (multi-process) → ``process_allgather`` concat along dim 0
     """
+    tree = _normalize_foreign(tree)
     state = PartialState()
 
     def _gather(x):
@@ -236,13 +262,14 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
 
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
-    return recursively_apply(_reduce, tree)
+    return recursively_apply(_reduce, _normalize_foreign(tree))
 
 
 def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
     """Pad array leaves to the max size along ``dim`` across processes
     (reference ``pad_across_processes:632``). Needed before ``gather`` when
     per-process batch sizes differ."""
+    tree = _normalize_foreign(tree)
     state = PartialState()
 
     def _pad(x):

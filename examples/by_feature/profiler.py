@@ -25,10 +25,15 @@ def training_function(args):
     step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
     params, opt_state = setup["params"], setup["optimizer"].opt_state
 
-    it = iter(setup["train_dl"])
+    def batches():
+        # cycle epochs so short dataloaders still feed every profiled step
+        while True:
+            yield from setup["train_dl"]
+
+    it = batches()
     # warm up OUTSIDE the profile window so the trace shows steady-state steps,
     # not the one-time XLA compile
-    params, opt_state, _ = step(params, opt_state, next(it))
+    params, opt_state, metrics = step(params, opt_state, next(it))
     with accelerator.profile(trace_dir=args.trace_dir):
         for _ in range(3):
             params, opt_state, metrics = step(params, opt_state, next(it))
