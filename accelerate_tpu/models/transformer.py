@@ -142,6 +142,36 @@ def init_llama(config: LlamaConfig, key) -> dict:
     return params
 
 
+def _activation_spec(mesh, *logical):
+    """PartitionSpec from logical dim names, dropping axes absent from the mesh.
+    ``logical`` entries: None, an axis name, or a tuple of axis names."""
+    from jax.sharding import PartitionSpec
+
+    def _present(axis):
+        if axis is None:
+            return None
+        if isinstance(axis, (tuple, list)):
+            kept = tuple(a for a in axis if mesh.shape.get(a, 1) > 1)
+            return kept if kept else None
+        return axis if mesh.shape.get(axis, 1) > 1 else None
+
+    return PartitionSpec(*(_present(ax) for ax in logical))
+
+
+def _constrain(x, mesh, *logical):
+    """Explicit activation sharding (maxtext-style): without these annotations
+    GSPMD may pick conflicting intermediate shardings around the embedding
+    gather / layer scan and fall back to replicate-then-reshard ("involuntary
+    full rematerialization")."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _activation_spec(mesh, *logical))
+    )
+
+
 def llama_forward(
     params: dict,
     input_ids: jax.Array,  # [B, S]
@@ -149,12 +179,21 @@ def llama_forward(
     attention_impl: str = "auto",
     attention_fn=None,
     remat: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """Return logits [B, S, vocab]. ``attention_fn`` overrides the attention op
-    (ring attention for CP plugs in here)."""
+    (ring attention for CP plugs in here); ``mesh`` enables explicit activation
+    sharding constraints (batch over dp axes, seq over cp)."""
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
-    h = params["embed_tokens"]["embedding"][input_ids]
+    _batch_axes = ("dp_replicate", "dp_shard")
+    # FSDP shards the table's embedding dim at rest; gather it for compute
+    # (classic FSDP all-gather-on-use) or the lookup output inherits a D-dim
+    # sharding that conflicts with the (batch, seq) activation layout and
+    # GSPMD falls back to full rematerialization
+    table = _constrain(params["embed_tokens"]["embedding"], mesh, "tp", None)
+    h = table[input_ids]
+    h = _constrain(h, mesh, _batch_axes, "cp", None)
     B, S, D = h.shape
 
     def layer(h, layer_params):
@@ -169,10 +208,12 @@ def llama_forward(
         else:
             attn = dot_product_attention(q, k, v, causal=True, impl=attention_impl)
         h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
+        h = _constrain(h, mesh, _batch_axes, "cp", None)
         x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
         gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
         up = x @ layer_params["w3"]["kernel"]
         h = h + (gate * up) @ layer_params["w2"]["kernel"]
+        h = _constrain(h, mesh, _batch_axes, "cp", None)
         return h, None
 
     if remat:
@@ -180,27 +221,35 @@ def llama_forward(
     h, _ = jax.lax.scan(layer, h, params["layers"])
     h = rms_norm(h, params["final_norm"]["scale"], config.norm_eps)
     if config.tie_embeddings:
-        return h @ params["embed_tokens"]["embedding"].T
-    return h @ params["lm_head"]["kernel"]
+        logits = h @ params["embed_tokens"]["embedding"].T
+    else:
+        logits = h @ params["lm_head"]["kernel"]
+    return _constrain(logits, mesh, _batch_axes, "cp", "tp")
 
 
 def llama_loss(params: dict, batch: dict, config: LlamaConfig, **fwd_kwargs) -> jax.Array:
     """Next-token cross entropy. ``batch``: input_ids [B, S] (labels shifted
     internally), optional loss_mask [B, S].
 
-    The forward runs on the FULL sequence and logits are shifted afterwards, so
-    the attention sequence length stays divisible by cp/sp shard sizes (a
-    pre-forward ``ids[:, :-1]`` would break the seq sharding)."""
+    The forward runs on the FULL sequence and targets come from a
+    shape-preserving ``roll`` (a cheap ppermute along cp on the ICI) with the
+    final position masked out — a ``[:, :-1]``/``[:, 1:]`` slice pair would
+    change the sequence extent and force GSPMD to replicate-then-reshard every
+    activation crossing the shift ("involuntary full rematerialization")."""
     ids = batch["input_ids"]
-    logits = llama_forward(params, ids, config, **fwd_kwargs)[:, :-1]
-    targets = ids[:, 1:]
+    seq_len = ids.shape[1]
+    logits = llama_forward(params, ids, config, **fwd_kwargs)
+    targets = jnp.roll(ids, shift=-1, axis=1)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]  # [B, S]
+    # position S-1 has no next token; its rolled target is position 0 — mask it
+    valid = jnp.broadcast_to(
+        (jnp.arange(seq_len) < seq_len - 1).astype(jnp.float32)[None, :], nll.shape
+    )
     mask = batch.get("loss_mask")
     if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        valid = valid * jnp.roll(mask, shift=-1, axis=1).astype(jnp.float32)
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 def llama_shard_rules():
