@@ -1,0 +1,243 @@
+"""Multi-process assertion script — run N copies under the launcher env protocol
+(``ACCELERATE_COORDINATOR_ADDRESS``/``ACCELERATE_NUM_PROCESSES``/
+``ACCELERATE_PROCESS_ID``) to prove the real multi-host code paths: process
+rendezvous, host-level collectives, per-host data loading with global-array
+assembly, dispatcher broadcast, training, checkpoint round-trip.
+
+Behavioral model: the reference's bundled in-process assert script
+(``/root/reference/src/accelerate/test_utils/scripts/test_script.py`` —
+rng sync ``:169``, DL preparation ``:187/:247``, ``training_check:449``,
+gather_for_metrics ``:623``), redesigned for the SPMD runtime: every process
+asserts on every step, and batches are global ``jax.Array``s rather than
+per-rank tensors.
+
+Usage (each process): python -m accelerate_tpu.test_utils.scripts.multihost_script \
+    --scenario all --tmpdir /tmp/xyz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def check_topology(accelerator, expect_n):
+    assert accelerator.num_processes == expect_n, (accelerator.num_processes, expect_n)
+    assert accelerator.process_index == int(os.environ["ACCELERATE_PROCESS_ID"])
+    accelerator.wait_for_everyone()
+
+
+def check_ops(accelerator):
+    import numpy as np
+
+    from accelerate_tpu.utils import operations as ops
+
+    n = accelerator.num_processes
+    me = accelerator.process_index
+
+    objs = ops.gather_object(("proc", me))
+    assert objs == [("proc", i) for i in range(n)], objs
+
+    payload = [{"value": 42, "blob": np.arange(3)}] if me == 0 else [None]
+    out = ops.broadcast_object_list(payload)[0]
+    assert out["value"] == 42 and out["blob"].tolist() == [0, 1, 2], out
+
+    g = ops.gather(np.array([me], dtype=np.int32))
+    assert np.asarray(g).reshape(-1).tolist() == list(range(n)), g
+
+    r = ops.reduce(np.array([float(me + 1)]), "mean")
+    expected = sum(range(1, n + 1)) / n
+    assert abs(float(np.asarray(r).reshape(-1)[0]) - expected) < 1e-6, r
+
+    r = ops.reduce(np.array([float(me + 1)]), "sum")
+    assert abs(float(np.asarray(r).reshape(-1)[0]) - sum(range(1, n + 1))) < 1e-6, r
+
+    padded = ops.pad_across_processes(np.ones((2 + me, 3)), dim=0)
+    assert np.asarray(padded).shape == (2 + (n - 1), 3), np.asarray(padded).shape
+
+    b = ops.broadcast(np.array([me * 100 + 7]))
+    assert int(np.asarray(b).reshape(-1)[0]) == 7, b
+
+    with accelerator.split_between_processes(list(range(2 * n + 1))) as mine:
+        sizes = ops.gather_object(len(mine))
+        assert sum(sizes) == 2 * n + 1, sizes
+
+    accelerator.wait_for_everyone()
+
+
+def _row_dataset(n_rows):
+    import numpy as np
+
+    class DS:
+        def __len__(self):
+            return n_rows
+
+        def __getitem__(self, i):
+            return {"x": np.full((4,), float(i), dtype=np.float32), "idx": np.int32(i)}
+
+    return DS()
+
+
+def check_dataloader(accelerator, dispatch: bool):
+    import numpy as np
+
+    from accelerate_tpu import DataLoader
+
+    n_rows = 16
+    per_proc_bs = 4 // accelerator.num_processes if accelerator.num_processes <= 4 else 1
+    dl = DataLoader(_row_dataset(n_rows), batch_size=per_proc_bs)
+    prepared = accelerator.prepare_data_loader(dl)
+
+    seen = []
+    for batch in prepared:
+        g = accelerator.gather(batch)
+        idx = np.asarray(g["idx"]).reshape(-1)
+        x0 = np.asarray(g["x"])[:, 0]
+        # field consistency: x rows must carry their index value
+        assert np.allclose(x0, idx.astype(np.float32)), (x0, idx)
+        seen.extend(idx.tolist())
+    # full coverage of the dataset, each row exactly once (even division here)
+    assert sorted(seen) == list(range(n_rows)), sorted(seen)
+    accelerator.wait_for_everyone()
+
+
+def check_dispatcher(accelerator):
+    import numpy as np
+
+    from accelerate_tpu import DataLoader
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    n_rows = 8
+    per_proc_bs = max(4 // accelerator.num_processes, 1)
+    dl = DataLoader(_row_dataset(n_rows), batch_size=per_proc_bs)
+    prepared = prepare_data_loader(
+        dl,
+        state=accelerator.state,
+        mesh=accelerator.mesh,
+        parallelism_config=accelerator.parallelism_config,
+        dispatch_batches=True,
+    )
+    seen = []
+    for batch in prepared:
+        g = accelerator.gather(batch)
+        seen.extend(np.asarray(g["idx"]).reshape(-1).tolist())
+    assert sorted(seen) == list(range(n_rows)), sorted(seen)
+    accelerator.wait_for_everyone()
+
+
+def check_training(accelerator, tmpdir: str):
+    """DP training across processes; writes the loss trajectory so the harness
+    can diff process counts (parity = the reference's training_check)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    W_true = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = X @ W_true
+
+    class DS:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return {"x": X[i], "y": Y[i]}
+
+    global_bs = 8
+    per_proc = global_bs // accelerator.num_processes
+    params = {"w": np.zeros((8, 1), np.float32), "b": np.zeros((1,), np.float32)}
+    params, opt, dl = accelerator.prepare(
+        params, optax.sgd(0.1), DataLoader(DS(), batch_size=per_proc)
+    )
+
+    def loss_fn(p, batch):
+        import jax.numpy as jnp
+
+        pred = batch["x"] @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step = accelerator.prepare_train_step(loss_fn, opt, donate=False)
+    opt_state = opt.opt_state
+    losses = []
+    for epoch in range(3):
+        for batch in dl:
+            params, opt_state, metrics = step(params, opt_state, batch)
+            losses.append(float(np.asarray(metrics["loss"])))
+    assert losses[-1] < losses[0], losses
+    # parameters must be identical on every process (they are replicated/global)
+    w_all = accelerator.gather_for_metrics(
+        {"w": np.asarray(jax.device_get(params["w"])).reshape(1, -1)}, use_gather_object=True
+    )
+    if accelerator.is_main_process:
+        with open(os.path.join(tmpdir, f"losses_np{accelerator.num_processes}.json"), "w") as f:
+            json.dump(losses, f)
+    accelerator.wait_for_everyone()
+    return params, opt_state
+
+
+def check_checkpoint(accelerator, tmpdir: str, params, opt_state):
+    import jax
+    import numpy as np
+
+    ckpt = os.path.join(tmpdir, f"ckpt_np{accelerator.num_processes}")
+    accelerator.save_state(ckpt, params=params, opt_state=opt_state)
+    # every process must have written its RNG snapshot
+    rng_file = os.path.join(ckpt, f"random_states_{accelerator.process_index}.pkl")
+    assert os.path.exists(rng_file), rng_file
+
+    zeros = jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(jax.device_get(x))), params)
+    restored = accelerator.load_state(ckpt, params=jax.tree_util.tree_map(
+        lambda z, live: jax.device_put(z, live.sharding) if hasattr(live, "sharding") else z,
+        zeros, params,
+    ))
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(restored[k])), np.asarray(jax.device_get(params[k]))
+        )
+    accelerator.wait_for_everyone()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scenario", default="all")
+    parser.add_argument("--tmpdir", default="/tmp")
+    args = parser.parse_args()
+
+    from accelerate_tpu import Accelerator
+
+    expect_n = int(os.environ.get("ACCELERATE_NUM_PROCESSES", 1))
+    accelerator = Accelerator(mixed_precision="no", rng_seed=0)
+
+    scenarios = args.scenario.split(",") if args.scenario != "all" else [
+        "topology", "ops", "dataloader", "dispatcher", "training", "checkpoint",
+    ]
+    params = opt_state = None
+    for scenario in scenarios:
+        if scenario == "topology":
+            check_topology(accelerator, expect_n)
+        elif scenario == "ops":
+            check_ops(accelerator)
+        elif scenario == "dataloader":
+            check_dataloader(accelerator, dispatch=False)
+        elif scenario == "dispatcher":
+            check_dispatcher(accelerator)
+        elif scenario == "training":
+            params, opt_state = check_training(accelerator, args.tmpdir)
+        elif scenario == "checkpoint":
+            if params is None:
+                params, opt_state = check_training(accelerator, args.tmpdir)
+            check_checkpoint(accelerator, args.tmpdir, params, opt_state)
+        else:
+            raise ValueError(f"unknown scenario {scenario}")
+        print(f"[proc {accelerator.process_index}] scenario {scenario}: OK", flush=True)
+
+    print(f"ALL OK proc={accelerator.process_index}/{accelerator.num_processes}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,74 @@ def assert_allclose_tree(a, b, rtol: float = 1e-5, atol: float = 1e-6, err_msg: 
                                    err_msg=err_msg)
 
 
+def find_free_port() -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def execute_multiprocess(
+    script_args: list[str],
+    num_processes: int = 2,
+    env_extra: dict | None = None,
+    timeout: int = 420,
+    devices_per_process: int = 1,
+) -> list[str]:
+    """Launch ``num_processes`` real OS processes running
+    ``python <script_args>`` under the multi-host env protocol
+    (``ACCELERATE_COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``) with a
+    CPU backend, wait for all, assert rc==0 everywhere, and return each
+    process's combined output.
+
+    The TPU-native twin of the reference's ``execute_subprocess_async``
+    (``test_utils/testing.py:764``) + ``DEFAULT_LAUNCH_COMMAND``: the reference
+    proves cross-process parity by launching its bundled assert scripts; so do
+    we, with ``jax.distributed`` rendezvous instead of torchrun.
+    """
+    import subprocess
+    import sys
+
+    port = find_free_port()
+    procs = []
+    for i in range(num_processes):
+        env = os.environ.copy()
+        env.pop("XLA_FLAGS", None)
+        if devices_per_process > 1:
+            env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices_per_process}"
+        env["ACCELERATE_USE_CPU"] = "true"
+        env["ACCELERATE_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
+        env["ACCELERATE_PROCESS_ID"] = str(i)
+        env.update(env_extra or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, *script_args],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = []
+    failed = []
+    for i, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise RuntimeError(f"multiprocess run timed out after {timeout}s (proc {i})")
+        outputs.append(out)
+        if proc.returncode != 0:
+            failed.append((i, proc.returncode, out))
+    if failed:
+        report = "\n".join(f"--- proc {i} rc={rc} ---\n{out[-4000:]}" for i, rc, out in failed)
+        raise AssertionError(f"{len(failed)}/{num_processes} processes failed:\n{report}")
+    return outputs
+
+
 def memory_allocated_mb() -> float:
     """Best-effort live-buffer accounting on the default backend."""
     import jax

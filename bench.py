@@ -43,16 +43,39 @@ _PEAK_FLOPS = {
 }
 
 
-def _init_backend(retries: int = 4, delay: float = 5.0) -> str:
+import contextlib
+import signal
+
+
+@contextlib.contextmanager
+def _deadline(seconds: int):
+    """Hard wall-clock limit for a blocking call (the axon tunnel has been
+    observed to HANG inside backend init, not just error)."""
+
+    def _raise(signum, frame):
+        raise TimeoutError(f"backend init exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _init_backend(retries: int = 3, delay: float = 5.0, init_timeout: int = 180) -> str:
     """``jax.default_backend()`` with retry: a remote-tunneled TPU backend can be
-    transiently UNAVAILABLE; clear the backend cache and back off between tries."""
+    transiently UNAVAILABLE (or hang); clear the backend cache and back off
+    between tries."""
     import jax
 
     last_err = None
     for attempt in range(retries):
         try:
-            return jax.default_backend()
-        except RuntimeError as e:  # backend init failure
+            with _deadline(init_timeout):
+                return jax.default_backend()
+        except (RuntimeError, TimeoutError) as e:  # backend init failure/hang
             last_err = e
             try:
                 jax._src.xla_bridge._clear_backends()
