@@ -356,13 +356,23 @@ class Accelerator:
             # the optimizer so meta leaves are replaced by their updated
             # histories instead of being "optimized" (reference: TE recipe wrap,
             # utils/transformer_engine.py apply_fp8_autowrap)
+            wrap_accumulation = True
             if self.mixed_precision == PrecisionType.FP8 and self._models:
                 from .ops.fp8 import has_fp8_meta, make_fp8_optimizer
 
                 if has_fp8_meta(self._models[-1]):
-                    optimizer = make_fp8_optimizer(optimizer, self._models[-1])
+                    # accumulation handled INSIDE the partition so meta
+                    # histories roll every micro-step (see make_fp8_optimizer)
+                    optimizer = make_fp8_optimizer(
+                        optimizer,
+                        self._models[-1],
+                        accumulation_steps=self.gradient_accumulation_steps,
+                    )
+                    wrap_accumulation = False
             optimizer = AcceleratedOptimizer(
-                optimizer, accumulation_steps=self.gradient_accumulation_steps
+                optimizer,
+                accumulation_steps=self.gradient_accumulation_steps,
+                wrap_accumulation=wrap_accumulation,
             )
         optimizer.accelerator_state = self.state
         self._optimizers.append(optimizer)

@@ -120,12 +120,43 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
     return output_dir
 
 
+def _should_shard(trees) -> bool:
+    """Auto-detect: shard the save when any leaf is not fully addressable
+    (multi-host sharded state — gathering it to one host is exactly the
+    host-RAM-OOM failure mode the reference avoids with DCP sharded writers)."""
+    import jax
+
+    for tree in trees:
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return True
+    return False
+
+
+def _remove_stale_model_files(output_dir: str) -> None:
+    """Remove previous model/optimizer artifacts (both formats) from a reused
+    checkpoint dir so a fresh save never mixes with leftovers."""
+    pattern = re.compile(
+        rf"({MODEL_NAME}|{OPTIMIZER_NAME})(_\d+)?"
+        r"(\.npz|-shard-\d{5}\.(npz|index\.json))"
+    )
+    for name in os.listdir(output_dir):
+        if pattern.fullmatch(name):
+            try:
+                os.remove(os.path.join(output_dir, name))
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+
 def save_accelerator_state(
     accelerator,
     output_dir: Optional[str] = None,
     params=None,
     opt_state=None,
     save_on_each_node: bool = False,
+    sharded: Optional[bool] = None,
 ) -> str:
     """Save everything needed to resume (reference ``save_accelerator_state:62``
     driven by ``accelerator.save_state:3529``).
@@ -133,6 +164,11 @@ def save_accelerator_state(
     ``params``/``opt_state`` let functional training loops pass their live
     threaded values explicitly; without them the values written back by the
     prepared train step (``Accelerator.prepare_train_step``) are used.
+
+    ``sharded=True`` (auto-on when any leaf spans hosts) writes model/optimizer
+    state as per-process shard files — no host ever materializes the full
+    state (reference ``save_fsdp_model utils/fsdp_utils.py:103`` via
+    ``torch.distributed.checkpoint`` sharded writers).
     """
     from .utils.random import capture_rng_states
 
@@ -145,7 +181,26 @@ def save_accelerator_state(
     opt_states = (
         [opt_state] if opt_state is not None else [o.opt_state for o in accelerator._optimizers]
     )
-    if is_writer:
+    if sharded is None:
+        sharded = _should_shard(list(models) + list(opt_states))
+    # a reused output_dir may hold the OTHER format (or shard files from a
+    # different process count) — load prefers npz and merges every index file,
+    # so stale leftovers would silently restore old state; scrub first
+    if accelerator.is_main_process and os.path.isdir(output_dir):
+        _remove_stale_model_files(output_dir)
+    if sharded:
+        from .sharded_checkpoint import save_sharded_pytree
+
+        os.makedirs(output_dir, exist_ok=True)
+        accelerator.wait_for_everyone()  # dir exists + stale files gone before any proc writes
+        for i, model in enumerate(models):
+            suffix = "" if i == 0 else f"_{i}"
+            save_sharded_pytree(model, output_dir, prefix=f"{MODEL_NAME}{suffix}")
+        for i, state in enumerate(opt_states):
+            if state is not None:
+                suffix = "" if i == 0 else f"_{i}"
+                save_sharded_pytree(state, output_dir, prefix=f"{OPTIMIZER_NAME}{suffix}")
+    elif is_writer:
         for i, model in enumerate(models):
             suffix = "" if i == 0 else f"_{i}"
             save_pytree(model, os.path.join(output_dir, f"{MODEL_NAME}{suffix}.npz"))
@@ -153,6 +208,7 @@ def save_accelerator_state(
             if state is not None:
                 suffix = "" if i == 0 else f"_{i}"
                 save_pytree(state, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.npz"))
+    if is_writer:
         for i, sched in enumerate(accelerator._schedulers):
             suffix = "" if i == 0 else f"_{i}"
             with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{suffix}.json"), "w") as f:
@@ -202,25 +258,37 @@ def load_accelerator_state(
             raise FileNotFoundError(f"no checkpoints under {base}")
         input_dir = os.path.join(base, candidates[-1])
 
+    from .sharded_checkpoint import is_sharded_checkpoint, load_sharded_pytree
+
+    def _load_tree(prefix: str, template):
+        """Dispatch npz vs sharded format; returns None if neither exists."""
+        npz_path = os.path.join(input_dir, f"{prefix}.npz")
+        if os.path.exists(npz_path):
+            return unflatten_into(template, load_flat(npz_path))
+        if is_sharded_checkpoint(input_dir, prefix):
+            return load_sharded_pytree(template, input_dir, prefix)
+        return None
+
     models = [params] if params is not None else accelerator._models
     restored = []
     for i, model in enumerate(models):
         suffix = "" if i == 0 else f"_{i}"
-        flat = load_flat(os.path.join(input_dir, f"{MODEL_NAME}{suffix}.npz"))
-        restored.append(unflatten_into(model, flat))
+        value = _load_tree(f"{MODEL_NAME}{suffix}", model)
+        if value is None:
+            raise FileNotFoundError(f"no {MODEL_NAME}{suffix} checkpoint in {input_dir}")
+        restored.append(value)
     restored_opt_state = None
     if opt_state is not None:
-        path = os.path.join(input_dir, f"{OPTIMIZER_NAME}.npz")
-        if os.path.exists(path):
-            restored_opt_state = unflatten_into(opt_state, load_flat(path))
-            if accelerator._optimizers:
-                accelerator._optimizers[0].opt_state = restored_opt_state
+        restored_opt_state = _load_tree(OPTIMIZER_NAME, opt_state)
+        if restored_opt_state is not None and accelerator._optimizers:
+            accelerator._optimizers[0].opt_state = restored_opt_state
     else:
         for i, opt in enumerate(accelerator._optimizers):
             suffix = "" if i == 0 else f"_{i}"
-            path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}.npz")
-            if os.path.exists(path) and opt.opt_state is not None:
-                opt.opt_state = unflatten_into(opt.opt_state, load_flat(path))
+            if opt.opt_state is not None:
+                value = _load_tree(f"{OPTIMIZER_NAME}{suffix}", opt.opt_state)
+                if value is not None:
+                    opt.opt_state = value
     for i, sched in enumerate(accelerator._schedulers):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{SCHEDULER_NAME}{suffix}.json")

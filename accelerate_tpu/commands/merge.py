@@ -17,6 +17,13 @@ import os
 def _load_flat_dir(path: str) -> dict:
     import numpy as np
 
+    from ..sharded_checkpoint import consolidate_sharded, is_sharded_checkpoint
+
+    if is_sharded_checkpoint(path, "model"):
+        # per-process sharded save_state dir (the reference's DCP-sharded FSDP
+        # checkpoints → merge_fsdp_weights path)
+        return consolidate_sharded(path, "model")
+
     flat: dict = {}
     index = os.path.join(path, "model.safetensors.index.json")
     if os.path.isfile(index):

@@ -148,15 +148,38 @@ class BatchSamplerShard:
         if hasattr(self.batch_sampler, "set_epoch"):
             self.batch_sampler.set_epoch(epoch)
 
+    def _tail_size(self) -> Optional[int]:
+        """Size of the epoch's short tail batch (0 if none), or None when the
+        underlying sampler is not introspectable."""
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if sampler is None or self.batch_size is None:
+            return None
+        try:
+            n = len(sampler)
+        except TypeError:
+            return None
+        return n % self.batch_size
+
     def __len__(self) -> int:
-        if self.split_batches:
-            return len(self.batch_sampler)
+        """Exact yield count for every mode — unlike the reference, whose
+        split-mode ``__len__`` is nominal (``len(batch_sampler)``) and over-
+        counts shards whose slice of the tail batch is empty when
+        ``even_batches=False`` (reference ``data_loader.py:175-196``)."""
         length = len(self.batch_sampler)
-        if self.drop_last or not self.even_batches:
-            return length // self.num_shards + int(
-                not self.drop_last and self.shard_index < length % self.num_shards and not self.even_batches
-            )
-        return math.ceil(length / self.num_shards)
+        if self.split_batches:
+            if self.even_batches or self.drop_last:
+                return length
+            tail = self._tail_size()
+            if tail is None or tail == 0 or self.batch_size is None:
+                return length  # nominal fallback (un-introspectable sampler)
+            # the tail batch only reaches shards whose slice starts before it ends
+            size = self.batch_size // self.num_shards
+            return length - 1 + int(tail > size * self.shard_index)
+        if self.drop_last:
+            return length // self.num_shards
+        if self.even_batches:
+            return math.ceil(length / self.num_shards)
+        return length // self.num_shards + int(self.shard_index < length % self.num_shards)
 
     def __iter__(self) -> Iterator[list[int]]:
         if self.split_batches:
@@ -170,15 +193,24 @@ class BatchSamplerShard:
         for batch in self.batch_sampler:
             if first_batch is None:
                 first_batch = batch
-                size = len(batch) // self.num_shards  # full-size chunk, fixed for the epoch
+                # per-shard slice of the NOMINAL batch size (reference
+                # ``batch_length`` :198) — a short first batch (dataset smaller
+                # than batch_size) must not shrink every shard's slice
+                size = (
+                    self.batch_size // self.num_shards
+                    if self.batch_size
+                    else len(batch) // self.num_shards
+                )
             chunk = batch[self.shard_index * size : (self.shard_index + 1) * size]
             if len(chunk) < size:
                 if not self.even_batches:
                     if chunk:
                         yield chunk
                     continue
-                # wraparound pad from the first batch (reference :206-216)
-                chunk = (chunk + first_batch)[:size]
+                # wraparound pad from the first batch (reference :206-216);
+                # loop because the first batch itself may be shorter than size
+                while len(chunk) < size and first_batch:
+                    chunk = (chunk + first_batch)[:size]
             if chunk:
                 yield chunk
 
@@ -209,10 +241,14 @@ class BatchSamplerShard:
             if self.shard_index < len(window):
                 yield window[self.shard_index]
             return
-        # complete the final round by recycling epoch-start batches (reference :236-262)
+        # complete the final round by recycling epoch-start batches (reference :236-262);
+        # a recycled batch can itself be the short tail (L < num_shards) — top it up
+        pool = [i for b in initial_batches for i in b]
         i = 0
         while len(window) < self.num_shards:
             recycled = initial_batches[i % len(initial_batches)]
+            if full_size and len(recycled) < full_size and pool:
+                recycled = (recycled + pool * math.ceil(full_size / len(pool)))[:full_size]
             window.append(recycled[:full_size] if full_size else recycled)
             i += 1
         yield window[self.shard_index]
@@ -569,25 +605,40 @@ class DataLoaderShard:
 
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
 
+    # -- iteration hooks (overridden by DataLoaderDispatcher) -----------------
+    def _iter_base(self):
+        """Which processes iterate the base loader (dispatcher: main only)."""
+        return iter(self.base_dataloader)
+
+    def _fetch_batch(self, base_iter):
+        """Next per-host batch or ``_NO_BATCH`` (dispatcher: rank-0 broadcast)."""
+        return next(base_iter, _NO_BATCH)
+
+    def _global_batch_size(self, batch) -> int:
+        """Global rows per yielded batch, for the gather_for_metrics remainder
+        (dispatcher batches are global already)."""
+        bs = find_batch_size(batch) or 0
+        if self.assembler is None:
+            return bs
+        return bs * self.assembler.dp_size // len(self.assembler.local_dp_rows())
+
     def __iter__(self):
         self._sync_rng()
         self.gradient_state._add_dataloader(self)
         self.end_of_dataloader = False
         self.remainder = -1
         try:
-            base_iter = iter(self.base_dataloader)
+            base_iter = self._iter_base()
             # prefetch-one-ahead so the last batch is flagged (reference :558-592)
-            current = next(base_iter, _NO_BATCH)
+            current = self._fetch_batch(base_iter)
             n = 0
             while current is not _NO_BATCH:
-                nxt = next(base_iter, _NO_BATCH)
+                nxt = self._fetch_batch(base_iter)
                 if n >= self.skip_batches:
                     if nxt is _NO_BATCH:
                         self.end_of_dataloader = True
                         if self.total_dataset_length is not None:
-                            bs = find_batch_size(current) or 0
-                            dp = self.assembler.dp_size if self.assembler else 1
-                            global_bs = bs * dp // len(self.assembler.local_dp_rows()) if self.assembler else bs
+                            global_bs = self._global_batch_size(current)
                             if global_bs:
                                 self.remainder = self.total_dataset_length % global_bs
                     self._batches_seen = n + 1
@@ -613,29 +664,53 @@ class DataLoaderShard:
 
 
 class DataLoaderDispatcher(DataLoaderShard):
-    """Process 0 reads full batches and the rest receive slices (reference
-    ``DataLoaderDispatcher data_loader.py:704``). Under SPMD single-host this
-    degenerates to :class:`DataLoaderShard` with all dp-rows local; in multi-host it
-    broadcasts the host block before assembly (object broadcast — pays DCN, exists
-    for IterableDataset sources that only rank 0 can read)."""
+    """ONLY process 0 reads the base loader; the rest receive batches over the
+    wire (reference ``DataLoaderDispatcher data_loader.py:704`` —
+    ``_fetch_batches:786`` rank-0 ``next()`` + ``broadcast_object_list``).
+
+    This is the documented contract for sources only rank 0 can read (a local
+    file, a DB cursor): non-main processes never touch ``base_dataloader`` —
+    neither its dataset nor its sampler — and readable sources pay 1× IO
+    instead of N×. Under a single process this degenerates to
+    :class:`DataLoaderShard`."""
+
+    def _iter_base(self):
+        # non-main processes NEVER iterate the base loader
+        state = PartialState()
+        return iter(self.base_dataloader) if state.is_main_process else iter(())
+
+    def _fetch_batch(self, base_iter):
+        """Main process ``next()``s the base loader; every process returns the
+        same global batch, or ``_NO_BATCH`` when exhausted."""
+        state = PartialState()
+        if state.num_processes == 1:
+            batch = next(base_iter, _NO_BATCH)
+            return batch if batch is _NO_BATCH else _to_numpy_batch(batch)
+        from .utils.operations import broadcast_object_list  # pragma: no cover - multihost only
+
+        if state.is_main_process:
+            batch = next(base_iter, _NO_BATCH)
+            payload = [None if batch is _NO_BATCH else _to_numpy_batch(batch)]
+        else:
+            payload = [None]
+        batch = broadcast_object_list(payload)[0]
+        return _NO_BATCH if batch is None else batch
+
+    def _global_batch_size(self, batch) -> int:
+        return find_batch_size(batch) or 0  # dispatch batches are global already
 
     def _process(self, batch):
         state = PartialState()
-        batch = _to_numpy_batch(batch)
-        if state.num_processes > 1:  # pragma: no cover - multihost only
-            from .utils.operations import broadcast_object_list
+        if state.num_processes > 1 and self.assembler is not None:  # pragma: no cover - multihost only
+            # keep only this host's dp-rows of the global batch
+            rows = self.assembler.local_dp_rows()
+            per_row = (find_batch_size(batch) or 0) // self.assembler.dp_size
 
-            payload = [batch] if state.is_main_process else [None]
-            batch = broadcast_object_list(payload)[0]
-            if self.assembler is not None:
-                rows = self.assembler.local_dp_rows()
-                per_row = (find_batch_size(batch) or 0) // self.assembler.dp_size
+            def _slice(x):
+                x = np.asarray(x)
+                return np.concatenate([x[r * per_row : (r + 1) * per_row] for r in rows], axis=0)
 
-                def _slice(x):
-                    x = np.asarray(x)
-                    return np.concatenate([x[r * per_row : (r + 1) * per_row] for r in rows], axis=0)
-
-                batch = recursively_apply(_slice, batch)
+            batch = recursively_apply(_slice, batch)
         if self.assembler is not None:
             return self.assembler.to_global(batch)
         return send_to_device(batch)

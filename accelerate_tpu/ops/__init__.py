@@ -1,1 +1,3 @@
-from .attention import dot_product_attention, make_padding_mask
+from .attention import dot_product_attention, make_padding_mask, segment_mask
+from .flash_attention import flash_attention
+from .fused_attention import fused_attention

@@ -18,6 +18,15 @@ import jax
 import jax.numpy as jnp
 
 
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """[B, S] ids → [B, 1, Sq, Skv] bool allow-mask: attend iff same id.
+
+    The single definition of segment semantics — the xla path and the off-TPU
+    kernel fallbacks all build their masks here so the three impls cannot
+    drift."""
+    return segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+
+
 def _repeat_kv(hidden: jax.Array, n_rep: int) -> jax.Array:
     """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] (GQA broadcast)."""
     if n_rep == 1:
@@ -33,26 +42,64 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,  # [B, 1|H, Sq, Skv] additive or bool
+    segment_ids: Optional[jax.Array] = None,  # [B, S] int; padding = 0
     scale: Optional[float] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """Standard softmax attention, BSHD layout.
 
-    ``impl``: "xla" (einsum, fused by XLA on the MXU), "flash" (Pallas kernel,
-    TPU), "auto" (flash on TPU when shapes allow, else xla).
+    ``impl``:
+
+    - "xla" — einsum, fused by XLA on the MXU. Fastest at short S (the whole
+      score tensor is small enough that XLA's fusions win — measured on v5e).
+    - "flash" — the streaming Pallas flash kernel; wins once S ≳ 512.
+    - "fused" — our single-pass Pallas kernel (``ops.fused_attention``): whole
+      score block in VMEM, one kernel for fwd and one for bwd. Within ~20% of
+      xla at S=128–256; available for fusion-hostile surrounding graphs.
+    - "auto" — picks by measured crossover: flash for S ≥ 512, else xla.
+
+    Masking comes in two forms:
+
+    - ``segment_ids`` — per-token ids for self-attention; position *i* attends
+      *j* iff ``segment_ids[b, i] == segment_ids[b, j]``. Encode padding as id
+      0 and real tokens as id 1 (or document ids for packed sequences). All
+      impls support this form — padded models (BERT + attention_mask) keep
+      kernel paths available.
+    - ``mask`` — arbitrary [B, 1|H, Sq, Skv] bool/additive mask; forces the
+      XLA einsum path (kernels cannot consult a full score-shaped mask).
     """
     if impl == "auto":
-        # the flash kernel has no arbitrary-mask support (causal only)
         impl = "flash" if mask is None and _flash_supported(q, k) else "xla"
-    if impl == "flash":
+    if impl in ("flash", "fused"):
         if mask is not None:
             raise ValueError(
-                "impl='flash' does not support an explicit mask (causal only); "
-                "use impl='xla' for padding masks"
+                f"impl={impl!r} does not support an arbitrary mask (causal and "
+                "segment_ids only); use impl='xla', or express padding/packing "
+                "as segment_ids"
             )
+        if impl == "fused":
+            from .fused_attention import fused_attention, fused_supported
+
+            # off-TPU the wrapper falls back to the einsum path, any shape
+            if jax.default_backend() == "tpu" and not fused_supported(q, k):
+                raise ValueError(
+                    f"impl='fused' does not support shapes q={q.shape} k={k.shape} "
+                    "(needs Sq == Skv, S a multiple of 128 and ≤ 1024, D a "
+                    "multiple of 64 and ≤ 256, q-heads divisible by kv-heads, "
+                    "and the per-row score block within VMEM); use impl='xla'"
+                )
+            return fused_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
+    if segment_ids is not None:
+        seg_mask = segment_mask(segment_ids)
+        if mask is None:
+            mask = seg_mask
+        elif mask.dtype == bool:
+            mask = jnp.logical_and(mask, seg_mask)
+        else:  # additive mask: fold the segment constraint in as -inf
+            mask = mask + jnp.where(seg_mask, 0.0, jnp.finfo(jnp.float32).min)
     return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
 
 
@@ -62,8 +109,14 @@ def _flash_supported(q, k) -> bool:
             return False
     except Exception:
         return False
-    # flash kernel wants seq multiples of its block size
-    return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)
+    # flash kernel wants seq multiples of its block size…
+    if not (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)):
+        return False
+    # …and only wins once the [S,S] score matrix stops fitting comfortably:
+    # measured on v5e (fwd+bwd, H=12, D=64): S=128 xla is 2.2× faster, S=512
+    # break-even, S=2048 flash 1.7× faster. Streaming KV through VMEM only
+    # pays past the crossover.
+    return k.shape[1] >= 512
 
 
 def _xla_attention(q, k, v, *, causal, mask, scale):

@@ -195,13 +195,23 @@ def _meta_replace_transform():
     return optax.GradientTransformation(init, update)
 
 
-def make_fp8_optimizer(inner, params):
+def make_fp8_optimizer(inner, params, accumulation_steps: int = 1):
     """Partition the optimizer: real params get ``inner``, fp8 meta leaves get
     replace-with-cotangent (see module docstring). ``params`` fixes the tree
-    structure for labeling."""
+    structure for labeling.
+
+    Gradient accumulation must wrap ONLY the real-param branch: amax histories
+    are observations, not gradients — averaging/delaying them across micro-steps
+    (MultiSteps around the whole partition) would smear the delayed-scaling
+    statistics. With ``accumulation_steps > 1`` the inner transform is wrapped
+    in ``optax.MultiSteps`` *inside* the partition, so meta leaves roll every
+    micro-step while params update on boundaries only.
+    """
     import optax
 
     labels = fp8_param_labels(params)
+    if accumulation_steps > 1:
+        inner = optax.MultiSteps(inner, every_k_schedule=accumulation_steps)
     return optax.multi_transform(
         {"default": inner, "fp8_meta": _meta_replace_transform()}, labels
     )

@@ -1,0 +1,252 @@
+"""Fused short-sequence attention: a Pallas TPU kernel for the S ≲ 512 regime.
+
+Why this exists: the stock flash kernel
+(``jax.experimental.pallas.ops.tpu.flash_attention``) streams KV through VMEM
+with online softmax — the right shape for long sequences, but at BERT-class
+lengths (S=128–256) its multi-kernel pipeline loses to XLA's einsum by ~2×
+(measured on v5e). The einsum path in turn pays HBM round-trips for the
+[B,H,S,S] f32 score tensor (50 MB/layer at B=64) plus layout shuffles.
+
+At short S the whole per-program score block FITS in VMEM, so this kernel
+fuses QKᵀ → mask → softmax → PV into ONE pass over a (batch-block × all
+heads) tile: scores never touch HBM in either direction, matmuls run in the
+input dtype (bf16 full MXU rate, f32 accumulate), and the grid is just
+B/block_b steps so Mosaic's per-step pipeline overhead is amortized. The
+backward is a second single-pass kernel (recompute scores from the saved
+logsumexp, then dq/dk/dv — the flash recompute trick with no blocking).
+
+Measured reality check (v5e, fwd+bwd, H=12, D=64): this kernel beats the
+stock flash kernel at short S but XLA's fused einsum still edges it out
+(~0.8× at S=128, ~1.0× at S=256) — XLA fuses the mask/softmax into the
+matmul epilogue extremely well at these sizes. It therefore ships as the
+explicit ``impl="fused"`` option rather than the "auto" default: useful when
+the surrounding graph is fusion-hostile, and as the in-tree template for
+bespoke attention variants (the bwd shows the full recompute-from-lse
+pattern in ~40 lines, vs ~600 for the blocked streaming kernel).
+
+Reference surface: flash/SDPA CUDA kernels reached through transformers
+(SURVEY.md §2.3); layout/semantics match ``ops.attention.dot_product_attention``
+(BSHD public API, GQA via in-kernel kv broadcast, segment-id masking, causal).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+# batched matmul helpers over a single flattened (bb·H) batch dim — Mosaic's
+# tpu.matmul supports at most ONE batch dimension
+_BATCH = ((0,), (0,))
+
+
+def _dot_nt(a, b):  # [G, M, K] × [G, N, K] → [G, M, N]
+    return jax.lax.dot_general(a, b, (((2,), (2,)), _BATCH), preferred_element_type=jnp.float32)
+
+
+def _dot_nn(a, b):  # [G, M, K] × [G, K, N] → [G, M, N]
+    return jax.lax.dot_general(a, b, (((2,), (1,)), _BATCH), preferred_element_type=jnp.float32)
+
+
+def _dot_tn(a, b):  # [G, K, M] × [G, K, N] → [G, M, N]
+    return jax.lax.dot_general(a, b, (((1,), (1,)), _BATCH), preferred_element_type=jnp.float32)
+
+
+def _flat_heads(ref, rep):
+    """[bb, Hkv, S, D] block → [bb·Hkv·rep, S, D] with GQA head broadcast
+    (leading-dim reshapes/broadcasts are layout-free in Mosaic)."""
+    x = ref[...]
+    bb, hkv, s, d = x.shape
+    if rep > 1:
+        x = jnp.broadcast_to(x[:, :, None], (bb, hkv, rep, s, d))
+    return x.reshape(bb * hkv * rep, s, d)
+
+
+def _seg_mask(seg_ref, h, sq, skv):
+    """[bb, 1, S] seg block → [bb·H, Sq, Skv] bool allow-mask."""
+    seg = seg_ref[:, 0, :]
+    bb = seg.shape[0]
+    m = seg[:, :, None] == seg[:, None, :]
+    return jnp.broadcast_to(m[:, None], (bb, h, sq, skv)).reshape(bb * h, sq, skv)
+
+
+def _masked_scores(q, k, seg_ref, scale, causal, h, use_seg):
+    """q,k [G,S,D] → masked [G,Sq,Skv] f32 scores (G = bb·H)."""
+    s = _dot_nt(q, k) * scale
+    if use_seg:
+        s = jnp.where(_seg_mask(seg_ref, h, s.shape[1], s.shape[2]), s, NEG_INF)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, seg_ref, o_ref, lse_ref,
+                *, scale, causal, rep, use_seg):
+    # blocks (BHSD): q/o [bb, H, S, D]; k/v [bb, Hkv, S, D]; seg [bb, 1, S];
+    # lse [bb, H, 1, S]
+    bb, h, sq, d = q_ref.shape
+    q = q_ref[...].reshape(bb * h, sq, d)
+    k = _flat_heads(k_ref, rep)
+    v = _flat_heads(v_ref, rep)
+    s = _masked_scores(q, k, seg_ref, scale, causal, h, use_seg)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = _dot_nn(p.astype(v.dtype), v) / l
+    o_ref[...] = o.reshape(bb, h, sq, d).astype(o_ref.dtype)
+    lse_ref[:, :, 0, :] = (m[..., 0] + jnp.log(l[..., 0])).reshape(bb, h, sq)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, seg_ref, lse_ref, o_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, causal, rep, use_seg):
+    bb, h, sq, d = q_ref.shape
+    q = q_ref[...].reshape(bb * h, sq, d)
+    k = _flat_heads(k_ref, rep)
+    v = _flat_heads(v_ref, rep)
+    o = o_ref[...].reshape(bb * h, sq, d).astype(jnp.float32)
+    do = do_ref[...].reshape(bb * h, sq, d)
+    lse = lse_ref[:, :, 0, :].reshape(bb * h, sq)
+
+    s = _masked_scores(q, k, seg_ref, scale, causal, h, use_seg)
+    p = jnp.exp(s - lse[:, :, None])  # [G, Sq, Skv] f32
+    pc = p.astype(q.dtype)
+
+    # dv = pᵀ do ; dp = do vᵀ ; ds = p (dp − ⟨do,o⟩) ; dq = ds k ; dk = dsᵀ q
+    dv = _dot_tn(pc, do)                      # [G, Skv, D]
+    dp = _dot_nt(do, v)                       # [G, Sq, Skv]
+    delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
+    ds = (p * (dp - delta)).astype(q.dtype)
+    dq = _dot_nn(ds, k) * scale               # [G, Sq, D]
+    dk = _dot_tn(ds, q) * scale               # [G, Skv, D]
+    dq_ref[...] = dq.reshape(bb, h, sq, d).astype(dq_ref.dtype)
+    dk_ref[...] = dk.reshape(bb, h, sq, d).astype(dk_ref.dtype)
+    dv_ref[...] = dv.reshape(bb, h, sq, d).astype(dv_ref.dtype)
+
+
+def _block_b(batch: int, h: int, s: int, n_score_bufs: int) -> int:
+    """Largest batch block whose f32 score buffers stay within ~4 MB of VMEM
+    (leaves room for the q/k/v/o tiles and Mosaic's double buffering)."""
+    budget = max(1, (4 * 1024 * 1024) // (h * s * s * 4 * n_score_bufs))
+    for bb in (8, 4, 2, 1):
+        if bb <= budget and batch % bb == 0:
+            return bb
+    return 1
+
+
+def _specs(H, Hkv, S, D, bb):
+    from jax.experimental import pallas as pl
+
+    q_spec = pl.BlockSpec((bb, H, S, D), lambda b: (b, 0, 0, 0))
+    kv_spec = pl.BlockSpec((bb, Hkv, S, D), lambda b: (b, 0, 0, 0))
+    seg_spec = pl.BlockSpec((bb, 1, S), lambda b: (b, 0, 0))
+    lse_spec = pl.BlockSpec((bb, H, 1, S), lambda b: (b, 0, 0, 0))
+    return q_spec, kv_spec, seg_spec, lse_spec
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_attention(q, k, v, segment_ids, scale, causal):
+    out, _ = _fused_fwd(q, k, v, segment_ids, scale, causal)
+    return out
+
+
+def _fused_fwd(q, k, v, segment_ids, scale, causal):
+    from jax.experimental import pallas as pl
+
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    bb = _block_b(B, H, S, n_score_bufs=2)
+    use_seg = segment_ids is not None
+    seg = (segment_ids if use_seg else jnp.zeros((B, S), jnp.int32))
+    seg = seg.astype(jnp.int32).reshape(B, 1, S)
+    q_spec, kv_spec, seg_spec, lse_spec = _specs(H, Hkv, S, D, bb)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          rep=H // Hkv, use_seg=use_seg),
+        grid=(B // bb,),
+        in_specs=[q_spec, kv_spec, kv_spec, seg_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, S), jnp.float32),
+        ],
+    )(q, k, v, seg)
+    return out, (q, k, v, seg, use_seg, lse, out)
+
+
+def _fused_bwd(scale, causal, res, do):
+    from jax.experimental import pallas as pl
+
+    q, k, v, seg, use_seg, lse, out = res
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    bb = _block_b(B, H, S, n_score_bufs=3)
+    q_spec, kv_spec, seg_spec, lse_spec = _specs(H, Hkv, S, D, bb)
+
+    # dk/dv come out per q-head ([B,H,S,D]); GQA folds them below
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                          rep=H // Hkv, use_seg=use_seg),
+        grid=(B // bb,),
+        in_specs=[q_spec, kv_spec, kv_spec, seg_spec, lse_spec, q_spec, q_spec],
+        out_specs=[q_spec, q_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+    )(q, k, v, seg, lse, out, do)
+    if Hkv != H:
+        rep = H // Hkv
+        dk = dk.reshape(B, Hkv, rep, S, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, rep, S, D).sum(axis=2)
+    return dq, dk, dv, None
+
+
+_fused_attention.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] int; padding = 0
+) -> jax.Array:
+    """Single-pass fused attention for short sequences (BSHD in/out).
+
+    Falls back to the XLA einsum path off-TPU so call sites stay portable."""
+    if jax.default_backend() != "tpu":
+        from .attention import _xla_attention, segment_mask
+
+        mask = segment_mask(segment_ids) if segment_ids is not None else None
+        return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))  # BSHD → BHSD
+    out = _fused_attention(qt, kt, vt, segment_ids, scale, causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+def fused_supported(q, k) -> bool:
+    """Shapes the single-tile kernel handles: one (batch row × all heads) score
+    block must fit VMEM, and q-heads must divide by kv-heads for the GQA
+    broadcast."""
+    B, Sq, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    if Sq != Skv or Sq % 128 != 0 or Sq > 1024:
+        return False
+    if D % 64 != 0 or D > 256:
+        return False
+    if H % Hkv != 0:
+        return False
+    # one batch row's score block (f32, ×3 buffers in bwd) must fit the budget
+    return H * Sq * Sq * 4 * 3 <= 8 * 1024 * 1024

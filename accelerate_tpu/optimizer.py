@@ -39,15 +39,18 @@ class AcceleratedOptimizer:
         optimizer,  # optax.GradientTransformation
         accumulation_steps: int = 1,
         scheduler_fn: Optional[Callable] = None,
+        wrap_accumulation: bool = True,
     ):
         import optax
 
         self.base_optimizer = optimizer
         self.accumulation_steps = accumulation_steps
         self.scheduler_fn = scheduler_fn
-        if accumulation_steps > 1:
+        if accumulation_steps > 1 and wrap_accumulation:
             self.optimizer = optax.MultiSteps(optimizer, every_k_schedule=accumulation_steps)
         else:
+            # wrap_accumulation=False: the transform already handles boundaries
+            # internally (fp8 partition nests MultiSteps on the param branch)
             self.optimizer = optimizer
         self.opt_state = None
         self._mesh = None
@@ -112,17 +115,19 @@ class AcceleratedOptimizer:
         state = self.opt_state
         if state is None:
             return 0
-        if hasattr(state, "gradient_step"):  # MultiSteps
-            return int(state.gradient_step)
+        ms = _find_multisteps_state(state)
+        if ms is not None:
+            return int(ms.gradient_step)
         return int(_find_count(state) or 0)
 
     @property
     def is_accumulation_boundary(self) -> bool:
         if self.accumulation_steps <= 1:
             return True
-        if self.opt_state is None or not hasattr(self.opt_state, "mini_step"):
+        ms = _find_multisteps_state(self.opt_state) if self.opt_state is not None else None
+        if ms is None:
             return True
-        return int(self.opt_state.mini_step) == 0
+        return int(ms.mini_step) == 0
 
     def state_dict(self) -> dict:
         import jax
@@ -151,6 +156,28 @@ def _placed_like(current, new):
     if isinstance(current, jax.Array):
         return jax.device_put(np.asarray(new), current.sharding)
     return new
+
+
+def _find_multisteps_state(state):
+    """Locate an ``optax.MultiSteps`` state node anywhere in the opt-state tree
+    (it can be nested inside a multi_transform partition, e.g. fp8)."""
+    if hasattr(state, "gradient_step") and hasattr(state, "mini_step"):
+        return state
+    if isinstance(state, dict):
+        children = state.values()
+    elif isinstance(state, (list, tuple)):
+        children = state
+    elif hasattr(state, "inner_states"):  # optax MultiTransformState
+        children = state.inner_states.values()
+    elif hasattr(state, "inner_state"):  # optax MaskedState
+        children = (state.inner_state,)
+    else:
+        return None
+    for child in children:
+        found = _find_multisteps_state(child)
+        if found is not None:
+            return found
+    return None
 
 
 def _find_count(state):

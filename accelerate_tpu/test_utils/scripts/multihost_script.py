@@ -54,6 +54,16 @@ def check_ops(accelerator):
     r = ops.reduce(np.array([float(me + 1)]), "sum")
     assert abs(float(np.asarray(r).reshape(-1)[0]) - sum(range(1, n + 1))) < 1e-6, r
 
+    # divergent host-local jax arrays must truly average (the reference's
+    # per-rank all_reduce semantics — reduce:728), not silently no-op
+    import jax.numpy as jnp
+
+    r = ops.reduce({"p": jnp.full((3,), float(me + 1))}, "mean")
+    expected = sum(range(1, n + 1)) / n
+    assert np.allclose(np.asarray(r["p"]), expected), r
+    r = ops.reduce(jnp.full((2,), float(me + 1)), "sum")
+    assert np.allclose(np.asarray(r), float(sum(range(1, n + 1)))), r
+
     padded = ops.pad_across_processes(np.ones((2 + me, 3)), dim=0)
     assert np.asarray(padded).shape == (2 + (n - 1), 3), np.asarray(padded).shape
 
@@ -64,6 +74,25 @@ def check_ops(accelerator):
         sizes = ops.gather_object(len(mine))
         assert sum(sizes) == 2 * n + 1, sizes
 
+    accelerator.wait_for_everyone()
+
+
+def check_local_sgd(accelerator):
+    """Multi-host LocalSGD: divergent per-process params must actually average
+    on the k-step boundary (reference ``_sync_and_avg_model_params``)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.local_sgd import LocalSGD
+
+    me = accelerator.process_index
+    n = accelerator.num_processes
+    params = {"w": jnp.full((4,), float(me + 1))}
+    with LocalSGD(accelerator, model=params, local_sgd_steps=2, enabled=True) as ls:
+        ls.step(params)
+        params = ls.step(params)  # boundary → cross-process average
+        expected = sum(range(1, n + 1)) / n
+        assert np.allclose(np.asarray(params["w"]), expected), (params, expected)
     accelerator.wait_for_everyone()
 
 
@@ -111,7 +140,23 @@ def check_dispatcher(accelerator):
 
     n_rows = 8
     per_proc_bs = max(4 // accelerator.num_processes, 1)
-    dl = DataLoader(_row_dataset(n_rows), batch_size=per_proc_bs)
+
+    me = accelerator.process_index
+
+    class RankZeroOnlyDS:
+        """The dispatcher's documented use case: a source only rank 0 can read.
+        Any non-main read is a hard failure (reference ``_fetch_batches:786`` —
+        rank 0 next()s, everyone else receives)."""
+
+        def __len__(self):
+            return n_rows
+
+        def __getitem__(self, i):
+            if me != 0:
+                raise RuntimeError(f"dataset read on non-main rank {me}")
+            return {"x": np.full((4,), float(i), dtype=np.float32), "idx": np.int32(i)}
+
+    dl = DataLoader(RankZeroOnlyDS(), batch_size=per_proc_bs)
     prepared = prepare_data_loader(
         dl,
         state=accelerator.state,
@@ -202,6 +247,109 @@ def check_checkpoint(accelerator, tmpdir: str, params, opt_state):
     accelerator.wait_for_everyone()
 
 
+def check_sharded_checkpoint(accelerator, tmpdir: str):
+    """FSDP-sharded save with NO host holding the full state, reload onto a
+    refactored mesh, resume to identical losses (reference
+    ``utils/fsdp_utils.py:103-414`` DCP sharded checkpoints)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.sharded_checkpoint import is_sharded_checkpoint
+
+    n_dev = jax.device_count()
+    assert n_dev >= 2, "needs >= 2 global devices"
+    mesh = Mesh(np.array(jax.devices()), ("dp_shard",))
+    dim = 8 * n_dev
+
+    rng = np.random.default_rng(1)
+    W0 = rng.normal(size=(dim, 4)).astype(np.float32) * 0.1
+    params = {"w": jax.device_put(W0, NamedSharding(mesh, P("dp_shard")))}
+    opt = optax.adam(0.05)
+    opt_state = opt.init(params)  # momenta inherit the params' sharding
+
+    X = rng.normal(size=(16, dim)).astype(np.float32)
+    Y = rng.normal(size=(16, 4)).astype(np.float32)
+
+    @jax.jit
+    def step(p, s, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    for _ in range(2):
+        params, opt_state, _ = step(params, opt_state, X, Y)
+
+    # save: auto-shards because leaves span hosts (not fully addressable)
+    ckpt = os.path.join(tmpdir, "sharded_ckpt")
+    accelerator.save_state(ckpt, params=params, opt_state=opt_state)
+    accelerator.wait_for_everyone()
+    assert is_sharded_checkpoint(ckpt, "model") and is_sharded_checkpoint(ckpt, "optimizer")
+    assert not os.path.exists(os.path.join(ckpt, "model.npz"))
+
+    # THE property: this host's shard file holds only its slice of the params,
+    # never the full array (the reference's DCP FileSystemWriter contract)
+    me = accelerator.process_index
+    with np.load(os.path.join(ckpt, f"model-shard-{me:05d}.npz")) as z:
+        stored = sum(int(z[k].size) for k in z.files)
+    full = dim * 4
+    assert stored == full // accelerator.num_processes, (stored, full)
+
+    # reference trajectory: two more steps
+    ref_losses = []
+    p_ref, s_ref = params, opt_state
+    for _ in range(2):
+        p_ref, s_ref, loss = step(p_ref, s_ref, X, Y)
+        ref_losses.append(float(loss))
+
+    # reload onto a REFACTORED mesh: shard dim 1 instead of dim 0 ('b' must
+    # span ALL devices — across both hosts — or the reshard test is vacuous)
+    mesh_b = Mesh(np.array(jax.devices()).reshape(1, -1), ("a", "b"))
+    template = {
+        "w": jax.device_put(jnp.zeros((dim, 4)), NamedSharding(mesh_b, P(None, "b")))
+    }
+    # template leaves must be GLOBAL arrays (opt.init outside jit would commit
+    # scalars like adam's count to one local device)
+    def _global_zeros(sd):
+        spec = P(None, "b") if sd.shape == (dim, 4) else P()
+        return jax.device_put(jnp.zeros(sd.shape, sd.dtype), NamedSharding(mesh_b, spec))
+
+    opt_template = jax.tree_util.tree_map(_global_zeros, jax.eval_shape(opt.init, template))
+    restored, restored_opt = accelerator.load_state(ckpt, params=template, opt_state=opt_template)
+    assert restored["w"].sharding.spec == P(None, "b")
+
+    resumed_losses = []
+    p_new, s_new = restored, restored_opt
+    for _ in range(2):
+        p_new, s_new, loss = step(p_new, s_new, X, Y)
+        resumed_losses.append(float(loss))
+    for a, b in zip(ref_losses, resumed_losses):
+        assert abs(a - b) < 1e-6, (ref_losses, resumed_losses)
+
+    # host-local (fully addressable) leaves: exactly ONE process may write them
+    # — divergent per-host values must deterministically restore to process 0's
+    # copy, not whichever shard file sorts last
+    from accelerate_tpu.sharded_checkpoint import load_sharded_pytree, save_sharded_pytree
+
+    local_dir = os.path.join(tmpdir, "local_leaf_ckpt")
+    os.makedirs(local_dir, exist_ok=True)
+    accelerator.wait_for_everyone()
+    me_f = float(accelerator.process_index)
+    save_sharded_pytree({"local": jnp.full((4,), me_f), "shared": params["w"]}, local_dir)
+    accelerator.wait_for_everyone()
+    got = load_sharded_pytree(
+        {"local": jnp.zeros((4,)), "shared": jax.device_put(jnp.zeros((dim, 4)), NamedSharding(mesh, P("dp_shard")))},
+        local_dir,
+    )
+    assert np.allclose(np.asarray(got["local"]), 0.0), np.asarray(got["local"])
+    accelerator.wait_for_everyone()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scenario", default="all")
@@ -214,7 +362,8 @@ def main():
     accelerator = Accelerator(mixed_precision="no", rng_seed=0)
 
     scenarios = args.scenario.split(",") if args.scenario != "all" else [
-        "topology", "ops", "dataloader", "dispatcher", "training", "checkpoint",
+        "topology", "ops", "local_sgd", "dataloader", "dispatcher", "training",
+        "checkpoint", "sharded_checkpoint",
     ]
     params = opt_state = None
     for scenario in scenarios:
@@ -222,6 +371,8 @@ def main():
             check_topology(accelerator, expect_n)
         elif scenario == "ops":
             check_ops(accelerator)
+        elif scenario == "local_sgd":
+            check_local_sgd(accelerator)
         elif scenario == "dataloader":
             check_dataloader(accelerator, dispatch=False)
         elif scenario == "dispatcher":
@@ -232,6 +383,8 @@ def main():
             if params is None:
                 params, opt_state = check_training(accelerator, args.tmpdir)
             check_checkpoint(accelerator, args.tmpdir, params, opt_state)
+        elif scenario == "sharded_checkpoint":
+            check_sharded_checkpoint(accelerator, args.tmpdir)
         else:
             raise ValueError(f"unknown scenario {scenario}")
         print(f"[proc {accelerator.process_index}] scenario {scenario}: OK", flush=True)

@@ -228,37 +228,48 @@ def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
 
 
 def reduce(tree, reduction: str = "mean", scale: float = 1.0):
-    """Reduce array leaves across the batch axes of the mesh / across processes
-    (reference ``reduce:728``). Host-level: gathers then reduces; inside jit use
-    ``jax.lax.psum`` directly."""
+    """Elementwise sum/mean of the per-PROCESS values of each leaf (reference
+    ``reduce:728`` — ``dist.all_reduce`` then divide by world size for mean).
+
+    Two leaf regimes:
+
+    - **host-local** (numpy, or a fully-addressable ``jax.Array`` — the only
+      kind whose value can differ per process): every process contributes its
+      own value; they are allgathered and summed/averaged across the process
+      axis. This is the path multi-host ``LocalSGD`` relies on to actually
+      average divergent replicas.
+    - **global** ``jax.Array`` spanning hosts (not fully addressable): GSPMD
+      guarantees one consistent logical value, so "mean" of the identical
+      per-process copies is the value itself and "sum" is ``num_processes ×``
+      it — exactly what the reference's all_reduce computes on identical
+      replicas.
+
+    Inside jit use ``jax.lax.psum/pmean`` directly.
+    """
     import jax.numpy as jnp
 
     state = PartialState()
 
     def _reduce(x):
-        if _is_jax_array(x) and getattr(x.sharding, "mesh", None) is not None:
-            mesh = x.sharding.mesh
-            spec = x.sharding.spec
-            # a sharded leaf: sum the per-shard values along sharded axes == global sum
-            # For host-level semantics we interpret reduce as "combine the per-device
-            # batch shards", which for a replicated array is identity.
-            if all(s is None for s in spec):
-                out = x * scale
-                if reduction == "sum" and state.num_processes > 1:
-                    out = out * state.num_processes
-                return out
-            gathered = _replicate_global_array(x)
-            return gathered * scale
+        was_jax = _is_jax_array(x)
+        if was_jax and not x.is_fully_addressable:  # pragma: no cover - multihost only
+            if reduction == "sum":
+                return x * (scale * state.num_processes)
+            return x * scale
         if state.num_processes > 1:  # pragma: no cover - multihost only
+            import jax
             from jax.experimental import multihost_utils
 
-            stacked = multihost_utils.process_allgather(np.asarray(x), tiled=False)
+            host_value = np.asarray(jax.device_get(x) if was_jax else x)
+            stacked = multihost_utils.process_allgather(host_value, tiled=False)
             if reduction == "mean":
-                return stacked.mean(axis=0) * scale
-            if reduction == "sum":
-                return stacked.sum(axis=0) * scale
-            return np.asarray(x) * scale
-        return jnp.asarray(x) * scale if _is_jax_array(x) else np.asarray(x) * scale
+                out = stacked.mean(axis=0) * scale
+            elif reduction == "sum":
+                out = stacked.sum(axis=0) * scale
+            else:
+                out = host_value * scale
+            return jnp.asarray(out) if was_jax else out
+        return jnp.asarray(x) * scale if was_jax else np.asarray(x) * scale
 
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(f"reduction must be mean/sum/none, got {reduction}")

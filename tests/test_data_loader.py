@@ -88,6 +88,79 @@ class TestBatchSamplerShard:
                     assert len(list(shard)) == len(shard), (n, bs, num)
 
 
+class TestBatchSamplerShardGrid:
+    """Exhaustive sweep over drop_last × even_batches × split_batches ×
+    uneven-tail sizes — the counterpart of the reference's 913-LoC index-math
+    suite (``/root/reference/tests/test_data_loader.py``), plus a stronger
+    invariant the reference doesn't hold: ``len() == sum(1 for _)`` in EVERY
+    mode, including split_batches (reference split ``__len__`` is nominal)."""
+
+    @staticmethod
+    def _grid():
+        for n in range(1, 19):
+            for bs in (1, 2, 3, 4):
+                for num_shards in (1, 2, 3, 4):
+                    for drop_last in (False, True):
+                        for even_batches in (False, True):
+                            for split in (False, True):
+                                if split and bs % num_shards != 0:
+                                    continue
+                                yield n, bs, num_shards, drop_last, even_batches, split
+
+    def test_full_grid_invariants(self):
+        for n, bs, num_shards, drop_last, even_batches, split in self._grid():
+            shards = [
+                BatchSamplerShard(
+                    make_batch_sampler(n, bs, drop_last),
+                    num_shards,
+                    i,
+                    split_batches=split,
+                    even_batches=even_batches,
+                )
+                for i in range(num_shards)
+            ]
+            results = [list(s) for s in shards]
+            cfg = dict(n=n, bs=bs, shards=num_shards, drop=drop_last,
+                       even=even_batches, split=split)
+
+            # 1. len() is EXACT in every mode
+            for i, (s, r) in enumerate(zip(shards, results)):
+                assert len(s) == len(r), (cfg, i, len(s), len(r))
+
+            all_indices = [i for r in results for b in r for i in b]
+            assert all(0 <= i < n for i in all_indices), cfg
+
+            if even_batches:
+                # 2. every shard sees the same number of batches...
+                counts = {len(r) for r in results}
+                assert len(counts) == 1, (cfg, [len(r) for r in results])
+                # ...and every batch is the same (full) size
+                sizes = {len(b) for r in results for b in r}
+                assert len(sizes) <= 1, (cfg, sizes)
+                if not drop_last:
+                    # 3. full coverage (wraparound may duplicate, never skip)
+                    assert set(all_indices) == set(range(n)), cfg
+            else:
+                if not drop_last:
+                    # 4. exact partition: every sample exactly once, none dropped
+                    assert sorted(all_indices) == list(range(n)), (cfg, sorted(all_indices))
+
+            if drop_last:
+                # 5. never duplicates with drop_last
+                assert len(all_indices) == len(set(all_indices)), cfg
+
+    def test_split_slice_size_is_nominal(self):
+        """Dataset smaller than one batch: each shard's slice must still be
+        batch_size // num_shards (reference ``batch_length`` :198), not shrunk."""
+        shards = [
+            BatchSamplerShard(make_batch_sampler(2, 4), 2, i, split_batches=True)
+            for i in range(2)
+        ]
+        for s in shards:
+            batches = list(s)
+            assert all(len(b) == 2 for b in batches), batches
+
+
 def test_iterable_dataset_shard():
     data = list(range(22))
     shards = [

@@ -218,3 +218,67 @@ def test_fp8_wrap_when_optimizer_prepared_first():
     # not an adam-mangled value
     np.testing.assert_allclose(float(p1["l1"][META_KEY]["x_hist"][0]),
                                float(jnp.max(jnp.abs(x))), rtol=1e-3)
+
+
+class TestFp8GradAccumulation:
+    """amax histories must roll EVERY micro-step while real params update only
+    on accumulation boundaries (round-2 verdict item: MultiSteps around the
+    whole partition would average/delay the delayed-scaling statistics)."""
+
+    def _setup(self, accum):
+        from accelerate_tpu import Accelerator
+
+        acc = Accelerator(
+            mixed_precision="fp8", cpu=True, gradient_accumulation_steps=accum
+        )
+        params = {"l1": fp8_dense_init(jax.random.PRNGKey(0), 16, 8)}
+        opt = optax.sgd(1e-2)
+        params, opt = acc.prepare(params, opt)
+
+        def loss_fn(p, b):
+            return jnp.mean(fp8_dense_apply(p["l1"], b["x"]) ** 2)
+
+        step = acc.prepare_train_step(loss_fn, opt, donate=False)
+        return acc, params, opt, step
+
+    def test_meta_rolls_every_microstep_params_on_boundary(self):
+        acc, params, opt, step = self._setup(accum=2)
+        opt_state = opt.opt_state
+        kernel0 = np.asarray(params["l1"]["kernel"]).copy()
+
+        batches = [
+            {"x": _rand((8, 16), seed) * (seed + 1.0)} for seed in range(4)
+        ]
+        hists = [np.asarray(params["l1"][META_KEY]["x_hist"]).copy()]
+        kernels = [kernel0]
+        for b in batches:
+            params, opt_state, _ = step(params, opt_state, b)
+            hists.append(np.asarray(params["l1"][META_KEY]["x_hist"]).copy())
+            kernels.append(np.asarray(params["l1"]["kernel"]).copy())
+
+        # histories differ after EVERY micro-step (slot 0 = that step's amax)
+        for i in range(1, len(hists)):
+            assert not np.array_equal(hists[i], hists[i - 1]), f"history stale at step {i}"
+            # and slot0 holds the *current* batch amax, not an average
+            # bf16 compute cast → compare with bf16-level tolerance
+            expected_amax = float(np.max(np.abs(np.asarray(batches[i - 1]["x"]))))
+            assert abs(float(hists[i][0]) - expected_amax) < 1e-2 * expected_amax, (
+                i, hists[i][0], expected_amax,
+            )
+
+        # params: unchanged after micro-step 1 and 3, changed on boundaries 2 and 4
+        assert np.array_equal(kernels[1], kernels[0]), "params moved mid-accumulation"
+        assert not np.array_equal(kernels[2], kernels[1]), "no update on boundary"
+        assert np.array_equal(kernels[3], kernels[2]), "params moved mid-accumulation"
+        assert not np.array_equal(kernels[4], kernels[3]), "no update on boundary"
+
+    def test_boundary_bookkeeping_with_nested_multisteps(self):
+        acc, params, opt, step = self._setup(accum=2)
+        opt_state = opt.opt_state
+        assert opt.is_accumulation_boundary  # fresh state: mini_step == 0
+        params, opt_state, _ = step(params, opt_state, {"x": _rand((8, 16), 1)})
+        assert not opt.is_accumulation_boundary
+        assert opt.step_count == 0
+        params, opt_state, _ = step(params, opt_state, {"x": _rand((8, 16), 2)})
+        assert opt.is_accumulation_boundary
+        assert opt.step_count == 1

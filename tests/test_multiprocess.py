@@ -23,7 +23,7 @@ def shared_tmpdir(tmp_path_factory):
 class TestTwoProcesses:
     def test_topology_and_ops(self, shared_tmpdir):
         outs = execute_multiprocess(
-            SCRIPT + ["--scenario", "topology,ops", "--tmpdir", shared_tmpdir],
+            SCRIPT + ["--scenario", "topology,ops,local_sgd", "--tmpdir", shared_tmpdir],
             num_processes=2,
         )
         for out in outs:
@@ -41,6 +41,18 @@ class TestTwoProcesses:
         outs = execute_multiprocess(
             SCRIPT + ["--scenario", "training,checkpoint", "--tmpdir", shared_tmpdir],
             num_processes=2,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+
+    def test_sharded_checkpoint(self, shared_tmpdir):
+        """FSDP-sharded save where no host materializes the full state, reload
+        onto a refactored mesh (2 devices/process → dim-1 sharding), resume to
+        identical losses."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "sharded_checkpoint", "--tmpdir", shared_tmpdir],
+            num_processes=2,
+            devices_per_process=2,
         )
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
