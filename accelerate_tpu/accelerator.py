@@ -697,7 +697,22 @@ class Accelerator:
     def maybe_context_parallel(self, buffers=None, buffer_seq_dims=None, no_restore_buffers=None):
         """API-parity shim (reference ``maybe_context_parallel:4056-4120``): torch
         must shard buffers in-place per step; under GSPMD the dataloader already
-        yields seq-sharded global arrays and the attention_fn does the rest."""
+        yields seq-sharded global arrays and the attention_fn does the rest.
+
+        Buffer arguments are therefore IGNORED — warn so a ported reference
+        script's author learns the actual CP hook (``get_attention_fn`` /
+        ``seq_dim`` on ``prepare_data_loader``) instead of silently assuming
+        per-step buffer sharding happened."""
+        if buffers is not None or buffer_seq_dims is not None or no_restore_buffers is not None:
+            import warnings
+
+            warnings.warn(
+                "maybe_context_parallel buffer arguments are ignored under SPMD: "
+                "sequence sharding comes from the prepared dataloader (seq_dim) "
+                "and the attention_fn from accelerator.get_attention_fn(); no "
+                "per-step in-place buffer resharding exists or is needed",
+                stacklevel=2,
+            )
         yield
 
     @contextlib.contextmanager

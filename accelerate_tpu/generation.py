@@ -1,0 +1,302 @@
+"""KV-cache autoregressive decoding: the runnable counterpart of the
+reference's big-model-inference benchmark (BASELINE config #5, reference
+``benchmarks/big_model_inference/README.md:27-37`` — model load time +
+seconds/token with device_map dispatch).
+
+Two paths, matching the two ways params can live:
+
+- :func:`greedy_generate` — resident params (replicated or GSPMD-sharded):
+  one jitted decode step; the cache is a stacked ``[L, B, max_len, Hkv, D]``
+  pytree threaded functionally (donated each step), the layer loop is the same
+  ``lax.scan`` as training so TP/FSDP shardings apply unchanged.
+- :func:`generate_dispatched` — offloaded params (:class:`DispatchedParams`
+  from ``device_map``-style dispatch): params are re-staged PER LAYER
+  (``unstack_layer_params``) so paging granularity matches the reference's
+  per-module hooks (``hooks.py:331-407``); each token pages layers through the
+  execution device with one-stage-ahead prefetch while a jitted single-layer
+  step computes.
+
+Static shapes throughout: the cache is pre-sized to ``max_len`` and positions
+mask the unwritten tail — no dynamic shapes reach XLA.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .models.transformer import LlamaConfig, apply_rope, rms_norm, rope_frequencies
+
+__all__ = [
+    "init_kv_cache",
+    "greedy_generate",
+    "generate_dispatched",
+    "unstack_layer_params",
+]
+
+
+def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Stacked cache: {"k","v"}: [L, B, max_len, Hkv, D]."""
+    shape = (config.n_layers, batch_size, max_len, config.n_kv_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
+    """q: [B, S, H, D]; caches [B, max_len, Hkv, D]; q_positions [S] — attend
+    causally over all cache slots with position <= the query's position."""
+    B, S, H, D = q.shape
+    max_len = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    if hkv != H:
+        rep = H // hkv
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / np.sqrt(D) if scale is None else scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    kv_pos = jnp.arange(max_len)
+    allow = kv_pos[None, :] <= q_positions[:, None]  # [S, max_len]
+    logits = jnp.where(allow[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config):
+    """One decoder layer over S tokens at ``positions``, updating [B,max,·,·]
+    caches in place (dynamic_update_slice along the sequence axis)."""
+    B, S, _ = h.shape
+    x = rms_norm(h, layer_params["attn_norm"]["scale"], config.norm_eps)
+    q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
+    k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+    v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+    q = apply_rope(q, cos, sin, positions=jnp.broadcast_to(positions[None], (B, S)))
+    k = apply_rope(k, cos, sin, positions=jnp.broadcast_to(positions[None], (B, S)))
+    start = positions[0]
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, positions)
+    h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
+    x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
+    gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
+    up = x @ layer_params["w3"]["kernel"]
+    h = h + (gate * up) @ layer_params["w2"]["kernel"]
+    return h, k_cache, v_cache
+
+
+def _forward_cached(params, ids, cache, start_pos, config: LlamaConfig):
+    """Forward S tokens starting at ``start_pos`` against the cache.
+    Returns (logits [B, S, vocab], new_cache)."""
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    h = params["embed_tokens"]["embedding"][ids]
+    S = ids.shape[1]
+    positions = start_pos + jnp.arange(S)
+
+    def layer(carry, xs):
+        h = carry
+        layer_params, k_c, v_c = xs
+        h, k_c, v_c = _layer_step(layer_params, h, k_c, v_c, positions, cos, sin, config)
+        return h, (k_c, v_c)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer, h, (params["layers"], cache["k"], cache["v"]),
+        unroll=config.unroll_layers,
+    )
+    h = rms_norm(h, params["final_norm"]["scale"], config.norm_eps)
+    if config.tie_embeddings:
+        logits = h @ params["embed_tokens"]["embedding"].T
+    else:
+        logits = h @ params["lm_head"]["kernel"]
+    return logits, {"k": k_new, "v": v_new}
+
+
+def greedy_generate(
+    params,
+    prompt_ids,  # [B, S_prompt] (non-ragged; pad+mask upstream if needed)
+    config: LlamaConfig,
+    max_new_tokens: int = 32,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+    return_stats: bool = False,
+    warmup: bool = False,
+):
+    """Jitted KV-cache greedy decoding for resident (replicated/sharded) params.
+
+    The whole decode loop is one compiled ``lax.scan`` — a single host
+    round-trip for the full generation (sequences that hit ``eos_token_id``
+    keep emitting it; there is no data-dependent early exit under jit).
+    Returns generated ids [B, S_prompt + max_new_tokens] (optionally with a
+    stats dict: prefill seconds, decode tokens/sec). ``warmup=True`` runs the
+    decode once before timing so stats exclude compilation."""
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, S = prompt_ids.shape
+    max_len = S + max_new_tokens
+    cache = init_kv_cache(config, B, max_len, cache_dtype)
+
+    prefill = jax.jit(partial(_forward_cached, config=config))
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_all(params, cache, first_tok):
+        """The ENTIRE decode loop on-device (one host round-trip total — a
+        per-token fetch would serialize on host/ICI latency)."""
+
+        def body(carry, i):
+            tok, finished, cache = carry
+            logits, cache = _forward_cached(params, tok[:, None], cache, S + i - 1, config)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = jnp.logical_or(finished, nxt == eos_token_id)
+            return (nxt, finished, cache), nxt
+
+        finished = (
+            first_tok == eos_token_id if eos_token_id is not None else jnp.zeros((B,), bool)
+        )
+        (_, _, cache), toks = jax.lax.scan(
+            body, (first_tok, finished, cache), jnp.arange(1, max_new_tokens)
+        )
+        return toks.T  # [B, max_new_tokens-1]
+
+    if warmup and max_new_tokens > 1:
+        logits_w, cache_w = prefill(params, prompt_ids, init_kv_cache(config, B, max_len, cache_dtype), jnp.int32(0))
+        tok_w = jnp.argmax(logits_w[:, -1], axis=-1).astype(prompt_ids.dtype)
+        jax.device_get(decode_all(params, cache_w, tok_w))
+
+    t0 = time.time()
+    logits, cache = prefill(params, prompt_ids, cache, jnp.int32(0))
+    first_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt_ids.dtype)
+    first_host = np.asarray(jax.device_get(first_tok))  # forces prefill for timing
+    prefill_s = time.time() - t0
+
+    t0 = time.time()
+    if max_new_tokens > 1:
+        rest = np.asarray(jax.device_get(decode_all(params, cache, first_tok)))
+    else:
+        rest = np.zeros((B, 0), first_host.dtype)
+    decode_s = time.time() - t0
+    generated = np.concatenate(
+        [np.asarray(jax.device_get(prompt_ids)), first_host[:, None], rest], axis=1
+    )
+    if return_stats:
+        n_decoded = max(max_new_tokens - 1, 1)
+        return generated, {
+            "prefill_seconds": prefill_s,
+            "decode_tokens_per_sec": n_decoded * B / max(decode_s, 1e-9),
+            "seconds_per_token": decode_s / n_decoded,
+        }
+    return generated
+
+
+# ---------------------------------------------------------------------------
+# dispatched (offloaded) decoding
+
+
+def unstack_layer_params(params, config: LlamaConfig) -> dict:
+    """Re-stage stacked-layer params into per-layer subtrees so device-map
+    dispatch pages ONE layer at a time (the reference's per-module hook
+    granularity). ``layer_07`` etc. sort correctly for stage ordering."""
+    stages = {"embed_tokens": params["embed_tokens"]}
+    for i in range(config.n_layers):
+        stages[f"layer_{i:03d}"] = jax.tree_util.tree_map(lambda x: x[i], params["layers"])
+    stages["final_norm"] = params["final_norm"]
+    if not config.tie_embeddings:
+        stages["lm_head"] = params["lm_head"]
+    return stages
+
+
+def generate_dispatched(
+    dispatched,  # DispatchedParams over unstack_layer_params(...) stages
+    prompt_ids,
+    config: LlamaConfig,
+    max_new_tokens: int = 32,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+    return_stats: bool = False,
+):
+    """Greedy decoding with per-layer paged params (cpu/disk offload).
+
+    Each forward pages layer stages through the execution device with
+    one-ahead prefetch (reference ``AlignDevicesHook`` hot loop, §3.4); the
+    jitted single-layer step is shared across layers so there is exactly one
+    compile per (S, position-signature)."""
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, S = prompt_ids.shape
+    max_len = S + max_new_tokens
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+
+    per_layer_cache = [
+        {
+            "k": jnp.zeros((B, max_len, config.n_kv_heads, config.head_dim), cache_dtype),
+            "v": jnp.zeros((B, max_len, config.n_kv_heads, config.head_dim), cache_dtype),
+        }
+        for _ in range(config.n_layers)
+    ]
+
+    layer_fn = jax.jit(
+        lambda lp, h, kc, vc, positions: _layer_step(lp, h, kc, vc, positions, cos, sin, config)
+    )
+    embed_fn = jax.jit(lambda emb, ids: emb["embedding"][ids])
+
+    norm_fn = jax.jit(lambda fp, h: rms_norm(h, fp["scale"], config.norm_eps))
+
+    layer_names = [f"layer_{i:03d}" for i in range(config.n_layers)]
+
+    def forward(ids, start_pos):
+        positions = start_pos + jnp.arange(ids.shape[1])
+        dispatched.prefetch("embed_tokens")
+        h = embed_fn(dispatched["embed_tokens"], ids)
+        for i, name in enumerate(layer_names):
+            if i + 1 < len(layer_names):
+                dispatched.prefetch(layer_names[i + 1])
+            lp = dispatched[name]
+            cache_i = per_layer_cache[i]
+            h, cache_i["k"], cache_i["v"] = layer_fn(
+                lp, h, cache_i["k"], cache_i["v"], positions
+            )
+            dispatched.release(name)
+        h = norm_fn(dispatched["final_norm"], h)
+        if config.tie_embeddings:
+            emb = dispatched["embed_tokens"]
+            logits = h @ emb["embedding"].T
+        else:
+            logits = h @ dispatched["lm_head"]["kernel"]
+        return logits
+
+    t0 = time.time()
+    logits = forward(prompt_ids, jnp.int32(0))
+    next_tok = np.asarray(jax.device_get(jnp.argmax(logits[:, -1], axis=-1)))
+    prefill_s = time.time() - t0
+
+    tokens = [next_tok]
+    finished = np.zeros((B,), bool)
+    if eos_token_id is not None:
+        finished |= next_tok == eos_token_id
+    t0 = time.time()
+    for i in range(1, max_new_tokens):
+        logits = forward(jnp.asarray(tokens[-1])[:, None], jnp.int32(S + i - 1))
+        tok = np.asarray(jax.device_get(jnp.argmax(logits[:, -1], axis=-1)))
+        if eos_token_id is not None:
+            tok = np.where(finished, eos_token_id, tok)
+            finished |= tok == eos_token_id
+        tokens.append(tok)
+        if eos_token_id is not None and finished.all():
+            break
+    decode_s = time.time() - t0
+    generated = np.concatenate(
+        [np.asarray(jax.device_get(prompt_ids))] + [t[:, None] for t in tokens], axis=1
+    )
+    if return_stats:
+        n_decoded = max(len(tokens) - 1, 1)
+        return generated, {
+            "prefill_seconds": prefill_s,
+            "decode_tokens_per_sec": n_decoded * B / max(decode_s, 1e-9),
+            "seconds_per_token": decode_s / n_decoded,
+        }
+    return generated

@@ -10,3 +10,10 @@ from .transformer import (
     llama_loss,
     llama_shard_rules,
 )
+from .resnet import (
+    ResNetConfig,
+    init_resnet,
+    resnet_forward,
+    resnet_loss,
+    resnet_shard_rules,
+)

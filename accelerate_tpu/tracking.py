@@ -21,7 +21,17 @@ from typing import Any, Optional, Union
 from .logging import get_logger
 from .state import PartialState
 from .utils.dataclasses import LoggerType
-from .utils.imports import is_mlflow_available, is_tensorboard_available, is_wandb_available
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_swanlab_available,
+    is_tensorboard_available,
+    is_trackio_available,
+    is_wandb_available,
+)
 
 logger = get_logger(__name__)
 
@@ -212,11 +222,231 @@ class MLflowTracker(GeneralTracker):
         mlflow.end_run()
 
 
+class CometMLTracker(GeneralTracker):
+    """reference ``tracking.py:499``."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        from comet_ml import start
+
+        self.experiment = start(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.experiment
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.experiment.log_parameters(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.experiment.set_step(step)
+        for k, v in _flatten_scalars(values).items():
+            if isinstance(v, str):
+                self.experiment.log_other(k, v)
+            else:
+                self.experiment.log_metric(k, v, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.experiment.end()
+
+
+class AimTracker(GeneralTracker):
+    """reference ``tracking.py:593``."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__(run_name)
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer["hparams"] = _jsonable(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in _flatten_scalars(values).items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """reference ``tracking.py:903``."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        clearml_logger = self.task.get_logger()
+        for k, v in _flatten_scalars(values).items():
+            if isinstance(v, str):
+                clearml_logger.report_text(f"{k}: {v}")
+            elif step is None:
+                clearml_logger.report_single_value(name=k, value=v, **kwargs)
+            else:
+                title, _, series = k.rpartition("/")
+                clearml_logger.report_scalar(
+                    title=title or k, series=series or k, value=v, iteration=step, **kwargs
+                )
+
+    @on_main_process
+    def finish(self) -> None:
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """reference ``tracking.py:1061``."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, live=None, **kwargs):
+        super().__init__(run_name)
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(_flatten_scalars(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in _flatten_scalars(values).items():
+            self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
+
+
+class SwanLabTracker(GeneralTracker):
+    """reference ``tracking.py:1149``."""
+
+    name = "swanlab"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        import swanlab
+
+        self.run = swanlab.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import swanlab
+
+        swanlab.config.update(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self.run.log(
+            {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)},
+            step=step,
+        )
+
+    @on_main_process
+    def finish(self) -> None:
+        import swanlab
+
+        swanlab.finish()
+
+
+class TrackioTracker(GeneralTracker):
+    """reference ``tracking.py:422``."""
+
+    name = "trackio"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        import trackio
+
+        self.run = trackio.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.run.config.update(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        # trackio's run.log has no step parameter (auto-incremented internally)
+        # — the reference drops it too (tracking.py:487)
+        self.run.log(
+            {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)},
+            **kwargs,
+        )
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
 LOGGER_TYPE_TO_CLASS = {
     "jsonl": JSONLTracker,
     "tensorboard": TensorBoardTracker,
     "wandb": WandBTracker,
     "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+    "swanlab": SwanLabTracker,
+    "trackio": TrackioTracker,
 }
 
 _AVAILABILITY = {
@@ -224,6 +454,12 @@ _AVAILABILITY = {
     "tensorboard": is_tensorboard_available,
     "wandb": is_wandb_available,
     "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+    "swanlab": is_swanlab_available,
+    "trackio": is_trackio_available,
 }
 
 

@@ -53,6 +53,30 @@ def is_tensorboard_available() -> bool:
     return _package_available("tensorboard") or _package_available("tensorboardX")
 
 
+def is_comet_ml_available() -> bool:
+    return _package_available("comet_ml")
+
+
+def is_aim_available() -> bool:
+    return _package_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _package_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _package_available("dvclive")
+
+
+def is_swanlab_available() -> bool:
+    return _package_available("swanlab")
+
+
+def is_trackio_available() -> bool:
+    return _package_available("trackio")
+
+
 def is_wandb_available() -> bool:
     return _package_available("wandb")
 

@@ -1,0 +1,63 @@
+"""Feature: schedule-free optimization (reference
+``examples/by_feature/schedule_free.py`` — Meta's schedulefree AdamW, no LR
+schedule needed). TPU-native: ``optax.contrib.schedule_free_adamw``, which
+keeps the same interpolation-based y/z iterates; evaluation must read the
+``schedule_free_eval_params`` projection, not the raw train params.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/schedule_free.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accuracy, maybe_force_cpu
+
+
+def training_function(args):
+    import optax
+
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, cpu=args.cpu,
+                              rng_seed=args.seed)
+    optimizer = optax.contrib.schedule_free_adamw(args.lr, warmup_steps=10)
+    setup = build_tiny_bert_setup(args, accelerator, optimizer=optimizer)
+    step = accelerator.prepare_train_step(setup["loss_fn"], setup["optimizer"])
+    eval_step = accelerator.prepare_eval_step(setup["logits_fn"])
+    params, opt_state = setup["params"], setup["optimizer"].opt_state
+    for epoch in range(args.epochs):
+        for batch in setup["train_dl"]:
+            params, opt_state, metrics = step(params, opt_state, batch)
+    # schedule-free keeps averaged iterates in the optimizer state; evaluation
+    # uses their projection rather than the live train params
+    eval_params = optax.contrib.schedule_free_eval_params(_inner_state(opt_state), params)
+    acc = evaluate_accuracy(accelerator, eval_step, eval_params, setup["eval_dl"])
+    accelerator.print(f"accuracy {acc:.3f} (schedule-free, no LR schedule)")
+    return {"eval_accuracy": acc}
+
+
+def _inner_state(opt_state):
+    """Unwrap MultiSteps/loss-scale wrappers down to the ScheduleFreeState."""
+    import optax
+
+    state = opt_state
+    while not isinstance(state, optax.contrib.ScheduleFreeState):
+        if hasattr(state, "inner_opt_state"):
+            state = state.inner_opt_state
+        elif isinstance(state, (tuple, list)) and state:
+            state = state[0]
+        else:
+            raise ValueError("no ScheduleFreeState found in optimizer state")
+    return state
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

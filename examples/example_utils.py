@@ -73,7 +73,7 @@ def maybe_force_cpu(args):
         jax.config.update("jax_platforms", "cpu")
 
 
-def build_tiny_bert_setup(args, accelerator, seq_len: int = 64):
+def build_tiny_bert_setup(args, accelerator, seq_len: int = 64, optimizer=None):
     """Common scaffold for the by_feature scripts: tiny BERT on synthetic MRPC
     (the reference's by_feature/* scripts all share the BERT-MRPC training body
     and differ in ONE feature each)."""
@@ -91,7 +91,8 @@ def build_tiny_bert_setup(args, accelerator, seq_len: int = 64):
     train = make_synthetic_mrpc(args.train_size, seq_len, config.vocab_size, seed=0)
     test = make_synthetic_mrpc(args.eval_size, seq_len, config.vocab_size, seed=1)
     params = init_bert(config, jax.random.PRNGKey(args.seed))
-    optimizer = optax.adam(args.lr)
+    if optimizer is None:
+        optimizer = optax.adam(args.lr)
     train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
                           shuffle=True, seed=args.seed)
     eval_dl = DataLoader(DictDataset(test), batch_size=args.batch_size)

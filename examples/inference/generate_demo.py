@@ -1,0 +1,109 @@
+"""Big-model generate demo: KV-cache greedy decoding with load-time and
+tokens/sec reporting — the runnable counterpart of the reference's
+big-model-inference benchmark table
+(``/root/reference/benchmarks/big_model_inference/README.md:27-37``: model
+load seconds + s/token under device_map dispatch).
+
+Three modes:
+- ``--mode resident``  — params live in HBM, fully jitted cached decode
+- ``--mode cpu``       — params CPU-offloaded, paged per layer with prefetch
+  (reference ``cpu_offload``)
+- ``--mode disk``      — params spilled to an offload folder (reference
+  ``disk_offload``)
+
+No hub access in this environment, so weights are synthetic at a
+configurable size; the mechanics (streamed load → dispatch → cached decode)
+are exactly the production path.
+
+Run: python examples/inference/generate_demo.py --model-size tiny --mode cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import maybe_force_cpu
+
+
+SIZES = {
+    # dim, layers, heads, kv_heads — "small" ≈ 110M, "1b" ≈ 1B params
+    "tiny": dict(dim=128, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=512),
+    "small": dict(dim=768, n_layers=12, n_heads=12, n_kv_heads=12, vocab_size=32000),
+    "1b": dict(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, vocab_size=32000),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-size", choices=sorted(SIZES), default="tiny")
+    parser.add_argument("--mode", choices=["resident", "cpu", "disk"], default="resident")
+    parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument("--prompt-len", type=int, default=32)
+    parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import cpu_offload, disk_offload
+    from accelerate_tpu.generation import (
+        generate_dispatched,
+        greedy_generate,
+        unstack_layer_params,
+    )
+    from accelerate_tpu.models import LlamaConfig, init_llama
+
+    config = LlamaConfig(max_seq_len=args.prompt_len + args.max_new_tokens + 16,
+                         **SIZES[args.model_size])
+
+    t0 = time.time()
+    params = init_llama(config, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+    tmpdir = None
+    if args.mode == "resident":
+        model = params
+    elif args.mode == "cpu":
+        model = cpu_offload(unstack_layer_params(params, config))
+    else:
+        tmpdir = tempfile.mkdtemp(prefix="generate_demo_offload_")
+        model = disk_offload(unstack_layer_params(params, config), tmpdir)
+    load_s = time.time() - t0
+
+    prompt = np.random.default_rng(0).integers(
+        0, config.vocab_size, (args.batch, args.prompt_len)
+    ).astype(np.int32)
+
+    if args.mode == "resident":
+        out, stats = greedy_generate(
+            params, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
+        )
+    else:
+        out, stats = generate_dispatched(
+            model, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
+        )
+
+    print(json.dumps({
+        "mode": args.mode,
+        "model_size": args.model_size,
+        "n_params": n_params,
+        "load_seconds": round(load_s, 3),
+        "prefill_seconds": round(stats["prefill_seconds"], 3),
+        "seconds_per_token": round(stats["seconds_per_token"], 4),
+        "decode_tokens_per_sec": round(stats["decode_tokens_per_sec"], 2),
+        "generated_shape": list(out.shape),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/bin/bash
+#SBATCH --job-name=accelerate-tpu
+#SBATCH --nodes=1
+#SBATCH --time=02:00:00
+# Single-host launch with elastic restart supervision
+# (reference: examples/slurm/submit_multigpu.sh).
+
+accelerate-tpu launch \
+    --mixed_precision bf16 \
+    --max_restarts 2 \
+    examples/complete_nlp_example.py --checkpointing_steps epoch

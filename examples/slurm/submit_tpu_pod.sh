@@ -1,0 +1,21 @@
+#!/bin/bash
+#SBATCH --job-name=accelerate-tpu-pod
+#SBATCH --nodes=4                  # one task per TPU-VM host
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=04:00:00
+# Multi-host SPMD launch under SLURM (reference: examples/slurm/submit_multinode.sh).
+# One process per host; jax.distributed rendezvous at node 0.
+
+export COORDINATOR=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+export ACCELERATE_COORDINATOR_ADDRESS=${COORDINATOR}:8476
+export ACCELERATE_NUM_PROCESSES=$SLURM_NNODES
+export ACCELERATE_PROCESS_ID=$SLURM_PROCID
+
+srun accelerate-tpu launch \
+    --num_machines "$SLURM_NNODES" \
+    --machine_rank "$SLURM_PROCID" \
+    --main_process_ip "$COORDINATOR" \
+    --main_process_port 8476 \
+    --mixed_precision bf16 \
+    --dp_shard_size "$SLURM_NNODES" \
+    examples/nlp_example.py --model-size base

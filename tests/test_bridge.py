@@ -226,3 +226,185 @@ class TestDLPack:
             not torch.equal(before[n], p.detach()) for n, p in tm.named_parameters()
         )
         assert changed
+
+
+def _tiny_gpt2(seed=0):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(seed)
+    cfg = GPT2Config(
+        vocab_size=100, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0, use_cache=False,
+    )
+    return GPT2LMHeadModel(cfg)
+
+
+def _tiny_llama(seed=0):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64,
+        use_cache=False,
+    )
+    return LlamaForCausalLM(cfg)
+
+
+def _lm_batch(n=2, seq=16, vocab=100, seed=0):
+    ids = np.random.default_rng(seed).integers(1, vocab, (n, seq)).astype(np.int64)
+    return {"input_ids": ids, "labels": ids.copy()}
+
+
+class TestDecoderBridge:
+    """Decoder families through the torch.export/ATen path (round-2 verdict
+    item 4: transformers.utils.fx no longer traces GPT-2/Llama)."""
+
+    @pytest.mark.parametrize("make_model", [_tiny_gpt2, _tiny_llama])
+    def test_forward_loss_matches_torch(self, make_model):
+        from accelerate_tpu.bridge.aten_lowering import lower_module_aten
+
+        model = make_model().eval()
+        batch = _lm_batch()
+        fn, params, buffers = lower_module_aten(model, batch)
+        out = fn(params, buffers, batch, train=False)
+        tout = model(**{k: torch.from_numpy(v) for k, v in batch.items()})
+        assert abs(float(np.asarray(out["loss"])) - float(tout.loss)) < 1e-4
+        np.testing.assert_allclose(
+            np.asarray(out["logits"]), tout.logits.detach().numpy(), atol=1e-4
+        )
+
+    @pytest.mark.parametrize("make_model", [_tiny_gpt2, _tiny_llama])
+    def test_grads_match_torch_autograd(self, make_model):
+        import jax
+
+        from accelerate_tpu.bridge.aten_lowering import lower_module_aten
+
+        model = make_model().eval()
+        batch = _lm_batch(seed=1)
+        fn, params, buffers = lower_module_aten(model, batch)
+        grads = jax.grad(lambda p: fn(p, buffers, batch, train=False)["loss"])(params)
+        tout = model(**{k: torch.from_numpy(v) for k, v in batch.items()})
+        tout.loss.backward()
+        # tied weights: jax grads accumulate on the canonical (first-seen) name
+        for name, p in model.named_parameters():
+            if p.grad is None or name not in grads:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(grads[name]), p.grad.numpy(), atol=3e-4,
+                err_msg=f"grad mismatch at {name}",
+            )
+
+    def test_gpt2_generate_matches_hf_greedy(self):
+        from accelerate_tpu.bridge import BridgedModule
+
+        model = _tiny_gpt2(seed=2)
+        prompt = np.random.default_rng(2).integers(1, 100, (2, 8)).astype(np.int64)
+        bridged = BridgedModule(model)
+        ours = bridged.generate(prompt, max_new_tokens=6)
+
+        model.config.use_cache = True
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+        np.testing.assert_array_equal(ours, ref)
+
+    def test_gpt2_training_loop_through_accelerator(self):
+        """torch-style loop: prepared GPT-2 trains (loss drops) through
+        accelerator.backward / optimizer.step with the ATen-lowered forward."""
+        from accelerate_tpu import Accelerator, DataLoader
+
+        accelerator = Accelerator(mixed_precision="no", rng_seed=0)
+        model = _tiny_gpt2(seed=3)
+        optimizer = torch.optim.AdamW(model.parameters(), lr=1e-2)
+        data = _lm_batch(n=16, seq=16, seed=3)
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return {k: v[i] for k, v in data.items()}
+
+        model, optimizer, dl = accelerator.prepare(model, optimizer, DataLoader(DS(), batch_size=8))
+        model.train()
+        losses = []
+        for epoch in range(12):
+            for batch in dl:
+                outputs = model(**batch)
+                accelerator.backward(outputs.loss)
+                optimizer.step()
+                optimizer.zero_grad()
+                losses.append(float(outputs.loss))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+class TestNativeGeneration:
+    def test_cached_greedy_matches_full_forward(self):
+        import jax
+        import jax.numpy as jnp
+
+        from accelerate_tpu.generation import greedy_generate
+        from accelerate_tpu.models import LlamaConfig, init_llama
+        from accelerate_tpu.models.transformer import llama_forward
+
+        cfg = LlamaConfig.tiny()
+        params = init_llama(cfg, jax.random.PRNGKey(0))
+        prompt = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+        ids = jnp.asarray(prompt)
+        for _ in range(5):
+            logits = llama_forward(params, ids, cfg)
+            ids = jnp.concatenate(
+                [ids, jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(ids.dtype)], axis=1
+            )
+        ref = np.asarray(ids)
+        out = greedy_generate(params, prompt, cfg, max_new_tokens=5, cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_dispatched_generate_with_cpu_offload(self):
+        import jax
+        import jax.numpy as jnp
+
+        from accelerate_tpu.big_modeling import cpu_offload
+        from accelerate_tpu.generation import (
+            generate_dispatched,
+            greedy_generate,
+            unstack_layer_params,
+        )
+        from accelerate_tpu.models import LlamaConfig, init_llama
+
+        cfg = LlamaConfig.tiny()
+        params = init_llama(cfg, jax.random.PRNGKey(1))
+        prompt = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+        ref = greedy_generate(params, prompt, cfg, max_new_tokens=5, cache_dtype=jnp.float32)
+
+        dp = cpu_offload(unstack_layer_params(params, cfg))
+        out, stats = generate_dispatched(
+            dp, prompt, cfg, max_new_tokens=5, cache_dtype=jnp.float32, return_stats=True
+        )
+        np.testing.assert_array_equal(out, ref)
+        assert stats["decode_tokens_per_sec"] > 0
+
+
+def test_gpt2_generate_eos_parity_mixed_finish():
+    """Rows that finish at different steps: positions after a row's first eos
+    must be pad_token_id, matching HF greedy semantics."""
+    from accelerate_tpu.bridge import BridgedModule
+
+    model = _tiny_gpt2(seed=4)
+    prompt = np.random.default_rng(4).integers(1, 100, (3, 8)).astype(np.int64)
+    bridged = BridgedModule(model)
+    # pick the token the model actually emits first for row 0 as the "eos" so
+    # rows finish at different times
+    probe = bridged.generate(prompt, max_new_tokens=4)
+    eos = int(probe[0, 8])
+    ours = bridged.generate(prompt, max_new_tokens=6, eos_token_id=eos, pad_token_id=0)
+
+    model.config.use_cache = True
+    ref = model.generate(
+        torch.from_numpy(prompt), max_new_tokens=6, do_sample=False,
+        eos_token_id=eos, pad_token_id=0,
+    ).numpy()
+    np.testing.assert_array_equal(ours[:, : ref.shape[1]], ref)

@@ -170,3 +170,32 @@ def test_tpu_pod_machine_rank_precedes_script(monkeypatch):
     inner_args = parser.parse_args(inner[2:])
     assert inner_args.machine_rank == 3
     assert inner_args.training_script == "train.py"
+
+
+def test_launch_max_restarts_supervision(tmp_path):
+    """Elastic supervision: the script fails on attempt 0, succeeds on attempt 1;
+    the restart must carry ACCELERATE_RESTART_COUNT and the resume hint."""
+    marker = tmp_path / "attempts.txt"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "count = int(os.environ['ACCELERATE_RESTART_COUNT'])\n"
+        "with open(marker, 'a') as f:\n"
+        "    f.write(f\"{count}:{os.environ.get('ACCELERATE_RESUME_FROM_CHECKPOINT', '')}\\n\")\n"
+        "sys.exit(1 if count == 0 else 0)\n"
+    )
+    r = run_cli("launch", "--cpu", "--max_restarts", "2", "--monitor_interval", "0",
+                str(script))
+    assert r.returncode == 0, r.stderr
+    lines = marker.read_text().strip().splitlines()
+    assert lines == ["0:", "1:latest"], lines
+
+
+def test_launch_max_restarts_exhausted(tmp_path):
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    r = run_cli("launch", "--cpu", "--max_restarts", "1", "--monitor_interval", "0",
+                str(script))
+    assert r.returncode == 3
+    assert "restart 1/1" in r.stderr

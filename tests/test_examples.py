@@ -188,3 +188,20 @@ class TestInferenceExamples:
         ns.pp, ns.microbatches = 4, 4
         out = mod.main_function(ns)
         assert out["max_err"] < 1e-4
+
+
+class TestNewByFeature:
+    def _run(self, relpath, **overrides):
+        mod = load_example(relpath)
+        ns = tiny_args(mod, relpath, **overrides)
+        return mod, ns
+
+    def test_schedule_free(self):
+        mod, ns = self._run("by_feature/schedule_free.py", epochs=2)
+        assert "eval_accuracy" in mod.training_function(ns)
+
+    def test_cross_validation(self):
+        mod, ns = self._run("by_feature/cross_validation.py", epochs=1)
+        ns.folds = 2
+        out = mod.training_function(ns)
+        assert 0.0 <= out["eval_accuracy"] <= 1.0

@@ -45,6 +45,16 @@ class TestTwoProcesses:
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
 
+    def test_ops_three_processes(self, shared_tmpdir):
+        """np=3: odd process counts exercise uneven split/pad paths that np=2
+        cannot (split_between_processes remainder, pad sizes 2/3/4)."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "topology,ops", "--tmpdir", shared_tmpdir],
+            num_processes=3,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+
     def test_sharded_checkpoint(self, shared_tmpdir):
         """FSDP-sharded save where no host materializes the full state, reload
         onto a refactored mesh (2 devices/process → dim-1 sharding), resume to

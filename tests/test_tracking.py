@@ -1,0 +1,72 @@
+"""Tracker suite tests (reference ``tests/test_tracking.py`` — 870 LoC of
+dummy/offline trackers + log-file parsing; here the always-available JSONL
+tracker plays the offline role and the 9 integration classes are validated
+structurally, since their libraries are not installed in this image)."""
+
+import json
+
+from accelerate_tpu.tracking import (
+    _AVAILABILITY,
+    LOGGER_TYPE_TO_CLASS,
+    GeneralTracker,
+    JSONLTracker,
+    filter_trackers,
+)
+
+
+def test_registry_covers_reference_integrations():
+    """The reference ships 9 integrations (tracking.py:182-1226); all must have
+    a counterpart class + availability probe here."""
+    expected = {
+        "tensorboard", "wandb", "mlflow", "comet_ml", "aim", "clearml",
+        "dvclive", "swanlab", "trackio",
+    }
+    assert expected <= set(LOGGER_TYPE_TO_CLASS)
+    assert expected <= set(_AVAILABILITY)
+    for name, cls in LOGGER_TYPE_TO_CLASS.items():
+        assert issubclass(cls, GeneralTracker)
+        assert cls.name == name
+        # the full API surface (reference GeneralTracker:143-181)
+        for method in ("store_init_configuration", "log", "finish"):
+            assert callable(getattr(cls, method)), (name, method)
+
+
+def test_filter_trackers_skips_unavailable(caplog):
+    # none of the heavy integrations are installed in this image — requesting
+    # one must warn-and-skip, not raise (reference filter_trackers:1262)
+    unavailable = [n for n in LOGGER_TYPE_TO_CLASS if not _AVAILABILITY[n]()]
+    if not unavailable:  # pragma: no cover - all libs present
+        return
+    got = filter_trackers([unavailable[0]], project_name="run")
+    assert got == []
+
+
+def test_filter_trackers_unknown_name_raises(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError):
+        filter_trackers(["definitely_not_a_tracker"], project_name="run")
+
+
+def test_jsonl_tracker_roundtrip(tmp_path):
+    tracker = JSONLTracker("run", logging_dir=str(tmp_path))
+    tracker.store_init_configuration({"lr": 1e-3, "nested": {"bs": 8}})
+    tracker.log({"loss": 1.5}, step=0)
+    tracker.log({"loss": 0.5}, step=1)
+    tracker.finish()
+    lines = [json.loads(line) for line in (tmp_path / "run.jsonl").read_text().splitlines()]
+    assert lines[0]["_type"] == "config" and lines[0]["lr"] == 1e-3
+    assert [entry["loss"] for entry in lines[1:]] == [1.5, 0.5]
+    assert [entry["step"] for entry in lines[1:]] == [0, 1]
+
+
+def test_all_resolves_to_available_only():
+    from accelerate_tpu.utils.dataclasses import LoggerType
+
+    got = filter_trackers(LoggerType.ALL, project_name="run", logging_dir="/tmp")
+    names = {t.name for t in got}
+    assert "jsonl" in names
+    for t in got:
+        t.finish()
+    for name in names:
+        assert _AVAILABILITY[name]()
