@@ -117,6 +117,8 @@ def _aten_handlers() -> dict[str, Callable]:
         jnp.array_split(x, chunks, axis=dim)))
 
     def _pad(ctx, x, pad, mode="constant", value=None):
+        if mode != "constant":
+            raise LoweringError(f"aten.pad mode={mode!r} not supported (constant only)")
         # torch pad: last-dim-first pairs
         cfg = [(0, 0)] * x.ndim
         for i in range(len(pad) // 2):
@@ -136,7 +138,14 @@ def _aten_handlers() -> dict[str, Callable]:
 
     reg(["aten.add.Tensor", "aten.add.Scalar"], binop(lambda a, b: a + b))
     reg(["aten.sub.Tensor", "aten.sub.Scalar"], binop(lambda a, b: a - b))
-    reg(["aten.rsub.Scalar", "aten.rsub.Tensor"], binop(lambda a, b: b - a))
+
+    def _rsub(ctx, a, b, *, alpha=None, **kw):
+        # torch: other - alpha * input (alpha scales INPUT, unlike add/sub)
+        if alpha is not None and alpha != 1:
+            a = a * alpha
+        return b - a
+
+    reg(["aten.rsub.Scalar", "aten.rsub.Tensor"], _rsub)
     reg(["aten.mul.Tensor", "aten.mul.Scalar"], binop(lambda a, b: a * b))
     reg(["aten.div.Tensor", "aten.div.Scalar"], binop(lambda a, b: a / b))
     reg("aten.floor_divide.default", binop(lambda a, b: a // b))
@@ -147,7 +156,8 @@ def _aten_handlers() -> dict[str, Callable]:
         "sqrt": jnp.sqrt, "rsqrt": jax.lax.rsqrt, "tanh": jnp.tanh,
         "sigmoid": jax.nn.sigmoid, "silu": jax.nn.silu, "relu": jax.nn.relu,
         "erf": jax.scipy.special.erf, "sin": jnp.sin, "cos": jnp.cos,
-        "bitwise_not": jnp.logical_not, "logical_not": jnp.logical_not,
+        "bitwise_not": jnp.invert,  # ~x: bitwise for ints, logical for bools
+        "logical_not": jnp.logical_not,
         "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
         "reciprocal": jnp.reciprocal, "sign": jnp.sign, "isnan": jnp.isnan,
         "isinf": jnp.isinf,
@@ -247,7 +257,12 @@ def _aten_handlers() -> dict[str, Callable]:
             return ctx.dropout(x, p)
         return x
 
-    reg(["aten.dropout.default", "aten.native_dropout.default"], _dropout)
+    reg("aten.dropout.default", _dropout)
+
+    # returns (output, keep_mask) — consumers read it via getitem; the RNG
+    # stream is ctx.dropout's so aten.dropout and native_dropout stay in sync
+    reg("aten.native_dropout.default", lambda ctx, x, p=0.5, train=False: ctx.dropout(
+        x, p if ctx.train else 0.0, return_mask=True))
     reg("aten.softmax.int", lambda ctx, x, dim=-1, dtype=None: jax.nn.softmax(
         x.astype(_to_jnp_dtype(dtype)) if dtype is not None else x, axis=dim))
     reg("aten._softmax.default", lambda ctx, x, dim, half_to_float: jax.nn.softmax(x, axis=dim))
@@ -286,14 +301,22 @@ def _aten_handlers() -> dict[str, Callable]:
         start, end, step, **_factory_kw(kw)))
     reg("aten.full.default", lambda ctx, size, value, **kw: jnp.full(
         [int(s) for s in size], value, **_factory_kw(kw)))
-    reg("aten.full_like.default", lambda ctx, x, value, **kw: jnp.full_like(x, value))
+    def _like_dtype(x, kw):
+        dtype = kw.get("dtype")
+        return _to_jnp_dtype(dtype) if dtype is not None else x.dtype
+
+    reg("aten.full_like.default", lambda ctx, x, value, **kw: jnp.full_like(
+        x, value, dtype=_like_dtype(x, kw)))
     reg("aten.zeros.default", lambda ctx, size, **kw: jnp.zeros(
         [int(s) for s in size], **_factory_kw(kw)))
     reg("aten.ones.default", lambda ctx, size, **kw: jnp.ones(
         [int(s) for s in size], **_factory_kw(kw)))
-    reg("aten.zeros_like.default", lambda ctx, x, **kw: jnp.zeros_like(x))
-    reg("aten.ones_like.default", lambda ctx, x, **kw: jnp.ones_like(x))
-    reg("aten.empty_like.default", lambda ctx, x, **kw: jnp.zeros_like(x))
+    reg("aten.zeros_like.default", lambda ctx, x, **kw: jnp.zeros_like(
+        x, dtype=_like_dtype(x, kw)))
+    reg("aten.ones_like.default", lambda ctx, x, **kw: jnp.ones_like(
+        x, dtype=_like_dtype(x, kw)))
+    reg("aten.empty_like.default", lambda ctx, x, **kw: jnp.zeros_like(
+        x, dtype=_like_dtype(x, kw)))
     reg("aten.scalar_tensor.default", lambda ctx, v, **kw: jnp.asarray(v, **_factory_kw(kw)))
 
     def _to(ctx, x, *args, **kw):
@@ -358,11 +381,17 @@ def lower_module_aten(model, example_inputs: dict):
     }
     was_training = model.training
     model.eval()
+    prior_use_cache = None
     if getattr(model, "config", None) is not None and getattr(model.config, "use_cache", None):
+        prior_use_cache = model.config.use_cache
         model.config.use_cache = False  # DynamicCache outputs are not exportable
-    with _traceable_masking(), torch.no_grad():
-        ep = torch.export.export(model, (), example, strict=False)
-    model.train(was_training)
+    try:
+        with _traceable_masking(), torch.no_grad():
+            ep = torch.export.export(model, (), example, strict=False)
+    finally:
+        model.train(was_training)
+        if prior_use_cache is not None:
+            model.config.use_cache = prior_use_cache
 
     sig = ep.graph_signature
     params, buffers = module_params_to_jax(model)

@@ -175,18 +175,18 @@ class _Ctx:
         self.rng = rng
         self._counter = 0
 
-    def dropout(self, x, p):
+    def dropout(self, x, p, return_mask: bool = False):
         import jax
         import jax.numpy as jnp
 
-        if not self.train or p == 0.0:
-            return x
-        if self.rng is None:
-            return x  # deterministic-train mode: dropout disabled
+        if not self.train or p == 0.0 or self.rng is None:
+            # inactive (eval / p=0 / deterministic-train mode): identity
+            return (x, jnp.ones(x.shape, bool)) if return_mask else x
         key = jax.random.fold_in(self.rng, self._counter)
         self._counter += 1
         keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
-        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+        out = jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+        return (out, keep) if return_mask else out
 
 
 def _module_handlers() -> dict[str, Callable]:

@@ -265,6 +265,7 @@ class BridgedModule:
         max_new_tokens: int = 32,
         eos_token_id=None,
         pad_token_id: int = 0,
+        attention_mask=None,
     ):
         """Greedy decoding for bridged decoder models (GPT-2, Llama, ...).
 
@@ -274,6 +275,12 @@ class BridgedModule:
         influence earlier positions, so each step's argmax at the current
         position is exact. (For the cache-based native path see
         ``accelerate_tpu.generation.greedy_generate``.)
+
+        Ragged (right-padded) batches: pass ``attention_mask``. Each distinct
+        prompt length decodes in its own exact forward (continuation starts at
+        the row's true length, pads never attended — HF greedy parity), so a
+        ragged batch costs up to one compile + forward chain per row; the
+        equal-length fast path stays batched.
         """
         import numpy as np
 
@@ -282,6 +289,31 @@ class BridgedModule:
         try:
             ids = np.asarray(input_ids)
             B, S = ids.shape
+            if attention_mask is not None:
+                mask = np.asarray(attention_mask)
+                lengths = mask.astype(np.int64).sum(axis=1)
+                prefix_ones = all(bool(mask[i, : lengths[i]].all()) for i in range(B))
+                if not prefix_ones or (lengths == 0).any():
+                    raise ValueError(
+                        "generate() supports right-padded attention_mask only "
+                        "(each row a non-empty prefix of ones)"
+                    )
+                if (lengths != S).any():
+                    rows = []
+                    for i in range(B):
+                        rows.append(
+                            self.generate(
+                                ids[i : i + 1, : lengths[i]],
+                                max_new_tokens=max_new_tokens,
+                                eos_token_id=eos_token_id,
+                                pad_token_id=pad_token_id,
+                            )[0]
+                        )
+                    width = max(r.shape[0] for r in rows)
+                    out = np.full((B, width), pad_token_id, dtype=ids.dtype)
+                    for i, r in enumerate(rows):
+                        out[i, : r.shape[0]] = r
+                    return out
             total = S + max_new_tokens
             padded = np.full((B, total), pad_token_id, dtype=ids.dtype)
             padded[:, :S] = ids

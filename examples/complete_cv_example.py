@@ -1,0 +1,107 @@
+"""Complete CV example: cv_example + tracking, per-epoch checkpointing, resume,
+LR scheduling (reference ``examples/complete_cv_example.py`` — ResNet-50 with
+checkpointing/tracking on pet images; same training shape on synthetic data).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/complete_cv_example.py --cpu --project-dir /tmp/cvproj \
+    --checkpointing-steps epoch [--resume-from-checkpoint .../checkpoint_0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from example_utils import DictDataset, add_common_args, make_synthetic_images, maybe_force_cpu
+
+
+def training_function(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader, ProjectConfiguration
+
+    pc = ProjectConfiguration(project_dir=args.project_dir, automatic_checkpoint_naming=True)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=pc,
+        rng_seed=args.seed,
+        cpu=args.cpu,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+
+    from cv_example import convnet_forward, init_convnet
+
+    train = make_synthetic_images(args.train_size, size=args.image_size, seed=0)
+    test = make_synthetic_images(args.eval_size, size=args.image_size, seed=1)
+    params = init_convnet(jax.random.PRNGKey(args.seed))
+    train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
+                          shuffle=True, seed=args.seed)
+    eval_dl = DataLoader(DictDataset(test), batch_size=args.batch_size)
+    steps_per_epoch = max(len(train_dl), 1)
+    total = max(args.epochs * steps_per_epoch, 2)
+    optimizer = optax.adamw(
+        optax.warmup_cosine_decay_schedule(0.0, args.lr, max(total // 10, 1), total)
+    )
+    params, optimizer, train_dl, eval_dl = accelerator.prepare(
+        params, optimizer, train_dl, eval_dl
+    )
+
+    def loss_fn(p, batch):
+        logits = convnet_forward(p, batch["pixel_values"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1))
+
+    step_fn = accelerator.prepare_train_step(loss_fn, optimizer)
+    eval_fn = accelerator.prepare_eval_step(lambda p, b: convnet_forward(p, b["pixel_values"]))
+    opt_state = optimizer.opt_state
+
+    start_epoch = 0
+    if args.resume_from_checkpoint:
+        params = accelerator.load_state(args.resume_from_checkpoint, params=params)
+        opt_state = accelerator._optimizers[-1].opt_state
+        name = os.path.basename(os.path.normpath(args.resume_from_checkpoint))
+        if name.startswith("checkpoint_"):
+            start_epoch = int(name.split("_")[1]) + 1
+        accelerator.print(f"resumed from {args.resume_from_checkpoint} (epoch {start_epoch})")
+
+    acc = 0.0
+    for epoch in range(start_epoch, args.epochs):
+        for batch in train_dl:
+            params, opt_state, metrics = step_fn(params, opt_state, batch)
+        correct = total_n = 0
+        for batch in eval_dl:
+            preds = jnp.argmax(eval_fn(params, batch), axis=-1)
+            g = accelerator.gather_for_metrics({"p": preds, "l": batch["labels"]})
+            correct += int(np.sum(np.asarray(g["p"]) == np.asarray(g["l"])))
+            total_n += int(np.asarray(g["l"]).shape[0])
+        acc = correct / max(total_n, 1)
+        accelerator.print(f"epoch {epoch}: accuracy {acc:.3f} loss {float(metrics['loss']):.4f}")
+        if args.with_tracking:
+            accelerator.log({"accuracy": acc, "train_loss": float(metrics["loss"])}, step=epoch)
+        if args.checkpointing_steps == "epoch" and args.project_dir:
+            accelerator.save_state(params=params)
+    accelerator.end_training()
+    return {"eval_accuracy": acc}
+
+
+def main():
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--project-dir", default=None)
+    parser.add_argument("--with-tracking", action="store_true")
+    parser.add_argument("--checkpointing-steps", default=None, choices=[None, "epoch"])
+    parser.add_argument("--resume-from-checkpoint", default=None)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

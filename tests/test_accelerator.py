@@ -88,6 +88,50 @@ def test_bf16_training_converges():
     assert loss < 0.5
 
 
+@pytest.mark.parametrize("precision", ["no", "bf16", "fp16"])
+def test_train_loop_matches_per_step_calls(precision):
+    """prepare_train_loop (K scanned steps / one dispatch) must be update-for-
+    update identical to K prepare_train_step calls — incl. fp16 dynamic loss
+    scaling state threading through the scan carry."""
+    from accelerate_tpu.utils.operations import stack_batches
+
+    def make(n_batches=4, bs=8):
+        return [
+            {
+                "x": X_ALL[i * bs : (i + 1) * bs],
+                "y": Y_ALL[i * bs : (i + 1) * bs],
+            }
+            for i in range(n_batches)
+        ]
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision=precision)
+    params, opt = acc.prepare(fresh_params(), optax.sgd(1e-2))
+    step = acc.prepare_train_step(loss_fn, opt)
+    p1, s1 = params, opt.opt_state
+    step_losses = []
+    for b in make():
+        p1, s1, m = step(p1, s1, b)
+        step_losses.append(float(m["loss"]))
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = Accelerator(mixed_precision=precision)
+    params2, opt2 = acc2.prepare(fresh_params(), optax.sgd(1e-2))
+    loop = acc2.prepare_train_loop(loss_fn, opt2)
+    p2, s2, m2 = loop(params2, opt2.opt_state, stack_batches(make()))
+    loop_losses = [float(x) for x in np.asarray(m2["loss"])]
+
+    np.testing.assert_allclose(step_losses, loop_losses, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-6)
+    # write-back tracking: optimizer sees the post-loop state (checkpointable)
+    assert opt2.opt_state is s2
+
+
 def test_prepare_assigns_shardings():
     acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
     big = {"w": np.zeros((128, 64), np.float32), "tiny": np.zeros(4, np.float32)}

@@ -310,6 +310,26 @@ class TestDecoderBridge:
         ).numpy()
         np.testing.assert_array_equal(ours, ref)
 
+    def test_gpt2_generate_ragged_prompts(self):
+        """Right-padded ragged batch with attention_mask: each row must match
+        generating its own unpadded prompt alone (pads never attended)."""
+        from accelerate_tpu.bridge import BridgedModule
+
+        model = _tiny_gpt2(seed=2)
+        rng = np.random.default_rng(5)
+        row0 = rng.integers(1, 100, (5,)).astype(np.int64)
+        row1 = rng.integers(1, 100, (8,)).astype(np.int64)
+        ids = np.zeros((2, 8), np.int64)
+        ids[0, :5], ids[1] = row0, row1
+        mask = np.zeros((2, 8), np.int64)
+        mask[0, :5], mask[1] = 1, 1
+        bridged = BridgedModule(model)
+        out = bridged.generate(ids, max_new_tokens=4, attention_mask=mask)
+        ref0 = bridged.generate(row0[None], max_new_tokens=4)[0]
+        ref1 = bridged.generate(row1[None], max_new_tokens=4)[0]
+        np.testing.assert_array_equal(out[0, : ref0.shape[0]], ref0)
+        np.testing.assert_array_equal(out[1, : ref1.shape[0]], ref1)
+
     def test_gpt2_training_loop_through_accelerator(self):
         """torch-style loop: prepared GPT-2 trains (loss drops) through
         accelerator.backward / optimizer.step with the ATen-lowered forward."""

@@ -172,6 +172,38 @@ def test_tpu_pod_machine_rank_precedes_script(monkeypatch):
     assert inner_args.training_script == "train.py"
 
 
+def test_tpu_pod_restart_refans_whole_pod(monkeypatch):
+    """Pod elastic restart re-runs the WHOLE fan-out (per-worker restart could
+    not rejoin the running collective) and injects resume hints on retry."""
+    import accelerate_tpu.commands.launch as L
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 1 if len(calls) == 1 else 0
+
+        return R()
+
+    monkeypatch.setattr(L.subprocess, "run", fake_run)
+    parser = L.launch_command_parser()
+    args = parser.parse_args([
+        "--tpu_pod", "--tpu_name", "t", "--num_machines", "2",
+        "--main_process_ip", "10.0.0.2", "--max_restarts", "2",
+        "--monitor_interval", "0", "train.py",
+    ])
+    rc = L.launch_command(args)
+    assert rc == 0
+    assert len(calls) == 2
+    first = next(a for a in calls[0] if a.startswith("--command="))
+    second = next(a for a in calls[1] if a.startswith("--command="))
+    assert "--max_restarts" not in first  # workers must NOT self-restart
+    assert "ACCELERATE_RESUME_FROM_CHECKPOINT=latest" in second
+    assert "ACCELERATE_RESTART_COUNT=1" in second
+
+
 def test_launch_max_restarts_supervision(tmp_path):
     """Elastic supervision: the script fails on attempt 0, succeeds on attempt 1;
     the restart must carry ACCELERATE_RESTART_COUNT and the resume hint."""

@@ -205,3 +205,41 @@ class TestNewByFeature:
         ns.folds = 2
         out = mod.training_function(ns)
         assert 0.0 <= out["eval_accuracy"] <= 1.0
+
+    def test_gradient_accumulation_for_autoregressive_models(self):
+        mod, ns = self._run(
+            "by_feature/gradient_accumulation_for_autoregressive_models.py",
+            epochs=3, batch_size=2, train_size=128,
+        )
+        ns.seq_len, ns.gradient_accumulation_steps, ns.lr = 64, 2, 3e-3
+        out = mod.training_function(ns)
+        assert out["train_loss"] < 6.0  # init ~log(512)=6.24, drops fast
+
+    def test_sequence_parallelism(self):
+        mod = load_example("sequence_parallelism.py")
+        ns = tiny_args(mod, "sequence_parallelism.py", epochs=3, batch_size=8, train_size=128)
+        ns.seq_len, ns.sp, ns.dp_shard = 128, 4, 2
+        out = mod.training_function(ns)
+        assert out["train_loss"] < out["first_loss"]
+
+    def test_complete_cv_example_with_resume(self, tmp_path):
+        mod = load_example("complete_cv_example.py")
+        ns = tiny_args(mod, "complete_cv_example.py", epochs=1, batch_size=4,
+                       train_size=128, eval_size=64, lr=3e-3)
+        ns.image_size, ns.project_dir = 32, str(tmp_path)
+        ns.with_tracking, ns.checkpointing_steps = True, "epoch"
+        ns.resume_from_checkpoint = None
+        out = mod.training_function(ns)
+        assert "eval_accuracy" in out
+        ckpt = os.path.join(str(tmp_path), "checkpoints", "checkpoint_0")
+        assert os.path.isdir(ckpt)
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        ns2 = tiny_args(mod, "complete_cv_example.py", epochs=2, batch_size=4,
+                        train_size=128, eval_size=64, lr=3e-3)
+        ns2.image_size, ns2.project_dir = 32, str(tmp_path / "resumed")
+        ns2.with_tracking, ns2.checkpointing_steps = False, None
+        ns2.resume_from_checkpoint = ckpt
+        out2 = mod.training_function(ns2)
+        assert "eval_accuracy" in out2
