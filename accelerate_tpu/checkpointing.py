@@ -140,7 +140,7 @@ def _remove_stale_model_files(output_dir: str) -> None:
     checkpoint dir so a fresh save never mixes with leftovers."""
     pattern = re.compile(
         rf"({MODEL_NAME}|{OPTIMIZER_NAME})(_\d+)?"
-        r"(\.npz|-shard-\d{5}\.(npz|index\.json))"
+        r"(\.npz|-shard-\d{5}\.(npz|bin|index\.json))"
     )
     for name in os.listdir(output_dir):
         if pattern.fullmatch(name):

@@ -9,16 +9,25 @@ import subprocess
 import tempfile
 import threading
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src", "pipeline.cc")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
 _LIB = os.path.join(_LIB_DIR, "libatpu_pipeline.so")
 _lock = threading.Lock()
 
 
+def _sources() -> list[str]:
+    return sorted(
+        os.path.join(_SRC_DIR, name)
+        for name in os.listdir(_SRC_DIR)
+        if name.endswith(".cc")
+    )
+
+
 def _needs_build() -> bool:
     if not os.path.isfile(_LIB):
         return True
-    return os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(lib_mtime < os.path.getmtime(src) for src in _sources())
 
 
 def build_library(verbose: bool = False) -> str | None:
@@ -40,7 +49,7 @@ def build_library(verbose: bool = False) -> str | None:
         cmd = [
             os.environ.get("CXX", "g++"),
             "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            _SRC, "-o", tmp,
+            *_sources(), "-o", tmp,
         ]
         try:
             res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)

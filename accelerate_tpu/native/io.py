@@ -1,0 +1,140 @@
+"""Native chunked-file IO: the byte-moving layer under sharded checkpoints.
+
+The reference's sharded checkpoint path delegates to
+``torch.distributed.checkpoint``'s C++ FileSystemWriter/Reader
+(``/root/reference/src/accelerate/utils/fsdp_utils.py:103-414``); this is the
+TPU-native equivalent (``src/io.cc``): a thread team does pwrite/pread off the
+GIL with per-chunk CRC32. Pure-Python fallback (same format, zlib crc32) when
+no compiler is available.
+
+Format is owned by the caller (``sharded_checkpoint.py``): one flat binary
+file per process, chunks at 64-byte-aligned offsets, layout recorded in the
+caller's JSON index.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+ALIGN = 64
+
+
+def _default_threads() -> int:
+    """IO thread-team size. Default 1: on a single local disk concurrent
+    pwrite at different offsets thrashes (measured 88 MB/s sequential vs
+    24 MB/s with 8 threads on this class of fs); parallel filesystems
+    (GCS/NFS on TPU pods) DO scale with threads — raise via
+    ``ACCELERATE_TPU_IO_THREADS`` there."""
+    try:
+        return max(1, int(os.environ.get("ACCELERATE_TPU_IO_THREADS", "1")))
+    except ValueError:
+        return 1
+
+
+def _lib():
+    from . import _load
+
+    lib = _load()
+    if lib is None:
+        return None
+    if not getattr(lib, "_atpu_io_bound", False):
+        try:
+            lib.atpu_io_write_chunks.restype = ctypes.c_int32
+            lib.atpu_io_write_chunks.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
+            ]
+            lib.atpu_io_read_chunks.restype = ctypes.c_int32
+            lib.atpu_io_read_chunks.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
+            ]
+            lib._atpu_io_bound = True
+        except AttributeError:  # stale .so without the io entry points
+            return None
+    return lib
+
+
+def plan_layout(nbytes_list: Sequence[int]) -> tuple[list[int], int]:
+    """64B-aligned offsets for a chunk sequence; returns (offsets, total)."""
+    offsets, pos = [], 0
+    for nb in nbytes_list:
+        offsets.append(pos)
+        pos += int(nb)
+        pos = (pos + ALIGN - 1) // ALIGN * ALIGN
+    return offsets, pos
+
+
+def write_chunks(path: str, arrays: Sequence[np.ndarray],
+                 num_threads: Optional[int] = None) -> tuple[list[int], list[int], list[int]]:
+    """Write arrays as raw chunks; returns (offsets, nbytes, crc32s)."""
+    if num_threads is None:
+        num_threads = _default_threads()
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = [a.nbytes for a in arrays]
+    offsets, _total = plan_layout(sizes)
+    lib = _lib()
+    if lib is not None and arrays:
+        n = len(arrays)
+        srcs = (ctypes.c_void_p * n)(*[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+        c_sizes = (ctypes.c_int64 * n)(*sizes)
+        c_offsets = (ctypes.c_int64 * n)(*offsets)
+        crcs = (ctypes.c_uint32 * n)()
+        rc = lib.atpu_io_write_chunks(path.encode(), n, srcs, c_sizes, c_offsets,
+                                      crcs, num_threads)
+        if rc == 0:
+            return offsets, sizes, list(crcs)
+        # fall through to the python path on native IO failure
+    crc_list = []
+    with open(path, "wb") as f:
+        for a, off in zip(arrays, offsets):
+            f.seek(off)
+            buf = a.tobytes()
+            f.write(buf)
+            crc_list.append(zlib.crc32(buf) & 0xFFFFFFFF)
+        # durability parity with the native path (which fsyncs and fails on
+        # error): a crash right after "save succeeded" must not leave a
+        # truncated container behind a CRC-carrying index
+        f.flush()
+        os.fsync(f.fileno())
+    return offsets, sizes, crc_list
+
+
+def read_chunks(path: str, offsets: Sequence[int], nbytes: Sequence[int],
+                crcs: Optional[Sequence[int]] = None,
+                num_threads: Optional[int] = None) -> list[np.ndarray]:
+    """Read raw chunks back as uint8 arrays (zero extra copies — callers wrap
+    them with ``np.frombuffer``); verifies CRC32 when provided."""
+    if num_threads is None:
+        num_threads = _default_threads()
+    n = len(offsets)
+    bufs = [np.empty(int(nb), dtype=np.uint8) for nb in nbytes]
+    lib = _lib()
+    if lib is not None and n:
+        dsts = (ctypes.c_void_p * n)(*[b.ctypes.data_as(ctypes.c_void_p) for b in bufs])
+        c_sizes = (ctypes.c_int64 * n)(*[int(x) for x in nbytes])
+        c_offsets = (ctypes.c_int64 * n)(*[int(x) for x in offsets])
+        c_crcs = (ctypes.c_uint32 * n)(*[int(c) for c in crcs]) if crcs is not None else None
+        rc = lib.atpu_io_read_chunks(path.encode(), n, dsts, c_sizes, c_offsets,
+                                     c_crcs, num_threads)
+        if rc == 0:
+            return bufs
+        if rc == -2:
+            raise ValueError(f"checkpoint chunk CRC mismatch in {path} (corrupt file?)")
+        # rc == -1: fall through to the python path
+    with open(path, "rb") as f:
+        for i, (off, nb, buf) in enumerate(zip(offsets, nbytes, bufs)):
+            f.seek(int(off))
+            got = f.readinto(memoryview(buf))
+            if got != int(nb):
+                raise IOError(f"short read in {path} at offset {off}")
+            if crcs is not None and (zlib.crc32(buf) & 0xFFFFFFFF) != int(crcs[i]):
+                raise ValueError(f"checkpoint chunk CRC mismatch in {path} (corrupt file?)")
+    return bufs

@@ -293,12 +293,31 @@ def check_sharded_checkpoint(accelerator, tmpdir: str):
     assert not os.path.exists(os.path.join(ckpt, "model.npz"))
 
     # THE property: this host's shard file holds only its slice of the params,
-    # never the full array (the reference's DCP FileSystemWriter contract)
+    # never the full array (the reference's DCP FileSystemWriter contract).
+    # Count elements from the index (format-agnostic: bin or npz container).
+    import json
+
     me = accelerator.process_index
-    with np.load(os.path.join(ckpt, f"model-shard-{me:05d}.npz")) as z:
-        stored = sum(int(z[k].size) for k in z.files)
+    with open(os.path.join(ckpt, f"model-shard-{me:05d}.index.json")) as f:
+        index = json.load(f)
+    stored = sum(
+        int(np.prod([e - s for s, e in zip(c["start"], c["stop"])] or [1]))
+        for meta in index["leaves"].values()
+        for c in meta["chunks"]
+    )
     full = dim * 4
     assert stored == full // accelerator.num_processes, (stored, full)
+    # the index is self-reported; the BYTES on disk must agree (f32 leaves,
+    # ≤64B alignment slack per chunk + container overhead)
+    n_chunks = sum(len(m["chunks"]) for m in index["leaves"].values())
+    for ext in (".bin", ".npz"):
+        shard_path = os.path.join(ckpt, f"model-shard-{me:05d}{ext}")
+        if os.path.isfile(shard_path):
+            disk = os.path.getsize(shard_path)
+            assert disk <= stored * 4 + n_chunks * 64 + 1024, (disk, stored * 4)
+            break
+    else:
+        raise AssertionError("no shard container file found")
 
     # reference trajectory: two more steps
     ref_losses = []

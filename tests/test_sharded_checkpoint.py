@@ -94,13 +94,30 @@ class TestShardedSaveLoad:
         mesh = _mesh((4, 2), ("fsdp", "tp"))
         live = _shard(params, mesh, P("fsdp", "tp"), P(None, "tp"))
         save_sharded_pytree(live, str(tmp_path), prefix="model")
-        stored = 0
+        import json
+
+        stored = n_chunks = 0
         for name in os.listdir(tmp_path):
-            if name.endswith(".npz"):
-                with np.load(os.path.join(tmp_path, name)) as z:
-                    stored += sum(int(z[k].size) for k in z.files)
+            if name.endswith(".index.json"):
+                with open(os.path.join(tmp_path, name)) as f:
+                    index = json.load(f)
+                for meta in index["leaves"].values():
+                    n_chunks += len(meta["chunks"])
+                    for chunk in meta["chunks"]:
+                        stored += int(np.prod([
+                            e - s for s, e in zip(chunk["start"], chunk["stop"])
+                        ] or [1]))
         expected = sum(np.asarray(v).size for v in jax.tree_util.tree_leaves(params))
         assert stored == expected, (stored, expected)
+        # and the BYTES physically on disk agree (the index is self-reported;
+        # a writer that stored full arrays while recording slice coords would
+        # pass the count above) — all leaves here are f32, plus ≤64B alignment
+        # slack per chunk
+        disk = sum(
+            os.path.getsize(os.path.join(tmp_path, n))
+            for n in os.listdir(tmp_path) if n.endswith((".bin", ".npz"))
+        )
+        assert disk <= expected * 4 + n_chunks * 64 + 1024, (disk, expected * 4, n_chunks)
 
     def test_consolidate_and_merge_cli(self, params, tmp_path):
         mesh = _mesh((8,), ("fsdp",))
@@ -200,3 +217,31 @@ def test_checkpoint_dir_reuse_scrubs_stale_format(tmp_path):
         params={"w": jax.device_put(jnp.zeros((16, 2)), NamedSharding(mesh, P("fsdp")))},
     )
     np.testing.assert_allclose(np.asarray(restored["w"]), 2.0)
+
+
+def test_legacy_npz_shard_set_still_loads(params, tmp_path, monkeypatch):
+    """A shard dir written with ACCELERATE_TPU_CKPT_FORMAT=npz (the pre-native
+    container) must load through the default bin-aware reader."""
+    mesh = _mesh((8,), ("fsdp",))
+    live = _shard(params, mesh, P("fsdp"), P("fsdp"))
+    monkeypatch.setenv("ACCELERATE_TPU_CKPT_FORMAT", "npz")
+    save_sharded_pytree(live, str(tmp_path), prefix="model")
+    monkeypatch.delenv("ACCELERATE_TPU_CKPT_FORMAT")
+    assert any(n.endswith(".npz") for n in os.listdir(tmp_path))
+    restored = load_sharded_pytree(live, str(tmp_path), prefix="model")
+    np.testing.assert_allclose(np.asarray(restored["layer"]["w"]), params["layer"]["w"])
+    np.testing.assert_allclose(np.asarray(restored["head"]), params["head"])
+
+
+def test_stale_other_format_file_does_not_misroute(params, tmp_path, monkeypatch):
+    """A stale .bin left in the dir must not hijack chunk routing when a fresh
+    npz-format save (public API, no accelerator scrub) overwrites the index."""
+    mesh = _mesh((8,), ("fsdp",))
+    live = _shard(params, mesh, P("fsdp"), P("fsdp"))
+    save_sharded_pytree(live, str(tmp_path), prefix="model")  # writes .bin
+    assert any(n.endswith(".bin") for n in os.listdir(tmp_path))
+    monkeypatch.setenv("ACCELERATE_TPU_CKPT_FORMAT", "npz")
+    save_sharded_pytree(live, str(tmp_path), prefix="model")  # overwrites index
+    monkeypatch.delenv("ACCELERATE_TPU_CKPT_FORMAT")
+    restored = load_sharded_pytree(live, str(tmp_path), prefix="model")
+    np.testing.assert_allclose(np.asarray(restored["layer"]["w"]), params["layer"]["w"])
