@@ -42,6 +42,12 @@ __all__ = [
 
 
 def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    if getattr(config, "moe_experts", 0):
+        raise NotImplementedError(
+            "KV-cache generation supports dense LlamaConfig only; MoE decode "
+            "(moe_experts > 0) is not wired into the cached layer step yet — "
+            "use the full-forward path (llama_forward) for MoE inference"
+        )
     """Stacked cache: {"k","v"}: [L, B, max_len, Hkv, D]."""
     shape = (config.n_layers, batch_size, max_len, config.n_kv_heads, config.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -17,3 +17,13 @@ from .resnet import (
     resnet_loss,
     resnet_shard_rules,
 )
+from .t5 import (
+    T5Config,
+    init_t5,
+    t5_decode,
+    t5_encode,
+    t5_forward,
+    t5_greedy_generate,
+    t5_loss,
+    t5_shard_rules,
+)

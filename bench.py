@@ -377,6 +377,67 @@ def run_bench():
     }
 
 
+def run_bench_longcontext(on_tpu: bool) -> dict:
+    """Long-context config (reference claims: CP "1M+ seq" / ALST "15M tokens",
+    ``docs/source/concept_guides/{context,sequence}_parallelism.md``; here the
+    single-chip leg): decoder train step at 8k sequence with the streaming
+    flash-attention kernel + remat — the per-chip building block the cp-axis
+    ring attention composes over ICI (multi-chip path exercised by
+    dryrun_multichip and tests/test_long_context.py)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.models import LlamaConfig, init_llama
+    from accelerate_tpu.models.transformer import llama_loss
+
+    _reset_state()
+    if on_tpu:
+        config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+                             n_kv_heads=8, max_seq_len=8192, unroll_layers=False)
+        bs, seq, steps = 1, 8192, 8
+    else:
+        config = LlamaConfig.tiny()
+        bs, seq, steps = 1, 256, 2
+    params = init_llama(config, jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+    params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
+    opt = optax.adafactor(1e-4)
+    opt_state = opt.init(params)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, config.vocab_size, (bs, seq)), jnp.int32
+    )
+    impl = "flash" if on_tpu else "xla"  # S=8192 is deep in flash territory
+
+    @jax.jit
+    def step(p, s, b):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss(p, b, config, remat=True, attention_impl=impl)
+        )(p)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    batch = {"input_ids": ids}
+    params, opt_state, loss = step(params, opt_state, batch)
+    float(np.asarray(loss))
+    t0 = _t.time()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    final = float(np.asarray(loss))
+    elapsed = _t.time() - t0
+    return {
+        "metric": f"long-context train throughput (seq {seq}, {impl} attention)",
+        "value": round(steps * bs * seq / elapsed, 1),
+        "unit": "tokens/sec/chip",
+        "seq_len": seq,
+        "n_params": n_params,
+        "final_loss": round(final, 4),
+    }
+
+
 def main():
     try:
         result = run_bench()
@@ -403,6 +464,7 @@ def main():
         ("resnet_dp", run_bench_resnet),
         ("fsdp_lm", run_bench_fsdp_lm),
         ("inference", run_bench_inference),
+        ("long_context", run_bench_longcontext),
     ):
         try:
             entry = fn(on_tpu)

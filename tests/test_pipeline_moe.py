@@ -278,3 +278,66 @@ class Test1F1B:
         mem_1f1b = lowered_1f1b.memory_analysis().temp_size_in_bytes
         mem_gpipe = lowered_gpipe.memory_analysis().temp_size_in_bytes
         assert mem_1f1b < mem_gpipe, (mem_1f1b, mem_gpipe)
+
+
+class TestMoEInModel:
+    """MoE wired into the Llama family (LlamaConfig.moe_experts > 0)."""
+
+    def _cfg(self):
+        import dataclasses
+
+        from accelerate_tpu.models import LlamaConfig
+
+        return dataclasses.replace(
+            LlamaConfig.tiny(), moe_experts=4, n_layers=2, unroll_layers=False
+        )
+
+    def test_moe_llama_trains(self):
+        import optax
+
+        from accelerate_tpu.models import init_llama, llama_loss
+
+        cfg = self._cfg()
+        params = init_llama(cfg, jax.random.PRNGKey(0))
+        assert params["layers"]["moe"]["wi"]["kernel"].shape[:2] == (2, 4)
+        rng = np.random.default_rng(0)
+        ids = np.tile(rng.integers(2, cfg.vocab_size, (8, 4)).astype(np.int32), (1, 16))
+        batch = {"input_ids": jnp.asarray(ids)}
+        opt = optax.adam(3e-3)
+        s = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(lambda p: llama_loss(p, batch, cfg))(p)
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s, l
+
+        params, s, l = step(params, s)
+        first = float(l)
+        for _ in range(40):
+            params, s, l = step(params, s)
+        assert float(l) < first * 0.5, (first, float(l))
+
+    def test_moe_llama_ep_sharded_step(self):
+        import optax
+
+        from accelerate_tpu import Accelerator, ParallelismConfig
+        from accelerate_tpu.models import init_llama, llama_loss, llama_shard_rules
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        pc = ParallelismConfig(dp_shard_size=2, ep_size=2, tp_size=2)
+        acc = Accelerator(parallelism_config=pc, rng_seed=0)
+        cfg = self._cfg()
+        params = init_llama(cfg, jax.random.PRNGKey(0))
+        params, opt = acc.prepare(params, optax.adam(1e-3), shard_rules=llama_shard_rules())
+        # experts sharded over ep, expert matmuls over tp
+        spec = params["layers"]["moe"]["wi"]["kernel"].sharding.spec
+        assert spec[1] == "ep" and spec[3] == "tp", spec
+        step = acc.prepare_train_step(lambda p, b: llama_loss(p, b, cfg), opt)
+        ids = np.tile(np.random.default_rng(0).integers(2, cfg.vocab_size, (8, 4)).astype(np.int32), (1, 16))
+        batch = {"input_ids": jnp.asarray(ids)}
+        s = opt.opt_state
+        p, s, m1 = step(params, s, batch)
+        p, s, m2 = step(p, s, batch)
+        assert float(m2["loss"]) < float(m1["loss"])
