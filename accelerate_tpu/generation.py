@@ -122,40 +122,62 @@ def _forward_cached(params, ids, cache, start_pos, config: LlamaConfig):
     return logits, {"k": k_new, "v": v_new}
 
 
-def greedy_generate(
+def sample_token_logits(logits, key, *, temperature: float = 1.0, top_k: int = 0,
+                        top_p: float = 1.0):
+    """One sampling step over ``logits [B, V]`` (jit-friendly; knobs are
+    Python-static): temperature scaling, then top-k truncation, then nucleus
+    (top-p) — the standard HF sampler composition. ``temperature == 0`` is
+    greedy argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        k = min(top_k, logits.shape[-1])  # HF clamps oversize top_k
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [B, 1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # smallest prefix reaching mass >= top_p (always keeps >= 1 token)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _cached_generate(
     params,
     prompt_ids,  # [B, S_prompt] (non-ragged; pad+mask upstream if needed)
     config: LlamaConfig,
-    max_new_tokens: int = 32,
-    eos_token_id: Optional[int] = None,
-    cache_dtype=jnp.bfloat16,
-    return_stats: bool = False,
-    warmup: bool = False,
+    max_new_tokens: int,
+    eos_token_id: Optional[int],
+    cache_dtype,
+    return_stats: bool,
+    warmup: bool,
+    select,  # (logits [B, V], key) -> next token [B]
+    rng_key,
 ):
-    """Jitted KV-cache greedy decoding for resident (replicated/sharded) params.
-
-    The whole decode loop is one compiled ``lax.scan`` — a single host
-    round-trip for the full generation (sequences that hit ``eos_token_id``
-    keep emitting it; there is no data-dependent early exit under jit).
-    Returns generated ids [B, S_prompt + max_new_tokens] (optionally with a
-    stats dict: prefill seconds, decode tokens/sec). ``warmup=True`` runs the
-    decode once before timing so stats exclude compilation."""
+    """Shared KV-cache decode core: prefill once, then the ENTIRE decode loop
+    in one compiled ``lax.scan`` (a single host round-trip — per-token fetches
+    would serialize on host/ICI latency). Sequences that hit ``eos_token_id``
+    keep emitting it; there is no data-dependent early exit under jit."""
     prompt_ids = jnp.asarray(prompt_ids)
     B, S = prompt_ids.shape
     max_len = S + max_new_tokens
     cache = init_kv_cache(config, B, max_len, cache_dtype)
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
 
     prefill = jax.jit(partial(_forward_cached, config=config))
 
     @partial(jax.jit, donate_argnums=(1,))
-    def decode_all(params, cache, first_tok):
-        """The ENTIRE decode loop on-device (one host round-trip total — a
-        per-token fetch would serialize on host/ICI latency)."""
-
+    def decode_all(params, cache, first_tok, key):
         def body(carry, i):
             tok, finished, cache = carry
             logits, cache = _forward_cached(params, tok[:, None], cache, S + i - 1, config)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+            nxt = select(logits[:, -1], jax.random.fold_in(key, i)).astype(tok.dtype)
             if eos_token_id is not None:
                 nxt = jnp.where(finished, eos_token_id, nxt)
                 finished = jnp.logical_or(finished, nxt == eos_token_id)
@@ -169,20 +191,22 @@ def greedy_generate(
         )
         return toks.T  # [B, max_new_tokens-1]
 
+    def _first(logits):
+        return select(logits[:, -1], jax.random.fold_in(rng_key, 0)).astype(prompt_ids.dtype)
+
     if warmup and max_new_tokens > 1:
         logits_w, cache_w = prefill(params, prompt_ids, init_kv_cache(config, B, max_len, cache_dtype), jnp.int32(0))
-        tok_w = jnp.argmax(logits_w[:, -1], axis=-1).astype(prompt_ids.dtype)
-        jax.device_get(decode_all(params, cache_w, tok_w))
+        jax.device_get(decode_all(params, cache_w, _first(logits_w), rng_key))
 
     t0 = time.time()
     logits, cache = prefill(params, prompt_ids, cache, jnp.int32(0))
-    first_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt_ids.dtype)
+    first_tok = _first(logits)
     first_host = np.asarray(jax.device_get(first_tok))  # forces prefill for timing
     prefill_s = time.time() - t0
 
     t0 = time.time()
     if max_new_tokens > 1:
-        rest = np.asarray(jax.device_get(decode_all(params, cache, first_tok)))
+        rest = np.asarray(jax.device_get(decode_all(params, cache, first_tok, rng_key)))
     else:
         rest = np.zeros((B, 0), first_host.dtype)
     decode_s = time.time() - t0
@@ -197,6 +221,55 @@ def greedy_generate(
             "seconds_per_token": decode_s / n_decoded,
         }
     return generated
+
+
+def greedy_generate(
+    params,
+    prompt_ids,
+    config: LlamaConfig,
+    max_new_tokens: int = 32,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+    return_stats: bool = False,
+    warmup: bool = False,
+):
+    """Jitted KV-cache greedy decoding for resident (replicated/sharded)
+    params. Returns ids [B, S_prompt + max_new_tokens] (with a stats dict —
+    prefill seconds, decode tokens/sec — when ``return_stats``); ``warmup``
+    runs the decode once before timing so stats exclude compilation."""
+    return _cached_generate(
+        params, prompt_ids, config, max_new_tokens, eos_token_id, cache_dtype,
+        return_stats, warmup,
+        select=lambda logits, key: jnp.argmax(logits, axis=-1),
+        rng_key=None,
+    )
+
+
+def sample_generate(
+    params,
+    prompt_ids,
+    config: LlamaConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng_key=None,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+    return_stats: bool = False,
+    warmup: bool = False,
+):
+    """Jitted KV-cache SAMPLED decoding (temperature / top-k / nucleus), the
+    counterpart of HF ``generate(do_sample=True)``. The PRNG key is folded per
+    step inside the compiled scan, so a given (key, prompt, knobs) triple is
+    fully deterministic; ``temperature=0`` degrades to greedy."""
+    return _cached_generate(
+        params, prompt_ids, config, max_new_tokens, eos_token_id, cache_dtype,
+        return_stats, warmup,
+        select=partial(sample_token_logits, temperature=temperature,
+                       top_k=top_k, top_p=top_p),
+        rng_key=rng_key,
+    )
 
 
 # ---------------------------------------------------------------------------

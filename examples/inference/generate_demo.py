@@ -46,6 +46,11 @@ def main():
     parser.add_argument("--batch", type=int, default=1)
     parser.add_argument("--prompt-len", type=int, default=32)
     parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="> 0 switches to sampled decoding (resident mode)")
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
     args = parser.parse_args()
     maybe_force_cpu(args)
@@ -58,6 +63,7 @@ def main():
     from accelerate_tpu.generation import (
         generate_dispatched,
         greedy_generate,
+        sample_generate,
         unstack_layer_params,
     )
     from accelerate_tpu.models import LlamaConfig, init_llama
@@ -84,10 +90,22 @@ def main():
         0, config.vocab_size, (args.batch, args.prompt_len)
     ).astype(np.int32)
 
+    if args.mode != "resident" and (
+        args.temperature > 0 or args.top_k or args.top_p < 1.0
+    ):
+        parser.error("sampling flags (--temperature/--top-k/--top-p) need --mode resident; "
+                     "dispatched decoding is greedy-only")
     if args.mode == "resident":
-        out, stats = greedy_generate(
-            params, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
-        )
+        if args.temperature > 0:
+            out, stats = sample_generate(
+                params, prompt, config, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+                rng_key=jax.random.PRNGKey(args.seed), return_stats=True,
+            )
+        else:
+            out, stats = greedy_generate(
+                params, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
+            )
     else:
         out, stats = generate_dispatched(
             model, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
