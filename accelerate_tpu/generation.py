@@ -36,6 +36,8 @@ from .models.transformer import LlamaConfig, apply_rope, rms_norm, rope_frequenc
 __all__ = [
     "init_kv_cache",
     "greedy_generate",
+    "sample_generate",
+    "sample_token_logits",
     "generate_dispatched",
     "unstack_layer_params",
 ]

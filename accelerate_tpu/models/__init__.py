@@ -17,6 +17,11 @@ from .resnet import (
     resnet_loss,
     resnet_shard_rules,
 )
+from .convert import (
+    bert_params_from_hf,
+    llama_params_from_hf,
+    t5_params_from_hf,
+)
 from .t5 import (
     T5Config,
     init_t5,

@@ -1,0 +1,222 @@
+"""HF-checkpoint → native-pytree weight converters.
+
+The reference serves pretrained torch models directly; our native model
+families (stacked-layer pytrees, ``models/{transformer,t5}.py``) need their
+weights re-laid-out: torch ``[out, in]`` linears transpose to ``[in, out]``,
+and per-layer tensors stack on a leading ``[L, ...]`` axis (the scan layout).
+These converters accept an HF ``nn.Module``, a ``state_dict``-like mapping of
+tensors/ndarrays, or a ``.safetensors`` path (streamed one tensor at a time —
+no full-model torch materialization; the moral twin of the reference's
+``load_checkpoint_in_model`` lazy loading, ``utils/modeling.py:1788``).
+
+Architectural requirements (asserted where cheap): Llama expects the HF
+``rotate_half`` RoPE convention (matches ``apply_rope``); T5 expects
+``feed_forward_proj="relu"`` v1.0 blocks; BERT expects the classic
+post-layer-norm encoder (``BertForSequenceClassification``).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _as_numpy_getter(source):
+    """Normalize (module | mapping | safetensors path) → (keys, getter, close).
+    ``close()`` must be called when conversion is done (releases the
+    safetensors file handle; no-op for in-memory sources)."""
+    if isinstance(source, str):
+        from safetensors import safe_open
+
+        handle = safe_open(source, framework="numpy")
+        return (
+            list(handle.keys()),
+            lambda k: handle.get_tensor(k),
+            lambda: handle.__exit__(None, None, None),
+        )
+    if hasattr(source, "state_dict") and callable(source.state_dict):
+        source = source.state_dict()
+    if isinstance(source, Mapping):
+        def get(k):
+            v = source[k]
+            return v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+
+        return list(source.keys()), get, lambda: None
+    raise TypeError(f"unsupported weight source: {type(source)!r}")
+
+
+def llama_params_from_hf(source, config) -> dict:
+    """HF ``LlamaForCausalLM`` weights → ``init_llama``-shaped pytree."""
+    keys, get, close = _as_numpy_getter(source)
+    try:
+        return _llama_params(keys, get, config)
+    finally:
+        close()
+
+
+def _llama_params(keys, get, config) -> dict:
+    prefix = "model." if any(k.startswith("model.") for k in keys) else ""
+    L = config.n_layers
+
+    def stack_t(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+
+    def stack_raw(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+
+    p = prefix
+    params = {
+        "embed_tokens": {"embedding": jnp.asarray(get(f"{p}embed_tokens.weight"))},
+        "layers": {
+            "attn_norm": {"scale": stack_raw(p + "layers.{}.input_layernorm.weight")},
+            "wq": {"kernel": stack_t(p + "layers.{}.self_attn.q_proj.weight")},
+            "wk": {"kernel": stack_t(p + "layers.{}.self_attn.k_proj.weight")},
+            "wv": {"kernel": stack_t(p + "layers.{}.self_attn.v_proj.weight")},
+            "wo": {"kernel": stack_t(p + "layers.{}.self_attn.o_proj.weight")},
+            "mlp_norm": {"scale": stack_raw(p + "layers.{}.post_attention_layernorm.weight")},
+            "w1": {"kernel": stack_t(p + "layers.{}.mlp.gate_proj.weight")},
+            "w3": {"kernel": stack_t(p + "layers.{}.mlp.up_proj.weight")},
+            "w2": {"kernel": stack_t(p + "layers.{}.mlp.down_proj.weight")},
+        },
+        "final_norm": {"scale": jnp.asarray(get(f"{p}norm.weight"))},
+    }
+    if not config.tie_embeddings:
+        head_key = "lm_head.weight"
+        if head_key in keys:
+            params["lm_head"] = {"kernel": jnp.asarray(get(head_key).T)}
+        else:  # HF tied checkpoint loaded into an untied config
+            params["lm_head"] = {"kernel": params["embed_tokens"]["embedding"].T}
+    return params
+
+
+def bert_params_from_hf(source, config) -> dict:
+    """HF ``BertForSequenceClassification`` weights → ``init_bert`` pytree."""
+    keys, get, close = _as_numpy_getter(source)
+    try:
+        return _bert_params(keys, get, config)
+    finally:
+        close()
+
+
+def _bert_params(keys, get, config) -> dict:
+    prefix = "bert." if any(k.startswith("bert.") for k in keys) else ""
+    L = config.n_layers
+    p = prefix
+
+    def stack_t(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+
+    def stack_raw(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+
+    enc = p + "encoder.layer.{}."
+    return {
+        "embeddings": {
+            "word": {"embedding": jnp.asarray(get(f"{p}embeddings.word_embeddings.weight"))},
+            "position": {"embedding": jnp.asarray(get(f"{p}embeddings.position_embeddings.weight"))},
+            "token_type": {"embedding": jnp.asarray(get(f"{p}embeddings.token_type_embeddings.weight"))},
+            "norm": {"scale": jnp.asarray(get(f"{p}embeddings.LayerNorm.weight")),
+                     "bias": jnp.asarray(get(f"{p}embeddings.LayerNorm.bias"))},
+        },
+        "layers": {
+            "wq": {"kernel": stack_t(enc + "attention.self.query.weight"),
+                   "bias": stack_raw(enc + "attention.self.query.bias")},
+            "wk": {"kernel": stack_t(enc + "attention.self.key.weight"),
+                   "bias": stack_raw(enc + "attention.self.key.bias")},
+            "wv": {"kernel": stack_t(enc + "attention.self.value.weight"),
+                   "bias": stack_raw(enc + "attention.self.value.bias")},
+            "wo": {"kernel": stack_t(enc + "attention.output.dense.weight"),
+                   "bias": stack_raw(enc + "attention.output.dense.bias")},
+            "attn_norm": {"scale": stack_raw(enc + "attention.output.LayerNorm.weight"),
+                          "bias": stack_raw(enc + "attention.output.LayerNorm.bias")},
+            "fc1": {"kernel": stack_t(enc + "intermediate.dense.weight"),
+                    "bias": stack_raw(enc + "intermediate.dense.bias")},
+            "fc2": {"kernel": stack_t(enc + "output.dense.weight"),
+                    "bias": stack_raw(enc + "output.dense.bias")},
+            "mlp_norm": {"scale": stack_raw(enc + "output.LayerNorm.weight"),
+                         "bias": stack_raw(enc + "output.LayerNorm.bias")},
+        },
+        "pooler": {"kernel": jnp.asarray(get(f"{p}pooler.dense.weight").T),
+                   "bias": jnp.asarray(get(f"{p}pooler.dense.bias"))},
+        "classifier": {"kernel": jnp.asarray(get("classifier.weight").T),
+                       "bias": jnp.asarray(get("classifier.bias"))},
+    }
+
+
+def t5_params_from_hf(source, config) -> dict:
+    """HF ``T5ForConditionalGeneration`` weights → ``init_t5`` pytree."""
+    keys, get, close = _as_numpy_getter(source)
+    try:
+        return _t5_params(keys, get, config)
+    finally:
+        close()
+
+
+def _t5_params(keys, get, config) -> dict:
+    L = config.n_layers
+
+    def stack_t(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+
+    def stack_raw(fmt):
+        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+
+    def attn_block(stem, hf_attn):
+        return {
+            "wq": {"kernel": stack_t(f"{stem}.{hf_attn}.q.weight")},
+            "wk": {"kernel": stack_t(f"{stem}.{hf_attn}.k.weight")},
+            "wv": {"kernel": stack_t(f"{stem}.{hf_attn}.v.weight")},
+            "wo": {"kernel": stack_t(f"{stem}.{hf_attn}.o.weight")},
+        }
+
+    params = {
+        "shared_embedding": {"embedding": jnp.asarray(get("shared.weight"))},
+        "encoder": {
+            "rel_pos": {"embedding": jnp.asarray(get(
+                "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ))},
+            "layers": {
+                "attn_norm": {"scale": stack_raw("encoder.block.{}.layer.0.layer_norm.weight")},
+                "attn": attn_block("encoder.block.{}.layer.0", "SelfAttention"),
+                "mlp_norm": {"scale": stack_raw("encoder.block.{}.layer.1.layer_norm.weight")},
+                "wi": {"kernel": stack_t("encoder.block.{}.layer.1.DenseReluDense.wi.weight")},
+                "wo": {"kernel": stack_t("encoder.block.{}.layer.1.DenseReluDense.wo.weight")},
+            },
+            "final_norm": {"scale": jnp.asarray(get("encoder.final_layer_norm.weight"))},
+        },
+        "decoder": {
+            "rel_pos": {"embedding": jnp.asarray(get(
+                "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            ))},
+            "layers": {
+                "self_norm": {"scale": stack_raw("decoder.block.{}.layer.0.layer_norm.weight")},
+                "self_attn": attn_block("decoder.block.{}.layer.0", "SelfAttention"),
+                "cross_norm": {"scale": stack_raw("decoder.block.{}.layer.1.layer_norm.weight")},
+                "cross_attn": attn_block("decoder.block.{}.layer.1", "EncDecAttention"),
+                "mlp_norm": {"scale": stack_raw("decoder.block.{}.layer.2.layer_norm.weight")},
+                "wi": {"kernel": stack_t("decoder.block.{}.layer.2.DenseReluDense.wi.weight")},
+                "wo": {"kernel": stack_t("decoder.block.{}.layer.2.DenseReluDense.wo.weight")},
+            },
+            "final_norm": {"scale": jnp.asarray(get("decoder.final_layer_norm.weight"))},
+        },
+    }
+    if not config.tie_word_embeddings:
+        # tied HF checkpoints into an untied config: HF's tied forward rescales
+        # hidden states by d^-0.5 before the shared projection; our untied
+        # forward does not, so the rescale folds into the kernel. A tied
+        # checkpoint shows up either as a MISSING lm_head tensor (safetensors
+        # drops shared storage) or as a byte-identical duplicate of shared
+        # (state_dict materializes both names).
+        shared = np.asarray(params["shared_embedding"]["embedding"])
+        if "lm_head.weight" in keys:
+            head = np.asarray(get("lm_head.weight"))
+            kernel = jnp.asarray(head.T)
+            if head.shape == shared.shape and np.array_equal(head, shared):
+                kernel = kernel * (config.dim ** -0.5)
+            params["lm_head"] = {"kernel": kernel}
+        else:
+            params["lm_head"] = {"kernel": jnp.asarray(shared.T) * (config.dim ** -0.5)}
+    return params

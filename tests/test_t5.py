@@ -43,56 +43,9 @@ def _hf_t5(seed=0):
 
 
 def _convert_hf_weights(model, cfg: T5Config) -> dict:
-    """HF torch state dict → our stacked-layer pytree (weights transposed to
-    [in, out]; per-layer tensors stacked on the leading axis)."""
-    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
-    L = cfg.n_layers
+    from accelerate_tpu.models import t5_params_from_hf
 
-    def stack(fmt):
-        return jnp.stack([jnp.asarray(sd[fmt.format(i)].T) for i in range(L)])
-
-    def norm_stack(fmt):
-        return jnp.stack([jnp.asarray(sd[fmt.format(i)]) for i in range(L)])
-
-    def attn_block(stem, hf_attn):
-        return {
-            "wq": {"kernel": stack(f"{stem}.{hf_attn}.q.weight")},
-            "wk": {"kernel": stack(f"{stem}.{hf_attn}.k.weight")},
-            "wv": {"kernel": stack(f"{stem}.{hf_attn}.v.weight")},
-            "wo": {"kernel": stack(f"{stem}.{hf_attn}.o.weight")},
-        }
-
-    return {
-        "shared_embedding": {"embedding": jnp.asarray(sd["shared.weight"])},
-        "encoder": {
-            "rel_pos": {"embedding": jnp.asarray(
-                sd["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
-            )},
-            "layers": {
-                "attn_norm": {"scale": norm_stack("encoder.block.{}.layer.0.layer_norm.weight")},
-                "attn": attn_block("encoder.block.{}.layer.0", "SelfAttention"),
-                "mlp_norm": {"scale": norm_stack("encoder.block.{}.layer.1.layer_norm.weight")},
-                "wi": {"kernel": stack("encoder.block.{}.layer.1.DenseReluDense.wi.weight")},
-                "wo": {"kernel": stack("encoder.block.{}.layer.1.DenseReluDense.wo.weight")},
-            },
-            "final_norm": {"scale": jnp.asarray(sd["encoder.final_layer_norm.weight"])},
-        },
-        "decoder": {
-            "rel_pos": {"embedding": jnp.asarray(
-                sd["decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
-            )},
-            "layers": {
-                "self_norm": {"scale": norm_stack("decoder.block.{}.layer.0.layer_norm.weight")},
-                "self_attn": attn_block("decoder.block.{}.layer.0", "SelfAttention"),
-                "cross_norm": {"scale": norm_stack("decoder.block.{}.layer.1.layer_norm.weight")},
-                "cross_attn": attn_block("decoder.block.{}.layer.1", "EncDecAttention"),
-                "mlp_norm": {"scale": norm_stack("decoder.block.{}.layer.2.layer_norm.weight")},
-                "wi": {"kernel": stack("decoder.block.{}.layer.2.DenseReluDense.wi.weight")},
-                "wo": {"kernel": stack("decoder.block.{}.layer.2.DenseReluDense.wo.weight")},
-            },
-            "final_norm": {"scale": jnp.asarray(sd["decoder.final_layer_norm.weight"])},
-        },
-    }
+    return t5_params_from_hf(model, cfg)
 
 
 class TestHFParity:
