@@ -37,6 +37,7 @@ __all__ = [
     "init_kv_cache",
     "greedy_generate",
     "sample_generate",
+    "beam_generate",
     "sample_token_logits",
     "generate_dispatched",
     "unstack_layer_params",
@@ -272,6 +273,111 @@ def sample_generate(
                        top_k=top_k, top_p=top_p),
         rng_key=rng_key,
     )
+
+
+def beam_generate(
+    params,
+    prompt_ids,  # [B, S_prompt]
+    config: LlamaConfig,
+    num_beams: int = 4,
+    max_new_tokens: int = 32,
+    eos_token_id: Optional[int] = None,
+    length_penalty: float = 1.0,
+    cache_dtype=jnp.bfloat16,
+    return_scores: bool = False,
+):
+    """Jitted KV-cache beam search (deterministic highest-probability decode).
+
+    Standard beam algorithm: prefill once at batch B, tile the cache to
+    ``B * num_beams``, then each scanned step expands every live beam over the
+    vocab, keeps the top ``num_beams`` of ``num_beams * V`` candidates, and
+    REORDERS the KV cache with the surviving beams' parent indices (a gather
+    on the cache batch axis — the whole loop stays one compiled scan, like the
+    greedy/sampled paths). Finished beams (hit ``eos_token_id``) are frozen:
+    their only continuation is another eos at zero log-prob, so their score is
+    carried unchanged. Final ranking divides by ``length^length_penalty``
+    (HF semantics; 1.0 = average log-prob).
+
+    Returns ids ``[B, S_prompt + max_new_tokens]`` for the best beam
+    (``return_scores=True`` adds the [B] length-normalized scores).
+    """
+    prompt_ids = jnp.asarray(prompt_ids)
+    B, S = prompt_ids.shape
+    K = num_beams
+    max_len = S + max_new_tokens
+    V = config.vocab_size
+
+    cache = init_kv_cache(config, B, max_len, cache_dtype)
+    prefill = jax.jit(partial(_forward_cached, config=config))
+    logits, cache = prefill(params, prompt_ids, cache, jnp.int32(0))
+
+    @jax.jit
+    def beam_all(params, cache, last_logits):
+        # tile the cache over beams: [L, B, ...] -> [L, B*K, ...]
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=1), cache
+        )
+        logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)  # [B, V]
+        scores0, tok0 = jax.lax.top_k(logp0, K)  # [B, K]
+        finished0 = (
+            tok0 == eos_token_id if eos_token_id is not None else jnp.zeros((B, K), bool)
+        )
+        lengths0 = jnp.ones((B, K), jnp.int32)
+        tokens0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+        tokens0 = tokens0.at[:, :, 0].set(tok0)
+
+        def body(carry, i):
+            tokens, scores, finished, lengths, cache = carry
+            last = jax.lax.dynamic_index_in_dim(tokens, i - 1, axis=2)  # [B, K, 1]
+            logits, cache = _forward_cached(
+                params, last.reshape(B * K, 1), cache, S + i - 1, config
+            )
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+            logp = logp.reshape(B, K, V)
+            if eos_token_id is not None:
+                # frozen beams may only emit eos again, at no score cost
+                frozen = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+            cand = scores[:, :, None] + logp  # [B, K, V]
+            new_scores, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+            parent = flat_idx // V  # [B, K]
+            tok = (flat_idx % V).astype(jnp.int32)
+
+            tokens = jnp.take_along_axis(tokens, parent[:, :, None], axis=1)
+            tokens = tokens.at[:, :, i].set(tok)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            lengths = jnp.take_along_axis(lengths, parent, axis=1)
+            lengths = jnp.where(finished, lengths, lengths + 1)
+            if eos_token_id is not None:
+                finished = jnp.logical_or(finished, tok == eos_token_id)
+            # reorder the cache: [L, B*K, ...] -> group beams -> gather parents
+            def cache_reorder(c):
+                shaped = c.reshape((c.shape[0], B, K) + c.shape[2:])
+                idx = parent.reshape((1, B, K) + (1,) * (shaped.ndim - 3))
+                return jnp.take_along_axis(shaped, idx, axis=2).reshape(c.shape)
+
+            cache = jax.tree_util.tree_map(cache_reorder, cache)
+            return (tokens, new_scores, finished, lengths, cache), None
+
+        (tokens, scores, finished, lengths, cache), _ = jax.lax.scan(
+            body,
+            (tokens0, scores0, finished0, lengths0, cache),
+            jnp.arange(1, max_new_tokens),
+        )
+        norm = scores / jnp.power(lengths.astype(jnp.float32), length_penalty)
+        best = jnp.argmax(norm, axis=1)  # [B]
+        best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]
+        best_score = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+        return best_tokens, best_score
+
+    best_tokens, best_score = beam_all(params, cache, logits[:, -1])
+    out = np.concatenate(
+        [np.asarray(jax.device_get(prompt_ids)), np.asarray(jax.device_get(best_tokens))],
+        axis=1,
+    )
+    if return_scores:
+        return out, np.asarray(jax.device_get(best_score))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -42,10 +42,44 @@ def _as_numpy_getter(source):
     if isinstance(source, Mapping):
         def get(k):
             v = source[k]
-            return v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+            if hasattr(v, "detach"):  # torch tensor
+                v = v.detach().cpu()
+                if str(v.dtype) == "torch.bfloat16":
+                    # Tensor.numpy() rejects bf16; reinterpret the bits
+                    import ml_dtypes
+                    import torch
+
+                    return v.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+                return v.numpy()
+            return np.asarray(v)
 
         return list(source.keys()), get, lambda: None
     raise TypeError(f"unsupported weight source: {type(source)!r}")
+
+
+def _stack_t(get, fmt: str, L: int):
+    """Stack L per-layer torch ``[out, in]`` linears → ``[L, in, out]``."""
+    return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+
+
+def _stack_raw(get, fmt: str, L: int):
+    """Stack L per-layer tensors unchanged → leading ``[L, ...]`` axis."""
+    return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+
+
+def _assert_not_dropping_head(keys, get, embedding, head_key: str, what: str):
+    """Tied config + checkpoint carrying a DISTINCT head: refuse to silently
+    discard the head weights (the reverse direction is handled by folding)."""
+    if head_key not in keys:
+        return
+    head = np.asarray(get(head_key))
+    emb = np.asarray(embedding)
+    if head.shape == emb.shape and np.array_equal(head, emb):
+        return  # materialized tied duplicate — nothing lost
+    raise ValueError(
+        f"checkpoint has a distinct {head_key} but the target {what} config is "
+        "tied (tie embeddings=False to keep the checkpoint's head)"
+    )
 
 
 def llama_params_from_hf(source, config) -> dict:
@@ -62,10 +96,10 @@ def _llama_params(keys, get, config) -> dict:
     L = config.n_layers
 
     def stack_t(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+        return _stack_t(get, fmt, L)
 
     def stack_raw(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+        return _stack_raw(get, fmt, L)
 
     p = prefix
     params = {
@@ -89,6 +123,10 @@ def _llama_params(keys, get, config) -> dict:
             params["lm_head"] = {"kernel": jnp.asarray(get(head_key).T)}
         else:  # HF tied checkpoint loaded into an untied config
             params["lm_head"] = {"kernel": params["embed_tokens"]["embedding"].T}
+    else:
+        _assert_not_dropping_head(
+            keys, get, params["embed_tokens"]["embedding"], "lm_head.weight", "Llama"
+        )
     return params
 
 
@@ -107,10 +145,10 @@ def _bert_params(keys, get, config) -> dict:
     p = prefix
 
     def stack_t(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+        return _stack_t(get, fmt, L)
 
     def stack_raw(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+        return _stack_raw(get, fmt, L)
 
     enc = p + "encoder.layer.{}."
     return {
@@ -159,10 +197,10 @@ def _t5_params(keys, get, config) -> dict:
     L = config.n_layers
 
     def stack_t(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i)).T) for i in range(L)])
+        return _stack_t(get, fmt, L)
 
     def stack_raw(fmt):
-        return jnp.stack([jnp.asarray(get(fmt.format(i))) for i in range(L)])
+        return _stack_raw(get, fmt, L)
 
     def attn_block(stem, hf_attn):
         return {
@@ -219,4 +257,8 @@ def _t5_params(keys, get, config) -> dict:
             params["lm_head"] = {"kernel": kernel}
         else:
             params["lm_head"] = {"kernel": jnp.asarray(shared.T) * (config.dim ** -0.5)}
+    else:
+        _assert_not_dropping_head(
+            keys, get, params["shared_embedding"]["embedding"], "lm_head.weight", "T5"
+        )
     return params

@@ -95,7 +95,7 @@ def main():
     ):
         parser.error("sampling flags (--temperature/--top-k/--top-p) need --mode resident; "
                      "dispatched decoding is greedy-only")
-    if args.mode == "resident" and args.temperature == 0 and (args.top_k or args.top_p < 1.0):
+    if args.mode == "resident" and args.temperature <= 0 and (args.top_k or args.top_p < 1.0):
         parser.error("--top-k/--top-p need --temperature > 0 (temperature 0 is greedy)")
     if args.mode == "resident":
         if args.temperature > 0:

@@ -3,7 +3,7 @@ models (the 'bring your pretrained weights to the native families' path —
 reference counterpart: serving torch checkpoints directly,
 ``utils/modeling.py:1788`` lazy loading)."""
 
-import dataclasses
+
 
 import numpy as np
 import pytest
@@ -165,3 +165,40 @@ def test_t5_tied_checkpoint_into_untied_config_rescales(tmp_path):
     # rescale folded into the kernel vs applied to hidden states: same math,
     # different float op order
     np.testing.assert_allclose(np.asarray(untied), np.asarray(tied), rtol=2e-4, atol=1e-6)
+
+
+def test_bf16_module_source():
+    """Converting a bf16-loaded HF module must not crash (Tensor.numpy rejects
+    BFloat16) and must preserve the bf16 dtype."""
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    torch.manual_seed(4)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False,
+    )).to(torch.bfloat16).eval()
+    cfg = LlamaConfig(vocab_size=64, dim=16, ffn_dim=32, n_layers=2, n_heads=2,
+                      n_kv_heads=2, max_seq_len=32)
+    params = llama_params_from_hf(hf, cfg)
+    assert params["layers"]["wq"]["kernel"].dtype == jnp.bfloat16
+    f32 = np.asarray(params["layers"]["wq"]["kernel"].astype(jnp.float32))
+    ref = hf.model.layers[0].self_attn.q_proj.weight.detach().float().numpy().T
+    np.testing.assert_array_equal(f32[0], ref)
+
+
+def test_tied_config_refuses_distinct_head():
+    """An untied checkpoint loaded into a tied config must raise, not silently
+    drop the checkpoint's lm_head."""
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    torch.manual_seed(5)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False,
+    )).eval()
+    cfg = LlamaConfig(vocab_size=64, dim=16, ffn_dim=32, n_layers=2, n_heads=2,
+                      n_kv_heads=2, max_seq_len=32, tie_embeddings=True)
+    with pytest.raises(ValueError, match="distinct lm_head"):
+        llama_params_from_hf(hf, cfg)
