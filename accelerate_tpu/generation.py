@@ -322,7 +322,9 @@ def beam_generate(
         finished0 = (
             tok0 == eos_token_id if eos_token_id is not None else jnp.zeros((B, K), bool)
         )
-        lengths0 = jnp.ones((B, K), jnp.int32)
+        # HF BeamHypotheses normalizes by the FULL sequence length (prompt +
+        # generated), so unequal-length finished beams rank identically to HF
+        lengths0 = jnp.full((B, K), S + 1, jnp.int32)
         tokens0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
         tokens0 = tokens0.at[:, :, 0].set(tok0)
 

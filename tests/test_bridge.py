@@ -551,7 +551,9 @@ class TestBeamSearch:
                 beams = cands[:K]
             best_seq, best_score = beams[0]
             np.testing.assert_array_equal(out[b], np.asarray(best_seq))
-            assert abs(scores[b] - best_score / N) < 1e-4, (scores[b], best_score / N)
+            # HF normalization: full sequence length (prompt + generated)
+            expected = best_score / (prompt.shape[1] + N)
+            assert abs(scores[b] - expected) < 1e-4, (scores[b], expected)
 
     def test_beam_finds_higher_likelihood_than_greedy(self):
         import jax
