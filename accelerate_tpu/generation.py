@@ -295,8 +295,9 @@ def beam_generate(
     on the cache batch axis — the whole loop stays one compiled scan, like the
     greedy/sampled paths). Finished beams (hit ``eos_token_id``) are frozen:
     their only continuation is another eos at zero log-prob, so their score is
-    carried unchanged. Final ranking divides by ``length^length_penalty``
-    (HF semantics; 1.0 = average log-prob).
+    carried unchanged. Final ranking divides by
+    ``generated_length^length_penalty`` (modern HF >= 4.35 semantics;
+    1.0 = average log-prob over the generated tokens).
 
     Returns ids ``[B, S_prompt + max_new_tokens]`` for the best beam
     (``return_scores=True`` adds the [B] length-normalized scores).
@@ -322,9 +323,10 @@ def beam_generate(
         finished0 = (
             tok0 == eos_token_id if eos_token_id is not None else jnp.zeros((B, K), bool)
         )
-        # HF BeamHypotheses normalizes by the FULL sequence length (prompt +
-        # generated), so unequal-length finished beams rank identically to HF
-        lengths0 = jnp.full((B, K), S + 1, jnp.int32)
+        # modern HF (>= 4.35) normalizes by GENERATED length only
+        # (GenerationMixin._update_finished_beams: cur_len+1-decoder_prompt_len);
+        # the pre-4.35 full-sequence divisor is legacy
+        lengths0 = jnp.ones((B, K), jnp.int32)
         tokens0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
         tokens0 = tokens0.at[:, :, 0].set(tok0)
 

@@ -551,8 +551,8 @@ class TestBeamSearch:
                 beams = cands[:K]
             best_seq, best_score = beams[0]
             np.testing.assert_array_equal(out[b], np.asarray(best_seq))
-            # HF normalization: full sequence length (prompt + generated)
-            expected = best_score / (prompt.shape[1] + N)
+            # modern-HF normalization: generated length only
+            expected = best_score / N
             assert abs(scores[b] - expected) < 1e-4, (scores[b], expected)
 
     def test_beam_finds_higher_likelihood_than_greedy(self):

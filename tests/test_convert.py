@@ -202,3 +202,31 @@ def test_tied_config_refuses_distinct_head():
                       n_kv_heads=2, max_seq_len=32, tie_embeddings=True)
     with pytest.raises(ValueError, match="distinct lm_head"):
         llama_params_from_hf(hf, cfg)
+
+
+def test_beam_generate_matches_hf_beam_search():
+    """Converted-weight beam search vs transformers generate(num_beams=K):
+    pins our ranking/normalization against the INSTALLED HF version."""
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from accelerate_tpu.generation import beam_generate
+
+    torch.manual_seed(7)
+    hf = LlamaForCausalLM(HFConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )).eval()
+    cfg = LlamaConfig(vocab_size=96, dim=32, ffn_dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, max_seq_len=64)
+    params = llama_params_from_hf(hf, cfg)
+    prompt = np.random.default_rng(7).integers(2, 96, (2, 6)).astype(np.int32)
+    ours = beam_generate(params, prompt, cfg, num_beams=3, max_new_tokens=6,
+                         cache_dtype=jnp.float32)
+    hf.config.use_cache = True
+    ref = hf.generate(
+        torch.from_numpy(prompt.astype(np.int64)), max_new_tokens=6,
+        num_beams=3, do_sample=False, early_stopping=False, pad_token_id=0,
+        length_penalty=1.0,
+    ).numpy()
+    np.testing.assert_array_equal(ours, ref)

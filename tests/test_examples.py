@@ -243,3 +243,16 @@ class TestNewByFeature:
         ns2.resume_from_checkpoint = ckpt
         out2 = mod.training_function(ns2)
         assert "eval_accuracy" in out2
+
+    def test_gradient_compression(self):
+        mod, ns = self._run("by_feature/gradient_compression.py", epochs=6,
+                            batch_size=4, train_size=256, eval_size=64, lr=3e-3)
+        ns.compress = "bf16"
+        out = mod.training_function(ns)
+        assert out["eval_accuracy"] > 0.8
+
+    def test_fsdp_with_peak_mem_tracking(self):
+        mod, ns = self._run("by_feature/fsdp_with_peak_mem_tracking.py", epochs=1)
+        ns.fsdp = 8
+        out = mod.training_function(ns)
+        assert "planned" in out and out["planned"]["argument_bytes"] >= 0
