@@ -1,0 +1,43 @@
+# Test/benchmark targets (reference Makefile:23-58 split: core vs cli vs
+# big-modeling vs examples, for CI sharding).
+
+.PHONY: test test_core test_cli test_big_modeling test_examples test_models \
+        test_multihost test_checkpoint quality bench
+
+PYTEST := python -m pytest -q
+
+test:
+	$(PYTEST) tests/
+
+test_core:
+	$(PYTEST) tests/ --ignore=tests/test_big_modeling.py \
+	  --ignore=tests/test_examples.py --ignore=tests/test_cli.py \
+	  --ignore=tests/test_multiprocess.py --ignore=tests/test_models.py \
+	  --ignore=tests/test_t5.py --ignore=tests/test_convert.py \
+	  --ignore=tests/test_bridge.py --ignore=tests/test_sharded_checkpoint.py \
+	  --ignore=tests/test_native.py
+
+test_cli:
+	$(PYTEST) tests/test_cli.py
+
+test_big_modeling:
+	$(PYTEST) tests/test_big_modeling.py
+
+test_examples:
+	$(PYTEST) tests/test_examples.py
+
+test_models:
+	$(PYTEST) tests/test_models.py tests/test_t5.py tests/test_convert.py \
+	  tests/test_bridge.py
+
+test_multihost:
+	$(PYTEST) tests/test_multiprocess.py
+
+test_checkpoint:
+	$(PYTEST) tests/test_sharded_checkpoint.py tests/test_native.py
+
+quality:
+	python -m compileall -q accelerate_tpu
+
+bench:
+	python bench.py

@@ -46,7 +46,13 @@ def training_function(args):
     # train step and ask XLA for its temp/argument/output allocation sizes
     step_unjit = accelerator._build_train_step(setup["loss_fn"], optimizer, False, False)
     batch0 = next(iter(setup["train_dl"]))
-    compiled = jax.jit(step_unjit).lower(params, optimizer.opt_state, batch0).compile()
+    # donate params/opt_state exactly like the prepared step does, or the plan
+    # double-counts the parameter memory (old + updated buffers)
+    compiled = (
+        jax.jit(step_unjit, donate_argnums=(0, 1))
+        .lower(params, optimizer.opt_state, batch0)
+        .compile()
+    )
     mem = compiled.memory_analysis()
     planned = {
         "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),

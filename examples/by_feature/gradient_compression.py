@@ -1,7 +1,14 @@
 """Gradient compression (the reference's ``by_feature/ddp_comm_hook.py``):
-DDP comm hooks (fp16/bf16 compress) shrink the allreduce payload. Under SPMD
-there is no hook registry — the same effect is a cast in the gradient path
-before XLA's compiler-inserted reduction, expressed as an optax transform.
+DDP comm hooks (fp16/bf16 compress) shrink the allreduce payload.
+
+Under SPMD the WIRE compression is already owned by the precision policy: with
+``mixed_precision="bf16"`` the backward pass computes bf16 gradients, so the
+compiler-inserted cross-replica reduction moves bf16 — the fp16/bf16 comm-hook
+payload saving is inherent, no hook registry needed. What this example adds on
+top is the hook's other half: KEEPING the gradient signal compressed through
+the optimizer path, expressed as an optax transform (round-trip cast) placed
+ahead of the update — demonstrating where reference comm-hook users hang
+custom gradient processing in this framework.
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/by_feature/gradient_compression.py --cpu --compress bf16
@@ -18,11 +25,12 @@ from example_utils import add_common_args, build_tiny_bert_setup, evaluate_accur
 
 
 def compress_gradients(dtype_name: str):
-    """optax transform casting grads to a compressed wire dtype and back —
-    the SPMD analogue of DDPCommunicationHookType.FP16/BF16 (reference
-    ``utils/dataclasses.py:134-240``). Placed FIRST in the chain, the cast
-    happens before the (compiler-scheduled) cross-replica reduction reads the
-    values, so the collective moves half the bytes."""
+    """optax transform bounding the gradient signal to a compressed dtype
+    (round-trip cast) before the optimizer consumes it — the update-side
+    analogue of DDPCommunicationHookType.FP16/BF16 (reference
+    ``utils/dataclasses.py:134-240``). NOTE: this runs AFTER the
+    compiler-inserted gradient reduction; the reduction itself already moves
+    bf16 bytes whenever the bf16 precision policy is active."""
     import jax
     import jax.numpy as jnp
     import optax

@@ -1,0 +1,81 @@
+"""Seq2seq example: T5-style encoder-decoder fine-tune + greedy eval
+(reference acceptance surface includes T5/T0pp through transformers; this is
+the native counterpart using ``models/t5.py``).
+
+Task (synthetic, learnable, GENERALIZES held-out): one keyword token is
+planted at a random position among distractors; the target spells out a fixed
+4-token pattern of the keyword — the decoder must find it via content-based
+cross-attention (tiny models reach >0.9 held-out exact match; harder
+position-addressed tasks like reversal only memorize at this scale).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/seq2seq_example.py --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from example_utils import DictDataset, add_common_args, maybe_force_cpu
+
+
+def make_keyword_task(n: int, src_len: int, vocab: int, seed: int = 0):
+    """src: distractors (40..vocab) with ONE keyword (2..39) planted at a
+    random position; tgt: [kw, kw, kw+1, kw] — content lookup + local map."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    src = rng.integers(40, vocab, (n, src_len)).astype(np.int32)
+    kw = rng.integers(2, 40, n).astype(np.int32)
+    pos = rng.integers(0, src_len, n)
+    src[np.arange(n), pos] = kw
+    tgt = np.stack([kw, kw, (kw + 1) % 40, kw], axis=1).astype(np.int32)
+    dec_in = np.concatenate([np.zeros((n, 1), np.int32), tgt[:, :-1]], axis=1)
+    return {"input_ids": src, "decoder_input_ids": dec_in, "labels": tgt}
+
+
+def training_function(args):
+    import dataclasses
+
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, DataLoader
+    from accelerate_tpu.models import T5Config, init_t5, t5_greedy_generate, t5_loss, t5_shard_rules
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision,
+                              cpu=args.cpu, rng_seed=args.seed)
+    config = dataclasses.replace(T5Config.tiny(), vocab_size=128)
+    train = make_keyword_task(args.train_size, args.src_len, config.vocab_size, seed=0)
+    test = make_keyword_task(args.eval_size, args.src_len, config.vocab_size, seed=1)
+    params = init_t5(config, jax.random.PRNGKey(args.seed))
+    train_dl = DataLoader(DictDataset(train), batch_size=args.batch_size,
+                          shuffle=True, seed=args.seed)
+    params, optimizer, train_dl = accelerator.prepare(
+        params, optax.adam(args.lr), train_dl, shard_rules=t5_shard_rules()
+    )
+    step = accelerator.prepare_train_step(lambda p, b: t5_loss(p, b, config), optimizer)
+    opt_state = optimizer.opt_state
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            params, opt_state, metrics = step(params, opt_state, batch)
+        accelerator.print(f"epoch {epoch}: loss {float(metrics['loss']):.4f}")
+
+    # greedy-decode eval: exact-sequence match rate on held-out data
+    out = t5_greedy_generate(params, test["input_ids"], config, max_new_tokens=4)
+    pred = np.asarray(out)[:, 1:5]  # drop the start token
+    exact = float((pred == test["labels"]).all(axis=1).mean())
+    accelerator.print(f"exact-match {exact:.3f}")
+    return {"train_loss": float(metrics["loss"]), "exact_match": exact}
+
+
+if __name__ == "__main__":
+    parser = add_common_args(argparse.ArgumentParser(description=__doc__))
+    parser.add_argument("--src-len", type=int, default=12)
+    args = parser.parse_args()
+    maybe_force_cpu(args)
+    training_function(args)

@@ -224,9 +224,11 @@ def test_beam_generate_matches_hf_beam_search():
     ours = beam_generate(params, prompt, cfg, num_beams=3, max_new_tokens=6,
                          cache_dtype=jnp.float32)
     hf.config.use_cache = True
+    # eos disabled on BOTH sides so the comparison is well-defined (with eos,
+    # HF pads finalized rows with pad_token while ours re-emits eos)
     ref = hf.generate(
         torch.from_numpy(prompt.astype(np.int64)), max_new_tokens=6,
         num_beams=3, do_sample=False, early_stopping=False, pad_token_id=0,
-        length_penalty=1.0,
+        length_penalty=1.0, eos_token_id=None,
     ).numpy()
     np.testing.assert_array_equal(ours, ref)

@@ -256,3 +256,11 @@ class TestNewByFeature:
         ns.fsdp = 8
         out = mod.training_function(ns)
         assert "planned" in out and out["planned"]["argument_bytes"] >= 0
+
+    def test_seq2seq_example(self):
+        mod = load_example("seq2seq_example.py")
+        ns = tiny_args(mod, "seq2seq_example.py", epochs=15, batch_size=16,
+                       train_size=2048, eval_size=64, lr=3e-3)
+        ns.src_len = 12
+        out = mod.training_function(ns)
+        assert out["exact_match"] > 0.8, out
