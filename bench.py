@@ -438,6 +438,54 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
     }
 
 
+def run_bench_compile_time(on_tpu: bool) -> dict:
+    """Compile-time config (reference ``benchmarks/torch.compile/README.md``:
+    regional vs full compilation, 5-9x claimed): our scan-over-stacked-layers
+    IS regional compilation — one layer body compiled once regardless of depth
+    — vs ``unroll_layers=True`` which inlines every layer like a full
+    torch.compile. Reports wall seconds to lower+compile the jitted forward
+    both ways and the resulting speedup."""
+    import dataclasses
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
+
+    _reset_state()
+    if on_tpu:
+        # ≈ Llama-1B (the reference table's smallest row)
+        base = LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
+                           n_kv_heads=8, max_seq_len=256)
+        B, S = 1, 128
+    else:
+        base = LlamaConfig.tiny()
+        B, S = 1, 32
+    ids = np.zeros((B, S), np.int32)
+
+    def compile_seconds(unroll: bool) -> float:
+        config = dataclasses.replace(base, unroll_layers=unroll)
+        # lower() only needs shapes — eval_shape skips allocating ~GBs of real
+        # parameters before the timed region
+        params = jax.eval_shape(lambda: init_llama(config, jax.random.PRNGKey(0)))
+        fn = jax.jit(lambda p, i: llama_forward(p, i, config, attention_impl="xla"))
+        t0 = _t.time()
+        fn.lower(params, ids).compile()
+        return _t.time() - t0
+
+    scan_s = compile_seconds(False)  # regional: one compiled layer body
+    full_s = compile_seconds(True)   # full: every layer inlined
+    return {
+        "metric": "forward compile seconds (scan=regional vs unrolled=full)",
+        "value": round(scan_s, 2),
+        "unit": "seconds",
+        "full_compile_seconds": round(full_s, 2),
+        "compile_speedup": round(full_s / max(scan_s, 1e-9), 2),
+        "n_layers": base.n_layers,
+    }
+
+
 def main():
     try:
         result = run_bench()
@@ -465,6 +513,7 @@ def main():
         ("fsdp_lm", run_bench_fsdp_lm),
         ("inference", run_bench_inference),
         ("long_context", run_bench_longcontext),
+        ("compile_time", run_bench_compile_time),
     ):
         try:
             entry = fn(on_tpu)
