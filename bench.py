@@ -464,6 +464,10 @@ def run_bench_compile_time(on_tpu: bool) -> dict:
         B, S = 1, 32
     ids = np.zeros((B, S), np.int32)
 
+    # throwaway compile first: one-time backend/compiler startup (tens of
+    # seconds through the TPU tunnel) must not land in the first timed region
+    jax.jit(lambda x: x + 1).lower(np.float32(0)).compile()
+
     def compile_seconds(unroll: bool) -> float:
         config = dataclasses.replace(base, unroll_layers=unroll)
         # lower() only needs shapes — eval_shape skips allocating ~GBs of real

@@ -274,3 +274,33 @@ def test_custom_object_checkpointing(tmp_path):
     c.n = 0
     acc.load_state(out, params={"w": np.zeros(2, np.float32)})
     assert c.n == 7
+
+
+def test_train_loop_on_sharded_mesh_with_dataloader():
+    """prepare_train_loop over stacked SHARDED global batches (the bench hot
+    path): FSDPxTP mesh, stack_batches of prepared-DataLoader output, loss
+    falls, and state write-back stays live for checkpointing."""
+    from accelerate_tpu.utils.operations import stack_batches
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc = Accelerator(
+        mixed_precision="bf16",
+        parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2),
+    )
+    params, opt, dl = acc.prepare(
+        fresh_params(), optax.adam(1e-2),
+        DataLoader(RegressionDS(), batch_size=4),
+        shard_rules=ShardingRules([(r"w", P("dp_shard", "tp")), (r"b", P())]),
+    )
+    loop = acc.prepare_train_loop(loss_fn, opt)
+    batches = list(dl)[:4]
+    stacked = stack_batches(batches)
+    p, s = params, opt.opt_state
+    p, s, m1 = loop(p, s, stacked)
+    p, s, m2 = loop(p, s, stacked)
+    losses1 = np.asarray(m1["loss"]); losses2 = np.asarray(m2["loss"])
+    assert losses1.shape == (4,)
+    assert float(losses2[-1]) < float(losses1[0])
+    assert opt.opt_state is s  # write-back for save_state
+    # params stayed sharded through the scan
+    assert p["w"].sharding.spec == P("dp_shard", "tp")
