@@ -410,19 +410,33 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, config.vocab_size, (bs, seq)), jnp.int32
     )
-    impl = "flash" if on_tpu else "xla"  # S=8192 is deep in flash territory
+    def make_step(impl):
+        @jax.jit
+        def step(p, s, b):
+            loss, grads = jax.value_and_grad(
+                lambda p: llama_loss(p, b, config, remat=True, attention_impl=impl)
+            )(p)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
 
-    @jax.jit
-    def step(p, s, b):
-        loss, grads = jax.value_and_grad(
-            lambda p: llama_loss(p, b, config, remat=True, attention_impl=impl)
-        )(p)
-        updates, s = opt.update(grads, s, p)
-        return optax.apply_updates(p, updates), s, loss
+        return step
 
     batch = {"input_ids": ids}
-    params, opt_state, loss = step(params, opt_state, batch)
-    float(np.asarray(loss))
+    impl = "flash" if on_tpu else "xla"  # S=8192 is deep in flash territory
+    step = make_step(impl)
+    try:
+        params_c, opt_state_c, loss = step(params, opt_state, batch)
+        float(np.asarray(loss))
+        params, opt_state = params_c, opt_state_c
+    except Exception as e:  # flash bwd unproven at this shape on hw: degrade, don't die
+        if impl == "xla":
+            raise
+        print(f"long-context flash path failed ({type(e).__name__}: {str(e)[:300]}); "
+              "xla fallback", file=sys.stderr)
+        impl = "xla"
+        step = make_step(impl)
+        params, opt_state, loss = step(params, opt_state, batch)
+        float(np.asarray(loss))
     t0 = _t.time()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, batch)
@@ -455,8 +469,10 @@ def run_bench_compile_time(on_tpu: bool) -> dict:
 
     _reset_state()
     if on_tpu:
-        # ≈ Llama-1B (the reference table's smallest row)
-        base = LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
+        # mid-size decoder: big enough that regional-vs-full separation is
+        # real, small enough that the UNROLLED compile stays minutes-safe
+        # through the remote-compile tunnel
+        base = LlamaConfig(vocab_size=32000, dim=1024, n_layers=12, n_heads=16,
                            n_kv_heads=8, max_seq_len=256)
         B, S = 1, 128
     else:
@@ -487,6 +503,7 @@ def run_bench_compile_time(on_tpu: bool) -> dict:
         "full_compile_seconds": round(full_s, 2),
         "compile_speedup": round(full_s / max(scan_s, 1e-9), 2),
         "n_layers": base.n_layers,
+        "dim": base.dim,
     }
 
 
