@@ -35,6 +35,7 @@ from .models.transformer import LlamaConfig, apply_rope, rms_norm, rope_frequenc
 
 __all__ = [
     "init_kv_cache",
+    "generation_shardings",
     "greedy_generate",
     "sample_generate",
     "beam_generate",
@@ -54,6 +55,56 @@ def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.
     """Stacked cache: {"k","v"}: [L, B, max_len, Hkv, D]."""
     shape = (config.n_layers, batch_size, max_len, config.n_kv_heads, config.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def generation_shardings(mesh, batch_size: int, config: LlamaConfig):
+    """(prompt_sharding, cache_sharding) for decoding over ``mesh`` — the
+    multi-chip leg of BASELINE config #5 ("dispatch_model generate, multi-chip
+    sharding"; reference shards generate via ``device_map`` across GPUs,
+    ``big_modeling.py:309``; here the TPU-native form is GSPMD over the mesh).
+
+    Placement policy (an axis is used only where it divides evenly; anything
+    else stays replicated over that axis):
+
+    - batch over the data axes (``dp_replicate``/``dp_shard``/``dp``), claimed
+      greedily one axis at a time while the joint shard count still divides the
+      batch — batched serving parallelism;
+    - KV heads over ``tp`` — with the params TP-sharded by
+      ``models.transformer.llama_shard_rules`` this reproduces the Megatron
+      decode dataflow: column-parallel QKV writes head-sharded cache entries,
+      attention runs per-head-shard, row-parallel ``wo`` psums the output.
+
+    Single-controller view: callers pass the GLOBAL batch (the driver/test CPU
+    mesh and the axon single-chip tunnel are both fully addressable; multihost
+    serving would hand each process its slice via
+    ``jax.make_array_from_process_local_data`` before calling decode).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    axes = dict(mesh.shape)
+    # greedy per-axis: claim each data axis whose size still divides the batch
+    used: list = []
+    used_size = 1
+    for a in ("dp_replicate", "dp_shard", "dp"):
+        size = axes.get(a, 1)
+        if size > 1 and batch_size % (used_size * size) == 0:
+            used.append(a)
+            used_size *= size
+    batch: Any = None if not used else (used[0] if len(used) == 1 else tuple(used))
+    tp = "tp" if axes.get("tp", 1) > 1 and config.n_kv_heads % axes["tp"] == 0 else None
+    prompt_sharding = NamedSharding(mesh, P(batch, None))
+    # cache leaves: [L, B, max_len, Hkv, D]
+    cache_sharding = NamedSharding(mesh, P(None, batch, None, tp, None))
+    return prompt_sharding, cache_sharding
+
+
+def _place_for_mesh(mesh, prompt_ids, cache, config):
+    """device_put prompt + cache per :func:`generation_shardings`."""
+    prompt_sharding, cache_sharding = generation_shardings(mesh, prompt_ids.shape[0], config)
+    prompt_ids = jax.device_put(prompt_ids, prompt_sharding)
+    cache = jax.tree_util.tree_map(lambda c: jax.device_put(c, cache_sharding), cache)
+    return prompt_ids, cache
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
@@ -161,15 +212,24 @@ def _cached_generate(
     warmup: bool,
     select,  # (logits [B, V], key) -> next token [B]
     rng_key,
+    mesh=None,
 ):
     """Shared KV-cache decode core: prefill once, then the ENTIRE decode loop
     in one compiled ``lax.scan`` (a single host round-trip — per-token fetches
     would serialize on host/ICI latency). Sequences that hit ``eos_token_id``
-    keep emitting it; there is no data-dependent early exit under jit."""
+    keep emitting it; there is no data-dependent early exit under jit.
+
+    With ``mesh``, the prompt and KV cache are placed per
+    :func:`generation_shardings` (batch over data axes, KV heads over ``tp``)
+    and GSPMD propagates the params' shardings through the compiled scan —
+    params should already be on the mesh (``parallel.sharding.shard_params``
+    with ``models.transformer.llama_shard_rules``)."""
     prompt_ids = jnp.asarray(prompt_ids)
     B, S = prompt_ids.shape
     max_len = S + max_new_tokens
     cache = init_kv_cache(config, B, max_len, cache_dtype)
+    if mesh is not None:
+        prompt_ids, cache = _place_for_mesh(mesh, prompt_ids, cache, config)
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
 
@@ -198,7 +258,10 @@ def _cached_generate(
         return select(logits[:, -1], jax.random.fold_in(rng_key, 0)).astype(prompt_ids.dtype)
 
     if warmup and max_new_tokens > 1:
-        logits_w, cache_w = prefill(params, prompt_ids, init_kv_cache(config, B, max_len, cache_dtype), jnp.int32(0))
+        cache_w = init_kv_cache(config, B, max_len, cache_dtype)
+        if mesh is not None:
+            _, cache_w = _place_for_mesh(mesh, prompt_ids, cache_w, config)
+        logits_w, cache_w = prefill(params, prompt_ids, cache_w, jnp.int32(0))
         jax.device_get(decode_all(params, cache_w, _first(logits_w), rng_key))
 
     t0 = time.time()
@@ -235,16 +298,20 @@ def greedy_generate(
     cache_dtype=jnp.bfloat16,
     return_stats: bool = False,
     warmup: bool = False,
+    mesh=None,
 ):
     """Jitted KV-cache greedy decoding for resident (replicated/sharded)
     params. Returns ids [B, S_prompt + max_new_tokens] (with a stats dict —
     prefill seconds, decode tokens/sec — when ``return_stats``); ``warmup``
-    runs the decode once before timing so stats exclude compilation."""
+    runs the decode once before timing so stats exclude compilation. Pass
+    ``mesh`` (params already mesh-sharded) for multi-chip TP/DP decode — see
+    :func:`generation_shardings`."""
     return _cached_generate(
         params, prompt_ids, config, max_new_tokens, eos_token_id, cache_dtype,
         return_stats, warmup,
         select=lambda logits, key: jnp.argmax(logits, axis=-1),
         rng_key=None,
+        mesh=mesh,
     )
 
 
@@ -261,17 +328,20 @@ def sample_generate(
     cache_dtype=jnp.bfloat16,
     return_stats: bool = False,
     warmup: bool = False,
+    mesh=None,
 ):
     """Jitted KV-cache SAMPLED decoding (temperature / top-k / nucleus), the
     counterpart of HF ``generate(do_sample=True)``. The PRNG key is folded per
     step inside the compiled scan, so a given (key, prompt, knobs) triple is
-    fully deterministic; ``temperature=0`` degrades to greedy."""
+    fully deterministic; ``temperature=0`` degrades to greedy. ``mesh`` as in
+    :func:`greedy_generate`."""
     return _cached_generate(
         params, prompt_ids, config, max_new_tokens, eos_token_id, cache_dtype,
         return_stats, warmup,
         select=partial(sample_token_logits, temperature=temperature,
                        top_k=top_k, top_p=top_p),
         rng_key=rng_key,
+        mesh=mesh,
     )
 
 
@@ -285,6 +355,7 @@ def beam_generate(
     length_penalty: float = 1.0,
     cache_dtype=jnp.bfloat16,
     return_scores: bool = False,
+    mesh=None,
 ):
     """Jitted KV-cache beam search (deterministic highest-probability decode).
 
@@ -309,6 +380,10 @@ def beam_generate(
     V = config.vocab_size
 
     cache = init_kv_cache(config, B, max_len, cache_dtype)
+    if mesh is not None:
+        # beams tile the batch axis inside jit (B -> B*K), which preserves the
+        # batch-axis divisibility, so the same placement policy applies
+        prompt_ids, cache = _place_for_mesh(mesh, prompt_ids, cache, config)
     prefill = jax.jit(partial(_forward_cached, config=config))
     logits, cache = prefill(params, prompt_ids, cache, jnp.int32(0))
 

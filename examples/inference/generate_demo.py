@@ -11,6 +11,13 @@ Three modes:
 - ``--mode disk``      — params spilled to an offload folder (reference
   ``disk_offload``)
 
+Resident mode takes ``--tp N --dp N`` to decode over an N×N device mesh
+(params TP-sharded by ``llama_shard_rules``, KV cache head-sharded over
+``tp`` / batch-sharded over ``dp`` — the multi-chip leg of BASELINE config
+#5). Try it without hardware via a virtual mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8
+python examples/inference/generate_demo.py --cpu --tp 2 --dp 2 --batch 4``
+
 No hub access in this environment, so weights are synthetic at a
 configurable size; the mechanics (streamed load → dispatch → cached decode)
 are exactly the production path.
@@ -51,6 +58,10 @@ def main():
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--top-p", type=float, default=1.0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel mesh size (resident mode)")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="data-parallel mesh size (resident mode)")
     parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
     args = parser.parse_args()
     maybe_force_cpu(args)
@@ -58,6 +69,14 @@ def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if args.tp * args.dp > 1:
+        if args.mode != "resident":
+            parser.error("--tp/--dp mesh decode needs --mode resident")
+        if len(jax.devices()) < args.tp * args.dp:
+            parser.error(
+                f"mesh needs {args.tp * args.dp} devices, have {len(jax.devices())}"
+            )
 
     from accelerate_tpu.big_modeling import cpu_offload, disk_offload
     from accelerate_tpu.generation import (
@@ -75,6 +94,19 @@ def main():
     params = init_llama(config, jax.random.PRNGKey(0))
     params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+    mesh = None
+    if args.tp * args.dp > 1:
+        from accelerate_tpu.models.transformer import llama_shard_rules
+        from accelerate_tpu.parallel.sharding import shard_params
+        from accelerate_tpu.parallelism_config import ParallelismConfig
+
+        # canonical ICI-aware mesh (tp innermost -> adjacent chips; warns and
+        # falls back to device-order reshape on CPU/virtual meshes)
+        mesh = ParallelismConfig(
+            dp_replicate_size=args.dp, tp_size=args.tp
+        ).build_mesh(jax.devices())
+        params, _ = shard_params(params, mesh, rules=llama_shard_rules())
 
     tmpdir = None
     if args.mode == "resident":
@@ -102,11 +134,12 @@ def main():
             out, stats = sample_generate(
                 params, prompt, config, max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-                rng_key=jax.random.PRNGKey(args.seed), return_stats=True,
+                rng_key=jax.random.PRNGKey(args.seed), return_stats=True, mesh=mesh,
             )
         else:
             out, stats = greedy_generate(
-                params, prompt, config, max_new_tokens=args.max_new_tokens, return_stats=True
+                params, prompt, config, max_new_tokens=args.max_new_tokens,
+                return_stats=True, mesh=mesh,
             )
     else:
         out, stats = generate_dispatched(
@@ -114,7 +147,7 @@ def main():
         )
 
     print(json.dumps({
-        "mode": args.mode,
+        "mode": args.mode if mesh is None else f"resident-mesh(dp={args.dp},tp={args.tp})",
         "model_size": args.model_size,
         "n_params": n_params,
         "load_seconds": round(load_s, 3),

@@ -1,0 +1,143 @@
+"""Mesh-sharded KV-cache decoding — the multi-chip leg of BASELINE config #5.
+
+The reference shards big-model generate across devices via ``device_map``
+dispatch (``/root/reference/src/accelerate/big_modeling.py:309`` +
+``benchmarks/big_model_inference/README.md:27-37``); the TPU-native form is
+GSPMD decode over a ``Mesh``: params TP-sharded by ``llama_shard_rules``, KV
+cache head-sharded over ``tp`` and batch-sharded over ``dp``
+(``generation.generation_shardings``). These tests pin (a) the placement
+policy and (b) token parity between single-device and mesh-sharded decode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu.generation import (
+    beam_generate,
+    generation_shardings,
+    greedy_generate,
+    sample_generate,
+)
+from accelerate_tpu.models.transformer import LlamaConfig, init_llama, llama_shard_rules
+from accelerate_tpu.parallel.sharding import shard_params
+
+
+def _tiny_config():
+    return LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, max_seq_len=128
+    )
+
+
+def _mesh_2x2():
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+
+
+class TestGenerationShardings:
+    def test_batch_over_dp_heads_over_tp(self):
+        mesh = _mesh_2x2()
+        prompt_sh, cache_sh = generation_shardings(mesh, batch_size=4, config=_tiny_config())
+        assert prompt_sh.spec == P("dp", None)
+        assert cache_sh.spec == P(None, "dp", None, "tp", None)
+
+    def test_indivisible_batch_stays_replicated(self):
+        mesh = _mesh_2x2()
+        prompt_sh, cache_sh = generation_shardings(mesh, batch_size=3, config=_tiny_config())
+        assert prompt_sh.spec == P(None, None)
+        assert cache_sh.spec == P(None, None, None, "tp", None)
+
+    def test_indivisible_kv_heads_stay_replicated(self):
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "tp"))
+        # tp=4 does not divide n_kv_heads=2 -> head axis replicated
+        _, cache_sh = generation_shardings(mesh, batch_size=4, config=_tiny_config())
+        assert cache_sh.spec == P(None, None, None, None, None)
+
+    def test_partial_data_axes_claimed_greedily(self):
+        mesh = Mesh(
+            np.array(jax.devices()).reshape(2, 2, 2), ("dp_replicate", "dp_shard", "tp")
+        )
+        # joint product 4 does not divide batch 2, but dp_replicate alone does
+        prompt_sh, cache_sh = generation_shardings(mesh, batch_size=2, config=_tiny_config())
+        assert prompt_sh.spec == P("dp_replicate", None)
+        assert cache_sh.spec == P(None, "dp_replicate", None, "tp", None)
+
+    def test_joint_data_axes(self):
+        mesh = Mesh(
+            np.array(jax.devices()).reshape(2, 2, 2), ("dp_replicate", "dp_shard", "tp")
+        )
+        prompt_sh, cache_sh = generation_shardings(mesh, batch_size=4, config=_tiny_config())
+        assert prompt_sh.spec == P(("dp_replicate", "dp_shard"), None)
+        assert cache_sh.spec == P(None, ("dp_replicate", "dp_shard"), None, "tp", None)
+
+
+class TestShardedDecodeParity:
+    """Sharded decode must produce the same tokens as single-device decode
+    (fp32 on the CPU mesh; GSPMD re-associates reductions, so logits match to
+    tolerance and argmax/beam paths to exact tokens on these sizes)."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        config = _tiny_config()
+        params = init_llama(config, jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        prompt = np.array(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, config.vocab_size)
+        ).astype(np.int32)
+        mesh = _mesh_2x2()
+        sharded, specs = shard_params(params, mesh, rules=llama_shard_rules())
+        return config, params, prompt, mesh, sharded, specs
+
+    def test_tp_specs_applied(self, setup):
+        _, _, _, _, sharded, specs = setup
+        assert specs["layers"]["wq"]["kernel"] == P(None, None, "tp")
+        assert specs["layers"]["wo"]["kernel"] == P(None, "tp", None)
+        shard_shape = sharded["layers"]["wq"]["kernel"].sharding.shard_shape(
+            sharded["layers"]["wq"]["kernel"].shape
+        )
+        assert shard_shape[2] == sharded["layers"]["wq"]["kernel"].shape[2] // 2
+
+    def test_greedy_parity(self, setup):
+        config, params, prompt, mesh, sharded, _ = setup
+        ref = greedy_generate(params, prompt, config, max_new_tokens=6, cache_dtype=np.float32)
+        got = greedy_generate(
+            sharded, prompt, config, max_new_tokens=6, cache_dtype=np.float32, mesh=mesh
+        )
+        np.testing.assert_array_equal(ref, got)
+
+    def test_sampled_parity_same_key(self, setup):
+        config, params, prompt, mesh, sharded, _ = setup
+        kwargs = dict(
+            max_new_tokens=6, temperature=0.7, top_k=8, cache_dtype=np.float32,
+            rng_key=jax.random.PRNGKey(7),
+        )
+        ref = sample_generate(params, prompt, config, **kwargs)
+        got = sample_generate(sharded, prompt, config, mesh=mesh, **kwargs)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_beam_parity(self, setup):
+        config, params, prompt, mesh, sharded, _ = setup
+        ref, ref_s = beam_generate(
+            params, prompt, config, num_beams=2, max_new_tokens=5,
+            cache_dtype=np.float32, return_scores=True,
+        )
+        got, got_s = beam_generate(
+            sharded, prompt, config, num_beams=2, max_new_tokens=5,
+            cache_dtype=np.float32, return_scores=True, mesh=mesh,
+        )
+        np.testing.assert_array_equal(ref, got)
+        np.testing.assert_allclose(ref_s, got_s, rtol=1e-4)
+
+    def test_eos_freeze_under_mesh(self, setup):
+        config, _, prompt, mesh, sharded, _ = setup
+        out = greedy_generate(
+            sharded, prompt, config, max_new_tokens=6, eos_token_id=5,
+            cache_dtype=np.float32, mesh=mesh,
+        )
+        gen = out[:, prompt.shape[1]:]
+        for row in gen:
+            hits = np.where(row == 5)[0]
+            if hits.size:
+                assert (row[hits[0]:] == 5).all()
