@@ -369,6 +369,54 @@ def check_sharded_checkpoint(accelerator, tmpdir: str):
     accelerator.wait_for_everyone()
 
 
+def check_generate(accelerator):
+    """Mesh-sharded KV-cache decode ACROSS PROCESSES: params TP-sharded over a
+    mesh spanning both hosts, the row-parallel ``wo`` psum rides the
+    cross-process collective backend inside the compiled decode scan, and the
+    (replicated) token output matches a single-device dense decode exactly
+    (the multihost leg of BASELINE config #5; see
+    ``generation.generation_shardings``)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.generation import greedy_generate, sample_generate
+    from accelerate_tpu.models.transformer import LlamaConfig, init_llama, llama_shard_rules
+    from accelerate_tpu.parallel.sharding import shard_params
+
+    n_dev = jax.device_count()
+    assert n_dev >= 2, "needs >= 2 global devices"
+    config = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, max_seq_len=64
+    )
+    # tp must divide the KV heads or the cache stays replicated and the
+    # head-sharded-decode contract this scenario pins is silently skipped
+    assert config.n_kv_heads % n_dev == 0, (n_dev, config.n_kv_heads)
+
+    params = init_llama(config, jax.random.PRNGKey(3))
+    params = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(4), (2, 6), 0, config.vocab_size), np.int32
+    )
+
+    # single-device dense reference (local to each process, identical inputs)
+    ref = greedy_generate(params, prompt, config, max_new_tokens=5, cache_dtype=np.float32)
+
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    sharded, _ = shard_params(params, mesh, rules=llama_shard_rules())
+    out = greedy_generate(
+        sharded, prompt, config, max_new_tokens=5, cache_dtype=np.float32, mesh=mesh
+    )
+    np.testing.assert_array_equal(ref, out)
+
+    key = jax.random.PRNGKey(11)
+    ref_s = sample_generate(params, prompt, config, max_new_tokens=5, temperature=0.8,
+                            top_k=16, rng_key=key, cache_dtype=np.float32)
+    out_s = sample_generate(sharded, prompt, config, max_new_tokens=5, temperature=0.8,
+                            top_k=16, rng_key=key, cache_dtype=np.float32, mesh=mesh)
+    np.testing.assert_array_equal(ref_s, out_s)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scenario", default="all")
@@ -382,7 +430,7 @@ def main():
 
     scenarios = args.scenario.split(",") if args.scenario != "all" else [
         "topology", "ops", "local_sgd", "dataloader", "dispatcher", "training",
-        "checkpoint", "sharded_checkpoint",
+        "checkpoint", "sharded_checkpoint", "generate",
     ]
     params = opt_state = None
     for scenario in scenarios:
@@ -404,6 +452,8 @@ def main():
             check_checkpoint(accelerator, args.tmpdir, params, opt_state)
         elif scenario == "sharded_checkpoint":
             check_sharded_checkpoint(accelerator, args.tmpdir)
+        elif scenario == "generate":
+            check_generate(accelerator)
         else:
             raise ValueError(f"unknown scenario {scenario}")
         print(f"[proc {accelerator.process_index}] scenario {scenario}: OK", flush=True)

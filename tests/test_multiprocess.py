@@ -67,6 +67,17 @@ class TestTwoProcesses:
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
 
+    def test_sharded_generate(self, shared_tmpdir):
+        """TP-sharded KV-cache decode across 2 processes: the row-parallel psum
+        rides the cross-process collective backend inside the compiled decode
+        scan; tokens match a single-device dense decode exactly."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "generate", "--tmpdir", shared_tmpdir],
+            num_processes=2,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+
     def test_training_parity_across_process_counts(self, shared_tmpdir):
         """Same global batch, same init → same loss trajectory for 1 vs 2
         processes (the reference's training_check parity contract)."""
