@@ -507,6 +507,57 @@ def run_bench_compile_time(on_tpu: bool) -> dict:
     }
 
 
+def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> float:
+    """Anchor this run against BENCH_BASELINE.json (TPU runs only).
+
+    The headline anchors to ``per_chip``; each breadth config anchors to its
+    own first nonzero TPU value, mutating its entry with a ``vs_baseline``
+    ratio (note: compile_time measures seconds, so LOWER is better there).
+    First sighting of any anchor writes it back. Returns the headline ratio.
+    """
+    baseline = {}
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except (json.JSONDecodeError, OSError):  # corrupt/unreadable = absent:
+            baseline = {}  # re-anchor rather than die before the output line
+    vs_baseline = 1.0
+    dirty = False
+    if baseline.get("per_chip"):
+        vs_baseline = result["per_chip"] / baseline["per_chip"]
+    else:
+        baseline.update({"per_chip": result["per_chip"], "model": result["model"]})
+        dirty = True
+    cfg_anchor = baseline.setdefault("configs", {})
+    for name, entry in configs.items():
+        value = entry.get("value") or 0.0
+        if cfg_anchor.get(name):
+            entry["vs_baseline"] = round(value / cfg_anchor[name], 4)
+        elif value:
+            cfg_anchor[name] = value
+            dirty = True
+    if dirty:
+        tmp = baseline_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(baseline, f)
+        os.replace(tmp, baseline_path)  # atomic: a killed run never truncates
+    return vs_baseline
+
+
+def sanitize_json(obj):
+    """Replace non-finite floats with None anywhere in a JSON-ish tree —
+    ``json.dumps`` would otherwise emit bare ``NaN``/``Infinity`` tokens and
+    break the driver's one-parseable-line contract."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    return obj
+
+
 def main():
     try:
         result = run_bench()
@@ -545,14 +596,7 @@ def main():
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     vs_baseline = 1.0
     if result["backend"] == "tpu":
-        if os.path.exists(baseline_path):
-            with open(baseline_path) as f:
-                baseline = json.load(f)
-            if baseline.get("per_chip"):
-                vs_baseline = result["per_chip"] / baseline["per_chip"]
-        else:
-            with open(baseline_path, "w") as f:
-                json.dump({"per_chip": result["per_chip"], "model": result["model"]}, f)
+        vs_baseline = apply_baseline_anchors(result, configs, baseline_path)
     def _num(x):  # NaN/Inf would make json.dumps emit a non-parseable token
         return None if x is None or not math.isfinite(x) else round(x, 4)
 
@@ -571,7 +615,7 @@ def main():
                 # MRPC-shaped, so loss/accuracy are parity signals between
                 # configs/rounds, not real-GLUE numbers
                 "note": "synthetic data (no hub access); loss comparable across rounds only",
-                "configs": configs,
+                "configs": sanitize_json(configs),
             }
         )
     )

@@ -1,0 +1,78 @@
+"""The bench's baseline-anchoring must never lose the driver's number: these
+pin the pure bookkeeping (``bench.apply_baseline_anchors``) that runs between
+measurement and the final JSON line."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import apply_baseline_anchors, sanitize_json
+
+
+def _result(per_chip=1000.0):
+    return {"per_chip": per_chip, "model": "bert-base", "backend": "tpu"}
+
+
+class TestBaselineAnchors:
+    def test_first_run_seeds_all_anchors(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        configs = {"resnet_dp": {"value": 500.0}, "inference": {"value": 0.0}}
+        ratio = apply_baseline_anchors(_result(), configs, path)
+        assert ratio == 1.0
+        saved = json.load(open(path))
+        assert saved["per_chip"] == 1000.0
+        assert saved["configs"] == {"resnet_dp": 500.0}  # zero values never anchor
+        assert "vs_baseline" not in configs["resnet_dp"]
+
+    def test_second_run_reports_ratios(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        apply_baseline_anchors(_result(1000.0), {"resnet_dp": {"value": 500.0}}, path)
+        configs = {"resnet_dp": {"value": 600.0}, "fsdp_lm": {"value": 70.0}}
+        ratio = apply_baseline_anchors(_result(1500.0), configs, path)
+        assert ratio == 1.5
+        assert configs["resnet_dp"]["vs_baseline"] == 1.2
+        # new config on a later run: anchored now, ratio next time
+        saved = json.load(open(path))
+        assert saved["configs"]["fsdp_lm"] == 70.0
+        assert "vs_baseline" not in configs["fsdp_lm"]
+
+    def test_legacy_headline_only_baseline(self, tmp_path):
+        """Round-2's file has only per_chip; configs get added without
+        touching the headline anchor."""
+        path = str(tmp_path / "b.json")
+        json.dump({"per_chip": 852.4, "model": "bert-base"}, open(path, "w"))
+        configs = {"long_context": {"value": 22586.0}}
+        ratio = apply_baseline_anchors(_result(1796.7), configs, path)
+        assert round(ratio, 3) == round(1796.7 / 852.4, 3)
+        saved = json.load(open(path))
+        assert saved["per_chip"] == 852.4
+        assert saved["configs"]["long_context"] == 22586.0
+
+    def test_corrupt_baseline_reanchors_instead_of_crashing(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        with open(path, "w") as f:
+            f.write('{"per_chip": 10')  # truncated by a killed writer
+        ratio = apply_baseline_anchors(_result(), {"resnet_dp": {"value": 5.0}}, path)
+        assert ratio == 1.0
+        assert json.load(open(path))["per_chip"] == 1000.0
+
+    def test_sanitize_strips_non_finite(self):
+        configs = {"a": {"final_loss": float("nan"), "value": 1.0,
+                         "list": [float("inf"), 2.0]}}
+        out = json.dumps(sanitize_json(configs), allow_nan=False)  # must not raise
+        assert json.loads(out) == {"a": {"final_loss": None, "value": 1.0,
+                                         "list": [None, 2.0]}}
+
+    def test_errored_config_entries_are_harmless(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        configs = {"inference": {"metric": "inference", "value": 0.0, "error": "boom"}}
+        apply_baseline_anchors(_result(), configs, path)
+        saved = json.load(open(path))
+        assert saved["configs"] == {}
+        # and an errored run against an existing anchor reports ratio 0, not a crash
+        json.dump({"per_chip": 1000.0, "configs": {"inference": 50.0}}, open(path, "w"))
+        configs = {"inference": {"value": 0.0, "error": "boom"}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert configs["inference"]["vs_baseline"] == 0.0
