@@ -65,6 +65,32 @@ class TestBaselineAnchors:
         assert json.loads(out) == {"a": {"final_loss": None, "value": 1.0,
                                          "list": [None, 2.0]}}
 
+    def test_nan_values_never_anchor_or_divide(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        nan = float("nan")
+        configs = {"fsdp_lm": {"value": nan}}
+        ratio = apply_baseline_anchors(_result(nan), configs, path)
+        assert ratio == 1.0
+        saved = json.load(open(path)) if os.path.exists(path) else {}
+        assert "per_chip" not in saved and saved.get("configs", {}) == {}
+        # nan against an existing finite anchor: ratio 0, anchor untouched
+        json.dump({"per_chip": 1000.0, "configs": {"fsdp_lm": 50.0}}, open(path, "w"))
+        configs = {"fsdp_lm": {"value": nan}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert configs["fsdp_lm"]["vs_baseline"] == 0.0
+        assert json.load(open(path))["configs"]["fsdp_lm"] == 50.0
+
+    def test_wrong_shaped_baseline_reanchors(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump([1, 2, 3], open(path, "w"))  # valid JSON, wrong shape
+        ratio = apply_baseline_anchors(_result(), {"resnet_dp": {"value": 5.0}}, path)
+        assert ratio == 1.0
+        assert json.load(open(path))["per_chip"] == 1000.0
+        json.dump({"per_chip": 1000.0, "configs": "oops"}, open(path, "w"))
+        configs = {"resnet_dp": {"value": 5.0}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert json.load(open(path))["configs"] == {"resnet_dp": 5.0}
+
     def test_errored_config_entries_are_harmless(self, tmp_path):
         path = str(tmp_path / "b.json")
         configs = {"inference": {"metric": "inference", "value": 0.0, "error": "boom"}}
