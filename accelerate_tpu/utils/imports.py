@@ -85,30 +85,6 @@ def is_mlflow_available() -> bool:
     return _package_available("mlflow")
 
 
-def is_comet_ml_available() -> bool:
-    return _package_available("comet_ml")
-
-
-def is_clearml_available() -> bool:
-    return _package_available("clearml")
-
-
-def is_aim_available() -> bool:
-    return _package_available("aim")
-
-
-def is_dvclive_available() -> bool:
-    return _package_available("dvclive")
-
-
-def is_swanlab_available() -> bool:
-    return _package_available("swanlab")
-
-
-def is_trackio_available() -> bool:
-    return _package_available("trackio")
-
-
 def is_rich_available() -> bool:
     return _package_available("rich")
 

@@ -45,6 +45,17 @@ _PEAK_FLOPS = {
 
 import contextlib
 import signal
+from typing import Optional
+
+
+def _env_int(key: str, default: int) -> int:
+    """int(os.environ[key]) with the default on missing OR malformed values —
+    a bad knob must never cost the round its number."""
+    try:
+        return int(os.environ.get(key, default))
+    except (TypeError, ValueError):
+        print(f"WARNING: ignoring malformed {key}={os.environ.get(key)!r}", file=sys.stderr)
+        return default
 
 
 @contextlib.contextmanager
@@ -84,7 +95,9 @@ def _probe_backend_subprocess(timeout: int) -> bool:
 _BACKEND_DEGRADED: Optional[str] = None  # set when TPU probe failed -> CPU run
 
 
-def _init_backend(retries: int = None, delay: float = 5.0, init_timeout: int = None) -> str:
+def _init_backend(
+    retries: Optional[int] = None, delay: float = 5.0, init_timeout: Optional[int] = None
+) -> str:
     """``jax.default_backend()`` with retry: a remote-tunneled TPU backend can be
     transiently UNAVAILABLE (or hang); probe in a subprocess first (see
     :func:`_probe_backend_subprocess`), clear the backend cache and back off
@@ -95,10 +108,10 @@ def _init_backend(retries: int = None, delay: float = 5.0, init_timeout: int = N
 
     global _BACKEND_DEGRADED
     if retries is None:
-        retries = int(os.environ.get("ACCELERATE_BENCH_RETRIES", 4))
+        retries = _env_int("ACCELERATE_BENCH_RETRIES", 4)
     retries = max(retries, 1)  # 0 would skip probing entirely, last_err=None
     if init_timeout is None:
-        init_timeout = int(os.environ.get("ACCELERATE_BENCH_PROBE_TIMEOUT", 180))
+        init_timeout = _env_int("ACCELERATE_BENCH_PROBE_TIMEOUT", 180)
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # explicit CPU request: the axon sitecustomize ignores the env var, so
@@ -544,8 +557,11 @@ def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> f
     vs_baseline = 1.0
     dirty = False
     if _finite(baseline.get("per_chip")) and baseline["per_chip"]:
-        if _finite(result["per_chip"]):
-            vs_baseline = result["per_chip"] / baseline["per_chip"]
+        # non-finite headline vs a real anchor = failed run: report the 0.0
+        # failure sentinel, not 1.0 "at baseline"
+        vs_baseline = (
+            result["per_chip"] / baseline["per_chip"] if _finite(result["per_chip"]) else 0.0
+        )
     elif _finite(result["per_chip"]):
         baseline.update({"per_chip": result["per_chip"], "model": result["model"]})
         dirty = True

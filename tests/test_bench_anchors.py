@@ -80,6 +80,22 @@ class TestBaselineAnchors:
         assert configs["fsdp_lm"]["vs_baseline"] == 0.0
         assert json.load(open(path))["configs"]["fsdp_lm"] == 50.0
 
+    def test_nan_headline_vs_real_anchor_is_failure_sentinel(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump({"per_chip": 1000.0}, open(path, "w"))
+        ratio = apply_baseline_anchors(_result(float("nan")), {}, path)
+        assert ratio == 0.0  # failed run must not read as "at baseline"
+
+    def test_malformed_env_knobs_fall_back(self, monkeypatch):
+        from bench import _env_int
+
+        monkeypatch.setenv("ACCELERATE_BENCH_RETRIES", "three")
+        assert _env_int("ACCELERATE_BENCH_RETRIES", 4) == 4
+        monkeypatch.setenv("ACCELERATE_BENCH_RETRIES", "")
+        assert _env_int("ACCELERATE_BENCH_RETRIES", 4) == 4
+        monkeypatch.setenv("ACCELERATE_BENCH_RETRIES", "2")
+        assert _env_int("ACCELERATE_BENCH_RETRIES", 4) == 2
+
     def test_wrong_shaped_baseline_reanchors(self, tmp_path):
         path = str(tmp_path / "b.json")
         json.dump([1, 2, 3], open(path, "w"))  # valid JSON, wrong shape

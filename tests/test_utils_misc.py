@@ -1,0 +1,127 @@
+"""Unit tests for the small utility surfaces the reference covers in
+``tests/test_utils.py`` / ``test_imports.py`` / ``test_logging.py``:
+environment parsing, env patching, capability probes, the rank-aware logging
+adapter, and the main-process-only tqdm."""
+
+import logging
+import os
+
+import pytest
+
+from accelerate_tpu.logging import MultiProcessAdapter, get_logger
+from accelerate_tpu.utils import environment as env
+from accelerate_tpu.utils import imports
+
+
+class TestEnvironment:
+    def test_str_to_bool(self):
+        for s in ("1", "true", "True", "YES", "on"):
+            assert env.str_to_bool(s) == 1
+        for s in ("0", "false", "OFF", "no"):
+            assert env.str_to_bool(s) == 0
+        with pytest.raises(ValueError):
+            env.str_to_bool("maybe")
+
+    def test_parse_flag_from_env(self):
+        with env.patch_environment(MY_FLAG="true"):
+            assert env.parse_flag_from_env("MY_FLAG") is True
+        with env.patch_environment(MY_FLAG="0"):
+            assert env.parse_flag_from_env("MY_FLAG", default=True) is False
+        assert env.parse_flag_from_env("MY_FLAG_UNSET", default=True) is True
+
+    def test_parse_choice_and_int(self):
+        with env.patch_environment(MP="bf16", N1="4"):
+            assert env.parse_choice_from_env("MP") == "bf16"
+            assert env.get_int_from_env(("N0", "N1"), 7) == 4
+        assert env.get_int_from_env(("N0", "N1"), 7) == 7
+
+    def test_patch_environment_restores_and_deletes(self):
+        os.environ["KEEP_ME"] = "original"
+        with env.patch_environment(KEEP_ME="patched", ADDED="x"):
+            assert os.environ["KEEP_ME"] == "patched"
+            assert os.environ["ADDED"] == "x"
+        assert os.environ["KEEP_ME"] == "original"
+        assert "ADDED" not in os.environ
+        del os.environ["KEEP_ME"]
+
+    def test_patch_environment_restores_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with env.patch_environment(BOOM_VAR="1"):
+                raise RuntimeError
+        assert "BOOM_VAR" not in os.environ
+
+    def test_are_libraries_initialized(self):
+        assert "numpy" in env.are_libraries_initialized("numpy", "not_a_real_lib_xyz")
+
+
+class TestImports:
+    def test_probes_return_bool(self):
+        for name in dir(imports):
+            if name.startswith("is_") and name.endswith("_available"):
+                assert isinstance(getattr(imports, name)(), bool), name
+
+    def test_known_available(self):
+        # baked into the environment (see repo instructions)
+        assert imports.is_optax_available()
+        assert imports.is_torch_available()
+        assert imports.is_safetensors_available()
+
+    def test_no_duplicate_probe_definitions(self):
+        """A probe defined twice silently shadows the first: keep the module
+        free of copy-paste duplicates."""
+        import ast
+        import inspect
+
+        tree = ast.parse(inspect.getsource(imports))
+        names = [n.name for n in tree.body if isinstance(n, ast.FunctionDef)]
+        assert len(names) == len(set(names)), sorted(
+            n for n in names if names.count(n) > 1
+        )
+
+
+class TestLogging:
+    def test_main_process_logs(self, caplog):
+        logger = get_logger("t_log_main")
+        with caplog.at_level(logging.INFO, logger="t_log_main"):
+            logger.info("hello %s", "world")
+        assert "hello world" in caplog.text
+
+    def test_level_from_env(self):
+        with env.patch_environment(ACCELERATE_LOG_LEVEL="ERROR"):
+            logger = get_logger("t_log_env")
+            assert logger.logger.level == logging.ERROR
+
+    def test_warning_once_dedupes(self, caplog):
+        logger = get_logger("t_log_once")
+        with caplog.at_level(logging.WARNING, logger="t_log_once"):
+            logger.warning_once("repeat me")
+            logger.warning_once("repeat me")
+            logger.warning_once("another")
+        assert caplog.text.count("repeat me") == 1
+        assert caplog.text.count("another") == 1
+
+    def test_in_order_single_process(self, caplog):
+        logger = get_logger("t_log_order")
+        with caplog.at_level(logging.INFO, logger="t_log_order"):
+            logger.info("ordered", in_order=True, main_process_only=False)
+        assert "ordered" in caplog.text
+
+    def test_adapter_type(self):
+        assert isinstance(get_logger("t_log_type"), MultiProcessAdapter)
+
+
+class TestTqdm:
+    def test_main_process_enabled(self):
+        from accelerate_tpu.utils.tqdm import tqdm
+
+        bar = tqdm(range(3), main_process_only=True)
+        # single process IS the main process: bar must not be disabled
+        assert not bar.disable
+        assert sum(1 for _ in bar) == 3
+
+    def test_kwargs_passthrough(self):
+        from accelerate_tpu.utils.tqdm import tqdm
+
+        bar = tqdm(range(2), disable=True)
+        assert bar.disable
+        list(bar)
