@@ -22,6 +22,9 @@ from .utils import (
 __all__ = [
     "Accelerator",
     "DispatchedParams",
+    "debug_launcher",
+    "notebook_launcher",
+    "skip_first_batches",
     "cpu_offload",
     "disk_offload",
     "dispatch_params",
@@ -74,6 +77,14 @@ def __getattr__(name):
         from .launchers import notebook_launcher
 
         return notebook_launcher
+    if name == "debug_launcher":
+        from .launchers import debug_launcher
+
+        return debug_launcher
+    if name == "skip_first_batches":
+        from .data_loader import skip_first_batches
+
+        return skip_first_batches
     if name == "LocalSGD":
         from .local_sgd import LocalSGD
 

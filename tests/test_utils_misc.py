@@ -110,6 +110,25 @@ class TestLogging:
         assert isinstance(get_logger("t_log_type"), MultiProcessAdapter)
 
 
+class TestPublicAPI:
+    def test_reference_top_level_names_resolve(self):
+        """The reference's own top-level exports (its ``__init__.py``) must all
+        exist here — ``prepare_pippy`` excepted, whose analog is
+        ``parallel.pipeline.make_pipeline_forward`` (trainable, unlike PiPPy)."""
+        import accelerate_tpu as at
+
+        for name in ("Accelerator", "PartialState", "ParallelismConfig",
+                     "notebook_launcher", "debug_launcher", "skip_first_batches"):
+            assert getattr(at, name) is not None, name
+        from accelerate_tpu.parallel.pipeline import make_pipeline_forward  # noqa: F401
+
+    def test_all_exports_resolve(self):
+        import accelerate_tpu as at
+
+        for name in at.__all__:
+            assert getattr(at, name) is not None, name
+
+
 class TestTqdm:
     def test_main_process_enabled(self):
         from accelerate_tpu.utils.tqdm import tqdm
