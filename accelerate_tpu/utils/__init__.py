@@ -23,6 +23,50 @@ from .environment import (
     patch_environment,
     str_to_bool,
 )
+# Collectives and RNG helpers are re-exported LAZILY (module __getattr__
+# below): operations/random import ..state, which imports this package —
+# eager imports here would cycle. Reference users' `from accelerate.utils
+# import gather, set_seed, ...` spellings resolve the same either way.
+_OPERATIONS = {
+    "DistributedOperationException",
+    "broadcast",
+    "broadcast_object_list",
+    "concatenate",
+    "find_batch_size",
+    "gather",
+    "gather_object",
+    "get_data_structure",
+    "initialize_tensors",
+    "pad_across_processes",
+    "pad_input_tensors",
+    "recursively_apply",
+    "reduce",
+    "send_to_device",
+    "slice_tensors",
+    "stack_batches",
+    "verify_operation",
+}
+_RANDOM = {
+    "capture_rng_states",
+    "restore_rng_states",
+    "set_seed",
+    "synchronize_rng_state",
+    "synchronize_rng_states",
+}
+
+
+def __getattr__(name):
+    if name in _OPERATIONS:
+        from . import operations
+
+        return getattr(operations, name)
+    if name in _RANDOM:
+        from . import random
+
+        return getattr(random, name)
+    raise AttributeError(f"module 'accelerate_tpu.utils' has no attribute {name!r}")
+
+
 from .imports import (
     is_chex_available,
     is_cpu_only,
@@ -43,3 +87,16 @@ from .imports import (
     is_transformers_available,
     is_wandb_available,
 )
+
+# __all__ spans the eager imports above AND the lazy collectives/RNG names
+# (star-import resolves the lazy ones through module __getattr__, PEP 562);
+# __dir__ keeps tab-completion/introspection seeing the lazy names too.
+__all__ = sorted(
+    {n for n in globals() if not n.startswith("_") and n != "annotations"}
+    | _OPERATIONS
+    | _RANDOM
+)
+
+
+def __dir__():
+    return sorted(set(globals()) | _OPERATIONS | _RANDOM)

@@ -128,6 +128,24 @@ class TestPublicAPI:
         for name in at.__all__:
             assert getattr(at, name) is not None, name
 
+    def test_utils_namespace_parity(self):
+        """Reference users spell `from accelerate.utils import gather,
+        set_seed, send_to_device, ...` — the same names must resolve from
+        accelerate_tpu.utils (lazily, to dodge the state import cycle)."""
+        from accelerate_tpu import utils
+
+        for name in sorted(utils._OPERATIONS | utils._RANDOM) + [
+            "DistributedType", "ProjectConfiguration", "patch_environment", "str_to_bool",
+        ]:
+            assert getattr(utils, name) is not None, name
+        # every __all__ entry must resolve (star-import contract) and be
+        # visible to dir() (tab completion)
+        for name in utils.__all__:
+            assert getattr(utils, name) is not None, name
+        assert set(utils.__all__) <= set(dir(utils))
+        with pytest.raises(AttributeError):
+            utils.not_a_real_name
+
 
 class TestTqdm:
     def test_main_process_enabled(self):
