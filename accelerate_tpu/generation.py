@@ -46,12 +46,6 @@ __all__ = [
 
 
 def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
-    if getattr(config, "moe_experts", 0):
-        raise NotImplementedError(
-            "KV-cache generation supports dense LlamaConfig only; MoE decode "
-            "(moe_experts > 0) is not wired into the cached layer step yet — "
-            "use the full-forward path (llama_forward) for MoE inference"
-        )
     """Stacked cache: {"k","v"}: [L, B, max_len, Hkv, D]."""
     shape = (config.n_layers, batch_size, max_len, config.n_kv_heads, config.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -127,7 +121,7 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
 
 
-def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config):
+def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config, mesh=None):
     """One decoder layer over S tokens at ``positions``, updating [B,max,·,·]
     caches in place (dynamic_update_slice along the sequence axis)."""
     B, S, _ = h.shape
@@ -143,13 +137,33 @@ def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config):
     attn = _cached_attention(q, k_cache, v_cache, positions)
     h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
     x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
-    gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
-    up = x @ layer_params["w3"]["kernel"]
-    h = h + (gate * up) @ layer_params["w2"]["kernel"]
+    if config.moe_experts > 0:
+        from .parallel.moe import moe_ffn
+
+        # Same routing as the training forward (transformer.py llama layer),
+        # except the capacity factor is floored at E/top_k so the cached path
+        # NEVER capacity-drops: a decode step routes only the B new tokens as
+        # one tiny group, where the training-time capacity
+        # ceil(top_k*cf*g/E) would drop tokens that the full-sequence forward
+        # keeps (silent divergence). Drop-free eval routing is standard
+        # (Switch/GShard evaluate with raised capacity); the aux loss is
+        # irrelevant at inference.
+        no_drop_cf = max(config.moe_capacity_factor, config.moe_experts / config.moe_top_k)
+        y, _ = moe_ffn(
+            layer_params["moe"], x,
+            top_k=config.moe_top_k,
+            capacity_factor=no_drop_cf,
+            mesh=mesh,  # ep-axis dispatch/expert sharding constraints
+        )
+        h = h + y
+    else:
+        gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
+        up = x @ layer_params["w3"]["kernel"]
+        h = h + (gate * up) @ layer_params["w2"]["kernel"]
     return h, k_cache, v_cache
 
 
-def _forward_cached(params, ids, cache, start_pos, config: LlamaConfig):
+def _forward_cached(params, ids, cache, start_pos, config: LlamaConfig, mesh=None):
     """Forward S tokens starting at ``start_pos`` against the cache.
     Returns (logits [B, S, vocab], new_cache)."""
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
@@ -161,7 +175,9 @@ def _forward_cached(params, ids, cache, start_pos, config: LlamaConfig):
     def layer(carry, xs):
         h = carry
         layer_params, k_c, v_c = xs
-        h, k_c, v_c = _layer_step(layer_params, h, k_c, v_c, positions, cos, sin, config)
+        h, k_c, v_c = _layer_step(
+            layer_params, h, k_c, v_c, positions, cos, sin, config, mesh=mesh
+        )
         return h, (k_c, v_c)
 
     h, (k_new, v_new) = jax.lax.scan(
@@ -233,13 +249,13 @@ def _cached_generate(
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
 
-    prefill = jax.jit(partial(_forward_cached, config=config))
+    prefill = jax.jit(partial(_forward_cached, config=config, mesh=mesh))
 
     @partial(jax.jit, donate_argnums=(1,))
     def decode_all(params, cache, first_tok, key):
         def body(carry, i):
             tok, finished, cache = carry
-            logits, cache = _forward_cached(params, tok[:, None], cache, S + i - 1, config)
+            logits, cache = _forward_cached(params, tok[:, None], cache, S + i - 1, config, mesh=mesh)
             nxt = select(logits[:, -1], jax.random.fold_in(key, i)).astype(tok.dtype)
             if eos_token_id is not None:
                 nxt = jnp.where(finished, eos_token_id, nxt)
@@ -384,7 +400,7 @@ def beam_generate(
         # beams tile the batch axis inside jit (B -> B*K), which preserves the
         # batch-axis divisibility, so the same placement policy applies
         prompt_ids, cache = _place_for_mesh(mesh, prompt_ids, cache, config)
-    prefill = jax.jit(partial(_forward_cached, config=config))
+    prefill = jax.jit(partial(_forward_cached, config=config, mesh=mesh))
     logits, cache = prefill(params, prompt_ids, cache, jnp.int32(0))
 
     @jax.jit
@@ -409,7 +425,7 @@ def beam_generate(
             tokens, scores, finished, lengths, cache = carry
             last = jax.lax.dynamic_index_in_dim(tokens, i - 1, axis=2)  # [B, K, 1]
             logits, cache = _forward_cached(
-                params, last.reshape(B * K, 1), cache, S + i - 1, config
+                params, last.reshape(B * K, 1), cache, S + i - 1, config, mesh=mesh
             )
             logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
             logp = logp.reshape(B, K, V)

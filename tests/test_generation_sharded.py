@@ -73,6 +73,61 @@ class TestGenerationShardings:
         assert cache_sh.spec == P(None, ("dp_replicate", "dp_shard"), None, "tp", None)
 
 
+class TestMoEDecode:
+    """KV-cache decode for MoE configs must match full-forward recompute
+    decoding token-for-token. ``moe_capacity_factor`` is set high enough that
+    no token is capacity-dropped — with drops, prefill (S tokens per routing
+    group) and decode (1 token per group) could legitimately diverge."""
+
+    def test_moe_greedy_matches_full_forward_decode(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.models.transformer import llama_forward
+
+        config = LlamaConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+        )
+        params = init_llama(config, jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        prompt = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, config.vocab_size), np.int32
+        )
+
+        got = greedy_generate(params, prompt, config, max_new_tokens=5, cache_dtype=np.float32)
+
+        # reference: recompute the full forward for every step (no cache)
+        ids = prompt
+        for _ in range(5):
+            logits = llama_forward(params, ids, config, attention_impl="xla")
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(ids, got)
+
+    def test_decode_is_drop_free_at_default_capacity(self):
+        """The cached path floors the capacity factor at E/top_k, so a decode
+        step (one tiny routing group of B tokens) never capacity-drops even
+        with the training default cf — pinned by comparing against an
+        explicitly no-drop config on a prompt of IDENTICAL tokens (maximal
+        expert collision, the adversarial case for per-step capacity)."""
+        import dataclasses
+
+        base = LlamaConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            max_seq_len=64, moe_experts=8, moe_top_k=2,  # default cf 1.25
+        )
+        params = init_llama(base, jax.random.PRNGKey(2))
+        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        prompt = np.full((4, 2), 7, np.int32)  # same token everywhere
+
+        got_default = greedy_generate(params, prompt, base, max_new_tokens=4,
+                                      cache_dtype=np.float32)
+        high = dataclasses.replace(base, moe_capacity_factor=16.0)
+        got_nodrop = greedy_generate(params, prompt, high, max_new_tokens=4,
+                                     cache_dtype=np.float32)
+        np.testing.assert_array_equal(got_default, got_nodrop)
+
+
 class TestShardedDecodeParity:
     """Sharded decode must produce the same tokens as single-device decode
     (fp32 on the CPU mesh; GSPMD re-associates reductions, so logits match to
