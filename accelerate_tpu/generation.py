@@ -137,29 +137,21 @@ def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config, 
     attn = _cached_attention(q, k_cache, v_cache, positions)
     h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
     x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
-    if config.moe_experts > 0:
-        from .parallel.moe import moe_ffn
+    # MoE capacity: DECODE steps (S == 1) route only the B new tokens as one
+    # tiny group, where the training-time capacity ceil(top_k*cf*g/E) would
+    # drop tokens the full-sequence forward keeps (silent divergence) — floor
+    # the factor at E/top_k there so per-step routing is drop-free (Switch/
+    # GShard-style raised eval capacity; cost is bounded by the tiny group).
+    # PREFILL (S > 1) keeps the training factor: its routing group equals the
+    # full forward's at that length, and the floor would blow dispatch memory
+    # up to O(g^2·E) on long prompts. Aux loss is irrelevant at inference.
+    from .models.transformer import llama_ffn
 
-        # Same routing as the training forward (transformer.py llama layer),
-        # except the capacity factor is floored at E/top_k so the cached path
-        # NEVER capacity-drops: a decode step routes only the B new tokens as
-        # one tiny group, where the training-time capacity
-        # ceil(top_k*cf*g/E) would drop tokens that the full-sequence forward
-        # keeps (silent divergence). Drop-free eval routing is standard
-        # (Switch/GShard evaluate with raised capacity); the aux loss is
-        # irrelevant at inference.
-        no_drop_cf = max(config.moe_capacity_factor, config.moe_experts / config.moe_top_k)
-        y, _ = moe_ffn(
-            layer_params["moe"], x,
-            top_k=config.moe_top_k,
-            capacity_factor=no_drop_cf,
-            mesh=mesh,  # ep-axis dispatch/expert sharding constraints
-        )
-        h = h + y
-    else:
-        gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
-        up = x @ layer_params["w3"]["kernel"]
-        h = h + (gate * up) @ layer_params["w2"]["kernel"]
+    capacity_factor = None
+    if config.moe_experts > 0 and S == 1:
+        capacity_factor = max(config.moe_capacity_factor, config.moe_experts / config.moe_top_k)
+    y, _ = llama_ffn(layer_params, x, config, mesh=mesh, capacity_factor=capacity_factor)
+    h = h + y
     return h, k_cache, v_cache
 
 

@@ -194,6 +194,28 @@ def _constrain(x, mesh, *logical):
     )
 
 
+def llama_ffn(layer_params: dict, x: jax.Array, config: LlamaConfig, mesh=None,
+              capacity_factor: Optional[float] = None):
+    """The per-layer FFN block — dense SwiGLU or expert-parallel MoE — shared
+    by the training forward and the cached decode path (generation.py) so the
+    two cannot drift. Returns ``(y, aux)``; ``capacity_factor`` overrides the
+    config's (the decode path floors it for drop-free per-step routing)."""
+    if config.moe_experts > 0:
+        from ..parallel.moe import moe_ffn
+
+        return moe_ffn(
+            layer_params["moe"], x,
+            top_k=config.moe_top_k,
+            capacity_factor=(
+                config.moe_capacity_factor if capacity_factor is None else capacity_factor
+            ),
+            mesh=mesh,  # ep-axis dispatch/expert activation constraints
+        )
+    gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
+    up = x @ layer_params["w3"]["kernel"]
+    return (gate * up) @ layer_params["w2"]["kernel"], jnp.float32(0.0)
+
+
 def llama_forward(
     params: dict,
     input_ids: jax.Array,  # [B, S]
@@ -235,21 +257,8 @@ def llama_forward(
         h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
         h = _constrain(h, mesh, _batch_axes, "cp", None)
         x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
-        if config.moe_experts > 0:
-            from ..parallel.moe import moe_ffn
-
-            y, aux = moe_ffn(
-                layer_params["moe"], x,
-                top_k=config.moe_top_k,
-                capacity_factor=config.moe_capacity_factor,
-                mesh=mesh,  # ep-axis dispatch/expert activation constraints
-            )
-            h = h + y
-        else:
-            gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
-            up = x @ layer_params["w3"]["kernel"]
-            h = h + (gate * up) @ layer_params["w2"]["kernel"]
-            aux = jnp.float32(0.0)
+        y, aux = llama_ffn(layer_params, x, config, mesh=mesh)
+        h = h + y
         h = _constrain(h, mesh, _batch_axes, "cp", None)
         return h, aux
 

@@ -104,12 +104,38 @@ class TestMoEDecode:
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(ids, got)
 
+    def test_moe_decode_over_ep_mesh_matches_unsharded(self):
+        """Expert-parallel decode: experts sharded over ``ep`` (llama_shard_rules
+        moe entries), tokens replicated — same tokens as unsharded decode."""
+        from jax.sharding import Mesh
+
+        config = LlamaConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+        )
+        params = init_llama(config, jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        prompt = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, config.vocab_size), np.int32
+        )
+        ref = greedy_generate(params, prompt, config, max_new_tokens=5, cache_dtype=np.float32)
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("ep", "tp"))
+        sharded, specs = shard_params(params, mesh, rules=llama_shard_rules())
+        assert specs["layers"]["moe"]["wi"]["kernel"] == P(None, "ep", None, "tp")
+        got = greedy_generate(
+            sharded, prompt, config, max_new_tokens=5, cache_dtype=np.float32, mesh=mesh
+        )
+        np.testing.assert_array_equal(ref, got)
+
     def test_decode_is_drop_free_at_default_capacity(self):
-        """The cached path floors the capacity factor at E/top_k, so a decode
-        step (one tiny routing group of B tokens) never capacity-drops even
-        with the training default cf — pinned by comparing against an
-        explicitly no-drop config on a prompt of IDENTICAL tokens (maximal
-        expert collision, the adversarial case for per-step capacity)."""
+        """Single-token (S == 1) steps floor the capacity factor at E/top_k, so
+        per-step routing never capacity-drops even with the training default
+        cf — pinned by comparing against an explicitly no-drop config on a
+        prompt of IDENTICAL tokens (maximal expert collision, the adversarial
+        case for per-step capacity). The prompt is one token so prefill is
+        itself a single-token step (longer prefills deliberately keep the
+        training capacity — their routing group matches the full forward's)."""
         import dataclasses
 
         base = LlamaConfig(
@@ -118,7 +144,7 @@ class TestMoEDecode:
         )
         params = init_llama(base, jax.random.PRNGKey(2))
         params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
-        prompt = np.full((4, 2), 7, np.int32)  # same token everywhere
+        prompt = np.full((4, 1), 7, np.int32)  # same token everywhere
 
         got_default = greedy_generate(params, prompt, base, max_new_tokens=4,
                                       cache_dtype=np.float32)
