@@ -104,6 +104,26 @@ class TestMoEDecode:
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(ids, got)
 
+    def test_moe_dispatched_decode_matches_resident(self):
+        """The per-layer paged path (cpu_offload + generate_dispatched) routes
+        MoE layers identically to resident decode."""
+        from accelerate_tpu.big_modeling import cpu_offload
+        from accelerate_tpu.generation import generate_dispatched, unstack_layer_params
+
+        config = LlamaConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+        )
+        params = init_llama(config, jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        prompt = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0, config.vocab_size), np.int32
+        )
+        ref = greedy_generate(params, prompt, config, max_new_tokens=4, cache_dtype=np.float32)
+        disp = cpu_offload(unstack_layer_params(params, config))
+        out = generate_dispatched(disp, prompt, config, max_new_tokens=4, cache_dtype=np.float32)
+        np.testing.assert_array_equal(ref, out)
+
     def test_moe_decode_over_ep_mesh_matches_unsharded(self):
         """Expert-parallel decode: experts sharded over ``ep`` (llama_shard_rules
         moe entries), tokens replicated — same tokens as unsharded decode."""
