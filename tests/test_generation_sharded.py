@@ -32,6 +32,22 @@ def _tiny_config():
     )
 
 
+def _tiny_moe_config(**overrides):
+    kwargs = dict(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def _f32_params(config, seed):
+    import jax
+
+    params = init_llama(config, jax.random.PRNGKey(seed))
+    return jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+
+
 def _mesh_2x2():
     return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
 
@@ -84,12 +100,8 @@ class TestMoEDecode:
 
         from accelerate_tpu.models.transformer import llama_forward
 
-        config = LlamaConfig(
-            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
-            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
-        )
-        params = init_llama(config, jax.random.PRNGKey(0))
-        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        config = _tiny_moe_config()
+        params = _f32_params(config, 0)
         prompt = np.asarray(
             jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, config.vocab_size), np.int32
         )
@@ -110,12 +122,8 @@ class TestMoEDecode:
         from accelerate_tpu.big_modeling import cpu_offload
         from accelerate_tpu.generation import generate_dispatched, unstack_layer_params
 
-        config = LlamaConfig(
-            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
-            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
-        )
-        params = init_llama(config, jax.random.PRNGKey(0))
-        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        config = _tiny_moe_config()
+        params = _f32_params(config, 0)
         prompt = np.asarray(
             jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0, config.vocab_size), np.int32
         )
@@ -129,12 +137,8 @@ class TestMoEDecode:
         moe entries), tokens replicated — same tokens as unsharded decode."""
         from jax.sharding import Mesh
 
-        config = LlamaConfig(
-            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
-            max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
-        )
-        params = init_llama(config, jax.random.PRNGKey(0))
-        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        config = _tiny_moe_config()
+        params = _f32_params(config, 0)
         prompt = np.asarray(
             jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, config.vocab_size), np.int32
         )
@@ -158,12 +162,8 @@ class TestMoEDecode:
         training capacity — their routing group matches the full forward's)."""
         import dataclasses
 
-        base = LlamaConfig(
-            vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
-            max_seq_len=64, moe_experts=8, moe_top_k=2,  # default cf 1.25
-        )
-        params = init_llama(base, jax.random.PRNGKey(2))
-        params = jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
+        base = _tiny_moe_config(moe_experts=8, moe_capacity_factor=1.25)  # default cf
+        params = _f32_params(base, 2)
         prompt = np.full((4, 1), 7, np.int32)  # same token everywhere
 
         got_default = greedy_generate(params, prompt, base, max_new_tokens=4,
