@@ -1,0 +1,34 @@
+"""Rank-aware rich console (reference ``utils/rich.py``: a shared ``Console``
+singleton gated on the extra being installed).
+
+Here the console is additionally main-process-only by default — N hosts
+printing N copies of a table is the multihost analogue of N progress bars
+(see ``utils/tqdm.py``).
+"""
+
+from __future__ import annotations
+
+from .imports import is_rich_available
+
+_console = None
+
+
+def get_console():
+    """Shared ``rich.console.Console`` (created on first use)."""
+    if not is_rich_available():
+        raise ImportError("rich is not installed; pip install rich")
+    global _console
+    if _console is None:
+        from rich.console import Console
+
+        _console = Console()
+    return _console
+
+
+def rich_print(*args, main_process_only: bool = True, **kwargs):
+    """``console.print`` that renders only on the main process by default."""
+    from ..state import PartialState
+
+    if main_process_only and not PartialState().is_main_process:
+        return
+    get_console().print(*args, **kwargs)

@@ -33,17 +33,16 @@ def _tiny_config():
 
 
 def _tiny_moe_config(**overrides):
+    """Overriding a knob to None drops it so the dataclass default applies."""
     kwargs = dict(
         vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
         max_seq_len=64, moe_experts=4, moe_top_k=2, moe_capacity_factor=8.0,
     )
     kwargs.update(overrides)
-    return LlamaConfig(**kwargs)
+    return LlamaConfig(**{k: v for k, v in kwargs.items() if v is not None})
 
 
 def _f32_params(config, seed):
-    import jax
-
     params = init_llama(config, jax.random.PRNGKey(seed))
     return jax.tree_util.tree_map(lambda x: x.astype(np.float32), params)
 
@@ -162,7 +161,7 @@ class TestMoEDecode:
         training capacity — their routing group matches the full forward's)."""
         import dataclasses
 
-        base = _tiny_moe_config(moe_experts=8, moe_capacity_factor=1.25)  # default cf
+        base = _tiny_moe_config(moe_experts=8, moe_capacity_factor=None)  # dataclass-default cf
         params = _f32_params(base, 2)
         prompt = np.full((4, 1), 7, np.int32)  # same token everywhere
 

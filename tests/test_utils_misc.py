@@ -147,6 +147,31 @@ class TestPublicAPI:
             utils.not_a_real_name
 
 
+class TestRich:
+    def test_console_singleton_and_print(self, capsys):
+        pytest.importorskip("rich")
+        from accelerate_tpu.utils.rich import get_console, rich_print
+
+        assert get_console() is get_console()
+        rich_print("hello rich")
+        assert "hello rich" in capsys.readouterr().out
+
+    def test_print_gates_on_main_process(self, capsys):
+        pytest.importorskip("rich")
+        from unittest.mock import PropertyMock, patch
+
+        from accelerate_tpu.state import PartialState
+        from accelerate_tpu.utils.rich import rich_print
+
+        with patch.object(type(PartialState()), "is_main_process",
+                          new_callable=PropertyMock, return_value=False):
+            rich_print("suppressed")  # non-main + default main_process_only
+            rich_print("forced", main_process_only=False)
+        out = capsys.readouterr().out
+        assert "suppressed" not in out
+        assert "forced" in out
+
+
 class TestTqdm:
     def test_main_process_enabled(self):
         from accelerate_tpu.utils.tqdm import tqdm
