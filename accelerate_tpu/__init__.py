@@ -97,6 +97,10 @@ def __getattr__(name):
         from .utils.tqdm import tqdm
 
         return tqdm
+    if name in ("rich_print", "get_console"):
+        from .utils import rich
+
+        return getattr(rich, name)
     if name in _BIG_MODELING:
         from . import big_modeling
 

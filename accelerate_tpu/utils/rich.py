@@ -29,6 +29,10 @@ def rich_print(*args, main_process_only: bool = True, **kwargs):
     """``console.print`` that renders only on the main process by default."""
     from ..state import PartialState
 
+    if not is_rich_available():  # check on EVERY rank, before the gate: a
+        # missing dep must fail symmetrically, not strand non-main processes
+        # at the next collective (same order as utils/tqdm.py)
+        raise ImportError("rich is not installed; pip install rich")
     if main_process_only and not PartialState().is_main_process:
         return
     get_console().print(*args, **kwargs)

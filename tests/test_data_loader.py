@@ -161,6 +161,63 @@ class TestBatchSamplerShardGrid:
             assert all(len(b) == 2 for b in batches), batches
 
 
+def test_iterable_dataset_shard_grid():
+    """Exhaustive sweep mirroring the reference's iterable-shard tests
+    (``/root/reference/tests/test_data_loader.py``): every (length, batch_size,
+    num_shards, drop_last, even_batches) cell must satisfy the invariants —
+    all shards yield the SAME count; full windows are exact round-robin
+    slices; the tail is dropped, padded from the stream head (even_batches),
+    or truncated (neither); and every yielded item comes from the dataset."""
+    from accelerate_tpu.data_loader import IterableDatasetShard
+
+    for length in range(0, 26):
+        data = list(range(length))
+        for batch_size in (1, 2, 3):
+            for num_shards in (2, 3):
+                window = batch_size * num_shards
+                for drop_last in (False, True):
+                    for even_batches in (False, True):
+                        shards = [
+                            list(
+                                IterableDatasetShard(
+                                    data, batch_size, num_shards, i,
+                                    drop_last=drop_last, even_batches=even_batches,
+                                )
+                            )
+                            for i in range(num_shards)
+                        ]
+                        cell = (length, batch_size, num_shards, drop_last, even_batches)
+                        n_full = length // window
+                        tail = length % window
+                        # same yield count on every shard
+                        if drop_last or tail == 0:
+                            expect = [n_full * batch_size] * num_shards
+                        elif even_batches:
+                            expect = [(n_full + 1) * batch_size] * num_shards
+                        else:
+                            # last partial window truncates: shard i gets its
+                            # slice of the tail items
+                            expect = [
+                                max(0, min(batch_size, tail - i * batch_size))
+                                + n_full * batch_size
+                                for i in range(num_shards)
+                            ]
+                        assert [len(s) for s in shards] == expect, cell
+                        # full windows: exact round-robin partition
+                        flat_full = [x for w in range(n_full) for i in range(num_shards)
+                                     for x in shards[i][w * batch_size:(w + 1) * batch_size]]
+                        assert flat_full == data[: n_full * window], cell
+                        # every yielded element exists in the stream
+                        for s in shards:
+                            assert set(s) <= set(data), cell
+                        # even_batches tail pad comes from the FIRST window
+                        if tail and not drop_last and even_batches and length:
+                            first_window = data[:window] if length >= window else data
+                            padded = [x for s in shards for x in s[n_full * batch_size:]]
+                            for x in padded[tail:]:
+                                assert x in first_window, cell
+
+
 def test_iterable_dataset_shard():
     data = list(range(22))
     shards = [
