@@ -152,6 +152,30 @@ def get_cluster_input() -> ClusterConfig:
     return cfg
 
 
+def write_basic_config(mixed_precision: str = "bf16", save_location: Optional[str] = None):
+    """Non-interactive default config for notebooks/CI (reference
+    ``commands/config/default.py`` ``write_basic_config``, re-exported from
+    ``accelerate.utils``). Refuses to clobber: returns ``False`` if the file
+    already exists (delete it or pass another ``save_location``); otherwise
+    writes a single-host config with the requested precision and returns the
+    path."""
+    from ..utils.dataclasses import PrecisionType
+
+    mixed_precision = str(mixed_precision).lower()  # reference lowercases too
+    valid = [p.value for p in PrecisionType]
+    if mixed_precision not in valid:
+        raise ValueError(f"mixed_precision must be one of {valid}, got {mixed_precision!r}")
+    path = save_location or default_config_file
+    if os.path.isfile(path):
+        print(
+            f"Config file already exists at {path}; not overwriting. Delete it or "
+            "pass save_location to write elsewhere."
+        )
+        return False
+    ClusterConfig(mixed_precision=mixed_precision).save(path)
+    return path
+
+
 def config_command(args) -> int:
     if args.default:
         cfg = ClusterConfig()

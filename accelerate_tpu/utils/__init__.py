@@ -64,6 +64,10 @@ def __getattr__(name):
         from . import random
 
         return getattr(random, name)
+    if name == "write_basic_config":  # reference: accelerate.utils re-export
+        from ..commands.config import write_basic_config
+
+        return write_basic_config
     raise AttributeError(f"module 'accelerate_tpu.utils' has no attribute {name!r}")
 
 
@@ -91,12 +95,15 @@ from .imports import (
 # __all__ spans the eager imports above AND the lazy collectives/RNG names
 # (star-import resolves the lazy ones through module __getattr__, PEP 562);
 # __dir__ keeps tab-completion/introspection seeing the lazy names too.
+_LAZY_EXTRA = {"write_basic_config"}
+
 __all__ = sorted(
     {n for n in globals() if not n.startswith("_") and n != "annotations"}
     | _OPERATIONS
     | _RANDOM
+    | _LAZY_EXTRA
 )
 
 
 def __dir__():
-    return sorted(set(globals()) | _OPERATIONS | _RANDOM)
+    return sorted(set(globals()) | _OPERATIONS | _RANDOM | _LAZY_EXTRA)

@@ -147,6 +147,35 @@ class TestPublicAPI:
             utils.not_a_real_name
 
 
+class TestWriteBasicConfig:
+    def test_writes_default_and_refuses_clobber(self, tmp_path, capsys):
+        from accelerate_tpu.commands.config import ClusterConfig
+        from accelerate_tpu.utils import write_basic_config
+
+        path = str(tmp_path / "cfg.yaml")
+        out = write_basic_config("fp16", path)
+        assert out == path
+        cfg = ClusterConfig.load(path)
+        assert cfg.mixed_precision == "fp16"
+        assert write_basic_config("bf16", path) is False  # no clobber
+        assert ClusterConfig.load(path).mixed_precision == "fp16"
+
+    def test_rejects_unknown_precision(self, tmp_path):
+        from accelerate_tpu.utils import write_basic_config
+
+        with pytest.raises(ValueError):
+            write_basic_config("tf32", str(tmp_path / "x.yaml"))
+
+    def test_uppercase_precision_accepted(self, tmp_path):
+        """Reference parity: accelerate lowercases before validating."""
+        from accelerate_tpu.commands.config import ClusterConfig
+        from accelerate_tpu.utils import write_basic_config
+
+        path = str(tmp_path / "u.yaml")
+        assert write_basic_config("BF16", path) == path
+        assert ClusterConfig.load(path).mixed_precision == "bf16"
+
+
 class TestRich:
     def test_console_singleton_and_print(self, capsys):
         pytest.importorskip("rich")
