@@ -1,7 +1,8 @@
 """Unit tests for the small utility surfaces the reference covers in
 ``tests/test_utils.py`` / ``test_imports.py`` / ``test_logging.py``:
 environment parsing, env patching, capability probes, the rank-aware logging
-adapter, and the main-process-only tqdm."""
+adapter, the main-process-only tqdm/rich helpers, the public-API export
+contracts, ``write_basic_config``, and the notebook/debug launchers."""
 
 import logging
 import os
@@ -145,6 +146,54 @@ class TestPublicAPI:
         assert set(utils.__all__) <= set(dir(utils))
         with pytest.raises(AttributeError):
             utils.not_a_real_name
+
+
+class TestLaunchers:
+    def test_debug_launcher_runs_on_virtual_mesh(self):
+        import jax
+
+        from accelerate_tpu import debug_launcher
+
+        def fn(mult):
+            assert os.environ.get("ACCELERATE_USE_CPU") == "yes"
+            return len(jax.devices()) * mult
+
+        # conftest already forced the 8-device CPU mesh; the launcher must run
+        # the function under the accelerate env and hand back its return
+        assert debug_launcher(fn, args=(2,)) == 16
+        assert "ACCELERATE_USE_CPU" not in os.environ  # env restored
+
+    def test_notebook_launcher_single_host(self):
+        from accelerate_tpu import notebook_launcher
+
+        def fn(x):
+            assert os.environ.get("ACCELERATE_MIXED_PRECISION") == "bf16"
+            return x + 1
+
+        assert notebook_launcher(fn, args=(41,), mixed_precision="bf16") == 42
+
+    def test_notebook_launcher_multinode_needs_master_addr(self):
+        from accelerate_tpu import notebook_launcher
+
+        with pytest.raises(ValueError):
+            notebook_launcher(lambda: None, num_nodes=2)
+
+    def test_notebook_launcher_multinode_sets_coordinator_env(self):
+        from accelerate_tpu import notebook_launcher
+
+        def fn():
+            return (
+                os.environ["ACCELERATE_COORDINATOR_ADDRESS"],
+                os.environ["ACCELERATE_NUM_PROCESSES"],
+                os.environ["ACCELERATE_PROCESS_ID"],
+            )
+
+        addr, n, rank = notebook_launcher(
+            fn, master_addr="10.0.0.1", use_port="9999", num_nodes=2, node_rank=1
+        )
+        assert addr == "10.0.0.1:9999"
+        assert (n, rank) == ("2", "1")
+        assert "ACCELERATE_COORDINATOR_ADDRESS" not in os.environ
 
 
 class TestWriteBasicConfig:
