@@ -9,6 +9,45 @@ import platform
 from .config import resolve_config_file
 
 
+def _probe_jax(timeout: int = 60) -> dict:
+    """Collect JAX backend facts in a KILLABLE subprocess.
+
+    Remote-tunneled TPU backends have been observed to hang INSIDE backend
+    init (a C call SIGALRM cannot interrupt) — and an outage is exactly when a
+    user runs ``env`` for diagnostics, so the probe must never wedge the
+    diagnostic itself. ``ACCELERATE_ENV_PROBE_TIMEOUT`` overrides the budget.
+    """
+    import json
+    import subprocess
+    import sys
+
+    code = (
+        "import json, jax\n"
+        "print(json.dumps({\n"
+        "  'JAX version': jax.__version__,\n"
+        "  'JAX backend': jax.default_backend(),\n"
+        "  'JAX device count': str(jax.device_count()),\n"
+        "  'JAX local devices': ', '.join(str(d) for d in jax.local_devices()[:8]),\n"
+        "  'JAX process count': str(jax.process_count()),\n"
+        "}))\n"
+    )
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout
+        )
+        if res.returncode == 0:
+            return json.loads(res.stdout.strip().splitlines()[-1])
+        # keep the field single-line: the last stderr line is the exception
+        # message (e.g. "ModuleNotFoundError: No module named 'jax'")
+        err_lines = res.stderr.strip().splitlines()
+        detail = err_lines[-1][:300] if err_lines else f"rc={res.returncode}"
+        return {"JAX": f"unavailable ({detail})"}
+    except subprocess.TimeoutExpired:
+        return {"JAX": f"backend init HUNG (> {timeout}s) — remote TPU tunnel likely down"}
+    except Exception as e:  # pragma: no cover - defensive
+        return {"JAX": f"unavailable ({e})"}
+
+
 def env_command(args) -> int:
     import numpy as np
 
@@ -21,15 +60,10 @@ def env_command(args) -> int:
         "Numpy version": np.__version__,
     }
     try:
-        import jax
-
-        lines["JAX version"] = jax.__version__
-        lines["JAX backend"] = jax.default_backend()
-        lines["JAX device count"] = str(jax.device_count())
-        lines["JAX local devices"] = ", ".join(str(d) for d in jax.local_devices()[:8])
-        lines["JAX process count"] = str(jax.process_count())
-    except Exception as e:  # pragma: no cover - depends on runtime
-        lines["JAX"] = f"unavailable ({e})"
+        probe_timeout = int(os.environ.get("ACCELERATE_ENV_PROBE_TIMEOUT", 60))
+    except (TypeError, ValueError):  # a bad knob must not kill the diagnostic
+        probe_timeout = 60
+    lines.update(_probe_jax(timeout=probe_timeout))
     for mod in ("flax", "optax", "orbax.checkpoint", "torch", "transformers"):
         try:
             import importlib

@@ -42,7 +42,10 @@ def test_config_rejects_unknown_keys(tmp_path):
         ClusterConfig.load(str(path))
 
 
-def test_env_command():
+def test_env_command(monkeypatch):
+    # keep the JAX backend probe short: on a hung TPU tunnel the killable
+    # subprocess waits out its budget before reporting the outage
+    monkeypatch.setenv("ACCELERATE_ENV_PROBE_TIMEOUT", "20")
     r = run_cli("env")
     assert r.returncode == 0, r.stderr
     assert "accelerate-tpu" in r.stdout
