@@ -36,7 +36,13 @@ def _probe_jax(timeout: int = 60) -> dict:
             [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout
         )
         if res.returncode == 0:
-            return json.loads(res.stdout.strip().splitlines()[-1])
+            # scan for the JSON blob: libraries may append log lines to stdout
+            for line in reversed(res.stdout.strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+            return {"JAX": "probe returned no parseable output"}
         # keep the field single-line: the last stderr line is the exception
         # message (e.g. "ModuleNotFoundError: No module named 'jax'")
         err_lines = res.stderr.strip().splitlines()
@@ -62,6 +68,8 @@ def env_command(args) -> int:
     try:
         probe_timeout = int(os.environ.get("ACCELERATE_ENV_PROBE_TIMEOUT", 60))
     except (TypeError, ValueError):  # a bad knob must not kill the diagnostic
+        probe_timeout = 60
+    if probe_timeout <= 0:  # 0/negative would misdiagnose a healthy backend as hung
         probe_timeout = 60
     lines.update(_probe_jax(timeout=probe_timeout))
     for mod in ("flax", "optax", "orbax.checkpoint", "torch", "transformers"):

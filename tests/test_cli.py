@@ -42,6 +42,32 @@ def test_config_rejects_unknown_keys(tmp_path):
         ClusterConfig.load(str(path))
 
 
+def test_env_probe_outcomes():
+    """The env diagnostic's JAX probe must yield a single-line field for every
+    outcome: healthy JSON, failed import, and a hung backend."""
+    import subprocess as sp
+    from types import SimpleNamespace
+    from unittest.mock import patch
+
+    from accelerate_tpu.commands.env import _probe_jax
+
+    healthy = SimpleNamespace(returncode=0, stdout='{"JAX backend": "tpu"}\n', stderr="")
+    with patch.object(sp, "run", return_value=healthy):
+        assert _probe_jax()["JAX backend"] == "tpu"
+
+    broken = SimpleNamespace(
+        returncode=1, stdout="",
+        stderr="Traceback ...\nModuleNotFoundError: No module named 'jax'\n",
+    )
+    with patch.object(sp, "run", return_value=broken):
+        out = _probe_jax()["JAX"]
+        assert out == "unavailable (ModuleNotFoundError: No module named 'jax')"
+        assert "\n" not in out
+
+    with patch.object(sp, "run", side_effect=sp.TimeoutExpired("cmd", 5)):
+        assert "HUNG" in _probe_jax(timeout=5)["JAX"]
+
+
 def test_env_command(monkeypatch):
     # keep the JAX backend probe short: on a hung TPU tunnel the killable
     # subprocess waits out its budget before reporting the outage
