@@ -36,12 +36,16 @@ def _probe_jax(timeout: int = 60) -> dict:
             [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout
         )
         if res.returncode == 0:
-            # scan for the JSON blob: libraries may append log lines to stdout
+            # scan for OUR blob — a dict with the probe's key — so stray
+            # JSON-formatted log lines or bare literals can't be mistaken
+            # for it (or crash lines.update with a non-dict)
             for line in reversed(res.stdout.strip().splitlines()):
                 try:
-                    return json.loads(line)
+                    parsed = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if isinstance(parsed, dict) and "JAX version" in parsed:
+                    return parsed
             return {"JAX": "probe returned no parseable output"}
         # keep the field single-line: the last stderr line is the exception
         # message (e.g. "ModuleNotFoundError: No module named 'jax'")

@@ -161,6 +161,18 @@ def _train_flops_per_sample(config, seq_len: int, n_params: int) -> float:
     return per_token * seq_len
 
 
+def _lm_train_mfu(tokens_per_sec: float, n_params: int, config, seq_len: int):
+    """Model-FLOPs utilization for an LM train config (None off-TPU); same
+    methodology as the headline bench via the shared FLOPs formula."""
+    import jax
+
+    peak = _peak_flops(jax.devices()[0])
+    if not peak:
+        return None
+    per_token = _train_flops_per_sample(config, seq_len, n_params) / seq_len
+    return round(tokens_per_sec * per_token / peak, 4)
+
+
 def _reset_state():
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
@@ -268,13 +280,17 @@ def run_bench_fsdp_lm(on_tpu: bool) -> dict:
     final = float(np.asarray(loss))
     elapsed = _t.time() - t0
     tokens_per_sec = steps * bs * seq / elapsed
-    return {
+    out = {
         "metric": "lm-774M fsdp-scale train throughput" if on_tpu else "lm-tiny train throughput",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "n_params": n_params,
         "final_loss": round(final, 4),
     }
+    mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
+    if mfu is not None:
+        out["mfu"] = mfu  # model FLOPs only; remat recompute not counted
+    return out
 
 
 def run_bench_inference(on_tpu: bool) -> dict:
@@ -468,14 +484,19 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
         params, opt_state, loss = step(params, opt_state, batch)
     final = float(np.asarray(loss))
     elapsed = _t.time() - t0
-    return {
+    tokens_per_sec = steps * bs * seq / elapsed
+    out = {
         "metric": f"long-context train throughput (seq {seq}, {impl} attention)",
-        "value": round(steps * bs * seq / elapsed, 1),
+        "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "seq_len": seq,
         "n_params": n_params,
         "final_loss": round(final, 4),
     }
+    mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
+    if mfu is not None:
+        out["mfu"] = mfu  # attention FLOPs dominate at this S; remat not counted
+    return out
 
 
 def run_bench_compile_time(on_tpu: bool) -> dict:

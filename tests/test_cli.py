@@ -51,7 +51,12 @@ def test_env_probe_outcomes():
 
     from accelerate_tpu.commands.env import _probe_jax
 
-    healthy = SimpleNamespace(returncode=0, stdout='{"JAX backend": "tpu"}\n', stderr="")
+    healthy = SimpleNamespace(
+        returncode=0,
+        # a stray structured-log line AFTER the blob must not be mistaken for it
+        stdout='{"JAX version": "0.9", "JAX backend": "tpu"}\n{"level": "info"}\n42\n',
+        stderr="",
+    )
     with patch.object(sp, "run", return_value=healthy):
         assert _probe_jax()["JAX backend"] == "tpu"
 
