@@ -11,26 +11,48 @@ __version__ = "0.1.0"
 from .parallelism_config import ParallelismConfig
 from .state import AcceleratorState, GradientState, PartialState
 from .utils import (
+    AutocastKwargs,
+    DDPCommunicationHookType,
     DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedDataParallelKwargs,
     DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradScalerKwargs,
     GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
     MixedPrecisionPolicy,
     PrecisionType,
+    ProfileKwargs,
     ProjectConfiguration,
 )
 
 __all__ = [
     "Accelerator",
+    "AutocastKwargs",
+    "DDPCommunicationHookType",
+    "DeepSpeedPlugin",
     "DispatchedParams",
+    "DistributedDataParallelKwargs",
+    "FullyShardedDataParallelPlugin",
+    "GradScalerKwargs",
+    "InitProcessGroupKwargs",
+    "ProfileKwargs",
     "debug_launcher",
     "notebook_launcher",
     "skip_first_batches",
     "cpu_offload",
+    "cpu_offload_with_hook",
     "disk_offload",
+    "dispatch_model",
     "dispatch_params",
     "infer_auto_device_map",
     "init_empty_weights",
+    "is_rich_available",
     "load_checkpoint_and_dispatch",
+    "load_checkpoint_in_model",
+    "prepare_pipeline",
+    "synchronize_rng_states",
     "LocalSGD",
     "find_executable_batch_size",
     "release_memory",
@@ -101,6 +123,22 @@ def __getattr__(name):
         from .utils import rich
 
         return getattr(rich, name)
+    if name == "load_checkpoint_in_model":
+        from .checkpointing import load_checkpoint_in_model
+
+        return load_checkpoint_in_model
+    if name == "synchronize_rng_states":
+        from .utils.random import synchronize_rng_states
+
+        return synchronize_rng_states
+    if name == "is_rich_available":
+        from .utils.imports import is_rich_available
+
+        return is_rich_available
+    if name == "prepare_pipeline":
+        from .parallel.pipeline import prepare_pipeline
+
+        return prepare_pipeline
     if name in _BIG_MODELING:
         from . import big_modeling
 
@@ -118,8 +156,11 @@ def __getattr__(name):
 
 _BIG_MODELING = {
     "DispatchedParams",
+    "UserCpuOffloadHook",
     "cpu_offload",
+    "cpu_offload_with_hook",
     "disk_offload",
+    "dispatch_model",
     "dispatch_params",
     "init_empty_weights",
     "init_on_device",

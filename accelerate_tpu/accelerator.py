@@ -45,6 +45,27 @@ from .utils.dataclasses import (
 from .utils import operations as ops
 
 
+class RemovableHandle:
+    """Unregister token returned by the state-hook registrars (same contract as
+    the torch handle the reference's ``register_*_state_pre_hook`` returns)."""
+
+    _next_id = 0
+
+    def __init__(self, registry: dict):
+        self._registry = registry
+        self.id = RemovableHandle._next_id
+        RemovableHandle._next_id += 1
+
+    def remove(self) -> None:
+        self._registry.pop(self.id, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.remove()
+
+
 def _is_param_pytree(obj) -> bool:
     """A dict/flax-style pytree whose leaves are all arrays → model params."""
     import jax
@@ -124,13 +145,49 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         cpu: bool = False,
         device_placement: bool = True,
+        kwargs_handlers: Optional[Sequence[Any]] = None,
     ):
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             steps = gradient_accumulation_steps if gradient_accumulation_steps != 1 else env_steps
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
+        # kwargs_handlers routing (reference accelerator.py:414-460: one handler
+        # per class, each steering one subsystem)
+        self.ddp_handler = None
+        self.autocast_handler = None
+        self.profile_handler = None
+        init_pg_kwargs: dict[str, Any] = {}
+        if kwargs_handlers:
+            from .utils.dataclasses import (
+                AutocastConfig,
+                DistributedDataParallelKwargs,
+                InitProcessGroupKwargs,
+            )
+
+            seen: set[type] = set()
+            for handler in kwargs_handlers:
+                if type(handler) in seen:
+                    raise ValueError(f"duplicate kwargs handler of type {type(handler).__name__}")
+                seen.add(type(handler))
+                if isinstance(handler, InitProcessGroupKwargs):
+                    init_pg_kwargs = {
+                        k: v for k, v in handler.to_dict().items() if v is not None
+                    }
+                elif isinstance(handler, GradScalerConfig):
+                    if grad_scaler_config is not None:
+                        raise ValueError("grad_scaler_config given both directly and as a handler")
+                    grad_scaler_config = handler
+                elif isinstance(handler, AutocastConfig):
+                    self.autocast_handler = handler
+                elif isinstance(handler, DistributedDataParallelKwargs):
+                    self.ddp_handler = handler
+                elif isinstance(handler, ProfileConfig):
+                    self.profile_handler = handler
+                else:
+                    raise ValueError(f"unsupported kwargs handler: {handler!r}")
         self.state = AcceleratorState(
-            mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
+            mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config,
+            **init_pg_kwargs,
         )
         self.gradient_state = GradientState(gradient_accumulation_plugin)
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
@@ -150,6 +207,14 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
         self._custom_objects: list = []
+        self._save_state_pre_hooks: dict[int, Callable] = {}
+        self._load_state_pre_hooks: dict[int, Callable] = {}
+        import weakref
+
+        # keyed by the loss_fn OBJECT (weakly): a dead lambda's compiled step is
+        # collected instead of pinning executables for the Accelerator lifetime
+        self._lomo_steps = weakref.WeakKeyDictionary()
+        self._autocast_enabled = True
         self._param_specs = None
         self._accum_count = 0
         self.flag_tensor = None
@@ -431,8 +496,21 @@ class Accelerator:
         import optax
 
         policy = self.state.mixed_precision_policy
+        if not self._autocast_enabled:
+            # inside `autocast(AutocastKwargs(enabled=False))`: full precision
+            from .utils.dataclasses import MixedPrecisionPolicy
+
+            policy = MixedPrecisionPolicy.from_precision(PrecisionType.NO)
         fp16 = self.state.mixed_precision == PrecisionType.FP16
         scaler = self.grad_scaler_config
+        # DDP comm-hook compat: bound the gradient signal to the compressed
+        # wire dtype (the half of fp16/bf16 comm hooks that survives GSPMD —
+        # see DistributedDataParallelKwargs)
+        compress_dtype = (
+            self.ddp_handler.gradient_compression_dtype()
+            if getattr(self, "ddp_handler", None) is not None
+            else None
+        )
 
         def _scaled_loss(params, batch, loss_scale):
             compute_params = policy.cast_to_compute(params)
@@ -449,6 +527,13 @@ class Accelerator:
 
         def _base_step(params, opt_state, batch, loss_scale):
             grads, (loss, aux) = grad_fn(params, batch, loss_scale)
+            if compress_dtype is not None:
+                # compress while still loss-scaled (the reference's fp16 comm
+                # hook compresses pre-unscale grads, so small signals ride the
+                # scale above fp16's subnormal floor)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(compress_dtype).astype(g.dtype), grads
+                )
             grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
             grads = policy.cast_to_param(grads)  # accumulate/update in param dtype
             metrics = {"loss": loss}
@@ -801,6 +886,46 @@ class Accelerator:
         self.flag_tensor = False
         return any(flags)
 
+    # ------------------------------------------------------------------ lomo --
+    def lomo_backward(self, loss_fn: Callable, params, *args, learning_rate: float = 1e-3):
+        """Fused backward + SGD update in one donated jit (reference
+        ``lomo_backward:4265``, which routes backward through a LOMO optimizer's
+        ``fused_backward`` so full gradients are never stored).
+
+        The XLA-native form: ``jax.value_and_grad`` + the SGD update compiled as
+        ONE step with the params buffer donated — the scheduler applies each
+        layer's update as its gradient is produced, so the full gradient tree
+        need not coexist with the params in HBM. Returns
+        ``(loss, new_params)``; rebind params (functional update, no mutation).
+
+        Define ``loss_fn`` ONCE outside the training loop and pass the batch
+        through ``*args`` — a fresh lambda per step is a fresh compile per step
+        (the compiled step is cached per loss_fn object, weakly).
+        """
+        import jax
+
+        step = self._lomo_steps.get(loss_fn)
+        if step is None:
+            import jax.numpy as jnp
+
+            policy = self.state.mixed_precision_policy
+
+            def _step(params, lr, *a):
+                def _loss(p, *inner):
+                    return loss_fn(policy.cast_to_compute(p), *inner).astype(jnp.float32)
+
+                loss, grads = jax.value_and_grad(_loss)(params, *a)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, g: p - lr.astype(p.dtype) * g.astype(p.dtype), params, grads
+                )
+                return loss, new_params
+
+            step = jax.jit(_step, donate_argnums=(0,)) if not self.jit_config.disable_jit else _step
+            self._lomo_steps[loss_fn] = step
+        import jax.numpy as jnp
+
+        return step(params, jnp.float32(learning_rate), *args)
+
     # ---------------------------------------------------------- persistence --
     def register_for_checkpointing(self, *objects):
         """Track custom stateful objects for save/load_state (reference ``:4019``).
@@ -810,9 +935,27 @@ class Accelerator:
                 raise ValueError(f"{obj} lacks state_dict/load_state_dict")
             self._custom_objects.append(obj)
 
+    def register_save_state_pre_hook(self, hook: Callable) -> "RemovableHandle":
+        """Register ``hook(models, output_dir)`` to run at the top of
+        :meth:`save_state` (reference ``register_save_state_pre_hook:3497``;
+        its torch ``weights`` list collapses into the models/params list here).
+        Returns a handle whose ``remove()`` unregisters."""
+        handle = RemovableHandle(self._save_state_pre_hooks)
+        self._save_state_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_load_state_pre_hook(self, hook: Callable) -> "RemovableHandle":
+        """Register ``hook(models, input_dir)`` to run at the top of
+        :meth:`load_state` (reference ``register_load_state_pre_hook:3664``)."""
+        handle = RemovableHandle(self._load_state_pre_hooks)
+        self._load_state_pre_hooks[handle.id] = hook
+        return handle
+
     def save_state(self, output_dir: Optional[str] = None, params=None, opt_state=None, **kwargs) -> str:
         from .checkpointing import save_accelerator_state
 
+        # pre-hooks fire inside save_accelerator_state, AFTER automatic
+        # checkpoint naming resolves the real directory
         return save_accelerator_state(
             self, output_dir=output_dir, params=params, opt_state=opt_state, **kwargs
         )
@@ -865,9 +1008,23 @@ class Accelerator:
     # -------------------------------------------------------------- contexts --
     @contextlib.contextmanager
     def autocast(self, autocast_handler=None):
-        """Informational parity shim (reference ``autocast:4123``): precision is a
-        dtype policy applied in prepared steps, not a tape-mode context."""
-        yield
+        """Precision-policy override context (reference ``autocast:4123``).
+
+        Precision here is a compile-time dtype policy, not a tape mode — so the
+        context governs train steps *built* inside it: with
+        ``AutocastKwargs(enabled=False)`` (passed here or via
+        ``kwargs_handlers``), :meth:`prepare_train_step` calls made inside the
+        context compile full-precision compute. Steps already compiled are
+        unaffected (their policy is baked into the executable).
+        """
+        handler = autocast_handler or self.autocast_handler
+        prev = self._autocast_enabled
+        if handler is not None:
+            self._autocast_enabled = bool(handler.enabled)
+        try:
+            yield
+        finally:
+            self._autocast_enabled = prev
 
     @contextlib.contextmanager
     def profile(self, profile_config: Optional[ProfileConfig] = None, trace_dir: Optional[str] = None):
@@ -876,7 +1033,7 @@ class Accelerator:
         ``<project_dir>/profile``."""
         import jax
 
-        cfg = profile_config or ProfileConfig()
+        cfg = profile_config or self.profile_handler or ProfileConfig()
         out = trace_dir or cfg.output_trace_dir or os.path.join(self.project_dir or ".", "profile")
         if self.is_main_process:
             os.makedirs(out, exist_ok=True)

@@ -226,6 +226,65 @@ def cpu_offload(params, execution_device=None) -> DispatchedParams:
     return DispatchedParams(params, {"": "cpu"}, execution_device=execution_device)
 
 
+class UserCpuOffloadHook:
+    """Manual paging control for one model in a multi-model pipeline (reference
+    ``cpu_offload_with_hook:219`` returns this so e.g. a diffusion pipeline can
+    keep only the active model in HBM). ``offload()`` commits the tree back to
+    host RAM and frees the device buffers."""
+
+    def __init__(self, host_tree, device=None):
+        import jax
+
+        self._jax = jax
+        self._host = jax.tree_util.tree_map(np.asarray, host_tree)
+        self._device = device or _default_device()
+        self._on_device = None
+        self.prev_hook: Optional["UserCpuOffloadHook"] = None
+
+    @property
+    def params(self):
+        """The live tree: device-resident after :meth:`load`, host otherwise."""
+        return self._on_device if self._on_device is not None else self._host
+
+    def load(self):
+        """Page onto the execution device (offloading the previous pipeline
+        stage first, mirroring the reference's hook chaining)."""
+        if self.prev_hook is not None:
+            self.prev_hook.offload()
+        if self._on_device is None:
+            self._on_device = self._jax.tree_util.tree_map(
+                lambda x: self._jax.device_put(x, self._device), self._host
+            )
+        return self._on_device
+
+    def offload(self):
+        """Commit back to host and drop device buffers."""
+        if self._on_device is not None:
+            self._host = self._jax.tree_util.tree_map(np.asarray, self._on_device)
+            for leaf in self._jax.tree_util.tree_leaves(self._on_device):
+                if hasattr(leaf, "delete"):
+                    leaf.delete()
+            self._on_device = None
+
+    def remove(self):
+        self.offload()
+
+
+def cpu_offload_with_hook(
+    params, execution_device=None, prev_module_hook: Optional[UserCpuOffloadHook] = None
+):
+    """Place ``params`` on device now and hand back a hook whose ``offload()``
+    pages them off again (reference ``cpu_offload_with_hook:219``). Chaining
+    ``prev_module_hook`` makes loading model N offload model N-1 — the pattern
+    multi-model inference pipelines use to fit serially in HBM.
+
+    Returns ``(device_params, hook)``.
+    """
+    hook = UserCpuOffloadHook(params, device=execution_device)
+    hook.prev_hook = prev_module_hook
+    return hook.load(), hook
+
+
 def disk_offload(params, offload_dir: str, execution_device=None) -> DispatchedParams:
     """Everything spilled to disk memmaps (reference ``disk_offload:263``)."""
     os.makedirs(offload_dir, exist_ok=True)
@@ -261,3 +320,8 @@ def load_checkpoint_and_dispatch(
     # tensors already sit on their devices; DispatchedParams must not re-place
     # them — pass through resident leaves, page host/disk ones
     return DispatchedParams(tree, device_map, offload_folder=offload_folder)
+
+
+# Reference name: a "model" here is its param tree, so dispatching a model is
+# dispatching its params (reference ``dispatch_model:309``).
+dispatch_model = dispatch_params

@@ -181,6 +181,10 @@ def save_accelerator_state(
     opt_states = (
         [opt_state] if opt_state is not None else [o.opt_state for o in accelerator._optimizers]
     )
+    # user pre-hooks see the RESOLVED directory (post automatic naming), like
+    # the reference's register_save_state_pre_hook contract (accelerator.py:3497)
+    for hook in getattr(accelerator, "_save_state_pre_hooks", {}).values():
+        hook(models, output_dir)
     if sharded is None:
         sharded = _should_shard(list(models) + list(opt_states))
     # a reused output_dir may hold the OTHER format (or shard files from a
@@ -263,6 +267,11 @@ def load_accelerator_state(
         if not candidates:
             raise FileNotFoundError(f"no checkpoints under {base}")
         input_dir = os.path.join(base, candidates[-1])
+
+    # user pre-hooks see the RESOLVED directory (after latest-checkpoint
+    # discovery), reference register_load_state_pre_hook contract (:3664)
+    for hook in getattr(accelerator, "_load_state_pre_hooks", {}).values():
+        hook([params] if params is not None else accelerator._models, input_dir)
 
     from .sharded_checkpoint import is_sharded_checkpoint, load_sharded_pytree
 

@@ -2,7 +2,9 @@ from .long_context import make_context_parallel_attention, sequence_parallel_att
 from .moe import init_moe_ffn, moe_ffn, moe_shard_rules
 from .pipeline import (
     make_pipeline_forward,
+    make_pipeline_train_step_1f1b,
     merge_microbatches,
+    prepare_pipeline,
     split_into_stages,
     split_microbatches,
 )

@@ -149,6 +149,27 @@ def make_pipeline_forward(
     return forward
 
 
+def prepare_pipeline(
+    layer_params: list,
+    stage_fn: Callable,
+    mesh,
+    num_microbatches: int | None = None,
+    axis_name: str = "pp",
+):
+    """One-call pipeline prep (the reference's user entry ``prepare_pippy:126``:
+    auto-split into balanced stages + a GPipe-scheduled forward). Balances the
+    homogeneous layer stack over the ``pp`` mesh axis and returns
+    ``(stage_params_stack, forward)`` with ``forward(stage_params_stack, x)``
+    running the microbatched schedule. ``num_microbatches`` defaults to the
+    pipeline degree (enough to fill the trapezoid)."""
+    pp = int(mesh.shape[axis_name])
+    if num_microbatches is None:
+        num_microbatches = max(pp, 1)
+    stacked = split_into_stages(layer_params, pp)
+    forward = make_pipeline_forward(stage_fn, mesh, num_microbatches, axis_name)
+    return stacked, forward
+
+
 def make_pipeline_train_step_1f1b(
     stage_fn: Callable,
     loss_fn: Callable,

@@ -1,8 +1,14 @@
 from .dataclasses import (
     AutocastConfig,
+    AutocastKwargs,
+    DDPCommunicationHookType,
     DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedDataParallelKwargs,
     DistributedType,
+    FullyShardedDataParallelPlugin,
     GradScalerConfig,
+    GradScalerKwargs,
     GradientAccumulationPlugin,
     InitProcessGroupKwargs,
     JitConfig,
@@ -11,10 +17,12 @@ from .dataclasses import (
     MixedPrecisionPolicy,
     PrecisionType,
     ProfileConfig,
+    ProfileKwargs,
     ProjectConfiguration,
     RNGType,
     SaveFormat,
 )
+from .versions import compare_versions, is_jax_version
 from .environment import (
     are_libraries_initialized,
     get_int_from_env,

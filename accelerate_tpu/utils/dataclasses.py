@@ -339,6 +339,175 @@ class MixedPrecisionPolicy:
         return jax.tree_util.tree_map(_cast, tree)
 
 
+# ---------------------------------------------------------------------------
+# Reference-compat plugin/kwargs spellings.
+#
+# The reference steers torch engines (DDP buckets, torch FSDP wrappers, the
+# DeepSpeed runtime) through these objects. On TPU the same intents are
+# sharding assignments and dtype policies, so each shim translates its knobs
+# into the native configuration (and warns about knobs with no XLA meaning)
+# rather than mirroring engine internals.
+
+
+class DDPCommunicationHookType(BaseEnum):
+    """Gradient-compression choices (reference ``DDPCommunicationHookType``,
+    ``utils/dataclasses.py:134``). The allreduce itself is GSPMD-inserted on
+    TPU; the hook's wire-compression half maps to casting the gradient signal
+    (see ``examples/by_feature/gradient_compression.py``)."""
+
+    NO = "no"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    POWER_SGD = "power_sgd"
+    BATCHED_POWER_SGD = "batched_power_sgd"
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Reference ``DistributedDataParallelKwargs`` (``utils/dataclasses.py:155``)
+    compat. Bucketing/graph knobs steer torch DDP's NCCL schedule and have no
+    GSPMD counterpart (XLA schedules grad collectives itself); they are accepted
+    so reference configs parse. ``comm_hook`` is honored: it selects the dtype
+    returned by :meth:`gradient_compression_dtype`, which
+    ``Accelerator.prepare_train_step`` applies to the gradient signal."""
+
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+    comm_hook: DDPCommunicationHookType = DDPCommunicationHookType.NO
+
+    def __post_init__(self):
+        self.comm_hook = DDPCommunicationHookType(str(self.comm_hook))
+
+    def gradient_compression_dtype(self) -> Optional[str]:
+        """dtype name the gradient signal is bounded to, or None."""
+        if self.comm_hook == DDPCommunicationHookType.FP16:
+            return "float16"
+        if self.comm_hook == DDPCommunicationHookType.BF16:
+            return "bfloat16"
+        if self.comm_hook in (
+            DDPCommunicationHookType.POWER_SGD,
+            DDPCommunicationHookType.BATCHED_POWER_SGD,
+        ):
+            import warnings
+
+            warnings.warn(
+                "PowerSGD low-rank gradient compression has no XLA counterpart; "
+                "falling back to a bf16 cast of the gradient signal."
+            )
+            return "bfloat16"
+        return None
+
+
+@dataclass
+class FullyShardedDataParallelPlugin(KwargsHandler):
+    """Migration shim for reference ``FullyShardedDataParallelPlugin``
+    (``utils/dataclasses.py:1566``). FSDP on TPU is not a module wrapper — it is
+    a ``NamedSharding`` assignment over the ``dp_shard`` mesh axis — so this
+    object's one real job is :meth:`to_parallelism_config`. Wrapper-scheduling
+    knobs (auto-wrap policy, backward prefetch, ``use_orig_params``) have no
+    XLA meaning: GSPMD decides gather/reshard scheduling.
+
+    ``sharding_strategy`` accepts the reference spellings (``FULL_SHARD``,
+    ``SHARD_GRAD_OP``, ``NO_SHARD``, ``HYBRID_SHARD``, or their 1-4 codes).
+    ``FULL_SHARD`` and ``SHARD_GRAD_OP`` collapse: under GSPMD, params are
+    gathered on demand either way, so ZeRO-2 vs ZeRO-3 is a scheduling detail
+    the compiler owns."""
+
+    sharding_strategy: Any = "FULL_SHARD"
+    cpu_offload: bool = False
+    activation_checkpointing: bool = False
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    cpu_ram_efficient_loading: bool = True
+
+    _STRATEGIES = {1: "FULL_SHARD", 2: "SHARD_GRAD_OP", 3: "NO_SHARD", 4: "HYBRID_SHARD"}
+
+    def __post_init__(self):
+        s = self.sharding_strategy
+        if isinstance(s, int):
+            s = self._STRATEGIES.get(s, "FULL_SHARD")
+        s = str(s).rsplit(".", 1)[-1].upper()  # accept "ShardingStrategy.FULL_SHARD"
+        if s not in self._STRATEGIES.values():
+            raise ValueError(f"unknown sharding_strategy {self.sharding_strategy!r}")
+        self.sharding_strategy = s
+
+    def to_parallelism_config(
+        self, num_devices: Optional[int] = None, dp_replicate_size: int = 1
+    ):
+        """Translate to the native mesh config. ``HYBRID_SHARD`` needs
+        ``dp_replicate_size`` (the outer replica count; reference HSDP)."""
+        from ..parallelism_config import ParallelismConfig
+
+        if self.sharding_strategy == "NO_SHARD":
+            if num_devices is None:
+                import jax
+
+                num_devices = len(jax.devices())
+            return ParallelismConfig(dp_replicate_size=num_devices)
+        if self.sharding_strategy == "HYBRID_SHARD" and dp_replicate_size == 1:
+            raise ValueError("HYBRID_SHARD requires dp_replicate_size > 1")
+        return ParallelismConfig(dp_replicate_size=dp_replicate_size, dp_shard_size=-1)
+
+
+@dataclass
+class DeepSpeedPlugin(KwargsHandler):
+    """Migration shim for reference ``DeepSpeedPlugin`` (``utils/dataclasses.py:1113``).
+    ZeRO stages are optimizer/grad/param shardings; under GSPMD all three are the
+    same ``dp_shard`` NamedSharding with compiler-scheduled gathers, so stages
+    1-3 map to one FSDP config and stage 0 to pure replication. A reference
+    ``hf_ds_config`` dict is accepted and mined for the fields that still mean
+    something here (stage, accumulation, clipping, offload)."""
+
+    zero_stage: int = 2
+    gradient_accumulation_steps: int = 1
+    gradient_clipping: Optional[float] = None
+    offload_optimizer_device: Optional[str] = None
+    offload_param_device: Optional[str] = None
+    zero3_init_flag: bool = False
+    zero3_save_16bit_model: bool = False
+    hf_ds_config: Optional[dict] = None
+
+    def __post_init__(self):
+        cfg = self.hf_ds_config or {}
+        zero = cfg.get("zero_optimization", {})
+        if "stage" in zero and not _is_auto(zero["stage"]):
+            self.zero_stage = int(zero["stage"])
+        if "gradient_accumulation_steps" in cfg and not _is_auto(cfg["gradient_accumulation_steps"]):
+            self.gradient_accumulation_steps = int(cfg["gradient_accumulation_steps"])
+        if "gradient_clipping" in cfg and not _is_auto(cfg["gradient_clipping"]):
+            self.gradient_clipping = float(cfg["gradient_clipping"])
+        for src, attr in (("offload_optimizer", "offload_optimizer_device"),
+                          ("offload_param", "offload_param_device")):
+            dev = zero.get(src, {}).get("device")
+            if dev and dev != "none":
+                setattr(self, attr, dev)
+        if not 0 <= self.zero_stage <= 3:
+            raise ValueError(f"zero_stage must be 0-3, got {self.zero_stage}")
+
+    def to_parallelism_config(self, num_devices: Optional[int] = None):
+        from ..parallelism_config import ParallelismConfig
+
+        if self.zero_stage == 0:
+            if num_devices is None:
+                import jax
+
+                num_devices = len(jax.devices())
+            return ParallelismConfig(dp_replicate_size=num_devices)
+        return ParallelismConfig(dp_shard_size=-1)
+
+
+def _is_auto(v) -> bool:
+    return isinstance(v, str) and v == "auto"
+
+
+# Reference names for config objects that already exist natively (the reference
+# calls every kwargs-handler "...Kwargs"; our spellings say what they configure).
+AutocastKwargs = AutocastConfig
+GradScalerKwargs = GradScalerConfig
+ProfileKwargs = ProfileConfig
+
+
 def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
     raise NotImplementedError(
         "Megatron-LM is a CUDA engine; its TP/PP/EP capabilities are provided natively "

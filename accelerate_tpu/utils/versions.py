@@ -1,0 +1,51 @@
+"""Version comparison helpers (reference ``utils/versions.py`` —
+``compare_versions``, ``is_torch_version``). Ours compares against jax, the
+engine the framework actually rides on, with a generic probe for anything else.
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+_OPS = {
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">=": operator.ge,
+    ">": operator.gt,
+}
+
+
+def _parse(v: str) -> tuple:
+    """Minimal PEP-440-ish parse: numeric dotted prefix, suffixes compare as 0."""
+    parts = []
+    for piece in v.split(".")[:4]:
+        digits = ""
+        for ch in piece:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def compare_versions(library_or_version, op: str, requirement_version: str) -> bool:
+    """``compare_versions("jax", ">=", "0.4.30")`` — reference
+    ``utils/versions.py`` semantics. First arg may be a library name (its
+    installed version is looked up) or a version string."""
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+    version = str(library_or_version)
+    if not version[:1].isdigit():
+        version = importlib.metadata.version(version)
+    return _OPS[op](_parse(version), _parse(requirement_version))
+
+
+def is_jax_version(op: str, version: str) -> bool:
+    """True when the installed jax satisfies ``op version``."""
+    import jax
+
+    return compare_versions(jax.__version__, op, version)
