@@ -45,6 +45,10 @@ from .utils.dataclasses import (
 from .utils import operations as ops
 
 
+# max cached compiled lomo steps (distinct loss_fns) per Accelerator
+_LOMO_CACHE_SIZE = 8
+
+
 class RemovableHandle:
     """Unregister token returned by the state-hook registrars (same contract as
     the torch handle the reference's ``register_*_state_pre_hook`` returns)."""
@@ -146,7 +150,39 @@ class Accelerator:
         cpu: bool = False,
         device_placement: bool = True,
         kwargs_handlers: Optional[Sequence[Any]] = None,
+        fsdp_plugin: Optional[Any] = None,
+        deepspeed_plugin: Optional[Any] = None,
     ):
+        # Reference-compat plugins (accelerator.py:278 accepts both): each is a
+        # sharding intent here — translate to ParallelismConfig unless the user
+        # already gave one explicitly.
+        if fsdp_plugin is not None and deepspeed_plugin is not None:
+            raise ValueError("pass fsdp_plugin or deepspeed_plugin, not both")
+        plugin = fsdp_plugin or deepspeed_plugin
+        self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
+        if plugin is not None:
+            if not hasattr(plugin, "to_parallelism_config"):
+                raise TypeError(
+                    f"{type(plugin).__name__} is not a FullyShardedDataParallelPlugin/"
+                    "DeepSpeedPlugin (missing to_parallelism_config)"
+                )
+            if parallelism_config is None:
+                # NO_SHARD/stage-0 translation counts devices — honor the cpu
+                # flag FIRST or the count initializes the wrong backend (and
+                # jax_platforms becomes immutable once a backend exists)
+                from .utils.environment import parse_flag_from_env
+
+                if cpu or parse_flag_from_env("ACCELERATE_USE_CPU"):
+                    import jax
+
+                    jax.config.update("jax_platforms", "cpu")
+                parallelism_config = plugin.to_parallelism_config()
+            if (
+                deepspeed_plugin is not None
+                and gradient_accumulation_steps == 1
+                and getattr(plugin, "gradient_accumulation_steps", 1) > 1
+            ):
+                gradient_accumulation_steps = plugin.gradient_accumulation_steps
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             steps = gradient_accumulation_steps if gradient_accumulation_steps != 1 else env_steps
@@ -209,11 +245,15 @@ class Accelerator:
         self._custom_objects: list = []
         self._save_state_pre_hooks: dict[int, Callable] = {}
         self._load_state_pre_hooks: dict[int, Callable] = {}
-        import weakref
+        from collections import OrderedDict
 
-        # keyed by the loss_fn OBJECT (weakly): a dead lambda's compiled step is
-        # collected instead of pinning executables for the Accelerator lifetime
-        self._lomo_steps = weakref.WeakKeyDictionary()
+        # small LRU keyed by the loss_fn object. Weak keying cannot work here
+        # (the compiled step necessarily closes over loss_fn, so the value
+        # would pin its own key); bounding the cache caps the damage of a
+        # fresh-lambda-per-step caller at _LOMO_CACHE_SIZE live executables.
+        self._lomo_steps: OrderedDict = OrderedDict()
+        self._lomo_scale = float(self.grad_scaler_config.init_scale)
+        self._lomo_scale_growth = 0
         self._autocast_enabled = True
         self._param_specs = None
         self._accum_count = 0
@@ -417,6 +457,15 @@ class Accelerator:
 
     def prepare_optimizer(self, optimizer) -> AcceleratedOptimizer:
         if not isinstance(optimizer, AcceleratedOptimizer):
+            if self._plugin_grad_clip is not None:
+                # DeepSpeedPlugin.gradient_clipping carries over (the engine
+                # clipped inside step; here clipping is an optax link ahead of
+                # the user's transform)
+                import optax
+
+                optimizer = optax.chain(
+                    optax.clip_by_global_norm(self._plugin_grad_clip), optimizer
+                )
             # fp8 models carry delayed-scaling meta in the param tree; partition
             # the optimizer so meta leaves are replaced by their updated
             # histories instead of being "optimized" (reference: TE recipe wrap,
@@ -900,31 +949,67 @@ class Accelerator:
 
         Define ``loss_fn`` ONCE outside the training loop and pass the batch
         through ``*args`` — a fresh lambda per step is a fresh compile per step
-        (the compiled step is cached per loss_fn object, weakly).
+        (compiled steps are kept in a small LRU of ``_LOMO_CACHE_SIZE``
+        entries, so fresh-lambda callers recompile but do not leak).
+
+        Under ``mixed_precision="fp16"`` the loss is scaled by a dynamic loss
+        scale held host-side on the Accelerator (``grad_scaler_config`` tunes
+        it): overflowed steps are skipped (params returned unchanged) and the
+        scale backs off, mirroring the prepared-step scaler — workable here
+        because this eager-style API already syncs the loss to host each call.
         """
         import jax
 
+        fp16 = self.state.mixed_precision == PrecisionType.FP16
         step = self._lomo_steps.get(loss_fn)
+        if step is not None:
+            self._lomo_steps.move_to_end(loss_fn)
         if step is None:
             import jax.numpy as jnp
 
             policy = self.state.mixed_precision_policy
 
-            def _step(params, lr, *a):
+            def _step(params, lr, loss_scale, *a):
                 def _loss(p, *inner):
-                    return loss_fn(policy.cast_to_compute(p), *inner).astype(jnp.float32)
+                    return loss_fn(policy.cast_to_compute(p), *inner).astype(jnp.float32) * loss_scale
 
                 loss, grads = jax.value_and_grad(_loss)(params, *a)
+                grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+                finite = jnp.all(jnp.asarray(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
+                ))
+                if fp16:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+                    )
                 new_params = jax.tree_util.tree_map(
                     lambda p, g: p - lr.astype(p.dtype) * g.astype(p.dtype), params, grads
                 )
-                return loss, new_params
+                return loss / loss_scale, new_params, finite
 
             step = jax.jit(_step, donate_argnums=(0,)) if not self.jit_config.disable_jit else _step
             self._lomo_steps[loss_fn] = step
+            while len(self._lomo_steps) > _LOMO_CACHE_SIZE:
+                self._lomo_steps.popitem(last=False)
         import jax.numpy as jnp
 
-        return step(params, jnp.float32(learning_rate), *args)
+        scale = self._lomo_scale if fp16 else 1.0
+        loss, new_params, finite = step(
+            params, jnp.float32(learning_rate), jnp.float32(scale), *args
+        )
+        if fp16:
+            # dynamic-scale bookkeeping (GradScaler semantics): backoff on
+            # overflow, grow after growth_interval consecutive finite steps
+            cfg = self.grad_scaler_config
+            if bool(finite):
+                self._lomo_scale_growth += 1
+                if self._lomo_scale_growth >= cfg.growth_interval:
+                    self._lomo_scale = scale * cfg.growth_factor
+                    self._lomo_scale_growth = 0
+            else:
+                self._lomo_scale = max(1.0, scale * cfg.backoff_factor)
+                self._lomo_scale_growth = 0
+        return loss, new_params
 
     # ---------------------------------------------------------- persistence --
     def register_for_checkpointing(self, *objects):

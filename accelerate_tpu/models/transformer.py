@@ -194,6 +194,23 @@ def _constrain(x, mesh, *logical):
     )
 
 
+def _remat_policy(remat: bool | str):
+    """Map the ``remat`` knob to a ``jax.checkpoint`` policy (None = save
+    nothing, i.e. full recompute)."""
+    if remat is True or remat == "nothing":
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    try:
+        return policies[remat]
+    except KeyError:
+        raise ValueError(
+            f"remat must be bool, 'nothing', 'dots' or 'dots_no_batch'; got {remat!r}"
+        ) from None
+
+
 def llama_ffn(layer_params: dict, x: jax.Array, config: LlamaConfig, mesh=None,
               capacity_factor: Optional[float] = None):
     """The per-layer FFN block — dense SwiGLU or expert-parallel MoE — shared
@@ -222,7 +239,7 @@ def llama_forward(
     config: LlamaConfig,
     attention_impl: str = "auto",
     attention_fn=None,
-    remat: bool = False,
+    remat: bool | str = False,
     mesh=None,
     with_aux: bool = False,
 ) -> jax.Array:
@@ -230,7 +247,13 @@ def llama_forward(
     is the mean MoE load-balance loss, 0.0 for dense configs). ``attention_fn``
     overrides the attention op (ring attention for CP plugs in here); ``mesh``
     enables explicit activation sharding constraints (batch over dp axes, seq
-    over cp)."""
+    over cp).
+
+    ``remat``: ``False`` (save all), ``True`` (recompute all — min memory), or
+    a policy name trading memory for recompute FLOPs (the knob behind the
+    reference's FSDP ``activation_checkpointing``): ``"dots"`` saves matmul
+    outputs, ``"dots_no_batch"`` saves only weight-stationary matmuls (the
+    usual transformer sweet spot), ``"nothing"`` ≡ ``True``."""
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     _batch_axes = ("dp_replicate", "dp_shard")
@@ -263,7 +286,7 @@ def llama_forward(
         return h, aux
 
     if remat:
-        layer = jax.checkpoint(layer)
+        layer = jax.checkpoint(layer, policy=_remat_policy(remat))
     h, aux_per_layer = jax.lax.scan(layer, h, params["layers"], unroll=config.unroll_layers)
     h = rms_norm(h, params["final_norm"]["scale"], config.norm_eps)
     if config.tie_embeddings:

@@ -25,10 +25,13 @@ from .dataclasses import (
 from .versions import compare_versions, is_jax_version
 from .environment import (
     are_libraries_initialized,
+    clear_environment,
+    convert_dict_to_env_variables,
     get_int_from_env,
     parse_choice_from_env,
     parse_flag_from_env,
     patch_environment,
+    purge_accelerate_environment,
     str_to_bool,
 )
 # Collectives and RNG helpers are re-exported LAZILY (module __getattr__
@@ -61,6 +64,58 @@ _RANDOM = {
     "synchronize_rng_state",
     "synchronize_rng_states",
 }
+# Reference `from accelerate.utils import …` spellings, routed to their
+# TPU-native homes (reference utils/__init__.py re-exports ~260 names; these
+# are the ones with native counterparts here).
+_MODELING = {
+    "abstract_params",
+    "clean_device_map",
+    "compute_module_sizes",
+    "compute_parameter_sizes",
+    "convert_file_size_to_int",
+    "dtype_byte_size",
+    "find_tied_parameters",
+    "get_balanced_memory",
+    "get_max_memory",
+    "infer_auto_device_map",
+    "load_checkpoint_in_params",
+    "load_state_dict",
+    "named_parameters",
+    "retie_parameters",
+    "total_byte_size",
+    "unflatten_parameters",
+}
+_OFFLOAD = {
+    "OffloadedWeightsLoader",
+    "PrefixedDataset",
+    "load_offload_index",
+    "load_offloaded_weight",
+    "offload_state_dict",
+    "offload_weight",
+    "save_offload_index",
+}
+_MEMORY = {"clear_device_cache", "find_executable_batch_size", "release_memory", "should_reduce_batch_size"}
+_QUANT = {"QuantizationConfig", "QuantizedArray", "load_and_quantize_model", "quantize_params", "dequantize_params"}
+_OTHER = {
+    "check_os_kernel",
+    "clean_state_dict_for_safetensors",
+    "convert_bytes",
+    "convert_outputs_to_fp32",
+    "convert_to_fp32",
+    "extract_model_from_parallel",
+    "find_device",
+    "get_pretty_name",
+    "honor_type",
+    "is_namedtuple",
+    "is_port_in_use",
+    "listify",
+    "load",
+    "merge_dicts",
+    "recursive_getattr",
+    "save",
+}
+# checkpoint-layout constants (reference utils/constants.py:20-33)
+_CONSTANTS = {"MODEL_NAME", "OPTIMIZER_NAME", "SCHEDULER_NAME", "SAMPLER_NAME", "RNG_NAME"}
 
 
 def __getattr__(name):
@@ -72,6 +127,51 @@ def __getattr__(name):
         from . import random
 
         return getattr(random, name)
+    if name in _MODELING:
+        from . import modeling
+
+        return getattr(modeling, name)
+    if name in _OFFLOAD:
+        from . import offload
+
+        return getattr(offload, name)
+    if name in _MEMORY:
+        from . import memory
+
+        return getattr(memory, name)
+    if name in _QUANT:
+        from . import quantization
+
+        return getattr(quantization, name)
+    if name in _OTHER:
+        from . import other
+
+        return getattr(other, name)
+    if name in _CONSTANTS:
+        from .. import checkpointing
+
+        return getattr(checkpointing, name)
+    if name == "BnbQuantizationConfig":  # reference name for the quant config
+        from .quantization import QuantizationConfig
+
+        return QuantizationConfig
+    if name == "wait_for_everyone":
+        # deferred: constructing PartialState initializes the backend — that
+        # must happen at call time, not attribute-lookup time
+        def wait_for_everyone():
+            from ..state import PartialState
+
+            return PartialState().wait_for_everyone()
+
+        return wait_for_everyone
+    if name == "merge_fsdp_weights":  # reference utils/fsdp_utils.py:360
+        from ..sharded_checkpoint import merge_sharded_checkpoint
+
+        return merge_sharded_checkpoint
+    if name == "tqdm":
+        from .tqdm import tqdm
+
+        return tqdm
     if name == "write_basic_config":  # reference: accelerate.utils re-export
         from ..commands.config import write_basic_config
 
@@ -100,18 +200,25 @@ from .imports import (
     is_wandb_available,
 )
 
-# __all__ spans the eager imports above AND the lazy collectives/RNG names
-# (star-import resolves the lazy ones through module __getattr__, PEP 562);
-# __dir__ keeps tab-completion/introspection seeing the lazy names too.
-_LAZY_EXTRA = {"write_basic_config"}
+# __all__ spans the eager imports above AND the lazy names (star-import
+# resolves the lazy ones through module __getattr__, PEP 562); __dir__ keeps
+# tab-completion/introspection seeing the lazy names too.
+_LAZY_EXTRA = {
+    "write_basic_config",
+    "BnbQuantizationConfig",
+    "wait_for_everyone",
+    "merge_fsdp_weights",
+    "tqdm",
+}
+_ALL_LAZY = (
+    _OPERATIONS | _RANDOM | _MODELING | _OFFLOAD | _MEMORY | _QUANT | _OTHER
+    | _CONSTANTS | _LAZY_EXTRA
+)
 
 __all__ = sorted(
-    {n for n in globals() if not n.startswith("_") and n != "annotations"}
-    | _OPERATIONS
-    | _RANDOM
-    | _LAZY_EXTRA
+    {n for n in globals() if not n.startswith("_") and n != "annotations"} | _ALL_LAZY
 )
 
 
 def __dir__():
-    return sorted(set(globals()) | _OPERATIONS | _RANDOM | _LAZY_EXTRA)
+    return sorted(set(globals()) | _ALL_LAZY)

@@ -426,11 +426,24 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     def __post_init__(self):
         s = self.sharding_strategy
         if isinstance(s, int):
-            s = self._STRATEGIES.get(s, "FULL_SHARD")
+            if s not in self._STRATEGIES:
+                raise ValueError(
+                    f"unknown sharding_strategy code {s} (valid: {sorted(self._STRATEGIES)})"
+                )
+            s = self._STRATEGIES[s]
         s = str(s).rsplit(".", 1)[-1].upper()  # accept "ShardingStrategy.FULL_SHARD"
         if s not in self._STRATEGIES.values():
             raise ValueError(f"unknown sharding_strategy {self.sharding_strategy!r}")
         self.sharding_strategy = s
+
+    @property
+    def remat(self) -> "bool | str":
+        """The ``activation_checkpointing`` knob in native form: pass this as
+        the model forward's ``remat=`` argument (e.g.
+        ``llama_loss(..., remat=plugin.remat)``). Maps to the
+        ``"dots_no_batch"`` policy — the transformer sweet spot — rather than
+        full recompute, matching torch FSDP's per-block checkpointing cost."""
+        return "dots_no_batch" if self.activation_checkpointing else False
 
     def to_parallelism_config(
         self, num_devices: Optional[int] = None, dp_replicate_size: int = 1

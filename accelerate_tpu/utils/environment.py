@@ -81,3 +81,71 @@ def are_libraries_initialized(*library_names: str) -> list[str]:
     import sys
 
     return [name for name in library_names if name in sys.modules]
+
+
+def convert_dict_to_env_variables(current_env: dict[str, Any]) -> list[str]:
+    """``{k: v}`` → ``["k=v", ...]`` suitable for a spawned process's env block
+    (reference ``utils/environment.py:34`` — the launcher's env-injection
+    sanitizer). Key case is preserved (env names are case-sensitive:
+    ``http_proxy`` ≠ ``HTTP_PROXY``); keys may not contain ``=``/newlines/
+    ``;`` and values may not contain newlines/``;``."""
+    bad_keys = {
+        str(k) for k in current_env if any(ch in str(k) for ch in ("=", "\n", ";"))
+    }
+    bad_vals = {
+        str(k) for k, v in current_env.items() if any(ch in str(v) for ch in ("\n", ";"))
+    }
+    if bad_keys or bad_vals:
+        raise ValueError(
+            "malformed env entries (shell-injection guard): "
+            f"keys={sorted(bad_keys)} values-of={sorted(bad_vals)}"
+        )
+    return [f"{k}={v}" for k, v in current_env.items()]
+
+
+@contextmanager
+def clear_environment():
+    """Run with a COMPLETELY empty ``os.environ``, restored (same mapping
+    object, contents back) on exit — even on exception (reference
+    ``clear_environment:341``)."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+def purge_accelerate_environment(func_or_cls):
+    """Decorator: run the function (or every test method of a class) with all
+    ``ACCELERATE_*`` / ``PARALLELISM_CONFIG_*`` vars removed, restoring them
+    afterwards (reference ``purge_accelerate_environment:412`` — keeps env
+    state from one test leaking into the next)."""
+    import functools
+    import inspect
+
+    def _wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            saved = {
+                k: os.environ.pop(k)
+                for k in list(os.environ)
+                if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_"))
+            }
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                for k in list(os.environ):
+                    if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_")):
+                        del os.environ[k]
+                os.environ.update(saved)
+
+        return inner
+
+    if inspect.isclass(func_or_cls):
+        for name, member in list(vars(func_or_cls).items()):
+            if callable(member) and (name.startswith("test") or name in ("setUp", "tearDown")):
+                setattr(func_or_cls, name, _wrap(member))
+        return func_or_cls
+    return _wrap(func_or_cls)

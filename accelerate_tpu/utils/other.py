@@ -1,0 +1,197 @@
+"""Generic helpers (reference ``utils/other.py`` — ``save:354``, ``load``,
+``clean_state_dict_for_safetensors:319``, ``convert_bytes``, ``merge_dicts``,
+``is_port_in_use``, ``check_os_kernel:501``, ``get_pretty_name``; and
+``utils/operations.py`` — ``honor_type``, ``listify``, ``find_device``,
+``convert_to_fp32``). TPU-native versions: trees of jax/numpy arrays instead of
+torch tensors; "saving" means npz or safetensors of host arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import socket
+import warnings
+from typing import Any, Mapping
+
+import numpy as np
+
+
+# ------------------------------------------------------------- tree helpers --
+
+
+def is_namedtuple(data) -> bool:
+    """True for namedtuple instances (not plain tuples)."""
+    return isinstance(data, tuple) and hasattr(data, "_asdict") and hasattr(data, "_fields")
+
+
+def honor_type(obj, generator):
+    """Rebuild ``obj``'s sequence type from ``generator`` (namedtuples need
+    positional-splat construction)."""
+    if is_namedtuple(obj):
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def listify(data):
+    """Nested structure of arrays/scalars → plain python lists/numbers (the
+    form trackers and json can take)."""
+    if isinstance(data, (int, float, str, bool)) or data is None:
+        return data
+    if isinstance(data, Mapping):
+        return {k: listify(v) for k, v in data.items()}
+    if isinstance(data, (list, tuple)):
+        return honor_type(data, (listify(v) for v in data))
+    if hasattr(data, "tolist"):
+        return np.asarray(data).tolist()
+    return data
+
+
+def find_device(data):
+    """First jax array's device in a nested structure (None if none found)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(data):
+        if isinstance(leaf, jax.Array):
+            return next(iter(leaf.devices()))
+    return None
+
+
+def convert_to_fp32(tree):
+    """Cast every floating leaf to float32 (reference ``convert_to_fp32:819`` —
+    used on eval outputs computed under a low-precision policy)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+# alias matching the reference's decorator-flavored name (ours is a pure fn)
+convert_outputs_to_fp32 = convert_to_fp32
+
+
+def get_pretty_name(obj) -> str:
+    """Best human name for an object (reference ``get_pretty_name``)."""
+    for attr in ("__qualname__", "__name__"):
+        name = getattr(obj, attr, None)
+        if name:
+            return name
+    name = getattr(type(obj), "__qualname__", None) or getattr(type(obj), "__name__", "")
+    return name or str(obj)
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into (a copy of) ``destination``."""
+    out = dict(destination)
+    for key, value in source.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = merge_dicts(value, out[key])
+        else:
+            out[key] = value
+    return out
+
+
+def recursive_getattr(obj, attr: str):
+    """``recursive_getattr(m, "a.b.c")`` → ``m.a.b.c``."""
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True):
+    """Identity — params are never wrapped here (reference unwraps DDP/FSDP/
+    compiled modules, ``extract_model_from_parallel``)."""
+    return model
+
+
+# ------------------------------------------------------------------- system --
+
+
+def is_port_in_use(port: int | None = None) -> bool:
+    """True when localhost:``port`` already has a listener (the launcher's
+    coordinator-port probe)."""
+    if port is None:
+        port = 29500
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port))) == 0
+
+
+def check_os_kernel() -> None:
+    """Warn on Linux kernels older than 5.5 (reference ``check_os_kernel:501``:
+    MKL/threading stalls observed there affect host-side input pipelines)."""
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    try:
+        version = tuple(int(p) for p in info.release.split(".")[:2])
+    except ValueError:  # pragma: no cover - exotic kernel strings
+        return
+    if version < (5, 5):
+        warnings.warn(
+            f"Detected Linux kernel {info.release} (< 5.5); host-side data "
+            "pipelines may stall on older kernels. Consider upgrading.",
+            UserWarning,
+        )
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte count: ``convert_bytes(1024**2) == '1.0 MB'``."""
+    for unit in ("bytes", "KB", "MB", "GB", "TB"):
+        if abs(size) < 1024.0 or unit == "TB":
+            return f"{size:.1f} {unit}" if unit != "bytes" else f"{int(size)} {unit}"
+        size /= 1024.0
+    return f"{size:.1f} TB"  # pragma: no cover - unreachable
+
+
+# -------------------------------------------------------------- persistence --
+
+
+def clean_state_dict_for_safetensors(state_dict: Mapping[str, Any]) -> dict:
+    """Drop duplicate entries that share storage (tied weights) and commit to
+    host numpy — safetensors refuses aliased tensors (reference
+    ``clean_state_dict_for_safetensors:319``)."""
+    seen: dict[int, str] = {}
+    out: dict[str, Any] = {}
+    for key in sorted(state_dict):
+        value = state_dict[key]
+        ident = id(value)
+        if ident in seen:
+            continue
+        seen[ident] = key
+        out[key] = np.asarray(value)
+    return out
+
+
+def save(obj, f: str, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:
+    """Save a pytree/state-dict from the main process (reference ``save:354``).
+    ``safe_serialization`` writes safetensors (flat arrays only); otherwise npz.
+    """
+    from ..state import PartialState
+
+    state = PartialState()
+    if not (state.is_main_process or save_on_each_node):
+        return
+    from .modeling import named_parameters
+
+    flat = {k: np.asarray(v) for k, v in named_parameters(obj).items() if v is not None}
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        save_file(clean_state_dict_for_safetensors(flat), f)
+    else:
+        np.savez(f, **flat)
+
+
+def load(f: str):
+    """Load a flat state-dict saved by :func:`save` (npz or safetensors)."""
+    if str(f).endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(f)
+    with np.load(f, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}

@@ -41,7 +41,12 @@ def compare_versions(library_or_version, op: str, requirement_version: str) -> b
     version = str(library_or_version)
     if not version[:1].isdigit():
         version = importlib.metadata.version(version)
-    return _OPS[op](_parse(version), _parse(requirement_version))
+    a, b = _parse(version), _parse(requirement_version)
+    # pad to equal length so "0.7.0" == "0.7" (PEP 440 semantics)
+    width = max(len(a), len(b))
+    a += (0,) * (width - len(a))
+    b += (0,) * (width - len(b))
+    return _OPS[op](a, b)
 
 
 def is_jax_version(op: str, version: str) -> bool:

@@ -76,6 +76,10 @@ def test_compare_versions():
     assert not compare_versions("2.0", "==", "2.1")
     assert compare_versions("jax", ">", "0.1")
     assert is_jax_version(">=", "0.3")
+    # PEP-440-style padding: X.Y.0 == X.Y
+    assert compare_versions("0.7.0", "==", "0.7")
+    assert compare_versions("0.7.0", "<=", "0.7")
+    assert not compare_versions("0.7.1", "==", "0.7")
     with pytest.raises(ValueError):
         compare_versions("1.0", "~=", "1.0")
 
@@ -90,6 +94,39 @@ def test_fsdp_plugin_strategy_spellings():
     assert P(sharding_strategy="ShardingStrategy.SHARD_GRAD_OP").sharding_strategy == "SHARD_GRAD_OP"
     with pytest.raises(ValueError):
         P(sharding_strategy="BOGUS")
+    with pytest.raises(ValueError):
+        P(sharding_strategy=5)  # unknown int codes must not silently FULL_SHARD
+
+
+def test_fsdp_plugin_activation_checkpointing_maps_to_remat():
+    P = atpu.FullyShardedDataParallelPlugin
+    assert P().remat is False
+    assert P(activation_checkpointing=True).remat == "dots_no_batch"
+    # and the mapped policy is accepted by the model forward
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig, init_llama
+    from accelerate_tpu.models.transformer import llama_loss
+
+    cfg = LlamaConfig.tiny()
+    params = init_llama(cfg, jax.random.PRNGKey(0))
+    ids = np.ones((1, 16), np.int32)
+    loss = float(llama_loss(params, {"input_ids": ids}, cfg,
+                            remat=P(activation_checkpointing=True).remat))
+    assert np.isfinite(loss)
+
+
+def test_lomo_cache_is_bounded():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.accelerator import _LOMO_CACHE_SIZE
+
+    acc = Accelerator(cpu=True)
+    params = {"w": jnp.ones((2,))}
+    for i in range(_LOMO_CACHE_SIZE + 4):
+        # fresh lambda per call — the documented misuse; cache must stay bounded
+        _, params = acc.lomo_backward(lambda p: (p["w"] ** 2).sum(), params, learning_rate=0.01)
+    assert len(acc._lomo_steps) <= _LOMO_CACHE_SIZE
 
 
 def test_fsdp_plugin_to_parallelism_config():
@@ -133,6 +170,71 @@ def test_ddp_kwargs_comm_hook_dtype():
     assert K(comm_hook="bf16").gradient_compression_dtype() == "bfloat16"
     with pytest.warns(UserWarning):
         assert K(comm_hook=H.POWER_SGD).gradient_compression_dtype() == "bfloat16"
+
+
+def test_accelerator_accepts_fsdp_plugin():
+    acc = Accelerator(cpu=True, fsdp_plugin=atpu.FullyShardedDataParallelPlugin())
+    assert acc.mesh.shape["dp_shard"] == 8  # -1 inferred at mesh build
+
+
+def test_accelerator_accepts_deepspeed_plugin_with_accum():
+    acc = Accelerator(
+        cpu=True, deepspeed_plugin=atpu.DeepSpeedPlugin(zero_stage=2, gradient_accumulation_steps=4)
+    )
+    assert acc.gradient_accumulation_steps == 4
+    assert acc.mesh.shape["dp_shard"] == 8
+
+
+def test_deepspeed_plugin_gradient_clipping_applies():
+    """ds_config gradient_clipping must actually clip in the prepared step."""
+    import jax.numpy as jnp
+    import optax
+
+    clip = 0.01
+    acc = Accelerator(cpu=True, deepspeed_plugin=atpu.DeepSpeedPlugin(zero_stage=2, gradient_clipping=clip))
+    params, opt = acc.prepare({"w": jnp.full((4,), 100.0)}, optax.sgd(1.0))
+
+    def loss_fn(p, batch):
+        return jnp.sum(p["w"] * batch["x"])  # grad = x (norm >> clip)
+
+    step = acc.prepare_train_step(loss_fn, opt)
+    batch = {"x": jnp.full((4,), 10.0)}
+    params2, _, _ = step(params, opt.opt_state, batch)
+    # update magnitude bounded by lr * clip
+    delta = np.abs(np.asarray(params2["w"]) - 100.0)
+    assert float(delta.max()) <= clip + 1e-6
+
+
+def test_lomo_backward_fp16_scaled_and_overflow_safe():
+    import jax.numpy as jnp
+
+    acc = Accelerator(cpu=True, mixed_precision="fp16")
+    params = {"w": jnp.asarray([2.0, -1.0], jnp.float32)}
+
+    def loss_fn(p):
+        return jnp.sum(p["w"] ** 2)
+
+    for _ in range(30):
+        loss, params = acc.lomo_backward(loss_fn, params, learning_rate=0.1)
+    assert float(loss) < 0.1  # converges despite fp16 compute
+
+    # overflow: fp16 forward inf → update skipped, params unchanged, no NaN
+    big = {"w": jnp.asarray([60000.0, 60000.0], jnp.float32)}  # fp16 max ~65504
+
+    def sq(p):
+        return jnp.sum(p["w"] * p["w"])  # fp16 square overflows
+
+    loss, out = acc.lomo_backward(sq, big, learning_rate=0.1)
+    assert np.all(np.isfinite(np.asarray(out["w"])))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray([60000.0, 60000.0]))
+
+
+def test_accelerator_rejects_both_plugins_and_non_plugins():
+    with pytest.raises(ValueError):
+        Accelerator(cpu=True, fsdp_plugin=atpu.FullyShardedDataParallelPlugin(),
+                    deepspeed_plugin=atpu.DeepSpeedPlugin())
+    with pytest.raises(TypeError):
+        Accelerator(cpu=True, fsdp_plugin=object())
 
 
 # ------------------------------------------------------- kwargs_handlers --

@@ -32,6 +32,31 @@ def test_llama_forward_shapes_and_init_loss():
     assert abs(loss - np.log(cfg.vocab_size)) < 0.5  # ~uniform at init
 
 
+def test_llama_remat_policies_same_loss_and_grads():
+    """remat=False/True/'dots'/'dots_no_batch' are pure memory/recompute
+    trades — loss AND grads must match bit-for-bit-ish."""
+    cfg = LlamaConfig.tiny()
+    params = init_llama(cfg, jax.random.PRNGKey(0))
+    ids = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    batch = {"input_ids": ids}
+
+    def lg(remat):
+        return jax.value_and_grad(lambda p: llama_loss(p, batch, cfg, remat=remat))(params)
+
+    ref_loss, ref_grads = lg(False)
+    for remat in (True, "nothing", "dots", "dots_no_batch"):
+        loss, grads = lg(remat)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            grads, ref_grads,
+        )
+    import pytest
+
+    with pytest.raises(ValueError):
+        llama_loss(params, batch, cfg, remat="bogus")
+
+
 def test_llama_loss_mask():
     cfg = LlamaConfig.tiny()
     params = init_llama(cfg, jax.random.PRNGKey(0))

@@ -1,0 +1,201 @@
+"""`accelerate_tpu.utils` import-spelling parity + the generic helpers in
+utils/other.py (reference ``utils/other.py`` + ``utils/__init__.py`` exports).
+"""
+
+import os
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+import accelerate_tpu.utils as u
+
+
+def test_reference_utils_spellings_resolve():
+    for name in [
+        # constants (reference utils/constants.py:20-33)
+        "MODEL_NAME", "OPTIMIZER_NAME", "SCHEDULER_NAME", "SAMPLER_NAME", "RNG_NAME",
+        # modeling
+        "infer_auto_device_map", "find_tied_parameters", "retie_parameters",
+        "compute_module_sizes", "get_balanced_memory", "get_max_memory",
+        "dtype_byte_size", "convert_file_size_to_int", "load_state_dict",
+        # offload
+        "OffloadedWeightsLoader", "PrefixedDataset", "offload_weight",
+        "load_offloaded_weight", "offload_state_dict", "save_offload_index",
+        # memory
+        "find_executable_batch_size", "release_memory", "clear_device_cache",
+        # quantization
+        "load_and_quantize_model", "BnbQuantizationConfig",
+        # misc
+        "convert_bytes", "merge_dicts", "is_port_in_use", "honor_type",
+        "listify", "find_device", "convert_to_fp32", "convert_outputs_to_fp32",
+        "clean_state_dict_for_safetensors", "save", "load", "check_os_kernel",
+        "get_pretty_name", "recursive_getattr", "extract_model_from_parallel",
+        "merge_fsdp_weights", "wait_for_everyone", "tqdm",
+    ]:
+        assert getattr(u, name) is not None
+        assert name in dir(u)  # introspection sees lazy names
+
+
+def test_bnb_quantization_config_is_native_config():
+    from accelerate_tpu.utils.quantization import QuantizationConfig
+
+    assert u.BnbQuantizationConfig is QuantizationConfig
+
+
+def test_convert_bytes():
+    assert u.convert_bytes(512) == "512 bytes"
+    assert u.convert_bytes(1024) == "1.0 KB"
+    assert u.convert_bytes(1024**2 * 1.5) == "1.5 MB"
+    assert u.convert_bytes(1024**3) == "1.0 GB"
+
+
+def test_merge_dicts_recursive_non_mutating():
+    dst = {"a": {"c": 2}, "d": 3}
+    out = u.merge_dicts({"a": {"b": 1}}, dst)
+    assert out == {"a": {"b": 1, "c": 2}, "d": 3}
+    assert dst == {"a": {"c": 2}, "d": 3}
+
+
+def test_honor_type_and_listify():
+    NT = namedtuple("NT", "x y")
+    assert u.honor_type(NT(1, 2), iter([3, 4])) == NT(3, 4)
+    assert u.honor_type((1, 2), iter([3, 4])) == (3, 4)
+    assert u.is_namedtuple(NT(1, 2)) and not u.is_namedtuple((1, 2))
+    out = u.listify({"a": np.arange(3), "b": [np.float32(1.5), "s"], "c": None})
+    assert out == {"a": [0, 1, 2], "b": [1.5, "s"], "c": None}
+
+
+def test_convert_to_fp32():
+    import jax.numpy as jnp
+
+    tree = {"x": jnp.ones((2,), jnp.bfloat16), "i": jnp.ones((2,), jnp.int32)}
+    out = u.convert_to_fp32(tree)
+    assert out["x"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32  # non-float untouched
+
+
+def test_find_device():
+    import jax
+    import jax.numpy as jnp
+
+    dev = u.find_device({"a": [1, 2], "b": jnp.ones((2,))})
+    assert dev in jax.devices()
+    assert u.find_device({"a": [1, 2]}) is None
+
+
+def test_clean_state_dict_dedups_tied():
+    w = np.ones((2, 2), np.float32)
+    clean = u.clean_state_dict_for_safetensors({"w": w, "tied": w, "other": np.zeros(2)})
+    assert len(clean) == 2  # one of w/tied dropped, other kept
+
+
+def test_save_load_round_trip(tmp_path):
+    tree = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    npz = str(tmp_path / "s.npz")
+    u.save(tree, npz)
+    back = u.load(npz)
+    np.testing.assert_array_equal(back["layer/w"], tree["layer"]["w"])
+    st = str(tmp_path / "s.safetensors")
+    u.save(tree, st, safe_serialization=True)
+    np.testing.assert_array_equal(u.load(st)["layer/w"], tree["layer"]["w"])
+
+
+def test_is_port_in_use():
+    import socket
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    s.listen(1)
+    port = s.getsockname()[1]
+    try:
+        assert u.is_port_in_use(port)
+    finally:
+        s.close()
+    assert not u.is_port_in_use(port)
+
+
+def test_get_pretty_name_and_recursive_getattr():
+    assert u.get_pretty_name(test_convert_bytes) == "test_convert_bytes"
+    assert u.get_pretty_name(3.5) == "float"
+
+    class A:
+        pass
+
+    a = A()
+    a.b = A()
+    a.b.c = 7
+    assert u.recursive_getattr(a, "b.c") == 7
+
+
+def test_check_os_kernel_no_warning_on_modern_kernel(recwarn):
+    u.check_os_kernel()
+    assert not [w for w in recwarn.list if "kernel" in str(w.message)]
+
+
+def test_merge_fsdp_weights_is_shard_merge():
+    from accelerate_tpu.sharded_checkpoint import merge_sharded_checkpoint
+
+    assert u.merge_fsdp_weights is merge_sharded_checkpoint
+
+
+# ------------------------------------------------------- environment utils --
+
+
+def test_convert_dict_to_env_variables():
+    # key case preserved: http_proxy and HTTP_PROXY are different variables
+    assert u.convert_dict_to_env_variables({"http_proxy": "p", "BAR": 1}) == [
+        "http_proxy=p",
+        "BAR=1",
+    ]
+    with pytest.raises(ValueError):
+        u.convert_dict_to_env_variables({"evil": "a;rm -rf"})
+    with pytest.raises(ValueError):
+        u.convert_dict_to_env_variables({"evil": "a\nb"})
+    with pytest.raises(ValueError):
+        u.convert_dict_to_env_variables({"bad=key": "v"})
+
+
+def test_clear_environment_restores_even_on_exception():
+    os.environ["_SCRATCH_TEST_VAR"] = "1"
+    try:
+        with pytest.raises(RuntimeError):
+            with u.clear_environment():
+                assert "_SCRATCH_TEST_VAR" not in os.environ
+                os.environ["LEAKED"] = "y"
+                raise RuntimeError
+        assert os.environ.get("_SCRATCH_TEST_VAR") == "1"
+        assert "LEAKED" not in os.environ
+    finally:
+        os.environ.pop("_SCRATCH_TEST_VAR", None)
+
+
+def test_purge_accelerate_environment():
+    os.environ["ACCELERATE_SCRATCH"] = "outer"
+
+    @u.purge_accelerate_environment
+    def fn():
+        assert "ACCELERATE_SCRATCH" not in os.environ
+        os.environ["ACCELERATE_INNER"] = "x"  # must not leak out
+        return 42
+
+    try:
+        assert fn() == 42
+        assert os.environ.get("ACCELERATE_SCRATCH") == "outer"
+        assert "ACCELERATE_INNER" not in os.environ
+    finally:
+        os.environ.pop("ACCELERATE_SCRATCH", None)
+
+
+def test_purge_accelerate_environment_on_class():
+    os.environ["ACCELERATE_SCRATCH2"] = "v"
+
+    @u.purge_accelerate_environment
+    class T:
+        def test_m(self):
+            return "ACCELERATE_SCRATCH2" not in os.environ
+
+    try:
+        assert T().test_m() is True
+    finally:
+        os.environ.pop("ACCELERATE_SCRATCH2", None)
