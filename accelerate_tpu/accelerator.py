@@ -160,6 +160,19 @@ class Accelerator:
             raise ValueError("pass fsdp_plugin or deepspeed_plugin, not both")
         plugin = fsdp_plugin or deepspeed_plugin
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
+        # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state
+        _offload_dev = getattr(deepspeed_plugin, "offload_optimizer_device", None)
+        if _offload_dev == "nvme":
+            import warnings
+
+            warnings.warn(
+                "offload_optimizer_device='nvme' degrades to HOST RAM here "
+                "(pinned_host memory kind) — there is no disk tier; make sure "
+                "the optimizer state fits host memory"
+            )
+        self._offload_optimizer = bool(
+            _offload_dev in ("cpu", "nvme") or getattr(fsdp_plugin, "cpu_offload", False)
+        )
         if plugin is not None:
             if not hasattr(plugin, "to_parallelism_config"):
                 raise TypeError(
@@ -673,6 +686,7 @@ class Accelerator:
         has_aux: bool = False,
         compute_grad_norm: bool = False,
         donate: Optional[bool] = None,
+        offload_optimizer: Optional[bool] = None,
     ) -> Callable:
         """Compile the full training step (the reference's whole hot loop —
         forward, backward with overlapped comm, clip, optimizer, scheduler
@@ -685,11 +699,56 @@ class Accelerator:
         Under gradient accumulation the same compiled function is called every
         micro-batch; ``optax.MultiSteps`` applies the inner update only on
         boundary steps (traced ``lax.cond`` — no python-side sync flags).
+
+        ``offload_optimizer=True`` (defaulted on by
+        ``DeepSpeedPlugin(offload_optimizer_device="cpu")`` or
+        ``FullyShardedDataParallelPlugin(cpu_offload=True)``) keeps the
+        optimizer state in host RAM (``pinned_host``) between steps — the
+        ZeRO-Offload capability, XLA-native: H2D/D2H staging is inside the
+        compiled step. Frees ~2× params of HBM for Adam-family optimizers at
+        the cost of PCIe/DMA traffic per step. The live
+        ``optimizer.opt_state`` is committed to host immediately. TPU only
+        (the CPU emulation backend cannot compile memory-kind annotations;
+        falls back with a warning).
         """
         import jax
 
         optimizer = self._resolve_optimizer(optimizer)
         train_step = self._build_train_step(loss_fn, optimizer, has_aux, compute_grad_norm)
+
+        if offload_optimizer is None:
+            offload_optimizer = self._offload_optimizer
+        if offload_optimizer and self.jit_config.disable_jit:
+            import warnings
+
+            warnings.warn(
+                "offload_optimizer requested but jit is disabled "
+                "(jit_config.disable_jit) — memory-kind staging only exists "
+                "inside compiled programs; keeping optimizer state in device memory"
+            )
+        if offload_optimizer and not self.jit_config.disable_jit:
+            from .parallel.sharding import host_offload_supported, make_host_offloaded_step
+
+            if optimizer.opt_state is None:
+                raise ValueError(
+                    "offload_optimizer needs the live optimizer state — call "
+                    "prepare(params, optimizer) first"
+                )
+            if not host_offload_supported():
+                import warnings
+
+                warnings.warn(
+                    "optimizer host-offload requested but this backend cannot "
+                    "compile memory-kind annotations (CPU emulation); keeping "
+                    "optimizer state in device memory"
+                )
+            else:
+                donate = self.jit_config.donate_params if donate is None else donate
+                step, host_state = make_host_offloaded_step(
+                    train_step, optimizer.opt_state, donate=donate, mesh=self.mesh
+                )
+                optimizer.opt_state = host_state
+                return self._track_step(step, optimizer)
 
         if not self.jit_config.disable_jit:
             donate = self.jit_config.donate_params if donate is None else donate
@@ -725,6 +784,14 @@ class Accelerator:
         """
         import jax
 
+        if self._offload_optimizer:
+            import warnings
+
+            warnings.warn(
+                "optimizer host-offload is configured but not applied in the "
+                "scanned train loop — state must stay in HBM across the K "
+                "scanned steps; use prepare_train_step for per-step offload"
+            )
         optimizer = self._resolve_optimizer(optimizer)
         train_step = self._build_train_step(loss_fn, optimizer, has_aux, compute_grad_norm)
 

@@ -11,8 +11,12 @@ from .pipeline import (
 from .sharding import (
     FSDP_AXES,
     ShardingRules,
+    host_offload_supported,
     infer_param_specs,
     llama_tp_rules,
+    make_host_offloaded_step,
+    offload_to_host,
+    offload_tree_shardings,
     replicate,
     shard_like_params,
     shard_params,

@@ -219,3 +219,120 @@ def llama_tp_rules() -> ShardingRules:
             (r"lm_head/kernel", P(None, "tp")),
         ]
     )
+
+
+# ---------------------------------------------------------------------------
+# Optimizer-state host offload (ZeRO-Offload / FSDP cpu_offload parity)
+#
+# Reference: DeepSpeedPlugin offload_optimizer_device ("cpu"/"nvme") hands the
+# optimizer partition to the DeepSpeed CPU Adam engine; torch-FSDP
+# CPUOffload(offload_params=True) pages flat-params to host. The TPU-native
+# mechanism is XLA memory kinds: optimizer-state arrays live in host RAM
+# (``pinned_host``) between steps, and the compiled step stages them into HBM
+# on entry and commits them back on exit — the transfers are inside ONE XLA
+# program, so they overlap with compute instead of round-tripping through
+# Python. Frees sizeof(opt_state) of HBM (2× params for Adam).
+
+_HOST_KIND = "pinned_host"
+_host_offload_support: Optional[bool] = None
+
+
+def host_offload_supported() -> bool:
+    """True when this backend can compile memory-kind annotated programs (TPU
+    yes; the CPU emulation backend lacks the annotate_device_placement custom
+    call). Probed once with a tiny jit."""
+    global _host_offload_support
+    if _host_offload_support is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        try:
+            dev = jax.devices()[0]
+            host = SingleDeviceSharding(dev, memory_kind=_HOST_KIND)
+            devk = SingleDeviceSharding(dev, memory_kind="device")
+            x = jax.device_put(jnp.zeros((8,)), host)
+            # the full offload round trip: H2D stage, compute, D2H commit —
+            # the commit half is what unsupported backends fail to compile
+            y = jax.jit(
+                lambda a: jax.device_put(jax.device_put(a, devk) * 2, host)
+            )(x)
+            jax.block_until_ready(y)
+            # some backends (CPU emulation) compile but silently DROP the
+            # D2H placement — the round trip must actually land in host memory
+            _host_offload_support = getattr(y.sharding, "memory_kind", None) == _HOST_KIND
+        except Exception as e:
+            # cache the verdict only for the known can't-compile signatures;
+            # a transient runtime error must not pin False for the process
+            msg = str(e)
+            definitive = any(
+                sig in msg
+                for sig in ("annotate_device_placement", "memory kind", "Memory kind", "memory_kind")
+            ) or type(e).__name__ in ("NotImplementedError",)
+            if definitive:
+                _host_offload_support = False
+            return False
+    return _host_offload_support
+
+
+def _with_memory_kind(sharding, kind: str):
+    return sharding.with_memory_kind(kind)
+
+
+def offload_tree_shardings(tree, mesh=None):
+    """For a tree of live arrays return ``(host_shardings, device_shardings)``
+    trees derived from each leaf's current sharding.
+
+    With ``mesh`` given, leaves whose sharding does not span the mesh's device
+    set (e.g. an optax ``count`` scalar committed to one device before
+    prepare) are normalized to mesh-replicated — one jit cannot mix
+    single-device and mesh-wide operands."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh_devices = set(mesh.devices.flat) if mesh is not None else None
+
+    def _base(x):
+        s = x.sharding
+        if mesh_devices is not None and set(s.device_set) != mesh_devices:
+            return NamedSharding(mesh, PartitionSpec())
+        return s
+
+    host = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), _HOST_KIND), tree)
+    dev = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), "device"), tree)
+    return host, dev
+
+
+def offload_to_host(tree, mesh=None):
+    """Commit a tree of arrays to host memory (keeping their logical
+    shardings). Returns the host-resident tree."""
+    import jax
+
+    host, _ = offload_tree_shardings(tree, mesh=mesh)
+    return jax.device_put(tree, host)
+
+
+def make_host_offloaded_step(base_step, opt_state, donate: bool = True, mesh=None):
+    """Wrap ``base_step(params, opt_state, batch) -> (params, opt_state,
+    metrics)`` so the optimizer state lives in ``pinned_host`` between steps.
+
+    ``opt_state`` must be the LIVE (device-resident) state; it is committed to
+    host here and the matching host-resident state is returned alongside the
+    compiled step: ``(step, host_opt_state)``. Inside the jitted step the
+    state is staged HBM-ward (H2D), updated, and committed back (D2H) — both
+    transfers are part of the XLA program. Pass ``mesh`` so stray
+    single-device leaves are normalized onto it.
+    """
+    import jax
+
+    host_s, dev_s = offload_tree_shardings(opt_state, mesh=mesh)
+    host_state = jax.device_put(opt_state, host_s)
+
+    def step(params, opt_state, batch):
+        staged = jax.device_put(opt_state, dev_s)
+        new_params, new_opt, metrics = base_step(params, staged, batch)
+        new_opt = jax.device_put(new_opt, host_s)
+        return new_params, new_opt, metrics
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return jit_step, host_state

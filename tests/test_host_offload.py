@@ -1,0 +1,160 @@
+"""Optimizer-state host offload (ZeRO-Offload / FSDP cpu_offload parity via
+XLA memory kinds — ``parallel/sharding.py`` host-offload section).
+
+The CPU emulation backend cannot COMPILE memory-kind annotated programs, so on
+CPU we test placement + sharding plumbing + the documented warning fallback;
+the full compiled round-trip runs on real TPU (gated).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, DeepSpeedPlugin, FullyShardedDataParallelPlugin
+from accelerate_tpu.parallel import sharding as shlib
+
+
+def _is_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def test_offload_tree_shardings_kinds():
+    tree = {"m": jnp.ones((8,)), "v": jnp.ones((8,))}
+    host, dev = shlib.offload_tree_shardings(tree)
+    assert all(s.memory_kind == "pinned_host" for s in jax.tree_util.tree_leaves(host))
+    assert all(s.memory_kind == "device" for s in jax.tree_util.tree_leaves(dev))
+
+
+def test_offload_to_host_places_pinned():
+    tree = {"m": jnp.arange(8.0)}
+    out = shlib.offload_to_host(tree)
+    assert out["m"].sharding.memory_kind == "pinned_host"
+    np.testing.assert_array_equal(np.asarray(out["m"]), np.arange(8.0))
+
+
+def test_plugin_sets_offload_intent():
+    acc = Accelerator(cpu=True, deepspeed_plugin=DeepSpeedPlugin(
+        zero_stage=2, offload_optimizer_device="cpu"))
+    assert acc._offload_optimizer
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc2 = Accelerator(cpu=True, fsdp_plugin=FullyShardedDataParallelPlugin(cpu_offload=True))
+    assert acc2._offload_optimizer
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc3 = Accelerator(cpu=True)
+    assert not acc3._offload_optimizer
+
+
+def test_unsupported_backend_falls_back_with_warning(monkeypatch):
+    """On backends without memory-kind compilation the step must still train,
+    with the documented warning."""
+    monkeypatch.setattr(shlib, "_host_offload_support", False)
+    acc = Accelerator(cpu=True, deepspeed_plugin=DeepSpeedPlugin(
+        zero_stage=2, offload_optimizer_device="cpu"))
+    params, opt = acc.prepare({"w": jnp.ones((4,))}, optax.adam(0.1))
+
+    def loss_fn(p, b):
+        return jnp.sum((p["w"] * b["x"]) ** 2)
+
+    with pytest.warns(UserWarning, match="host-offload"):
+        step = acc.prepare_train_step(loss_fn, opt)
+    batch = {"x": jnp.ones((4,))}
+    p2, s2, m = step(params, opt.opt_state, batch)
+    assert float(m["loss"]) > 0
+    assert not np.allclose(np.asarray(p2["w"]), 1.0)
+
+
+def test_nvme_degrades_to_host_ram_with_warning():
+    with pytest.warns(UserWarning, match="nvme"):
+        acc = Accelerator(cpu=True, deepspeed_plugin=DeepSpeedPlugin(
+            zero_stage=2, offload_optimizer_device="nvme"))
+    assert acc._offload_optimizer
+
+
+def test_disable_jit_offload_warns(monkeypatch):
+    from accelerate_tpu.utils import JitConfig
+
+    monkeypatch.setattr(shlib, "_host_offload_support", True)
+    acc = Accelerator(cpu=True, jit_config=JitConfig(disable_jit=True))
+    params, opt = acc.prepare({"w": jnp.ones((2,))}, optax.sgd(0.1))
+    with pytest.warns(UserWarning, match="jit is disabled"):
+        acc.prepare_train_step(lambda p, b: jnp.sum(p["w"] ** 2), opt, offload_optimizer=True)
+
+
+def test_train_loop_warns_when_offload_configured(monkeypatch):
+    monkeypatch.setattr(shlib, "_host_offload_support", False)
+    acc = Accelerator(cpu=True, deepspeed_plugin=DeepSpeedPlugin(
+        zero_stage=2, offload_optimizer_device="cpu"))
+    params, opt = acc.prepare({"w": jnp.ones((2,))}, optax.sgd(0.1))
+    with pytest.warns(UserWarning, match="scanned train loop"):
+        acc.prepare_train_loop(lambda p, b: jnp.sum((p["w"] * b["x"]) ** 2), opt)
+
+
+def test_probe_does_not_cache_transient_failures(monkeypatch):
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: transient")
+
+    monkeypatch.setattr(shlib, "_host_offload_support", None)
+    import jax as _jax
+
+    monkeypatch.setattr(_jax, "jit", boom)
+    assert shlib.host_offload_supported() is False
+    assert shlib._host_offload_support is None  # transient -> not cached
+    monkeypatch.undo()
+    shlib._host_offload_support = None
+    # definitive signature -> cached False
+    def boom2(*a, **k):
+        raise RuntimeError("No registered implementation for untyped custom call to annotate_device_placement")
+
+    monkeypatch.setattr(shlib, "_host_offload_support", None)
+    monkeypatch.setattr(_jax, "jit", boom2)
+    assert shlib.host_offload_supported() is False
+    assert shlib._host_offload_support is False
+
+
+def test_offload_requires_live_opt_state(monkeypatch):
+    monkeypatch.setattr(shlib, "_host_offload_support", True)
+    acc = Accelerator(cpu=True)
+    opt = acc.prepare(optax.adam(0.1))
+    with pytest.raises(ValueError, match="live optimizer state"):
+        acc.prepare_train_step(lambda p, b: jnp.float32(0.0), opt, offload_optimizer=True)
+
+
+@pytest.mark.skipif(not _is_tpu(), reason="memory-kind compilation needs real TPU")
+def test_host_offloaded_step_trains_on_tpu():  # pragma: no cover - TPU only
+    acc = Accelerator(deepspeed_plugin=DeepSpeedPlugin(zero_stage=2, offload_optimizer_device="cpu"))
+    params, opt = acc.prepare({"w": jnp.ones((64,))}, optax.adam(0.05))
+
+    def loss_fn(p, b):
+        return jnp.sum((p["w"] * b["x"]) ** 2)
+
+    step = acc.prepare_train_step(loss_fn, opt)
+    assert all(
+        getattr(x.sharding, "memory_kind", None) == "pinned_host"
+        for x in jax.tree_util.tree_leaves(opt.opt_state)
+        if hasattr(x, "sharding")
+    )
+    params_s, state = params, opt.opt_state
+    batch = {"x": jnp.ones((64,))}
+    losses = []
+    for _ in range(10):
+        params_s, state, m = step(params_s, state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    # state still host-resident after compiled steps
+    assert all(
+        getattr(x.sharding, "memory_kind", None) == "pinned_host"
+        for x in jax.tree_util.tree_leaves(state)
+        if hasattr(x, "sharding")
+    )
