@@ -196,9 +196,19 @@ def _constrain(x, mesh, *logical):
 
 def _remat_policy(remat: bool | str):
     """Map the ``remat`` knob to a ``jax.checkpoint`` policy (None = save
-    nothing, i.e. full recompute)."""
+    nothing, i.e. full recompute). ``"offload_dots"`` saves the
+    weight-stationary matmul outputs to HOST memory instead of HBM
+    (activation offloading — compose with optimizer host offload to fit the
+    largest models). Unlike top-level program I/O placement, offload
+    annotations inside remat are compiler hints that every backend accepts
+    (the CPU mesh runs them too); only on TPU do they actually move bytes to
+    host RAM."""
     if remat is True or remat == "nothing":
         return None
+    if remat == "offload_dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
     policies = {
         "dots": jax.checkpoint_policies.checkpoint_dots,
         "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
@@ -207,7 +217,8 @@ def _remat_policy(remat: bool | str):
         return policies[remat]
     except KeyError:
         raise ValueError(
-            f"remat must be bool, 'nothing', 'dots' or 'dots_no_batch'; got {remat!r}"
+            f"remat must be bool, 'nothing', 'dots', 'dots_no_batch' or "
+            f"'offload_dots'; got {remat!r}"
         ) from None
 
 
@@ -253,7 +264,8 @@ def llama_forward(
     a policy name trading memory for recompute FLOPs (the knob behind the
     reference's FSDP ``activation_checkpointing``): ``"dots"`` saves matmul
     outputs, ``"dots_no_batch"`` saves only weight-stationary matmuls (the
-    usual transformer sweet spot), ``"nothing"`` ≡ ``True``."""
+    usual transformer sweet spot), ``"offload_dots"`` saves them to host RAM
+    instead of HBM (activation offloading), ``"nothing"`` ≡ ``True``."""
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     _batch_axes = ("dp_replicate", "dp_shard")

@@ -135,6 +135,15 @@ class TestByFeature:
         ns.local_sgd_steps = 4
         assert "eval_accuracy" in mod.training_function(ns)
 
+    def test_zero_offload(self):
+        import warnings
+
+        mod, ns = self._run("by_feature/zero_offload.py")
+        with warnings.catch_warnings():
+            # only the documented CPU-backend fallback warning is expected noise
+            warnings.filterwarnings("ignore", message=".*host-offload.*")
+            assert "eval_accuracy" in mod.training_function(ns)
+
     def test_memory(self):
         mod, ns = self._run("by_feature/memory.py")
         ns.starting_batch_size = 32

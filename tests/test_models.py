@@ -44,7 +44,7 @@ def test_llama_remat_policies_same_loss_and_grads():
         return jax.value_and_grad(lambda p: llama_loss(p, batch, cfg, remat=remat))(params)
 
     ref_loss, ref_grads = lg(False)
-    for remat in (True, "nothing", "dots", "dots_no_batch"):
+    for remat in (True, "nothing", "dots", "dots_no_batch", "offload_dots"):
         loss, grads = lg(remat)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
         jax.tree_util.tree_map(
