@@ -209,6 +209,12 @@ def _aten_handlers() -> dict[str, Callable]:
         jnp.max(x, axis=dim, keepdims=keepdim), jnp.argmax(x, axis=dim, keepdims=keepdim)))
     reg("aten.min.dim", lambda ctx, x, dim, keepdim=False: (
         jnp.min(x, axis=dim, keepdims=keepdim), jnp.argmin(x, axis=dim, keepdims=keepdim)))
+    # elementwise two-operand min/max (torch.min(a, b) — T5's relative-position
+    # bucketing uses this) + full reductions
+    reg(["aten.minimum.default", "aten.min.other"], lambda ctx, a, b: jnp.minimum(a, b))
+    reg(["aten.maximum.default", "aten.max.other"], lambda ctx, a, b: jnp.maximum(a, b))
+    reg("aten.max.default", lambda ctx, x: jnp.max(x))
+    reg("aten.min.default", lambda ctx, x: jnp.min(x))
     reg("aten.var.correction", lambda ctx, x, dim=None, *, correction=1, keepdim=False:
         jnp.var(x, axis=_dims(dim), ddof=int(correction), keepdims=keepdim))
 
@@ -318,6 +324,14 @@ def _aten_handlers() -> dict[str, Callable]:
     reg("aten.empty_like.default", lambda ctx, x, **kw: jnp.zeros_like(
         x, dtype=_like_dtype(x, kw)))
     reg("aten.scalar_tensor.default", lambda ctx, v, **kw: jnp.asarray(v, **_factory_kw(kw)))
+    # x.new_zeros(size) family: fresh tensor of given size, inheriting x's
+    # dtype unless overridden
+    reg("aten.new_zeros.default", lambda ctx, x, size, **kw: jnp.zeros(
+        [int(s) for s in size], dtype=_like_dtype(x, kw)))
+    reg("aten.new_ones.default", lambda ctx, x, size, **kw: jnp.ones(
+        [int(s) for s in size], dtype=_like_dtype(x, kw)))
+    reg("aten.new_full.default", lambda ctx, x, size, value, **kw: jnp.full(
+        [int(s) for s in size], value, dtype=_like_dtype(x, kw)))
 
     def _to(ctx, x, *args, **kw):
         import torch
@@ -343,6 +357,33 @@ def _aten_handlers() -> dict[str, Callable]:
         x, shifts, axis=tuple(dims) if dims else None))
     reg("aten.flip.default", lambda ctx, x, dims: jnp.flip(x, axis=tuple(dims)))
 
+    # -- functionalized mutation ops -------------------------------------------
+    # In-place ops (aten.add_, aten.copy_ on slice VIEWS, ...) cannot be
+    # interpreted per-node — a copy_ writing through a view mutates its BASE
+    # tensor, invisible to a functional interpreter. lower_module_aten detects
+    # mutating graphs and functionalizes them (ep.run_decompositions), after
+    # which mutation appears as these pure scatter/copy ops instead. Seen in
+    # the wild: T5's _shift_right (labels → decoder_input_ids).
+    def _slice_scatter(ctx, base, src, dim=0, start=None, end=None, step=1):
+        dim = dim % base.ndim
+        size = base.shape[dim]
+        start = 0 if start is None else (start + size if start < 0 else start)
+        end = size if end is None else min(end, size)
+        idx = (slice(None),) * dim + (slice(int(start), int(end), int(step or 1)),)
+        return base.at[idx].set(src)
+
+    def _select_scatter(ctx, base, src, dim, index):
+        dim = dim % base.ndim
+        idx = (slice(None),) * dim + (int(index),)
+        return base.at[idx].set(src)
+
+    reg("aten.slice_scatter.default", _slice_scatter)
+    reg("aten.select_scatter.default", _select_scatter)
+    reg("aten.copy.default", lambda ctx, dst, src, non_blocking=False: jnp.broadcast_to(
+        jnp.asarray(src).astype(dst.dtype), dst.shape))
+    reg(["aten.fill.Tensor", "aten.fill.Scalar"], lambda ctx, x, value: jnp.full_like(
+        x, jnp.asarray(value)))
+
     return H
 
 
@@ -360,6 +401,29 @@ def _dims(dim):
     if dim is None:
         return None
     return tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+
+def _graph_mutates(graph_module) -> bool:
+    """True when the exported program contains in-place ATen ops (trailing
+    underscore, e.g. ``aten.copy_``) whose buffer mutation a per-node
+    functional interpreter cannot express. Scans EVERY fx graph, including
+    higher-order-op subgraphs (no_grad/autocast bodies live in nested
+    GraphModules, not the top-level graph)."""
+    import torch.fx
+
+    for gm in graph_module.modules():
+        if not isinstance(gm, torch.fx.GraphModule):
+            continue
+        for node in gm.graph.nodes:
+            if node.op != "call_function":
+                continue
+            name = str(node.target)
+            parts = name.split(".")
+            if len(parts) >= 2:
+                op = parts[1] if parts[0] == "aten" else parts[-2]
+                if op.endswith("_") and not op.startswith("__"):
+                    return True
+    return False
 
 
 def lower_module_aten(model, example_inputs: dict):
@@ -392,6 +456,12 @@ def lower_module_aten(model, example_inputs: dict):
         model.train(was_training)
         if prior_use_cache is not None:
             model.config.use_cache = prior_use_cache
+
+    if _graph_mutates(ep.graph_module):
+        # in-place ops writing through views (T5 _shift_right's
+        # `shifted[:, 1:] = labels[:, :-1]`) are not interpretable per-node;
+        # functionalize — mutation becomes slice_scatter/select_scatter/copy
+        ep = ep.run_decompositions({})
 
     sig = ep.graph_signature
     params, buffers = module_params_to_jax(model)

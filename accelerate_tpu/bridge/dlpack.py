@@ -11,6 +11,24 @@ from __future__ import annotations
 from typing import Any
 
 
+def torch_tensor_to_numpy(tensor):
+    """torch.Tensor → host numpy array, UNCOMMITTED (no jax device). bf16 goes
+    through a bit-reinterpret (numpy itself has no bfloat16; ml_dtypes does).
+    The one shared implementation for batch conversion (bridge/module.py) and
+    HF-checkpoint conversion (models/convert.py)."""
+    import torch
+
+    t = tensor.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
 def torch_to_jax(tensor):
     """torch.Tensor → jax.Array, zero-copy when host-resident and contiguous."""
     import jax

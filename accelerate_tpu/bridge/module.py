@@ -341,15 +341,19 @@ class BridgedModule:
 
 
 def _to_jax(v):
+    """Batch-input conversion: returns UNCOMMITTED host arrays. DLPack import
+    (``torch_to_jax``) would commit to device 0, which conflicts with
+    mesh-placed params inside the jitted step ("incompatible devices") — let
+    jit place batch leaves to match the computation instead."""
     import numpy as np
 
     try:
         import torch
 
         if isinstance(v, torch.Tensor):
-            from .dlpack import torch_to_jax
+            from .dlpack import torch_tensor_to_numpy
 
-            return torch_to_jax(v)
+            return torch_tensor_to_numpy(v)
     except ImportError:
         pass
     if isinstance(v, (int, float, bool, np.ndarray)):

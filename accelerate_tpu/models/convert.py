@@ -43,14 +43,9 @@ def _as_numpy_getter(source):
         def get(k):
             v = source[k]
             if hasattr(v, "detach"):  # torch tensor
-                v = v.detach().cpu()
-                if str(v.dtype) == "torch.bfloat16":
-                    # Tensor.numpy() rejects bf16; reinterpret the bits
-                    import ml_dtypes
-                    import torch
+                from ..bridge.dlpack import torch_tensor_to_numpy
 
-                    return v.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
-                return v.numpy()
+                return torch_tensor_to_numpy(v)
             return np.asarray(v)
 
         return list(source.keys()), get, lambda: None

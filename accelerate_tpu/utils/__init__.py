@@ -180,20 +180,33 @@ def __getattr__(name):
 
 
 from .imports import (
+    is_bf16_available,
+    is_bnb_available,
     is_chex_available,
     is_cpu_only,
+    is_cuda_available,
     is_datasets_available,
+    is_deepspeed_available,
     is_flax_available,
+    is_fp8_available,
+    is_fp16_available,
     is_gpu_available,
+    is_matplotlib_available,
+    is_megatron_lm_available,
     is_mlflow_available,
+    is_mps_available,
     is_multihost,
     is_optax_available,
     is_orbax_available,
     is_pallas_available,
+    is_peft_available,
     is_rich_available,
     is_safetensors_available,
     is_tensorboard_available,
+    is_timm_available,
     is_torch_available,
+    is_torch_xla_available,
+    is_torchvision_available,
     is_tpu_available,
     is_tqdm_available,
     is_transformers_available,

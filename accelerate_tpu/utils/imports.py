@@ -137,3 +137,80 @@ def is_multihost() -> bool:
     import jax
 
     return jax.process_count() > 1
+
+
+# --------------------------------------------------------------------------
+# Reference-spelling probes migrated user code calls
+# (reference utils/imports.py:62-426). Answers reflect THIS stack honestly:
+# precision probes describe what the jitted step supports; torch-engine
+# probes are plain package probes and stay False in a TPU image.
+
+
+def is_bf16_available(ignore_tpu: bool = False) -> bool:
+    """bf16 is the native TPU matmul dtype; supported everywhere here
+    (reference checks CUDA capability; its ``ignore_tpu`` flag is accepted
+    for signature parity)."""
+    return True
+
+
+def is_fp16_available() -> bool:
+    """fp16 compute with in-graph dynamic loss scaling is always available."""
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def is_fp8_available() -> bool:
+    """True when jax exposes float8 dtypes (XLA fp8 dot support)."""
+    try:
+        import jax.numpy as jnp
+
+        return hasattr(jnp, "float8_e4m3fn")
+    except Exception:
+        return False
+
+
+def is_cuda_available() -> bool:
+    return is_gpu_available()
+
+
+def is_mps_available(min_version: str | None = None) -> bool:
+    return False
+
+
+def is_peft_available() -> bool:
+    return _package_available("peft")
+
+
+def is_timm_available() -> bool:
+    return _package_available("timm")
+
+
+def is_torchvision_available() -> bool:
+    return _package_available("torchvision")
+
+
+def is_matplotlib_available() -> bool:
+    return _package_available("matplotlib")
+
+
+def is_deepspeed_available() -> bool:
+    """Plain package probe; ZeRO capabilities are provided natively via
+    sharding (DeepSpeedPlugin shim), so this is False in a TPU image."""
+    return _package_available("deepspeed")
+
+
+def is_megatron_lm_available() -> bool:
+    return _package_available("megatron")
+
+
+def is_bnb_available() -> bool:
+    """bitsandbytes (CUDA); int8/NF4 quantization is native here
+    (``ops/quantization.py``)."""
+    return _package_available("bitsandbytes")
+
+
+def is_torch_xla_available(check_is_tpu: bool = False, check_is_gpu: bool = False) -> bool:
+    """The reference gates its TPU path on torch_xla; this framework IS the
+    TPU path, so the probe only reports whether the package exists for
+    interop purposes."""
+    return _package_available("torch_xla")

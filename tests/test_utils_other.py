@@ -139,6 +139,31 @@ def test_merge_fsdp_weights_is_shard_merge():
     assert u.merge_fsdp_weights is merge_sharded_checkpoint
 
 
+def test_reference_precision_and_engine_probes():
+    from accelerate_tpu.utils import (
+        is_bf16_available,
+        is_bnb_available,
+        is_cuda_available,
+        is_deepspeed_available,
+        is_fp8_available,
+        is_fp16_available,
+        is_mps_available,
+    )
+
+    assert is_bf16_available() is True  # native TPU dtype (signature parity)
+    assert is_bf16_available(ignore_tpu=True) is True
+    assert is_fp16_available() is True
+    assert is_fp8_available() is True  # jax float8 dtypes exist
+    assert is_cuda_available() is False  # tpu/cpu image
+    assert is_mps_available() is False
+    # torch-engine probes are plain package probes — consistent with the
+    # actual environment, whatever it has installed
+    from accelerate_tpu.utils.imports import _package_available
+
+    assert is_deepspeed_available() == _package_available("deepspeed")
+    assert is_bnb_available() == _package_available("bitsandbytes")
+
+
 # ------------------------------------------------------- environment utils --
 
 
