@@ -264,7 +264,7 @@ class BridgedModule:
         input_ids,
         max_new_tokens: int = 32,
         eos_token_id=None,
-        pad_token_id: int = 0,
+        pad_token_id: Optional[int] = None,
         attention_mask=None,
     ):
         """Greedy decoding for bridged decoder models (GPT-2, Llama, ...).
@@ -286,9 +286,20 @@ class BridgedModule:
 
         was_training = self.training
         self.training = False
+        if pad_token_id is None:
+            pad_token_id = getattr(getattr(self.torch_module, "config", None), "pad_token_id", None)
+            pad_token_id = 0 if pad_token_id is None else pad_token_id
         try:
             ids = np.asarray(input_ids)
             B, S = ids.shape
+            if getattr(getattr(self.torch_module, "config", None), "is_encoder_decoder", False):
+                return self._generate_seq2seq(
+                    ids,
+                    max_new_tokens=max_new_tokens,
+                    eos_token_id=eos_token_id,
+                    pad_token_id=pad_token_id,
+                    attention_mask=attention_mask,
+                )
             if attention_mask is not None:
                 mask = np.asarray(attention_mask)
                 lengths = mask.astype(np.int64).sum(axis=1)
@@ -324,13 +335,12 @@ class BridgedModule:
                     input_ids=padded,
                     attention_mask=np.ones((B, total), dtype=ids.dtype),
                 )
-                logits = np.asarray(out["logits"].array if hasattr(out["logits"], "array") else out["logits"])
-                tok = logits[:, cur - 1].argmax(-1).astype(ids.dtype)
+                tok = _logits_np(out)[:, cur - 1].argmax(-1).astype(ids.dtype)
                 if eos_token_id is not None:
                     # rows that finished EARLIER pad (HF greedy parity); the
                     # row's own first eos is kept
                     tok = np.where(finished, pad_token_id, tok)
-                    finished |= tok == eos_token_id
+                    finished |= _is_eos(tok, eos_token_id)
                 padded[:, cur] = tok
                 if eos_token_id is not None and finished.all():
                     padded = padded[:, : cur + 1]
@@ -338,6 +348,74 @@ class BridgedModule:
             return padded
         finally:
             self.training = was_training
+
+    def _generate_seq2seq(
+        self,
+        ids,
+        max_new_tokens: int,
+        eos_token_id,
+        pad_token_id: int,
+        attention_mask=None,
+    ):
+        """Greedy decoding for bridged encoder-decoder models (T5, ...).
+
+        Same fixed-shape strategy as the decoder path: decoder ids are padded
+        once to ``1 + max_new_tokens`` (starting from
+        ``config.decoder_start_token_id``) so one graph compiles; the causal
+        decoder makes each step's argmax at position ``t`` exact regardless of
+        the unfilled tail. Every step re-runs the full encoder+decoder — the
+        correctness-first bridge route (the native cached path is
+        ``accelerate_tpu.generation``); encoder cost could be hoisted with an
+        encoder/decoder split lowering if it ever matters.
+        """
+        import numpy as np
+
+        cfg = self.torch_module.config
+        start_id = cfg.decoder_start_token_id
+        if start_id is None:
+            raise ValueError("config.decoder_start_token_id required for seq2seq generate")
+        if eos_token_id is None:
+            eos_token_id = getattr(cfg, "eos_token_id", None)
+        B, S = ids.shape
+        enc_mask = (
+            np.asarray(attention_mask).astype(ids.dtype)
+            if attention_mask is not None
+            else np.ones((B, S), dtype=ids.dtype)
+        )
+        total = 1 + max_new_tokens
+        dec = np.full((B, total), pad_token_id, dtype=ids.dtype)
+        dec[:, 0] = start_id
+        finished = np.zeros((B,), bool)
+        for step in range(max_new_tokens):
+            out = self(
+                input_ids=ids, attention_mask=enc_mask, decoder_input_ids=dec
+            )
+            tok = _logits_np(out)[:, step].argmax(-1).astype(ids.dtype)
+            if eos_token_id is not None:
+                tok = np.where(finished, pad_token_id, tok)
+                finished |= _is_eos(tok, eos_token_id)
+            dec[:, step + 1] = tok
+            if eos_token_id is not None and finished.all():
+                dec = dec[:, : step + 2]
+                break
+        return dec
+
+
+def _logits_np(out):
+    """BridgedOutput logits → numpy (unwraps the _TensorView)."""
+    import numpy as np
+
+    v = out["logits"]
+    return np.asarray(v.array if hasattr(v, "array") else v)
+
+
+def _is_eos(tok, eos_token_id):
+    """Per-row bool: is ``tok`` an eos? Accepts an int OR a list of ids (HF
+    configs commonly store lists) — membership, never broadcasting."""
+    import numpy as np
+
+    ids = eos_token_id if isinstance(eos_token_id, (list, tuple, set)) else [eos_token_id]
+    return np.isin(tok, np.asarray(sorted(ids)))
 
 
 def _to_jax(v):

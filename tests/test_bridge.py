@@ -419,6 +419,40 @@ class TestEncoderDecoderBridge:
                 err_msg=f"grad mismatch at {name}",
             )
 
+    def test_t5_generate_matches_hf_greedy(self):
+        from transformers import T5Config, T5ForConditionalGeneration
+
+        from accelerate_tpu.bridge import BridgedModule
+
+        torch.manual_seed(1)
+        # large init scale → diverse argmax tokens (default tiny init degenerates
+        # to a constant token, which would vacuously pass)
+        cfg = T5Config(
+            vocab_size=100, d_model=32, d_kv=8, d_ff=64, num_layers=2, num_heads=4,
+            dropout_rate=0.0, decoder_start_token_id=0, use_cache=False,
+            initializer_factor=20.0,
+        )
+        model = T5ForConditionalGeneration(cfg).eval()
+        bm = BridgedModule(model)
+        ids = np.random.default_rng(1).integers(2, 100, (2, 12)).astype(np.int64)
+        got = bm.generate(ids, max_new_tokens=6)
+        model.config.use_cache = True
+        ref = model.generate(
+            torch.from_numpy(ids), max_new_tokens=6, do_sample=False, num_beams=1
+        ).numpy()
+        width = min(got.shape[1], ref.shape[1])
+        np.testing.assert_array_equal(got[:, :width], ref[:, :width])
+        assert len(set(got.flatten().tolist())) > 3  # non-degenerate decode
+
+    def test_eos_list_and_config_pad_handling(self):
+        from accelerate_tpu.bridge.module import _is_eos
+
+        tok = np.asarray([1, 2, 3, 5])
+        np.testing.assert_array_equal(_is_eos(tok, [1, 2]), [True, True, False, False])
+        np.testing.assert_array_equal(_is_eos(tok, 5), [False, False, False, True])
+        # B == len(eos_list): membership, not positional broadcasting
+        np.testing.assert_array_equal(_is_eos(np.asarray([2, 1]), [1, 2]), [True, True])
+
     def test_bridged_module_trains(self):
         model = _tiny_t5()
         batch = {k: torch.from_numpy(v) for k, v in _seq2seq_batch(n=4).items()}
