@@ -173,6 +173,12 @@ class Accelerator:
         self._offload_optimizer = bool(
             _offload_dev in ("cpu", "nvme") or getattr(fsdp_plugin, "cpu_offload", False)
         )
+        # ZeRO-1: params replicated, optimizer state sharded across replicas
+        self._zero1_axis = (
+            "dp_replicate"
+            if getattr(deepspeed_plugin, "zero_stage", None) == 1
+            else None
+        )
         if plugin is not None:
             if not hasattr(plugin, "to_parallelism_config"):
                 raise TypeError(
@@ -387,7 +393,10 @@ class Accelerator:
         if params_seen is not None:
             for opt in self._optimizers:
                 if opt.opt_state is None:
-                    opt.init(params_seen, self.mesh, self._param_specs)
+                    opt.init(
+                        params_seen, self.mesh, self._param_specs,
+                        zero1_axis=self._zero1_axis,
+                    )
         return results[0] if len(results) == 1 else tuple(results)
 
     def prepare_model(self, params, shard_rules: Optional[ShardingRules] = None, specs=None):

@@ -59,15 +59,20 @@ class AcceleratedOptimizer:
         self.accelerator_state = None  # set by Accelerator.prepare
 
     # ------------------------------------------------------------- functional --
-    def init(self, params, mesh=None, param_specs=None):
-        """Initialize (and shard) optimizer state for ``params``."""
+    def init(self, params, mesh=None, param_specs=None, zero1_axis=None):
+        """Initialize (and shard) optimizer state for ``params``.
+
+        ``zero1_axis``: shard otherwise-replicated state leaves over that mesh
+        axis (ZeRO-1; see ``parallel.sharding.zero1_state_specs``)."""
         self.opt_state = self.optimizer.init(params)
         if mesh is not None and param_specs is not None:
             from .parallel.sharding import shard_like_params
 
             self._mesh = mesh
             self._param_specs = param_specs
-            self.opt_state = shard_like_params(self.opt_state, mesh, params, param_specs)
+            self.opt_state = shard_like_params(
+                self.opt_state, mesh, params, param_specs, zero1_axis=zero1_axis
+            )
         if getattr(self, "_fp16_scaler_config", None) is not None:
             self._wrap_loss_scale_state()
         return self.opt_state

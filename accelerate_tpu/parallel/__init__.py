@@ -21,4 +21,5 @@ from .sharding import (
     shard_like_params,
     shard_params,
     tree_specs_like,
+    zero1_state_specs,
 )

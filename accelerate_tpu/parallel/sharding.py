@@ -177,16 +177,50 @@ def tree_specs_like(tree, params, param_specs):
     return _walk(tree)
 
 
-def shard_like_params(tree, mesh, params, param_specs):
+def shard_like_params(tree, mesh, params, param_specs, zero1_axis: Optional[str] = None):
     """Device-put ``tree`` with shardings inherited from params where structures
-    match (see :func:`tree_specs_like`)."""
+    match (see :func:`tree_specs_like`). ``zero1_axis`` additionally applies
+    :func:`zero1_state_specs` — optimizer-state sharding over a replicate
+    axis."""
     import jax
     from jax.sharding import NamedSharding
 
     specs = tree_specs_like(tree, params, param_specs)
+    if zero1_axis is not None:
+        specs = zero1_state_specs(tree, specs, mesh, axis=zero1_axis)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
     )
+
+
+def zero1_state_specs(state, specs, mesh, axis: str = "dp_replicate"):
+    """Shard otherwise-replicated optimizer-state leaves over the data-parallel
+    REPLICATE axis (ZeRO-1 as a GSPMD sharding — the technique of "Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training", Xu et
+    al. 2020, arXiv:2004.13336: annotate the moment buffers sharded, let XLA
+    partition the elementwise optimizer math and insert the gathers).
+
+    Params and grads stay replicated (pure DP); only the optimizer state —
+    2× params for Adam — splits across replicas, so each chip stores
+    ``state/dp_replicate``. Leaves already sharded by FSDP/TP rules, scalars,
+    and dims not divisible by the axis size are left unchanged.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    axis_size = dict(mesh.shape).get(axis, 1)
+    if axis_size <= 1:
+        return specs
+
+    def _maybe(leaf, spec):
+        if any(ax is not None for ax in tuple(spec)):
+            return spec  # FSDP/TP already shard this leaf
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1 and shape[0] > 0 and shape[0] % axis_size == 0:
+            return PartitionSpec(axis)
+        return spec
+
+    return jax.tree_util.tree_map(_maybe, state, specs)
 
 
 def replicate(tree, mesh):

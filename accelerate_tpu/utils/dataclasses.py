@@ -466,11 +466,13 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
 @dataclass
 class DeepSpeedPlugin(KwargsHandler):
     """Migration shim for reference ``DeepSpeedPlugin`` (``utils/dataclasses.py:1113``).
-    ZeRO stages are optimizer/grad/param shardings; under GSPMD all three are the
-    same ``dp_shard`` NamedSharding with compiler-scheduled gathers, so stages
-    1-3 map to one FSDP config and stage 0 to pure replication. A reference
-    ``hf_ds_config`` dict is accepted and mined for the fields that still mean
-    something here (stage, accumulation, clipping, offload)."""
+    ZeRO stages are shardings here: stage 0 → pure replication; stage 1 →
+    params replicated with the OPTIMIZER STATE sharded across replicas
+    (``parallel.sharding.zero1_state_specs``); stages 2-3 → the ``dp_shard``
+    FSDP NamedSharding (grad/param sharding collapse under GSPMD's
+    compiler-scheduled gathers). A reference ``hf_ds_config`` dict is accepted
+    and mined for the fields that still mean something here (stage,
+    accumulation, clipping, offload)."""
 
     zero_stage: int = 2
     gradient_accumulation_steps: int = 1
@@ -501,7 +503,9 @@ class DeepSpeedPlugin(KwargsHandler):
     def to_parallelism_config(self, num_devices: Optional[int] = None):
         from ..parallelism_config import ParallelismConfig
 
-        if self.zero_stage == 0:
+        if self.zero_stage in (0, 1):
+            # stage 1 keeps params replicated (the optimizer-state sharding is
+            # applied separately over the dp_replicate axis)
             if num_devices is None:
                 import jax
 
