@@ -158,6 +158,15 @@ class Accelerator:
         # already gave one explicitly.
         if fsdp_plugin is not None and deepspeed_plugin is not None:
             raise ValueError("pass fsdp_plugin or deepspeed_plugin, not both")
+        if deepspeed_plugin is None and fsdp_plugin is None:
+            from .utils.environment import parse_flag_from_env
+
+            if parse_flag_from_env("ACCELERATE_USE_DEEPSPEED"):
+                # the launcher's --use_deepspeed env protocol (reference
+                # utils/launch.py:557-577 → DeepSpeedPlugin env __post_init__)
+                from .utils.dataclasses import DeepSpeedPlugin
+
+                deepspeed_plugin = DeepSpeedPlugin.from_env()
         plugin = fsdp_plugin or deepspeed_plugin
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
         # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state

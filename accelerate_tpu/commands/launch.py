@@ -53,6 +53,18 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                    help="Seconds to wait between a failure and the relaunch")
     p.add_argument("--debug", action="store_true",
                    help="ACCELERATE_DEBUG_MODE: verify collective shapes across processes")
+    # DeepSpeed-style flags (reference utils/launch.py:557-577 env protocol;
+    # here they configure the native ZeRO shardings via DeepSpeedPlugin.from_env)
+    p.add_argument("--use_deepspeed", action="store_true",
+                   help="Signal DeepSpeed-style config: the script's Accelerator() "
+                        "builds a DeepSpeedPlugin from the ACCELERATE_DEEPSPEED_* env")
+    p.add_argument("--zero_stage", type=int, default=None)
+    p.add_argument("--offload_optimizer_device", default=None,
+                   choices=("none", "cpu", "nvme"))
+    p.add_argument("--offload_param_device", default=None, choices=("none", "cpu", "nvme"))
+    p.add_argument("--gradient_clipping", type=float, default=None)
+    p.add_argument("--deepspeed_config_file", default=None,
+                   help="Reference ds_config json; mined for stage/accum/clipping/offload")
     # Mesh axes (PARALLELISM_CONFIG_* protocol, parallelism_config.py)
     for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         p.add_argument(f"--{axis}_size", type=int, default=None)
@@ -151,6 +163,43 @@ def _script_cmd(args) -> list[str]:
     return cmd
 
 
+_DS_FLAG_ENV = {
+    "zero_stage": "ACCELERATE_DEEPSPEED_ZERO_STAGE",
+    "offload_optimizer_device": "ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE",
+    "offload_param_device": "ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE",
+    "gradient_clipping": "ACCELERATE_GRADIENT_CLIPPING",
+    "deepspeed_config_file": "ACCELERATE_DEEPSPEED_CONFIG_FILE",
+}
+
+
+def deepspeed_env(args) -> dict[str, str]:
+    """DeepSpeed-style flags → the reference's env protocol
+    (``utils/launch.py:557-577``); consumed by ``DeepSpeedPlugin.from_env``.
+
+    DeepSpeed mode activates only on the explicit signals — ``--use_deepspeed``,
+    ``--zero_stage`` or ``--deepspeed_config_file`` — never on auxiliary knobs
+    alone (``--gradient_clipping 1.0`` by itself must not silently flip the
+    run to ZeRO-2 sharding)."""
+    values = {env: getattr(args, flag, None) for flag, env in _DS_FLAG_ENV.items()}
+    active = (
+        getattr(args, "use_deepspeed", False)
+        or getattr(args, "zero_stage", None) is not None
+        or getattr(args, "deepspeed_config_file", None) is not None
+    )
+    if not active:
+        dropped = sorted(k for k, v in values.items() if v is not None)
+        if dropped:
+            print(
+                f"[accelerate-tpu launch] ignoring DeepSpeed flags without "
+                f"--use_deepspeed/--zero_stage: {dropped}",
+                file=sys.stderr,
+            )
+        return {}
+    env = {"ACCELERATE_USE_DEEPSPEED": "true"}
+    env.update({k: str(v) for k, v in values.items() if v is not None})
+    return env
+
+
 def simple_launcher(args, cfg: ClusterConfig) -> int:
     """Single-host launch: set env, run the script (reference ``simple_launcher:986``).
 
@@ -164,7 +213,7 @@ def simple_launcher(args, cfg: ClusterConfig) -> int:
     """
     import time
 
-    env = {**os.environ, **build_launch_env(cfg)}
+    env = {**os.environ, **build_launch_env(cfg), **deepspeed_env(args)}
     # make accelerate_tpu importable in the child even for uninstalled checkouts
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = os.pathsep.join(
@@ -225,6 +274,12 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     # is handled by the pod-level supervision loop below.
     if cfg.debug:
         inner.append("--debug")
+    if getattr(args, "use_deepspeed", False):
+        inner.append("--use_deepspeed")
+    for flag in _DS_FLAG_ENV:
+        v = getattr(args, flag, None)
+        if v is not None:
+            inner += [f"--{flag}", str(v)]
     if args.module:
         inner.append("-m")
     script_part = [args.training_script, *args.training_script_args]

@@ -486,19 +486,68 @@ class DeepSpeedPlugin(KwargsHandler):
     def __post_init__(self):
         cfg = self.hf_ds_config or {}
         zero = cfg.get("zero_optimization", {})
-        if "stage" in zero and not _is_auto(zero["stage"]):
-            self.zero_stage = int(zero["stage"])
-        if "gradient_accumulation_steps" in cfg and not _is_auto(cfg["gradient_accumulation_steps"]):
-            self.gradient_accumulation_steps = int(cfg["gradient_accumulation_steps"])
-        if "gradient_clipping" in cfg and not _is_auto(cfg["gradient_clipping"]):
-            self.gradient_clipping = float(cfg["gradient_clipping"])
+
+        def _fill(attr, value, cast):
+            """ds_config fills fields still at their DEFAULT; an explicit
+            constructor value wins (with a warning on disagreement — the
+            reference errors on flag/config mismatches, ``fill_match``)."""
+            if value is None or _is_auto(value):
+                return
+            value = cast(value)
+            current = getattr(self, attr)
+            default = type(self).__dataclass_fields__[attr].default
+            if current == default:
+                setattr(self, attr, value)
+            elif current != value:
+                import warnings
+
+                warnings.warn(
+                    f"DeepSpeedPlugin.{attr}={current!r} (explicit) disagrees with "
+                    f"hf_ds_config value {value!r}; keeping the explicit value"
+                )
+
+        _fill("zero_stage", zero.get("stage"), int)
+        _fill("gradient_accumulation_steps", cfg.get("gradient_accumulation_steps"), int)
+        _fill("gradient_clipping", cfg.get("gradient_clipping"), float)
         for src, attr in (("offload_optimizer", "offload_optimizer_device"),
                           ("offload_param", "offload_param_device")):
             dev = zero.get(src, {}).get("device")
             if dev and dev != "none":
-                setattr(self, attr, dev)
+                _fill(attr, dev, str)
         if not 0 <= self.zero_stage <= 3:
             raise ValueError(f"zero_stage must be 0-3, got {self.zero_stage}")
+
+    @classmethod
+    def from_env(cls) -> "DeepSpeedPlugin":
+        """Build from the launcher's env protocol (reference
+        ``utils/launch.py:557-577`` writer / ``utils/dataclasses.py:1225-1232``
+        reader): ``ACCELERATE_DEEPSPEED_ZERO_STAGE``, offload devices,
+        ``ACCELERATE_GRADIENT_CLIPPING``, ``ACCELERATE_DEEPSPEED_CONFIG_FILE``
+        (json loaded into ``hf_ds_config``)."""
+        kwargs: dict[str, Any] = {}
+        stage = os.environ.get("ACCELERATE_DEEPSPEED_ZERO_STAGE")
+        if stage is not None and not _is_auto(stage):
+            kwargs["zero_stage"] = int(stage)
+        clip = os.environ.get("ACCELERATE_GRADIENT_CLIPPING")
+        if clip is not None and not _is_auto(clip):
+            kwargs["gradient_clipping"] = float(clip)
+        for env_name, attr in (
+            ("ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE", "offload_optimizer_device"),
+            ("ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE", "offload_param_device"),
+        ):
+            dev = os.environ.get(env_name)
+            if dev and dev != "none":
+                kwargs[attr] = dev
+        config_file = os.environ.get("ACCELERATE_DEEPSPEED_CONFIG_FILE")
+        if config_file:
+            import json
+
+            with open(config_file) as f:
+                kwargs["hf_ds_config"] = json.load(f)
+        accum = os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS")
+        if accum is not None and not _is_auto(accum):
+            kwargs["gradient_accumulation_steps"] = int(accum)
+        return cls(**kwargs)
 
     def to_parallelism_config(self, num_devices: Optional[int] = None):
         from ..parallelism_config import ParallelismConfig

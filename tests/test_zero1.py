@@ -71,6 +71,75 @@ def test_zero1_training_matches_unsharded_baseline():
     assert abs(l0 - l1) < 1e-5  # loss reduction order differs in the last ulps
 
 
+def test_deepspeed_env_protocol_builds_plugin(tmp_path):
+    """accelerate-tpu launch --use_deepspeed ... → ACCELERATE_DEEPSPEED_* env →
+    Accelerator() builds the plugin (reference utils/launch.py:557-577)."""
+    import argparse
+    import json
+
+    from accelerate_tpu.commands.launch import deepspeed_env
+    from accelerate_tpu.utils import patch_environment
+
+    ns = argparse.Namespace(
+        use_deepspeed=True, zero_stage=1, offload_optimizer_device="cpu",
+        offload_param_device=None, gradient_clipping=0.5, deepspeed_config_file=None,
+    )
+    env = deepspeed_env(ns)
+    assert env["ACCELERATE_USE_DEEPSPEED"] == "true"
+    assert env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] == "1"
+    assert env["ACCELERATE_GRADIENT_CLIPPING"] == "0.5"
+    assert "ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE" not in env
+
+    # flags absent entirely → no DS env at all
+    assert deepspeed_env(argparse.Namespace()) == {}
+
+    ds_file = tmp_path / "ds.json"
+    ds_file.write_text(json.dumps({"zero_optimization": {"stage": 2}, "gradient_clipping": 1.5}))
+    with patch_environment(
+        ACCELERATE_USE_DEEPSPEED="true",
+        ACCELERATE_DEEPSPEED_CONFIG_FILE=str(ds_file),
+    ):
+        plugin = DeepSpeedPlugin.from_env()
+        assert plugin.zero_stage == 2
+        assert plugin.gradient_clipping == 1.5
+        # and a fresh Accelerator picks the plugin up from env
+        acc = Accelerator(cpu=True)
+        assert acc._plugin_grad_clip == 1.5
+        assert acc.mesh.shape["dp_shard"] == 8  # stage 2 → FSDP mesh
+
+    with patch_environment(
+        ACCELERATE_USE_DEEPSPEED="true",
+        ACCELERATE_DEEPSPEED_ZERO_STAGE="1",
+        ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE="cpu",
+    ):
+        plugin = DeepSpeedPlugin.from_env()
+        assert plugin.zero_stage == 1 and plugin.offload_optimizer_device == "cpu"
+
+
+def test_explicit_plugin_flags_beat_ds_config():
+    """--zero_stage 1 + ds.json stage 2 → explicit wins, with a warning
+    (the reference errors on flag/config mismatches)."""
+    with pytest.warns(UserWarning, match="disagrees"):
+        p = DeepSpeedPlugin(zero_stage=1, hf_ds_config={"zero_optimization": {"stage": 2}})
+    assert p.zero_stage == 1
+    # defaults still filled from config, no warning
+    p = DeepSpeedPlugin(hf_ds_config={"zero_optimization": {"stage": 3}})
+    assert p.zero_stage == 3
+
+
+def test_aux_flags_alone_do_not_activate_deepspeed(capsys):
+    import argparse
+
+    from accelerate_tpu.commands.launch import deepspeed_env
+
+    ns = argparse.Namespace(
+        use_deepspeed=False, zero_stage=None, offload_optimizer_device="none",
+        offload_param_device=None, gradient_clipping=1.0, deepspeed_config_file=None,
+    )
+    assert deepspeed_env(ns) == {}
+    assert "ignoring DeepSpeed flags" in capsys.readouterr().err
+
+
 def test_zero1_specs_leave_sharded_and_scalar_leaves_alone():
     from jax.sharding import Mesh, PartitionSpec as P
 
