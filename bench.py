@@ -173,6 +173,25 @@ def _lm_train_mfu(tokens_per_sec: float, n_params: int, config, seq_len: int):
     return round(tokens_per_sec * per_token / peak, 4)
 
 
+def _first_working_step(candidates, make_step, params, opt_state, batch, label):
+    """Compile-and-warm the first candidate config that runs: returns
+    ``(step, chosen, params, opt_state)`` with the warm-up step's outputs
+    committed. Failed candidates print to stderr and the next is tried;
+    exhausting the ladder re-raises the last error."""
+    last_err = None
+    for cand in candidates:
+        try:
+            step = make_step(cand)
+            params_c, opt_state_c, loss = step(params, opt_state, batch)
+            float(np.asarray(loss))  # force execution (tunnel-safe sync)
+            return step, cand, params_c, opt_state_c
+        except Exception as e:
+            last_err = e
+            print(f"{label} candidate {cand!r} failed "
+                  f"({type(e).__name__}: {str(e)[:200]}); trying next", file=sys.stderr)
+    raise RuntimeError(f"no {label} candidate compiled") from last_err
+
+
 def _reset_state():
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
@@ -263,17 +282,25 @@ def run_bench_fsdp_lm(on_tpu: bool) -> dict:
         np.random.default_rng(0).integers(0, config.vocab_size, (bs, seq)), jnp.int32
     )
 
-    @jax.jit
-    def step(p, s, b):
-        loss, grads = jax.value_and_grad(
-            lambda p: llama_loss(p, b, config, remat=True)
-        )(p)
-        updates, s = opt.update(grads, s, p)
-        return optax.apply_updates(p, updates), s, loss
+    def make_step(remat):
+        @jax.jit
+        def step(p, s, b):
+            loss, grads = jax.value_and_grad(
+                lambda p: llama_loss(p, b, config, remat=remat)
+            )(p)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        return step
 
     batch = {"input_ids": ids}
-    params, opt_state, loss = step(params, opt_state, batch)
-    float(np.asarray(loss))
+    # policy ladder: "dots_no_batch" keeps projection outputs (less recompute,
+    # more HBM) and falls back to full recompute if this model/chip combination
+    # can't hold them — the bench self-tunes instead of hard-coding the trade
+    step, remat_used, params, opt_state = _first_working_step(
+        ("dots_no_batch", True) if on_tpu else (True,),
+        make_step, params, opt_state, batch, label="fsdp_lm remat",
+    )
     t0 = _t.time()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, batch)
@@ -286,6 +313,7 @@ def run_bench_fsdp_lm(on_tpu: bool) -> dict:
         "unit": "tokens/sec/chip",
         "n_params": n_params,
         "final_loss": round(final, 4),
+        "remat": str(remat_used),
     }
     mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
     if mfu is not None:
@@ -452,11 +480,11 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, config.vocab_size, (bs, seq)), jnp.int32
     )
-    def make_step(impl):
+    def make_step(impl, remat):
         @jax.jit
         def step(p, s, b):
             loss, grads = jax.value_and_grad(
-                lambda p: llama_loss(p, b, config, remat=True, attention_impl=impl)
+                lambda p: llama_loss(p, b, config, remat=remat, attention_impl=impl)
             )(p)
             updates, s = opt.update(grads, s, p)
             return optax.apply_updates(p, updates), s, loss
@@ -464,21 +492,16 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
         return step
 
     batch = {"input_ids": ids}
-    impl = "flash" if on_tpu else "xla"  # S=8192 is deep in flash territory
-    step = make_step(impl)
-    try:
-        params_c, opt_state_c, loss = step(params, opt_state, batch)
-        float(np.asarray(loss))
-        params, opt_state = params_c, opt_state_c
-    except Exception as e:  # flash bwd unproven at this shape on hw: degrade, don't die
-        if impl == "xla":
-            raise
-        print(f"long-context flash path failed ({type(e).__name__}: {str(e)[:300]}); "
-              "xla fallback", file=sys.stderr)
-        impl = "xla"
-        step = make_step(impl)
-        params, opt_state, loss = step(params, opt_state, batch)
-        float(np.asarray(loss))
+    # ladder: flash attention with the lighter remat policy first, degrading to
+    # full recompute, then the einsum path — measure the best that runs
+    ladder = (
+        [("flash", "dots_no_batch"), ("flash", True), ("xla", True)]
+        if on_tpu
+        else [("xla", True)]
+    )
+    step, (impl, remat_used), params, opt_state = _first_working_step(
+        ladder, lambda c: make_step(*c), params, opt_state, batch, label="long-context",
+    )
     t0 = _t.time()
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, batch)
@@ -492,6 +515,7 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
         "seq_len": seq,
         "n_params": n_params,
         "final_loss": round(final, 4),
+        "remat": str(remat_used),
     }
     mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
     if mfu is not None:
@@ -589,12 +613,24 @@ def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> f
     cfg_anchor = baseline.setdefault("configs", {})
     if not isinstance(cfg_anchor, dict):
         cfg_anchor = baseline["configs"] = {}
+    cfg_meta = baseline.setdefault("configs_meta", {})
+    if not isinstance(cfg_meta, dict):
+        cfg_meta = baseline["configs_meta"] = {}
     for name, entry in configs.items():
         value = entry.get("value") or 0.0
         if _finite(cfg_anchor.get(name)) and cfg_anchor.get(name):
             entry["vs_baseline"] = round(value / cfg_anchor[name], 4) if _finite(value) else 0.0
+            # self-tuning configs: a ratio against an anchor measured under a
+            # DIFFERENT remat policy is not a like-for-like comparison — say so
+            prev_remat = cfg_meta.get(name, {}).get("remat")
+            if "remat" in entry and prev_remat is not None and prev_remat != entry["remat"]:
+                entry["vs_baseline_note"] = (
+                    f"remat policy differs from anchor ({prev_remat} vs {entry['remat']})"
+                )
         elif _finite(value) and value:
             cfg_anchor[name] = value
+            if "remat" in entry:
+                cfg_meta[name] = {"remat": entry["remat"]}
             dirty = True
     if dirty:
         tmp = baseline_path + ".tmp"

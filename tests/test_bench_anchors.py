@@ -38,6 +38,24 @@ class TestBaselineAnchors:
         assert saved["configs"]["fsdp_lm"] == 70.0
         assert "vs_baseline" not in configs["fsdp_lm"]
 
+    def test_remat_policy_mismatch_noted(self, tmp_path):
+        """Self-tuning configs: anchor remembers the policy; a run that fell
+        back to a different policy flags its ratio as non-comparable."""
+        path = str(tmp_path / "b.json")
+        apply_baseline_anchors(
+            _result(), {"fsdp_lm": {"value": 100.0, "remat": "dots_no_batch"}}, path
+        )
+        saved = json.load(open(path))
+        assert saved["configs_meta"]["fsdp_lm"] == {"remat": "dots_no_batch"}
+        configs = {"fsdp_lm": {"value": 80.0, "remat": "True"}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert configs["fsdp_lm"]["vs_baseline"] == 0.8
+        assert "dots_no_batch" in configs["fsdp_lm"]["vs_baseline_note"]
+        # same policy → no note
+        configs = {"fsdp_lm": {"value": 110.0, "remat": "dots_no_batch"}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert "vs_baseline_note" not in configs["fsdp_lm"]
+
     def test_legacy_headline_only_baseline(self, tmp_path):
         """Round-2's file has only per_chip; configs get added without
         touching the headline anchor."""
