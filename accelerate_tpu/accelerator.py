@@ -972,7 +972,7 @@ class Accelerator:
 
         pc = self.parallelism_config
         if pc.cp_enabled:
-            strategy = strategy or ("ring" if pc.cp_rotate_method == "ring" else "allgather")
+            strategy = strategy or pc.cp_rotate_method
             return make_context_parallel_attention(self.mesh, strategy=strategy)
         if pc.sp_enabled:
             return make_context_parallel_attention(self.mesh, strategy="ulysses")

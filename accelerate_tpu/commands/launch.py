@@ -68,7 +68,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     # Mesh axes (PARALLELISM_CONFIG_* protocol, parallelism_config.py)
     for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         p.add_argument(f"--{axis}_size", type=int, default=None)
-    p.add_argument("--cp_rotate_method", default=None, choices=("allgather", "ring"))
+    p.add_argument("--cp_rotate_method", default=None, choices=("allgather", "ring", "zigzag"))
     # TPU pod fan-out
     p.add_argument("--tpu_pod", action="store_true",
                    help="Fan out to every TPU-VM worker via gcloud ssh")

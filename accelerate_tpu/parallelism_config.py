@@ -54,7 +54,7 @@ class ParallelismConfig:
     sp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1
-    cp_rotate_method: str = "allgather"  # "allgather" | "ring"
+    cp_rotate_method: str = "allgather"  # "allgather" | "ring" | "zigzag"
 
     def __post_init__(self):
         for name in ("pp_size", "dp_replicate_size", "cp_size", "sp_size", "tp_size", "ep_size"):
@@ -65,8 +65,10 @@ class ParallelismConfig:
         if self.cp_size > 1 and self.sp_size > 1:
             # Reference makes CP and SP mutually exclusive (parallelism_config.py:323-329).
             raise ValueError("cp_size and sp_size cannot both be > 1 (pick ring-CP or Ulysses-SP)")
-        if self.cp_rotate_method not in ("allgather", "ring"):
-            raise ValueError(f"cp_rotate_method must be 'allgather' or 'ring', got {self.cp_rotate_method}")
+        if self.cp_rotate_method not in ("allgather", "ring", "zigzag"):
+            raise ValueError(
+                f"cp_rotate_method must be 'allgather', 'ring' or 'zigzag', got {self.cp_rotate_method}"
+            )
 
     # -- size/enabled properties (reference parallelism_config.py properties) ----
     @property

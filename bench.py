@@ -622,7 +622,8 @@ def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> f
             entry["vs_baseline"] = round(value / cfg_anchor[name], 4) if _finite(value) else 0.0
             # self-tuning configs: a ratio against an anchor measured under a
             # DIFFERENT remat policy is not a like-for-like comparison — say so
-            prev_remat = cfg_meta.get(name, {}).get("remat")
+            prev_meta = cfg_meta.get(name)
+            prev_remat = prev_meta.get("remat") if isinstance(prev_meta, dict) else None
             if "remat" in entry and prev_remat is not None and prev_remat != entry["remat"]:
                 entry["vs_baseline_note"] = (
                     f"remat policy differs from anchor ({prev_remat} vs {entry['remat']})"

@@ -26,7 +26,7 @@ def _shard(x, mesh, spec):
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 
-@pytest.mark.parametrize("strategy,axis", [("ring", "cp"), ("allgather", "cp"), ("ulysses", "sp")])
+@pytest.mark.parametrize("strategy,axis", [("ring", "cp"), ("zigzag", "cp"), ("allgather", "cp"), ("ulysses", "sp")])
 @pytest.mark.parametrize("causal", [True, False])
 def test_cp_sp_matches_reference(strategy, axis, causal):
     # ulysses shards heads (H=4) so sp must divide H; ring/allgather scale past H
@@ -41,9 +41,9 @@ def test_cp_sp_matches_reference(strategy, axis, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("strategy", ["ring", "zigzag", "ulysses"])
 def test_cp_sp_gradients_match(strategy):
-    axis = "cp" if strategy == "ring" else "sp"
+    axis = "sp" if strategy == "ulysses" else "cp"
     pc = ParallelismConfig(cp_size=8) if axis == "cp" else ParallelismConfig(sp_size=4)
     mesh = pc.build_mesh()
     q, k, v = _make_qkv(S=32)
@@ -73,6 +73,53 @@ def test_ring_with_gqa():
     qs, ks, vs = (_shard(x, mesh, spec) for x in (q, k, v))
     out = jax.jit(lambda a, b, c: attn(a, b, c, causal=True))(qs, ks, vs)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_with_gqa_and_dp():
+    pc = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = pc.build_mesh()
+    q, k, v = _make_qkv(B=4, S=32, H=8, Hkv=2)
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    attn = make_context_parallel_attention(mesh, strategy="zigzag")
+    spec = P(("dp_replicate", "dp_shard"), "cp", None, None)
+    qs, ks, vs = (_shard(x, mesh, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: attn(a, b, c, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_non_causal_falls_back_to_ring():
+    """Non-causal zigzag = plain ring (balanced placement buys nothing)."""
+    pc = ParallelismConfig(cp_size=8)
+    mesh = pc.build_mesh()
+    q, k, v = _make_qkv()
+    ref = dot_product_attention(q, k, v, causal=False, impl="xla")
+    attn = make_context_parallel_attention(mesh, strategy="zigzag")
+    spec = P(("dp_replicate", "dp_shard"), "cp", None, None)
+    qs, ks, vs = (_shard(x, mesh, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: attn(a, b, c, causal=False))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_in_llama_end_to_end():
+    """Llama forward with ZIGZAG attention over cp matches the plain forward
+    (exercises the exchange through rope'd q/k inside the real model)."""
+    from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
+    from accelerate_tpu.parallel.sharding import replicate
+
+    cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, max_seq_len=64)
+    params = init_llama(cfg, jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, 128, (2, 64)).astype(np.int32)
+    ref = llama_forward(params, ids, cfg, attention_impl="xla")
+
+    pc = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = pc.build_mesh()
+    attn = make_context_parallel_attention(mesh, strategy="zigzag")
+    params_r = replicate(params, mesh)
+    ids_s = jax.device_put(
+        jnp.asarray(ids), NamedSharding(mesh, P(("dp_replicate", "dp_shard"), "cp"))
+    )
+    out = jax.jit(lambda p, i: llama_forward(p, i, cfg, attention_fn=attn))(params_r, ids_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-4, atol=5e-4)
 
 
 def test_cp_in_llama_end_to_end():
