@@ -253,6 +253,8 @@ def llama_forward(
     remat: bool | str = False,
     mesh=None,
     with_aux: bool = False,
+    segment_ids=None,  # [B, S] int — packed sequences (0 = padding)
+    positions=None,  # [B, S] int — rope positions (default: per-segment index)
 ) -> jax.Array:
     """Return logits [B, S, vocab] (``with_aux=True`` → (logits, aux) where aux
     is the mean MoE load-balance loss, 0.0 for dense configs). ``attention_fn``
@@ -265,9 +267,24 @@ def llama_forward(
     reference's FSDP ``activation_checkpointing``): ``"dots"`` saves matmul
     outputs, ``"dots_no_batch"`` saves only weight-stationary matmuls (the
     usual transformer sweet spot), ``"offload_dots"`` saves them to host RAM
-    instead of HBM (activation offloading), ``"nothing"`` ≡ ``True``."""
+    instead of HBM (activation offloading), ``"nothing"`` ≡ ``True``.
+
+    ``segment_ids`` enables PACKED sequences (``utils/packing.py``): tokens
+    attend only within their segment (still causally), rope positions restart
+    per segment, and id 0 marks padding. Not combinable with ``attention_fn``
+    (the CP/SP rings don't carry segment info)."""
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    if segment_ids is not None:
+        if attention_fn is not None:
+            raise ValueError("segment_ids (packing) cannot combine with attention_fn (CP/SP)")
+        if positions is None:
+            # per-segment position: index minus the running segment-start index
+            # (roll-based start detection keeps the sequence extent unchanged)
+            seq_idx = jnp.arange(segment_ids.shape[1])[None, :]
+            is_start = jnp.roll(segment_ids, 1, axis=1) != segment_ids
+            is_start = is_start.at[:, 0].set(True)
+            positions = seq_idx - jax.lax.cummax(jnp.where(is_start, seq_idx, 0), axis=1)
     _batch_axes = ("dp_replicate", "dp_shard")
     # FSDP shards the table's embedding dim at rest; gather it for compute
     # (classic FSDP all-gather-on-use) or the lookup output inherits a D-dim
@@ -283,12 +300,14 @@ def llama_forward(
         q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
         k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
         v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
         if attention_fn is not None:
             attn = attention_fn(q, k, v, causal=True)
         else:
-            attn = dot_product_attention(q, k, v, causal=True, impl=attention_impl)
+            attn = dot_product_attention(
+                q, k, v, causal=True, segment_ids=segment_ids, impl=attention_impl
+            )
         h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
         h = _constrain(h, mesh, _batch_axes, "cp", None)
         x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
@@ -322,6 +341,13 @@ def llama_loss(params: dict, batch: dict, config: LlamaConfig, **fwd_kwargs) -> 
     activation crossing the shift ("involuntary full rematerialization")."""
     ids = batch["input_ids"]
     seq_len = ids.shape[1]
+    # packing: segment ids may arrive in the batch OR as a forward kwarg —
+    # both must engage the boundary/padding loss masking below
+    segment_ids = batch.get("segment_ids")
+    if segment_ids is None:
+        segment_ids = fwd_kwargs.get("segment_ids")
+    elif "segment_ids" not in fwd_kwargs:
+        fwd_kwargs = {**fwd_kwargs, "segment_ids": segment_ids}
     if config.moe_experts > 0:
         logits, moe_aux = llama_forward(params, ids, config, with_aux=True, **fwd_kwargs)
     else:
@@ -333,6 +359,11 @@ def llama_loss(params: dict, batch: dict, config: LlamaConfig, **fwd_kwargs) -> 
     valid = jnp.broadcast_to(
         (jnp.arange(seq_len) < seq_len - 1).astype(jnp.float32)[None, :], nll.shape
     )
+    if segment_ids is not None:
+        # packed: a position's target must be the NEXT token of the SAME
+        # segment — segment boundaries and padding (id 0) don't contribute
+        same_seg = jnp.roll(segment_ids, shift=-1, axis=1) == segment_ids
+        valid = valid * same_seg.astype(jnp.float32) * (segment_ids > 0).astype(jnp.float32)
     mask = batch.get("loss_mask")
     if mask is not None:
         valid = valid * jnp.roll(mask, shift=-1, axis=1).astype(jnp.float32)

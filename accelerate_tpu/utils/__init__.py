@@ -96,6 +96,7 @@ _OFFLOAD = {
 }
 _MEMORY = {"clear_device_cache", "find_executable_batch_size", "release_memory", "should_reduce_batch_size"}
 _QUANT = {"QuantizationConfig", "QuantizedArray", "load_and_quantize_model", "quantize_params", "dequantize_params"}
+_PACKING = {"pack_sequences", "unpack_logits"}
 _OTHER = {
     "check_os_kernel",
     "clean_state_dict_for_safetensors",
@@ -147,6 +148,10 @@ def __getattr__(name):
         from . import other
 
         return getattr(other, name)
+    if name in _PACKING:
+        from . import packing
+
+        return getattr(packing, name)
     if name in _CONSTANTS:
         from .. import checkpointing
 
@@ -224,7 +229,7 @@ _LAZY_EXTRA = {
     "tqdm",
 }
 _ALL_LAZY = (
-    _OPERATIONS | _RANDOM | _MODELING | _OFFLOAD | _MEMORY | _QUANT | _OTHER
+    _OPERATIONS | _RANDOM | _MODELING | _OFFLOAD | _MEMORY | _QUANT | _OTHER | _PACKING
     | _CONSTANTS | _LAZY_EXTRA
 )
 

@@ -1,0 +1,92 @@
+"""Sequence packing: variable-length documents → fixed-shape [N, S] batches.
+
+Static shapes are the TPU contract (SURVEY §7 hard parts: "static shapes force
+even_batches-style wraparound"); padding every document to S wastes MXU work
+proportional to the length variance. Packing lays several documents in one row
+with per-token ``segment_ids`` — the model (``llama_forward(segment_ids=...)``)
+masks cross-document attention, restarts rope positions per document, and
+excludes boundary/padding targets from the LM loss. The reference's
+counterpart pressure point is ``examples/by_feature/
+gradient_accumulation_for_autoregressive_models.py`` (token-weighted batching);
+packing is the TPU-native resolution.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+__all__ = ["pack_sequences", "unpack_logits"]
+
+
+def pack_sequences(
+    sequences: Iterable[Sequence[int]],
+    seq_len: int,
+    pad_id: int = 0,
+    split_long: bool = True,
+):
+    """Greedily pack token sequences into rows of exactly ``seq_len``.
+
+    Returns ``(input_ids, segment_ids)`` int32 arrays of shape [N, seq_len]:
+    ``segment_ids`` numbers each document 1..k within its row, 0 = padding.
+    Documents longer than ``seq_len`` are chunked (``split_long=True``) or
+    rejected. Packing is SHELF (append to the open row, open a new one when
+    full) — deterministic, O(n), and ORDER-PRESERVING: row-major segment order
+    equals input order, so :func:`unpack_logits` maps 1:1 back to the input
+    list. (First-fit packs a few percent tighter but reorders documents,
+    which silently breaks per-document bookkeeping; shuffle the corpus if
+    utilization matters more than order.)
+    """
+    chunks: list[list[int]] = []
+    for seq in sequences:
+        seq = list(seq)
+        if not seq:
+            continue
+        if len(seq) > seq_len:
+            if not split_long:
+                raise ValueError(f"sequence of {len(seq)} tokens exceeds seq_len={seq_len}")
+            for i in range(0, len(seq), seq_len):
+                piece = seq[i : i + seq_len]
+                if piece:
+                    chunks.append(piece)
+        else:
+            chunks.append(seq)
+
+    rows: list[list[list[int]]] = []
+    used = seq_len  # force a new row for the first chunk
+    for chunk in chunks:
+        if used + len(chunk) > seq_len:  # shelf: only the open row is a target
+            rows.append([])
+            used = 0
+        rows[-1].append(chunk)
+        used += len(chunk)
+
+    n = len(rows)
+    input_ids = np.full((n, seq_len), pad_id, dtype=np.int32)
+    segment_ids = np.zeros((n, seq_len), dtype=np.int32)
+    for r, row in enumerate(rows):
+        pos = 0
+        for s, chunk in enumerate(row, start=1):
+            input_ids[r, pos : pos + len(chunk)] = chunk
+            segment_ids[r, pos : pos + len(chunk)] = s
+            pos += len(chunk)
+    return input_ids, segment_ids
+
+
+def unpack_logits(logits, segment_ids):
+    """Split packed per-token outputs back into per-document arrays.
+
+    ``logits``: [N, S, ...]; returns a list of [len_i, ...] arrays in
+    row-major segment order — which :func:`pack_sequences`'s shelf packing
+    guarantees IS the original input order (per-document eval bookkeeping
+    stays aligned)."""
+    logits = np.asarray(logits)
+    segment_ids = np.asarray(segment_ids)
+    docs = []
+    for r in range(segment_ids.shape[0]):
+        for s in range(1, int(segment_ids[r].max(initial=0)) + 1):
+            sel = segment_ids[r] == s
+            if sel.any():
+                docs.append(logits[r][sel])
+    return docs
