@@ -30,19 +30,24 @@ def pack_sequences(
 
     Returns ``(input_ids, segment_ids)`` int32 arrays of shape [N, seq_len]:
     ``segment_ids`` numbers each document 1..k within its row, 0 = padding.
-    Documents longer than ``seq_len`` are chunked (``split_long=True``) or
-    rejected. Packing is SHELF (append to the open row, open a new one when
-    full) — deterministic, O(n), and ORDER-PRESERVING: row-major segment order
-    equals input order, so :func:`unpack_logits` maps 1:1 back to the input
-    list. (First-fit packs a few percent tighter but reorders documents,
-    which silently breaks per-document bookkeeping; shuffle the corpus if
-    utilization matters more than order.)
+    Documents longer than ``seq_len`` are chunked (``split_long=True``, one
+    OUTPUT SEGMENT PER CHUNK — a long doc maps to several consecutive
+    segments) or rejected. Empty documents are rejected (a silent skip would
+    misalign per-document bookkeeping). Packing is SHELF (append to the open
+    row, open a new one when full) — deterministic, O(n), and
+    ORDER-PRESERVING: row-major segment order equals input order, so with
+    no over-length docs :func:`unpack_logits` maps 1:1 back to the input
+    list. (First-fit packs a few percent tighter but reorders documents;
+    shuffle the corpus if utilization matters more than order.)
     """
     chunks: list[list[int]] = []
-    for seq in sequences:
+    for i, seq in enumerate(sequences):
         seq = list(seq)
         if not seq:
-            continue
+            raise ValueError(
+                f"sequence {i} is empty — filter empties out first (a silent "
+                "skip would misalign unpack_logits with the input list)"
+            )
         if len(seq) > seq_len:
             if not split_long:
                 raise ValueError(f"sequence of {len(seq)} tokens exceeds seq_len={seq_len}")
@@ -80,7 +85,8 @@ def unpack_logits(logits, segment_ids):
     ``logits``: [N, S, ...]; returns a list of [len_i, ...] arrays in
     row-major segment order — which :func:`pack_sequences`'s shelf packing
     guarantees IS the original input order (per-document eval bookkeeping
-    stays aligned)."""
+    stays aligned; docs that were CHUNKED by ``split_long`` appear as their
+    consecutive chunks)."""
     logits = np.asarray(logits)
     segment_ids = np.asarray(segment_ids)
     docs = []

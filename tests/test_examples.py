@@ -135,6 +135,13 @@ class TestByFeature:
         ns.local_sgd_steps = 4
         assert "eval_accuracy" in mod.training_function(ns)
 
+    def test_sequence_packing(self):
+        mod, ns = self._run("by_feature/sequence_packing.py")
+        ns.seq_len, ns.num_docs = 48, 32
+        out = mod.training_function(ns)
+        assert out["train_loss"] < 5.0
+        assert 0.3 < out["token_utilization"] <= 1.0
+
     def test_zero_offload(self):
         import warnings
 

@@ -31,6 +31,11 @@ def test_pack_sequences_long_doc_chunks_or_raises():
         pack_sequences([list(range(1, 12))], seq_len=4, split_long=False)
 
 
+def test_pack_sequences_rejects_empty_docs():
+    with pytest.raises(ValueError, match="empty"):
+        pack_sequences([[1, 2], [], [3]], seq_len=8)
+
+
 def test_packed_forward_matches_separate_docs():
     """Logits of each packed document == logits of that document run alone."""
     cfg = LlamaConfig.tiny()
