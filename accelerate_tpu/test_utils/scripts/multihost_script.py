@@ -417,6 +417,45 @@ def check_generate(accelerator):
     np.testing.assert_array_equal(ref_s, out_s)
 
 
+def check_zigzag_cp(accelerator):
+    """Zig-zag ring attention with the cp axis SPANNING PROCESSES: the lane
+    exchange and kv-pair rotation ppermutes ride the cross-host collective
+    backend. Every process's addressable output shards must match the
+    single-device reference slice exactly."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.ops.attention import dot_product_attention
+    from accelerate_tpu.parallel.long_context import make_context_parallel_attention
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    n_dev = jax.device_count()
+    assert n_dev >= 2
+    mesh = ParallelismConfig(cp_size=n_dev).build_mesh(jax.devices())
+    rng = np.random.default_rng(11)  # identical on every process
+    B, S, H, D = 2, 8 * n_dev, 4, 16
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    ref = np.asarray(dot_product_attention(
+        jax.device_put(q, jax.local_devices()[0]),
+        jax.device_put(k, jax.local_devices()[0]),
+        jax.device_put(v, jax.local_devices()[0]),
+        causal=True, impl="xla",
+    ))
+    attn = make_context_parallel_attention(mesh, strategy="zigzag")
+    spec = NamedSharding(mesh, P(None, "cp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: attn(a, b, c, causal=True))(qs, ks, vs)
+    jax.block_until_ready(out)
+    for shard in out.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data), ref[shard.index], rtol=2e-4, atol=2e-5,
+            err_msg=f"zigzag shard {shard.index} diverges from reference",
+        )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scenario", default="all")
@@ -430,7 +469,7 @@ def main():
 
     scenarios = args.scenario.split(",") if args.scenario != "all" else [
         "topology", "ops", "local_sgd", "dataloader", "dispatcher", "training",
-        "checkpoint", "sharded_checkpoint", "generate",
+        "checkpoint", "sharded_checkpoint", "generate", "zigzag",
     ]
     params = opt_state = None
     for scenario in scenarios:
@@ -454,6 +493,8 @@ def main():
             check_sharded_checkpoint(accelerator, args.tmpdir)
         elif scenario == "generate":
             check_generate(accelerator)
+        elif scenario == "zigzag":
+            check_zigzag_cp(accelerator)
         else:
             raise ValueError(f"unknown scenario {scenario}")
         print(f"[proc {accelerator.process_index}] scenario {scenario}: OK", flush=True)

@@ -45,6 +45,16 @@ class TestTwoProcesses:
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
 
+    def test_zigzag_cp_across_processes(self, shared_tmpdir):
+        """Zig-zag ring attention's lane-exchange/rotation ppermutes across a
+        REAL process boundary (the pod communication pattern)."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "zigzag", "--tmpdir", shared_tmpdir],
+            num_processes=2,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+
     def test_ops_three_processes(self, shared_tmpdir):
         """np=3: odd process counts exercise uneven split/pad paths that np=2
         cannot (split_between_processes remainder, pad sizes 2/3/4)."""
