@@ -367,8 +367,11 @@ def _aten_handlers() -> dict[str, Callable]:
     def _slice_scatter(ctx, base, src, dim=0, start=None, end=None, step=1):
         dim = dim % base.ndim
         size = base.shape[dim]
-        start = 0 if start is None else (start + size if start < 0 else start)
-        end = size if end is None else min(end, size)
+        # ATen: negative indices shift by size, then clamp to [0, size] — a
+        # still-negative value (e.g. end=-5 on size 4) means an EMPTY slice,
+        # not Python's from-the-back reinterpretation
+        start = 0 if start is None else min(max(start + size if start < 0 else start, 0), size)
+        end = size if end is None else min(max(end + size if end < 0 else end, 0), size)
         idx = (slice(None),) * dim + (slice(int(start), int(end), int(step or 1)),)
         return base.at[idx].set(src)
 

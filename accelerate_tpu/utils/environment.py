@@ -144,8 +144,27 @@ def purge_accelerate_environment(func_or_cls):
         return inner
 
     if inspect.isclass(func_or_cls):
-        for name, member in list(vars(func_or_cls).items()):
-            if callable(member) and (name.startswith("test") or name in ("setUp", "tearDown")):
-                setattr(func_or_cls, name, _wrap(member))
+        # dir() (not vars()) so test methods INHERITED from a base class are
+        # wrapped too — the wrapper lands on the decorated subclass, leaving
+        # the base untouched (reference covers inherited members as well).
+        # getattr_static preserves classmethod/staticmethod descriptors, which
+        # must be re-wrapped as the SAME descriptor kind.
+        for name in dir(func_or_cls):
+            if not (name.startswith("test") or name in ("setUp", "tearDown")):
+                continue
+            try:
+                raw = inspect.getattr_static(func_or_cls, name)
+            except AttributeError:
+                continue
+            inner_fn = raw.__func__ if isinstance(raw, (classmethod, staticmethod)) else raw
+            if not callable(inner_fn) or getattr(inner_fn, "_accelerate_env_purged", False):
+                continue
+            wrapped = _wrap(inner_fn)
+            wrapped._accelerate_env_purged = True
+            if isinstance(raw, classmethod):
+                wrapped = classmethod(wrapped)
+            elif isinstance(raw, staticmethod):
+                wrapped = staticmethod(wrapped)
+            setattr(func_or_cls, name, wrapped)
         return func_or_cls
     return _wrap(func_or_cls)

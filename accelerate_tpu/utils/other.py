@@ -184,7 +184,12 @@ def save(obj, f: str, save_on_each_node: bool = False, safe_serialization: bool 
 
         save_file(clean_state_dict_for_safetensors(flat), f)
     else:
-        np.savez(f, **flat)
+        # np.savez on a path silently appends ".npz" when the extension is
+        # missing (save(obj, "model.bin") would write "model.bin.npz" and a
+        # later load("model.bin") would fail); writing through an open file
+        # handle preserves the exact path the caller asked for.
+        with open(f, "wb") as fh:
+            np.savez(fh, **flat)
 
 
 def load(f: str):

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import importlib.metadata
 import operator
+import re
 
 _OPS = {
     "<": operator.lt,
@@ -18,35 +19,61 @@ _OPS = {
 }
 
 
+_PRE_RANK = {
+    # PEP 440 ordering among pre-release kinds: dev < alpha < beta < rc < final
+    "dev": -4,
+    "alpha": -3,
+    "a": -3,
+    "beta": -2,
+    "b": -2,
+    "rc": -1,
+    "c": -1,
+    "preview": -1,
+    "pre": -1,
+    "post": 1,  # post-releases sort ABOVE the bare release
+}
+# longest-first alternation so "preview" isn't eaten by "pre"; anchored at the
+# start of the (separator-stripped) suffix, and single-letter markers require a
+# following digit/end so platform tags like "-arm64" aren't read as alpha
+_PRE_RE = re.compile(r"^(preview|alpha|beta|post|dev|pre|rc|[abc](?=\d|$))[._\-]?(\d*)")
+
+
 def _parse(v: str) -> tuple:
-    """Minimal PEP-440-ish parse: numeric dotted prefix, suffixes compare as 0."""
-    parts = []
-    for piece in v.split(".")[:4]:
-        digits = ""
-        for ch in piece:
-            if ch.isdigit():
-                digits += ch
-            else:
-                break
-        parts.append(int(digits) if digits else 0)
-    return tuple(parts)
+    """Minimal fallback parse when ``packaging`` is unavailable: the numeric
+    dotted release prefix padded to fixed width, then (pre-release kind rank,
+    pre-release number) so ``0.5.0.dev0 < 0.5.0`` and ``1.0rc1 < 1.0rc2``."""
+    s = v.lower().strip()
+    m = re.match(r"\d+(?:\.\d+)*", s)
+    release = tuple(int(x) for x in m.group(0).split(".")) if m else (0,)
+    release = (release + (0,) * 5)[:5]
+    rest = s[m.end() :] if m else s
+    rest = rest.split("+", 1)[0]  # local segment ("+cuda12") never lowers rank
+    pm = _PRE_RE.match(rest.lstrip("._-"))
+    if pm:
+        return release + (_PRE_RANK[pm.group(1)], int(pm.group(2) or 0))
+    return release + (0, 0)
 
 
 def compare_versions(library_or_version, op: str, requirement_version: str) -> bool:
     """``compare_versions("jax", ">=", "0.4.30")`` — reference
     ``utils/versions.py`` semantics. First arg may be a library name (its
-    installed version is looked up) or a version string."""
+    installed version is looked up) or a version string. Uses
+    ``packaging.version`` (true PEP 440) when available."""
     if op not in _OPS:
         raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
     version = str(library_or_version)
     if not version[:1].isdigit():
         version = importlib.metadata.version(version)
-    a, b = _parse(version), _parse(requirement_version)
-    # pad to equal length so "0.7.0" == "0.7" (PEP 440 semantics)
-    width = max(len(a), len(b))
-    a += (0,) * (width - len(a))
-    b += (0,) * (width - len(b))
-    return _OPS[op](a, b)
+    try:
+        from packaging.version import InvalidVersion, Version
+
+        try:
+            return _OPS[op](Version(version), Version(requirement_version))
+        except InvalidVersion:
+            pass
+    except ImportError:
+        pass
+    return _OPS[op](_parse(version), _parse(requirement_version))
 
 
 def is_jax_version(op: str, version: str) -> bool:

@@ -47,6 +47,25 @@ def _batch(n=4, seq=16, vocab=100, num_labels=2, seed=0):
     }
 
 
+def test_slice_scatter_negative_end_matches_aten():
+    # end=-1 means size-1 in ATen slice semantics (ADVICE r03)
+    import jax.numpy as jnp
+
+    from accelerate_tpu.bridge.aten_lowering import _aten_handlers
+
+    h = _aten_handlers()["aten.slice_scatter.default"]
+    base = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    src = torch.full((3, 2), -1.0)
+    expected = torch.slice_scatter(base, src, dim=1, start=1, end=-1)
+    got = h(None, jnp.asarray(base.numpy()), jnp.asarray(src.numpy()), 1, 1, -1)
+    np.testing.assert_array_equal(np.asarray(got), expected.numpy())
+    # end below -size clamps to 0 => empty window, base unchanged (ATen clamp)
+    empty = torch.empty((3, 0))
+    expected2 = torch.slice_scatter(base, empty, dim=1, start=1, end=-5)
+    got2 = h(None, jnp.asarray(base.numpy()), jnp.asarray(empty.numpy()), 1, 1, -5)
+    np.testing.assert_array_equal(np.asarray(got2), expected2.numpy())
+
+
 class TestLoweringParity:
     def test_forward_loss_logits_match_torch(self):
         from accelerate_tpu.bridge import lower_module

@@ -101,6 +101,76 @@ def test_save_load_round_trip(tmp_path):
     np.testing.assert_array_equal(u.load(st)["layer/w"], tree["layer"]["w"])
 
 
+def test_save_respects_exact_path_without_npz_extension(tmp_path):
+    # np.savez on a bare path appends ".npz"; save() must write EXACTLY the
+    # path given so load() finds it again (ADVICE r03)
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    path = str(tmp_path / "model.bin")
+    u.save(tree, path)
+    assert os.path.exists(path) and not os.path.exists(path + ".npz")
+    np.testing.assert_array_equal(u.load(path)["w"], tree["w"])
+
+
+def test_compare_versions_prerelease_ordering():
+    from accelerate_tpu.utils.versions import compare_versions
+
+    # PEP 440: a dev build PRECEDES its release (ADVICE r03)
+    assert compare_versions("0.5.0.dev0", "<", "0.5.0")
+    assert not compare_versions("0.5.0.dev0", ">=", "0.5.0")
+    assert compare_versions("1.2.0rc1", "<", "1.2.0")
+    assert compare_versions("0.7", "==", "0.7.0")
+    assert compare_versions("1.10.2", ">", "1.9.9")
+    # ordering among pre-releases themselves (fallback parser must agree)
+    from accelerate_tpu.utils.versions import _parse
+
+    assert _parse("1.0rc2") > _parse("1.0rc1")
+    assert _parse("1.0.dev0") < _parse("1.0a1") < _parse("1.0b1") < _parse("1.0rc1") < _parse("1.0")
+    assert _parse("1.0.post1") > _parse("1.0")
+    assert _parse("1.0.0-beta") < _parse("1.0.0")
+    # local-version / platform suffixes are NOT pre-releases
+    assert _parse("0.4.30+cuda12") >= _parse("0.4.30")
+    assert _parse("1.0-arm64") >= _parse("1.0")
+
+
+def test_purge_accelerate_environment_preserves_classmethods():
+    os.environ["ACCELERATE_SCRATCH4"] = "v"
+
+    @u.purge_accelerate_environment
+    class T:
+        @classmethod
+        def test_cm(cls):
+            return "ACCELERATE_SCRATCH4" not in os.environ
+
+        @staticmethod
+        def test_sm():
+            return "ACCELERATE_SCRATCH4" not in os.environ
+
+    try:
+        assert T.test_cm() is True
+        assert T().test_cm() is True  # instance access must still bind cls
+        assert T.test_sm() is True
+    finally:
+        os.environ.pop("ACCELERATE_SCRATCH4", None)
+
+
+def test_purge_accelerate_environment_covers_inherited_methods():
+    os.environ["ACCELERATE_SCRATCH3"] = "v"
+
+    class Base:
+        def test_inherited(self):
+            return "ACCELERATE_SCRATCH3" not in os.environ
+
+    @u.purge_accelerate_environment
+    class Child(Base):
+        pass
+
+    try:
+        assert Child().test_inherited() is True  # inherited method purged too
+        assert Base().test_inherited() is False  # base class untouched
+    finally:
+        os.environ.pop("ACCELERATE_SCRATCH3", None)
+
+
 def test_is_port_in_use():
     import socket
 
