@@ -38,20 +38,30 @@ _PRE_RANK = {
 _PRE_RE = re.compile(r"^(preview|alpha|beta|post|dev|pre|rc|[abc](?=\d|$))[._\-]?(\d*)")
 
 
-def _parse(v: str) -> tuple:
-    """Minimal fallback parse when ``packaging`` is unavailable: the numeric
-    dotted release prefix padded to fixed width, then (pre-release kind rank,
-    pre-release number) so ``0.5.0.dev0 < 0.5.0`` and ``1.0rc1 < 1.0rc2``."""
+def _parse(v: str) -> "tuple[tuple, tuple]":
+    """Minimal fallback parse when ``packaging`` is unavailable: returns the
+    numeric dotted release tuple plus a (pre-release kind rank, pre-release
+    number) pair so ``0.5.0.dev0 < 0.5.0`` and ``1.0rc1 < 1.0rc2``."""
     s = v.lower().strip()
     m = re.match(r"\d+(?:\.\d+)*", s)
     release = tuple(int(x) for x in m.group(0).split(".")) if m else (0,)
-    release = (release + (0,) * 5)[:5]
     rest = s[m.end() :] if m else s
     rest = rest.split("+", 1)[0]  # local segment ("+cuda12") never lowers rank
     pm = _PRE_RE.match(rest.lstrip("._-"))
     if pm:
-        return release + (_PRE_RANK[pm.group(1)], int(pm.group(2) or 0))
-    return release + (0, 0)
+        return release, (_PRE_RANK[pm.group(1)], int(pm.group(2) or 0))
+    return release, (0, 0)
+
+
+def _fallback_compare(version: str, op: str, requirement_version: str) -> bool:
+    """Compare without ``packaging``: releases are padded to a COMMON width
+    ("0.7" == "0.7.0") before the pre-release pair breaks ties."""
+    a_rel, a_pre = _parse(version)
+    b_rel, b_pre = _parse(requirement_version)
+    width = max(len(a_rel), len(b_rel))
+    a = a_rel + (0,) * (width - len(a_rel)) + a_pre
+    b = b_rel + (0,) * (width - len(b_rel)) + b_pre
+    return _OPS[op](a, b)
 
 
 def compare_versions(library_or_version, op: str, requirement_version: str) -> bool:
@@ -73,7 +83,7 @@ def compare_versions(library_or_version, op: str, requirement_version: str) -> b
             pass
     except ImportError:
         pass
-    return _OPS[op](_parse(version), _parse(requirement_version))
+    return _fallback_compare(version, op, requirement_version)
 
 
 def is_jax_version(op: str, version: str) -> bool:

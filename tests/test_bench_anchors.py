@@ -136,3 +136,34 @@ class TestBaselineAnchors:
         configs = {"inference": {"value": 0.0, "error": "boom"}}
         apply_baseline_anchors(_result(), configs, path)
         assert configs["inference"]["vs_baseline"] == 0.0
+
+
+class TestProbeRecovery:
+    """Round-4 hardening: probe failure reasons are captured and the degraded
+    path can adopt a recovered-TPU child run's output — but ONLY a real one."""
+
+    def test_pick_tpu_json_line_accepts_real_tpu_result(self):
+        from bench import _pick_tpu_json_line
+
+        good = json.dumps({"value": 1250.0, "device_kind": "TPU v5 lite", "n_chips": 1})
+        out = "\n".join(["progress noise", good])
+        assert _pick_tpu_json_line(out) == good
+
+    def test_pick_tpu_json_line_rejects_cpu_and_degraded(self):
+        from bench import _pick_tpu_json_line
+
+        cpu = json.dumps({"value": 49.0, "device_kind": "cpu"})
+        degraded = json.dumps(
+            {"value": 10.0, "device_kind": "TPU v5 lite", "degraded": "probe failed"}
+        )
+        assert _pick_tpu_json_line("\n".join([cpu, degraded])) is None
+        assert _pick_tpu_json_line("not json\n{broken") is None
+        assert _pick_tpu_json_line("") is None
+
+    def test_probe_subprocess_reports_detail(self):
+        from bench import _probe_backend_subprocess
+
+        ok, detail = _probe_backend_subprocess(timeout=60)
+        assert isinstance(ok, bool) and isinstance(detail, str)
+        if not ok:
+            assert detail  # a failed probe must say why

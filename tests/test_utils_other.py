@@ -120,16 +120,21 @@ def test_compare_versions_prerelease_ordering():
     assert compare_versions("1.2.0rc1", "<", "1.2.0")
     assert compare_versions("0.7", "==", "0.7.0")
     assert compare_versions("1.10.2", ">", "1.9.9")
-    # ordering among pre-releases themselves (fallback parser must agree)
-    from accelerate_tpu.utils.versions import _parse
+    # ordering among pre-releases themselves (fallback parser must agree even
+    # when packaging is installed, so exercise it directly)
+    from accelerate_tpu.utils.versions import _fallback_compare as fc
 
-    assert _parse("1.0rc2") > _parse("1.0rc1")
-    assert _parse("1.0.dev0") < _parse("1.0a1") < _parse("1.0b1") < _parse("1.0rc1") < _parse("1.0")
-    assert _parse("1.0.post1") > _parse("1.0")
-    assert _parse("1.0.0-beta") < _parse("1.0.0")
+    assert fc("1.0rc2", ">", "1.0rc1")
+    assert fc("1.0.dev0", "<", "1.0a1") and fc("1.0a1", "<", "1.0b1")
+    assert fc("1.0b1", "<", "1.0rc1") and fc("1.0rc1", "<", "1.0")
+    assert fc("1.0.post1", ">", "1.0")
+    assert fc("1.0.0-beta", "<", "1.0.0")
+    assert fc("0.7", "==", "0.7.0")
     # local-version / platform suffixes are NOT pre-releases
-    assert _parse("0.4.30+cuda12") >= _parse("0.4.30")
-    assert _parse("1.0-arm64") >= _parse("1.0")
+    assert fc("0.4.30+cuda12", ">=", "0.4.30")
+    assert fc("1.0-arm64", ">=", "1.0")
+    # deep release tuples are not truncated
+    assert fc("1.2.3.4.5.1", "<", "1.2.3.4.5.2")
 
 
 def test_purge_accelerate_environment_preserves_classmethods():
