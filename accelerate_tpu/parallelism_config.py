@@ -177,12 +177,89 @@ class ParallelismConfig:
             )
         return shape
 
+    # -- multi-slice (DCN) topology ---------------------------------------------
+    @staticmethod
+    def _num_slices(devices) -> int:
+        """Distinct ``slice_index`` values across ``devices`` (1 when the
+        attribute is absent — single-slice or CPU/virtual devices)."""
+        ids = {getattr(d, "slice_index", None) for d in devices}
+        return 1 if None in ids else len(ids)
+
+    def dcn_mesh_shapes(
+        self, num_devices: int, num_slices: int
+    ) -> "tuple[tuple[int, ...], tuple[int, ...]]":
+        """Factor the global mesh into ``(per_slice_shape, dcn_shape)``.
+
+        The DCN factor lands on the OUTERMOST axes first — ``pp`` (one
+        activation transfer per microbatch crosses the slice boundary), then
+        ``dp_replicate`` (one param-sized allreduce per step) — exactly the
+        placement the reference's multi-node rendezvous achieves by rank
+        ordering (``/root/reference/src/accelerate/state.py:753-812``); inner
+        axes (dp_shard/cp/sp/tp/ep) stay intra-slice on ICI.
+        ``ACCELERATE_DCN_MESH_SHAPE`` (comma-separated 7-tuple in
+        ``MESH_AXIS_NAMES`` order) overrides the factorization, e.g. to push
+        ``dp_shard`` across DCN when cross-slice FSDP is intended.
+        """
+        shape = self.mesh_shape(num_devices)
+        explicit = os.environ.get("ACCELERATE_DCN_MESH_SHAPE", "").strip()
+        if explicit:
+            dcn = tuple(int(x) for x in explicit.split(","))
+            if len(dcn) != len(shape):
+                raise ValueError(
+                    f"ACCELERATE_DCN_MESH_SHAPE needs {len(shape)} comma-separated sizes "
+                    f"(axes {MESH_AXIS_NAMES}), got {explicit!r}"
+                )
+        else:
+            import math
+
+            dcn_list = [1] * len(shape)
+            remaining = num_slices
+            for idx in (0, 1):  # pp, dp_replicate — the DCN-tolerant axes
+                if remaining == 1:
+                    break
+                f = math.gcd(shape[idx], remaining)
+                dcn_list[idx] = f
+                remaining //= f
+            if remaining != 1:
+                raise ValueError(
+                    f"cannot place {num_slices} slices across the outer mesh axes: "
+                    f"pp={shape[0]} x dp_replicate={shape[1]} does not absorb the slice "
+                    f"count. Raise pp_size/dp_replicate_size to a multiple of the slice "
+                    f"count, or set ACCELERATE_DCN_MESH_SHAPE to place another axis "
+                    f"(e.g. dp_shard) across DCN explicitly."
+                )
+            dcn = tuple(dcn_list)
+        if int(np.prod(dcn)) != num_slices:
+            raise ValueError(
+                f"dcn mesh shape {dcn} has size {int(np.prod(dcn))} but there are "
+                f"{num_slices} slices"
+            )
+        bad = [
+            MESH_AXIS_NAMES[i]
+            for i, (s, d) in enumerate(zip(shape, dcn))
+            if d < 1 or s % d != 0
+        ]
+        if bad:
+            raise ValueError(
+                f"dcn factor does not divide the mesh axis size for {bad} "
+                f"(mesh {shape}, dcn {dcn})"
+            )
+        per_slice = tuple(s // d for s, d in zip(shape, dcn))
+        return per_slice, dcn
+
     def build_mesh(self, devices=None):
         """Build a ``jax.sharding.Mesh`` with canonical axis names.
 
-        Device placement uses ``mesh_utils.create_device_mesh`` so that inner mesh
-        axes map to physically-adjacent chips (ICI rings); falls back to a plain
-        reshape of ``jax.devices()`` order (fine for CPU/virtual meshes).
+        Single-slice: device placement uses ``mesh_utils.create_device_mesh``
+        so inner mesh axes map to physically-adjacent chips (ICI rings).
+        Multi-slice (``slice_index`` differs across devices, e.g. a multislice
+        TPU pod): ``mesh_utils.create_hybrid_device_mesh`` places the
+        DCN-tolerant outer axes (``pp``, ``dp_replicate``) across slices and
+        keeps the bandwidth-hungry inner axes on ICI — see
+        :meth:`dcn_mesh_shapes`. ``ACCELERATE_HYBRID_MESH_GRANULE=process``
+        treats processes (not slices) as the DCN unit, for platforms that
+        don't expose ``slice_index``. Falls back to a plain reshape of device
+        order (fine for CPU/virtual meshes).
         """
         import jax
         from jax.sharding import Mesh
@@ -198,15 +275,35 @@ class ParallelismConfig:
             # run on a subset (single-chip debugging on a multi-chip host)
             devices = devices[:requested]
         shape = self.mesh_shape(len(devices))
+        granule = os.environ.get("ACCELERATE_HYBRID_MESH_GRANULE", "slice").strip().lower()
+        if granule == "process":
+            num_slices = len({getattr(d, "process_index", 0) for d in devices})
+        else:
+            num_slices = self._num_slices(devices)
         try:
             from jax.experimental import mesh_utils
 
-            device_array = mesh_utils.create_device_mesh(
-                shape, devices=devices, allow_split_physical_axes=True
-            )
+            if num_slices > 1:
+                per_slice, dcn = self.dcn_mesh_shapes(len(devices), num_slices)
+                device_array = mesh_utils.create_hybrid_device_mesh(
+                    per_slice,
+                    dcn,
+                    devices=devices,
+                    process_is_granule=(granule == "process"),
+                    allow_split_physical_axes=True,
+                )
+            else:
+                device_array = mesh_utils.create_device_mesh(
+                    shape, devices=devices, allow_split_physical_axes=True
+                )
         except (ValueError, NotImplementedError, AssertionError) as e:
             import warnings
 
+            if num_slices > 1:
+                # a multi-slice topology that cannot be factored must NOT be
+                # silently flattened: a plain reshape would put tp/dp_shard
+                # collectives on DCN, a silent order-of-magnitude slowdown
+                raise
             warnings.warn(
                 f"mesh_utils.create_device_mesh failed ({e}); falling back to plain "
                 "device-order reshape — collectives may not ride optimal ICI rings.",

@@ -4,7 +4,9 @@ assertion scripts under ``scripts/`` so any install can self-verify with
 ``accelerate-tpu test``."""
 
 from .testing import (
+    FakeSliceDevice,
     assert_allclose_tree,
+    fake_slice_devices,
     get_backend,
     require_cpu,
     require_multi_device,

@@ -74,6 +74,31 @@ def assert_allclose_tree(a, b, rtol: float = 1e-5, atol: float = 1e-6, err_msg: 
                                    err_msg=err_msg)
 
 
+class FakeSliceDevice:
+    """Stand-in for a multislice TPU device, carrying exactly the attributes
+    ``mesh_utils.create_hybrid_device_mesh`` / ``create_device_mesh`` touch
+    (``slice_index`` grouping + the coords/platform probes). Used to validate
+    DCN-aware mesh construction without multislice hardware."""
+
+    def __init__(self, i: int, slice_index: int, per_slice: int):
+        self.id = i
+        self.slice_index = slice_index
+        self.process_index = slice_index
+        self.platform = "cpu"
+        self.device_kind = "fake"
+        self.coords = (i % per_slice, 0, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"FakeSliceDevice(id={self.id}, slice={self.slice_index})"
+
+
+def fake_slice_devices(n: int = 8, num_slices: int = 2) -> list:
+    """``n`` fake devices split evenly over ``num_slices`` slices."""
+    per_slice = n // num_slices
+    return [FakeSliceDevice(i, i // per_slice, per_slice) for i in range(n)]
+
+
 def find_free_port() -> int:
     import socket
 
