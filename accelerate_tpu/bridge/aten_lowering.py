@@ -357,6 +357,312 @@ def _aten_handlers() -> dict[str, Callable]:
         x, shifts, axis=tuple(dims) if dims else None))
     reg("aten.flip.default", lambda ctx, x, dims: jnp.flip(x, axis=tuple(dims)))
 
+    # -- convolution / pooling / batch-norm / resize (CV family) ---------------
+    # Closes the bridge's CV hole (VERDICT r03 item 4): the reference's CV
+    # acceptance surface (examples/cv_example.py, ResNet-50) crosses here.
+    from jax import lax
+
+    def _spatial(v, nd: int) -> tuple:
+        if isinstance(v, (list, tuple)):
+            vals = [int(x) for x in v]
+            if len(vals) == 1:
+                vals = vals * nd
+            return tuple(vals[:nd])
+        return (int(v),) * nd
+
+    def _conv_letters(nd: int) -> str:
+        return "DHW"[3 - nd :]
+
+    def _convolution(ctx, x, w, bias=None, stride=1, padding=0, dilation=1,
+                     transposed=False, output_padding=0, groups=1):
+        nd = x.ndim - 2
+        letters = _conv_letters(nd)
+        s = _spatial(stride, nd)
+        d = _spatial(dilation, nd)
+        groups = int(groups)
+        if not transposed:
+            if isinstance(padding, str):
+                pad = padding.upper()  # torch "same"/"valid"
+            else:
+                p = _spatial(padding, nd)
+                pad = [(pi, pi) for pi in p]
+            dn = lax.conv_dimension_numbers(
+                x.shape, w.shape, ("NC" + letters, "OI" + letters, "NC" + letters)
+            )
+            out = lax.conv_general_dilated(
+                x, w.astype(x.dtype), window_strides=s, padding=pad,
+                rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups,
+            )
+        else:
+            # ConvTranspose: torch weight is (Cin, Cout/g, *k). Express as a
+            # regular conv with lhs_dilation=stride on a spatially-flipped,
+            # (I,O)-swapped kernel; torch's output size contract
+            # (in-1)*s - 2p + d*(k-1) + output_padding + 1 fixes the padding.
+            p = _spatial(padding if not isinstance(padding, str) else 0, nd)
+            op = _spatial(output_padding, nd)
+            k = w.shape[2:]
+            cin, cout_g = w.shape[0], w.shape[1]
+            wg = w.reshape((groups, cin // groups, cout_g) + k)
+            wg = jnp.swapaxes(wg, 1, 2)  # (g, Cout/g, Cin/g, *k)
+            wg = wg.reshape((groups * cout_g, cin // groups) + k)
+            wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+            pad = [
+                (d[i] * (k[i] - 1) - p[i], d[i] * (k[i] - 1) - p[i] + op[i])
+                for i in range(nd)
+            ]
+            dn = lax.conv_dimension_numbers(
+                x.shape, wg.shape, ("NC" + letters, "OI" + letters, "NC" + letters)
+            )
+            out = lax.conv_general_dilated(
+                x, wg.astype(x.dtype), window_strides=(1,) * nd, padding=pad,
+                lhs_dilation=s, rhs_dilation=d, dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+        if bias is not None:
+            out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+        return out
+
+    reg("aten.convolution.default", _convolution)
+    reg(
+        ["aten.conv1d.default", "aten.conv2d.default", "aten.conv3d.default"],
+        lambda ctx, x, w, bias=None, stride=1, padding=0, dilation=1, groups=1:
+            _convolution(ctx, x, w, bias, stride, padding, dilation, False, 0, groups),
+    )
+    reg(
+        ["aten.conv_transpose1d.default", "aten.conv_transpose2d.input",
+         "aten.conv_transpose3d.input"],
+        lambda ctx, x, w, bias=None, stride=1, padding=0, output_padding=0,
+               groups=1, dilation=1:
+            _convolution(ctx, x, w, bias, stride, padding, dilation, True,
+                         output_padding, groups),
+    )
+
+    def _bn_stats(x):
+        axes = (0,) + tuple(range(2, x.ndim))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)  # biased, as torch normalizes with
+        return mean, var
+
+    def _bn_apply(x, mean, var, weight, bias, eps):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        inv = lax.rsqrt(var.astype(jnp.float32) + eps).reshape(shape)
+        out = (x.astype(jnp.float32) - mean.astype(jnp.float32).reshape(shape)) * inv
+        if weight is not None:
+            out = out * weight.astype(jnp.float32).reshape(shape)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32).reshape(shape)
+        return out.astype(x.dtype)
+
+    def _batch_norm(ctx, x, weight=None, bias=None, running_mean=None,
+                    running_var=None, training=False, momentum=0.1, eps=1e-5,
+                    cudnn_enabled=True):
+        if training or running_mean is None:
+            mean, var = _bn_stats(x)
+        else:
+            mean, var = running_mean, running_var
+        return _bn_apply(x, mean, var, weight, bias, eps)
+
+    reg("aten.batch_norm.default", _batch_norm)
+
+    def _bn_legit_functional(ctx, x, weight, bias, running_mean, running_var,
+                             training, momentum, eps):
+        # functionalized train-mode BN: returns new running stats as extra
+        # outputs (the BUFFER_MUTATION channel threads them back to the user)
+        if training:
+            mean, var = _bn_stats(x)
+            n = x.size // x.shape[1]
+            unbiased = var * (n / max(n - 1, 1))  # torch tracks UNBIASED var
+            new_mean = (1 - momentum) * running_mean.astype(jnp.float32) + momentum * mean
+            new_var = (1 - momentum) * running_var.astype(jnp.float32) + momentum * unbiased
+        else:
+            mean, var = running_mean, running_var
+            new_mean, new_var = running_mean, running_var
+        out = _bn_apply(x, mean, var, weight, bias, eps)
+        save_rstd = lax.rsqrt(var.astype(jnp.float32) + eps)
+        return (out, mean.astype(jnp.float32), save_rstd,
+                new_mean.astype(running_mean.dtype), new_var.astype(running_var.dtype))
+
+    reg("aten._native_batch_norm_legit_functional.default", _bn_legit_functional)
+    reg(
+        "aten._native_batch_norm_legit_no_training.default",
+        lambda ctx, x, weight, bias, running_mean, running_var, momentum, eps: (
+            _bn_apply(x, running_mean, running_var, weight, bias, eps),
+            jnp.zeros((0,), jnp.float32),
+            jnp.zeros((0,), jnp.float32),
+        ),
+    )
+
+    def _pool_dims(in_sz, k, s, p, d, ceil_mode):
+        """Per-dim (out, lo_pad, hi_pad, keep) following torch's pooling shape
+        contract: ceil-mode windows must START within input+lo padding."""
+        eff_k = d * (k - 1) + 1
+        if ceil_mode:
+            out = -(-(in_sz + 2 * p - eff_k) // s) + 1
+            if (out - 1) * s >= in_sz + p:
+                out -= 1
+        else:
+            out = (in_sz + 2 * p - eff_k) // s + 1
+        needed = (out - 1) * s + eff_k - p  # input cols the windows touch
+        keep = min(in_sz, needed)  # floor mode may leave a dead tail: slice it
+        hi = needed - keep
+        return out, p, hi, keep
+
+    def _reduce_pool(x, init, op, k, s, pads, d):
+        nd = len(k)
+        return lax.reduce_window(
+            x, init, op,
+            window_dimensions=(1, 1) + k,
+            window_strides=(1, 1) + s,
+            padding=((0, 0), (0, 0)) + pads,
+            window_dilation=(1, 1) + d,
+        )
+
+    def _max_pool(ctx, x, kernel_size, stride=None, padding=0, dilation=1,
+                  ceil_mode=False):
+        nd = x.ndim - 2
+        k = _spatial(kernel_size, nd)
+        s = _spatial(stride, nd) if stride not in (None, []) else k
+        p = _spatial(padding, nd)
+        d = _spatial(dilation, nd)
+        dims = [
+            _pool_dims(x.shape[2 + i], k[i], s[i], p[i], d[i], bool(ceil_mode))
+            for i in range(nd)
+        ]
+        x = x[(slice(None), slice(None)) + tuple(slice(0, dm[3]) for dm in dims)]
+        pads = tuple((dm[1], dm[2]) for dm in dims)
+        # init must be a CONCRETE scalar — a traced init breaks reduce_window's
+        # autodiff linearization
+        neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+               else int(jnp.iinfo(x.dtype).min))
+        return _reduce_pool(x, neg, lax.max, k, s, pads, d)
+
+    reg(["aten.max_pool1d.default", "aten.max_pool2d.default",
+         "aten.max_pool3d.default"], _max_pool)
+
+    def _avg_pool(ctx, x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                  count_include_pad=True, divisor_override=None):
+        nd = x.ndim - 2
+        k = _spatial(kernel_size, nd)
+        s = _spatial(stride, nd) if stride not in (None, []) else k
+        p = _spatial(padding, nd)
+        d = (1,) * nd
+        dims = [
+            _pool_dims(x.shape[2 + i], k[i], s[i], p[i], 1, bool(ceil_mode))
+            for i in range(nd)
+        ]
+        x = x[(slice(None), slice(None)) + tuple(slice(0, dm[3]) for dm in dims)]
+        pads = tuple((dm[1], dm[2]) for dm in dims)
+        total = _reduce_pool(x.astype(jnp.float32), 0.0, lax.add, k, s, pads, d)
+        if divisor_override:
+            div = jnp.asarray(float(divisor_override), jnp.float32)
+        else:
+            # divisor = window overlap with the COUNTED region: the real input
+            # plus (when count_include_pad) the symmetric padding — never the
+            # ceil-mode tail beyond it (torch semantics)
+            spatial = tuple(dm[3] for dm in dims)
+            if count_include_pad:
+                ones = jnp.ones((1, 1) + tuple(sz + 2 * pp for sz, pp in zip(spatial, p)),
+                                jnp.float32)
+                cpads = tuple((0, max(dm[2] - dm[1], 0)) for dm in dims)
+            else:
+                ones = jnp.ones((1, 1) + spatial, jnp.float32)
+                cpads = pads
+            div = _reduce_pool(ones, 0.0, lax.add, k, s, cpads, d)
+        return (total / div).astype(x.dtype)
+
+    reg(["aten.avg_pool1d.default", "aten.avg_pool2d.default",
+         "aten.avg_pool3d.default"], _avg_pool)
+
+    def _adaptive_avg_pool(ctx, x, output_size):
+        nd = x.ndim - 2
+        out_sz = _spatial(output_size, nd)
+        for i in range(nd):
+            axis = 2 + i
+            in_sz = x.shape[axis]
+            o = out_sz[i] if out_sz[i] is not None else in_sz
+            if o == in_sz:
+                continue
+            if in_sz % o == 0:
+                r = in_sz // o
+                shape = x.shape[:axis] + (o, r) + x.shape[axis + 1 :]
+                x = x.reshape(shape).mean(axis=axis + 1)
+            else:
+                # torch windows: [floor(j*in/o), ceil((j+1)*in/o)) — separable,
+                # one static slice per output position
+                pieces = []
+                for j in range(o):
+                    lo = (j * in_sz) // o
+                    hi = -(-((j + 1) * in_sz) // o)
+                    sl = (slice(None),) * axis + (slice(lo, hi),)
+                    pieces.append(x[sl].mean(axis=axis, keepdims=True))
+                x = jnp.concatenate(pieces, axis=axis)
+        return x
+
+    reg(["aten.adaptive_avg_pool1d.default", "aten.adaptive_avg_pool2d.default",
+         "aten.adaptive_avg_pool3d.default"], _adaptive_avg_pool)
+
+    def _resize_sizes(x, output_size, scale_factors):
+        nd = x.ndim - 2
+        if output_size not in (None, []):
+            return tuple(int(v) for v in output_size)
+        sf = scale_factors if isinstance(scale_factors, (list, tuple)) else [scale_factors] * nd
+        return tuple(int(math.floor(x.shape[2 + i] * float(sf[i]))) for i in range(nd))
+
+    def _upsample_nearest(ctx, x, output_size=None, scale_factors=None, exact=False):
+        sizes = _resize_sizes(x, output_size, scale_factors)
+        for i, o in enumerate(sizes):
+            axis = 2 + i
+            in_sz = x.shape[axis]
+            if o == in_sz:
+                continue
+            scale = in_sz / o
+            if exact:
+                idx = jnp.floor((jnp.arange(o) + 0.5) * scale).astype(jnp.int32)
+            else:
+                idx = jnp.floor(jnp.arange(o) * scale).astype(jnp.int32)
+            x = jnp.take(x, jnp.clip(idx, 0, in_sz - 1), axis=axis)
+        return x
+
+    reg(["aten.upsample_nearest1d.vec", "aten.upsample_nearest2d.vec",
+         "aten.upsample_nearest3d.vec"],
+        lambda ctx, x, output_size=None, scale_factors=None:
+            _upsample_nearest(ctx, x, output_size, scale_factors, exact=False))
+    reg(["aten._upsample_nearest_exact1d.vec", "aten._upsample_nearest_exact2d.vec",
+         "aten._upsample_nearest_exact3d.vec"],
+        lambda ctx, x, output_size=None, scale_factors=None:
+            _upsample_nearest(ctx, x, output_size, scale_factors, exact=True))
+
+    def _interp_linear_dim(x, axis, o, align_corners):
+        in_sz = x.shape[axis]
+        if o == in_sz:
+            return x
+        if align_corners:
+            # o == 1: torch clamps the scale to 0 and samples index 0
+            scale = (in_sz - 1) / (o - 1) if o > 1 else 0.0
+            src = jnp.arange(o, dtype=jnp.float32) * scale
+        else:
+            src = jnp.clip((jnp.arange(o, dtype=jnp.float32) + 0.5) * (in_sz / o) - 0.5,
+                           0.0, in_sz - 1)
+        lo = jnp.floor(src).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_sz - 1)
+        w = (src - lo).astype(jnp.float32)
+        bshape = [1] * x.ndim
+        bshape[axis] = o
+        w = w.reshape(bshape)
+        xf = x.astype(jnp.float32)
+        return (jnp.take(xf, lo, axis=axis) * (1 - w)
+                + jnp.take(xf, hi, axis=axis) * w).astype(x.dtype)
+
+    def _upsample_linear(ctx, x, output_size=None, align_corners=False, scale_factors=None):
+        sizes = _resize_sizes(x, output_size, scale_factors)
+        for i, o in enumerate(sizes):
+            x = _interp_linear_dim(x, 2 + i, o, bool(align_corners))
+        return x
+
+    reg(["aten.upsample_linear1d.vec", "aten.upsample_bilinear2d.vec",
+         "aten.upsample_trilinear3d.vec"], _upsample_linear)
+
     # -- functionalized mutation ops -------------------------------------------
     # In-place ops (aten.add_, aten.copy_ on slice VIEWS, ...) cannot be
     # interpreted per-node — a copy_ writing through a view mutates its BASE
@@ -429,14 +735,21 @@ def _graph_mutates(graph_module) -> bool:
     return False
 
 
-def lower_module_aten(model, example_inputs: dict):
+def lower_module_aten(model, example_inputs: dict, train_mode: bool = False):
     """Lower ``model`` via ``torch.export`` → ``(fn, params, buffers)``.
 
     ``example_inputs``: dict of example kwargs (numpy or torch tensors) fixing
     the traced shapes. Returned ``fn(params, buffers, inputs, train=False,
     rng=None)`` is pure/jittable; params/buffers are flat dot-path dicts of
     jax arrays (DLPack-shared with the module, same contract as
-    ``fx_lowering.lower_module``)."""
+    ``fx_lowering.lower_module``).
+
+    ``train_mode=True`` exports the TRAIN-mode graph: batch-norm normalizes by
+    batch statistics and dropout ops appear (driven by ``fn``'s ``train``/
+    ``rng`` args). Mutated buffers (BN running stats) come back through
+    ``fn(..., with_buffer_updates=True)`` → ``(out, {buffer_name: new_value})``;
+    the mutated names are listed on ``fn.mutated_buffers``.
+    """
     import numpy as np
     import torch
 
@@ -447,7 +760,7 @@ def lower_module_aten(model, example_inputs: dict):
         for k, v in example_inputs.items()
     }
     was_training = model.training
-    model.eval()
+    model.train(train_mode)
     prior_use_cache = None
     if getattr(model, "config", None) is not None and getattr(model.config, "use_cache", None):
         prior_use_cache = model.config.use_cache
@@ -517,7 +830,14 @@ def lower_module_aten(model, example_inputs: dict):
     # the subgraph operand are config scalars to drop
     _HOP_SKIP = {"wrap_with_set_grad_enabled": 1, "wrap_with_autocast": 4}
 
-    def fn(params, buffers, inputs, train: bool = False, rng=None):
+    mutated_buffer_names = [
+        buffer_alias.get(s.target, s.target)
+        for s in sig.output_specs
+        if s.kind.name == "BUFFER_MUTATION"
+    ]
+
+    def fn(params, buffers, inputs, train: bool = False, rng=None,
+           with_buffer_updates: bool = False):
         import jax.numpy as jnp
 
         ctx = _Ctx(train, rng)
@@ -582,27 +902,35 @@ def lower_module_aten(model, example_inputs: dict):
             raise LoweringError("graph had no output node")
 
         mapped = run_graph(root_gm)
-        # root output order matches output_specs; keep only user outputs
-        # (mutated buffers etc. are dropped)
+        # root output order matches output_specs; split user outputs from
+        # buffer mutations (BN running stats — returned when asked for)
+        buf_updates: dict = {}
         if len(mapped) == len(sig.output_specs):
-            flat_out = [
-                v for v, s in zip(mapped, sig.output_specs)
-                if s.kind.name == "USER_OUTPUT"
-            ]
+            flat_out = []
+            for v, s in zip(mapped, sig.output_specs):
+                if s.kind.name == "USER_OUTPUT":
+                    flat_out.append(v)
+                elif s.kind.name == "BUFFER_MUTATION":
+                    buf_updates[buffer_alias.get(s.target, s.target)] = v
         else:
             flat_out = mapped
+
+        def _finish(result):
+            return (result, buf_updates) if with_buffer_updates else result
+
         if out_spec is not None:
             try:
                 import torch.utils._pytree as torch_pytree
 
                 rebuilt = torch_pytree.tree_unflatten(flat_out, out_spec)
                 if hasattr(rebuilt, "items"):
-                    return {k: v for k, v in rebuilt.items() if v is not None}
-                return rebuilt
+                    return _finish({k: v for k, v in rebuilt.items() if v is not None})
+                return _finish(rebuilt)
             except Exception:
                 pass
         if len(flat_out) == 1:
-            return flat_out[0]
-        return tuple(flat_out)
+            return _finish(flat_out[0])
+        return _finish(tuple(flat_out))
 
+    fn.mutated_buffers = mutated_buffer_names
     return fn, params, buffers

@@ -58,6 +58,7 @@ class BridgedModule:
         self._aten_cache: dict = {}  # shapes-signature → lowered fn
         self._fx_failed = False  # fx trace known-unsupported: go straight to export
         self._train_step = None
+        self._train_fwd = None
         self._eval_step = None
         self._pending_grads = None
         self._pending_loss = None
@@ -109,15 +110,21 @@ class BridgedModule:
         return self.torch_module
 
     # -- lowering / compilation ---------------------------------------------
-    def _ensure_lowered(self, input_names, example_batch=None):
+    def _shape_key(self, example_batch):
+        """ATen-cache key: batch shapes + train/eval mode (the export bakes
+        mode-dependent semantics — train-mode BN normalizes by batch stats)."""
         import numpy as np
 
-        key = tuple(sorted(input_names))
-        shapes = (
-            tuple((k, tuple(np.shape(example_batch[k]))) for k in sorted(example_batch))
-            if example_batch is not None
-            else None
+        if example_batch is None:
+            return None
+        return (
+            bool(self.training),
+            tuple((k, tuple(np.shape(example_batch[k]))) for k in sorted(example_batch)),
         )
+
+    def _ensure_lowered(self, input_names, example_batch=None):
+        key = tuple(sorted(input_names))
+        shapes = self._shape_key(example_batch)
         if self._fn is not None and self._input_names == key and (
             self._aten_shapes is None or self._aten_shapes == shapes
         ):
@@ -155,7 +162,9 @@ class BridgedModule:
         if fn is None:
             from .aten_lowering import lower_module_aten
 
-            fn, _, _ = lower_module_aten(self.torch_module, example_batch)
+            fn, _, _ = lower_module_aten(
+                self.torch_module, example_batch, train_mode=bool(self.training)
+            )
             self._aten_cache[shapes] = fn
         self._aten_shapes = shapes
         return fn
@@ -171,38 +180,65 @@ class BridgedModule:
         import jax
 
         fn = self._fn
-        buffers = self.buffers
         policy = self._policy()
+        # export-path fns report mutated buffers (BN running stats); thread
+        # them out of the jitted step so self.buffers stays live across steps
+        has_buffer_updates = bool(getattr(fn, "mutated_buffers", None))
+        mutated = frozenset(getattr(fn, "mutated_buffers", ()) or ())
 
-        def train_loss(params, batch, rng):
-            out = fn(
-                policy.cast_to_compute(params),
-                policy.cast_to_compute(buffers),
-                policy.cast_to_compute(batch),
-                train=True,
-                rng=rng,
+        def cast_buffers(buffers):
+            # mutated buffers (running statistics) stay at storage precision:
+            # a bf16 compute policy must not quantize the momentum blend —
+            # torch keeps BN stats fp32 under autocast too
+            cast = policy.cast_to_compute(
+                {k: v for k, v in buffers.items() if k not in mutated}
             )
-            loss = out["loss"] if isinstance(out, dict) else out[0]
+            return {**cast, **{k: buffers[k] for k in mutated if k in buffers}}
+
+        def train_loss(params, buffers, batch, rng):
             import jax.numpy as jnp
 
-            return loss.astype(jnp.float32), out
+            cast = (
+                policy.cast_to_compute(params),
+                cast_buffers(buffers),
+                policy.cast_to_compute(batch),
+            )
+            if has_buffer_updates:
+                out, buf_updates = fn(*cast, train=True, rng=rng, with_buffer_updates=True)
+            else:
+                out, buf_updates = fn(*cast, train=True, rng=rng), {}
+            loss = out["loss"] if isinstance(out, dict) else out[0]
+            return loss.astype(jnp.float32), (out, buf_updates)
 
         grad_fn = jax.value_and_grad(train_loss, has_aux=True)
 
-        def train_step(params, batch, rng):
-            (loss, out), grads = grad_fn(params, batch, rng)
-            return loss, out, grads
+        def train_step(params, buffers, batch, rng):
+            (loss, (out, buf_updates)), grads = grad_fn(params, buffers, batch, rng)
+            return loss, out, grads, buf_updates
 
-        def eval_step(params, batch):
+        def train_forward(params, buffers, batch, rng):
+            # train-mode forward WITHOUT loss (no labels): torch still updates
+            # BN running stats on such a call — so must we
+            cast = (
+                policy.cast_to_compute(params),
+                cast_buffers(buffers),
+                policy.cast_to_compute(batch),
+            )
+            if has_buffer_updates:
+                return fn(*cast, train=True, rng=rng, with_buffer_updates=True)
+            return fn(*cast, train=True, rng=rng), {}
+
+        def eval_step(params, buffers, batch):
             return fn(
                 policy.cast_to_compute(params),
-                policy.cast_to_compute(buffers),
+                cast_buffers(buffers),
                 policy.cast_to_compute(batch),
                 train=False,
                 rng=None,
             )
 
         self._train_step = jax.jit(train_step)
+        self._train_fwd = jax.jit(train_forward)
         self._eval_step = jax.jit(eval_step)
 
     # -- the call ------------------------------------------------------------
@@ -222,13 +258,26 @@ class BridgedModule:
             # LoweringError retry below cannot leave stale grads/rng behind
             if self.training and "labels" in batch:
                 rng = jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), self._call_count)
-                loss, out, grads = self._train_step(self.params, batch, rng)
+                loss, out, grads, buf_updates = self._train_step(
+                    self.params, self.buffers, batch, rng
+                )
                 out = dict(out) if isinstance(out, dict) else {"loss": loss, "logits": out[1]}
                 out["loss"] = loss
                 self._call_count += 1
                 self._pending_grads = grads
+                self._apply_buffer_updates(buf_updates)
                 return out
-            out = self._eval_step(self.params, batch)
+            if self.training:
+                # train-mode logits probe (no labels): running stats update,
+                # no grads
+                rng = jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), self._call_count)
+                out, buf_updates = self._train_fwd(self.params, self.buffers, batch, rng)
+                self._call_count += 1
+                self._apply_buffer_updates(buf_updates)
+                if not isinstance(out, dict):
+                    out = {"logits": out if not isinstance(out, (tuple, list)) else out[0]}
+                return out
+            out = self._eval_step(self.params, self.buffers, batch)
             if not isinstance(out, dict):
                 out = {"logits": out if not isinstance(out, (tuple, list)) else out[0]}
             return out
@@ -244,16 +293,25 @@ class BridgedModule:
             # mistakes) propagate unmasked.
             if self._aten_shapes is not None:
                 raise
-            import numpy as _np
-
             self._fx_failed = True
-            shapes = tuple((k, tuple(_np.shape(raw_batch[k]))) for k in sorted(raw_batch))
-            self._fn = self._lower_aten(raw_batch, shapes)
+            self._fn = self._lower_aten(raw_batch, self._shape_key(raw_batch))
             self._train_step = None
             self._eval_step = None
             self._build_steps()
             out = _run()
         return BridgedOutput({k: _TensorView.wrap(v) for k, v in out.items()})
+
+    def _apply_buffer_updates(self, buf_updates):
+        if not buf_updates:
+            return
+        self.buffers = {
+            **self.buffers,
+            **{
+                k: v.astype(self.buffers[k].dtype)
+                for k, v in buf_updates.items()
+                if k in self.buffers
+            },
+        }
 
     def pop_pending_grads(self):
         grads, self._pending_grads = self._pending_grads, None
