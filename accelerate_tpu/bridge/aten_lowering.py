@@ -580,7 +580,7 @@ def _aten_handlers() -> dict[str, Callable]:
         for i in range(nd):
             axis = 2 + i
             in_sz = x.shape[axis]
-            o = out_sz[i] if out_sz[i] is not None else in_sz
+            o = out_sz[i]
             if o == in_sz:
                 continue
             if in_sz % o == 0:

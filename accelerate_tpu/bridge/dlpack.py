@@ -70,13 +70,20 @@ def module_params_to_jax(module) -> tuple[dict[str, Any], dict[str, Any]]:
     return params, buffers
 
 
-def write_back_to_module(module, params: dict[str, Any]) -> None:
-    """Copy (possibly sharded) jax params back into the torch module in-place —
-    used before torch-side save/export (reference ``get_state_dict:3947``)."""
+def write_back_to_module(module, params: dict[str, Any], buffers: dict[str, Any] | None = None) -> None:
+    """Copy (possibly sharded) jax params — and live buffers such as BN running
+    stats — back into the torch module in-place, used before torch-side
+    save/export (reference ``get_state_dict:3947``)."""
     import torch
 
     torch_params = dict(module.named_parameters())
+    torch_buffers = dict(module.named_buffers())
     with torch.no_grad():
         for name, value in params.items():
             if name in torch_params:
                 torch_params[name].copy_(jax_to_torch(value).to(torch_params[name].dtype))
+        for name, value in (buffers or {}).items():
+            if name in torch_buffers:
+                target = torch_buffers[name]
+                t = jax_to_torch(value).to(target.dtype).reshape(target.shape)
+                target.copy_(t)

@@ -102,11 +102,12 @@ class BridgedModule:
                 )
 
     def sync_to_torch(self):
-        """Copy live jax params back into the wrapped ``nn.Module`` (for
-        torch-side save/export — reference ``get_state_dict:3947``)."""
+        """Copy live jax params AND buffers (BN running stats update during
+        training) back into the wrapped ``nn.Module`` (for torch-side
+        save/export — reference ``get_state_dict:3947``)."""
         from .dlpack import write_back_to_module
 
-        write_back_to_module(self.torch_module, self.params)
+        write_back_to_module(self.torch_module, self.params, self.buffers)
         return self.torch_module
 
     # -- lowering / compilation ---------------------------------------------

@@ -641,6 +641,13 @@ class DataLoaderShard:
                             global_bs = self._global_batch_size(current)
                             if global_bs:
                                 self.remainder = self.total_dataset_length % global_bs
+                        else:
+                            # unknown length (iterable source): the dispatcher
+                            # header carried the final batch's REAL row count
+                            real = getattr(self, "_last_data_real_bs", None)
+                            full = getattr(self, "_last_data_global_bs", None)
+                            if real is not None and full and real < full:
+                                self.remainder = real
                     self._batches_seen = n + 1
                     yield self._process(current)
                 current = nxt
@@ -666,18 +673,78 @@ class DataLoaderShard:
 class DataLoaderDispatcher(DataLoaderShard):
     """ONLY process 0 reads the base loader; the rest receive batches over the
     wire (reference ``DataLoaderDispatcher data_loader.py:704`` —
-    ``_fetch_batches:786`` rank-0 ``next()`` + ``broadcast_object_list``).
+    ``_fetch_batches:786`` rank-0 ``next()`` + tensor ``broadcast:876``).
 
     This is the documented contract for sources only rank 0 can read (a local
     file, a DB cursor): non-main processes never touch ``base_dataloader`` —
     neither its dataset nor its sampler — and readable sources pay 1× IO
     instead of N×. Under a single process this degenerates to
-    :class:`DataLoaderShard`."""
+    :class:`DataLoaderShard`.
+
+    Wire protocol (the tensor fast-path — no per-batch pickling): the FIRST
+    batch of each distinct structure goes over the object channel and every
+    rank derives a *signature* (treedef + shapes + dtypes + batch size) from
+    it; subsequent batches ship as a 3-int header broadcast plus ONE raw-bytes
+    array broadcast of known size. An uneven final batch is padded up to the
+    signature's batch size by repeating final rows (reference
+    ``pad_input_tensors utils/operations.py:687``) so broadcast shapes stay
+    static and the global batch still divides across dp rows; the header
+    carries the REAL size so ``remainder``/``gather_for_metrics`` drop the
+    padded duplicates.
+    """
+
+    _H_END, _H_DATA, _H_NEW_SIG, _H_OBJECT = 0, 1, 2, 3
 
     def _iter_base(self):
         # non-main processes NEVER iterate the base loader
         state = PartialState()
         return iter(self.base_dataloader) if state.is_main_process else iter(())
+
+    # -- signature registry (identical on every rank by construction) ---------
+    def _ensure_sig_state(self):
+        if not hasattr(self, "_sigs"):
+            self._sigs = []  # sig_id -> dict(treedef, leaves, bs, nbytes)
+            self._sig_keys = {}  # rank-0 only: structure key -> sig_id
+            self._last_data_real_bs = None
+            self._last_data_global_bs = None
+
+    @staticmethod
+    def _leaf_meta(leaf, bs):
+        batched = leaf.ndim > 0 and leaf.shape[:1] == (bs,)
+        return (leaf.shape[1:] if batched else leaf.shape, leaf.dtype.str, batched)
+
+    def _register_sig(self, batch):
+        """Derive + store the signature from a full batch; every rank does this
+        on the same (object-channel) batch, so sig ids agree everywhere."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        leaves = [np.asarray(x) for x in leaves]
+        bs = find_batch_size(batch) or 0
+        metas = [self._leaf_meta(x, bs) for x in leaves]
+        shapes = [((bs,) + m[0] if m[2] else m[0]) for m in metas]
+        dtypes = [np.dtype(m[1]) for m in metas]
+        sizes = [int(np.prod(s, dtype=np.int64)) * d.itemsize for s, d in zip(shapes, dtypes)]
+        offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        sig = {
+            "treedef": treedef,
+            "shapes": shapes,
+            "dtypes": dtypes,
+            "offsets": offsets,
+            "nbytes": int(offsets[-1]),
+            "bs": bs,
+        }
+        self._sigs.append(sig)
+        sig_id = len(self._sigs) - 1
+        self._sig_keys[(treedef, tuple(metas))] = sig_id
+        return sig_id
+
+    @staticmethod
+    def _pad_rows(leaf, real_bs: int, target_bs: int):
+        if leaf.ndim == 0 or leaf.shape[0] != real_bs or real_bs == target_bs:
+            return leaf
+        reps = np.repeat(leaf[-1:], target_bs - real_bs, axis=0)
+        return np.concatenate([leaf, reps], axis=0)
 
     def _fetch_batch(self, base_iter):
         """Main process ``next()``s the base loader; every process returns the
@@ -686,15 +753,89 @@ class DataLoaderDispatcher(DataLoaderShard):
         if state.num_processes == 1:
             batch = next(base_iter, _NO_BATCH)
             return batch if batch is _NO_BATCH else _to_numpy_batch(batch)
-        from .utils.operations import broadcast_object_list  # pragma: no cover - multihost only
+        # pragma: no cover start - multihost only (exercised by the real
+        # multi-process suite, tests/test_multiprocess.py)
+        import jax
+        from jax.experimental import multihost_utils
 
-        if state.is_main_process:
+        from .utils.operations import broadcast_object_list
+
+        self._ensure_sig_state()
+        is_main = state.is_main_process
+
+        def bcast_header(vals):
+            arr = np.asarray(vals, np.int64)
+            return multihost_utils.broadcast_one_to_all(arr, is_source=is_main)
+
+        if is_main:
             batch = next(base_iter, _NO_BATCH)
-            payload = [None if batch is _NO_BATCH else _to_numpy_batch(batch)]
-        else:
-            payload = [None]
-        batch = broadcast_object_list(payload)[0]
-        return _NO_BATCH if batch is None else batch
+            if batch is _NO_BATCH:
+                bcast_header([self._H_END, 0, 0])
+                return _NO_BATCH
+            batch = _to_numpy_batch(batch)
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            leaves = [np.asarray(x) for x in leaves]
+            real_bs = find_batch_size(batch) or 0
+            if any(x.dtype.hasobject for x in leaves):
+                # object-dtype leaves (strings, ragged lists) have no raw-bytes
+                # form: keep the whole structure on the object channel
+                bcast_header([self._H_OBJECT, 0, real_bs])
+                broadcast_object_list([batch])
+                self._last_data_real_bs = real_bs
+                self._last_data_global_bs = real_bs
+                return batch
+            key = (treedef, tuple(self._leaf_meta(x, real_bs) for x in leaves))
+            sig_id = self._sig_keys.get(key)
+            if sig_id is None or real_bs > self._sigs[sig_id]["bs"]:
+                # first sighting of this structure: object channel, then every
+                # rank derives the signature from the same batch
+                bcast_header([self._H_NEW_SIG, 0, real_bs])
+                broadcast_object_list([batch])
+                self._register_sig(batch)
+                self._last_data_real_bs = real_bs
+                self._last_data_global_bs = real_bs
+                return batch
+            sig = self._sigs[sig_id]
+            if real_bs < sig["bs"]:  # ragged final batch: pad rows
+                leaves = [self._pad_rows(x, real_bs, sig["bs"]) for x in leaves]
+            bcast_header([self._H_DATA, sig_id, real_bs])
+            buf = np.frombuffer(
+                b"".join(np.ascontiguousarray(x).tobytes() for x in leaves), np.uint8
+            )
+            multihost_utils.broadcast_one_to_all(buf, is_source=True)
+            self._last_data_real_bs = real_bs
+            self._last_data_global_bs = sig["bs"]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        kind, sig_id, real_bs = (int(v) for v in bcast_header([0, 0, 0]))
+        if kind == self._H_END:
+            return _NO_BATCH
+        if kind in (self._H_NEW_SIG, self._H_OBJECT):
+            batch = broadcast_object_list([None])[0]
+            if kind == self._H_NEW_SIG:
+                self._register_sig(batch)
+            self._last_data_real_bs = real_bs
+            self._last_data_global_bs = find_batch_size(batch) or 0
+            return batch
+        sig = self._sigs[sig_id]
+        buf = multihost_utils.broadcast_one_to_all(
+            np.zeros(sig["nbytes"], np.uint8), is_source=False
+        )
+        # ONE host copy of the payload; per-leaf views via frombuffer offsets
+        payload = np.asarray(buf).tobytes()
+        leaves = [
+            np.frombuffer(
+                payload,
+                dtype=sig["dtypes"][i],
+                count=int(np.prod(sig["shapes"][i], dtype=np.int64)),
+                offset=int(sig["offsets"][i]),
+            ).reshape(sig["shapes"][i])
+            for i in range(len(sig["shapes"]))
+        ]
+        self._last_data_real_bs = real_bs
+        self._last_data_global_bs = sig["bs"]
+        return jax.tree_util.tree_unflatten(sig["treedef"], leaves)
+        # pragma: no cover end
 
     def _global_batch_size(self, batch) -> int:
         return find_batch_size(batch) or 0  # dispatch batches are global already

@@ -172,6 +172,92 @@ def check_dispatcher(accelerator):
     accelerator.wait_for_everyone()
 
 
+def check_dispatcher_ragged(accelerator):
+    """Tensor fast-path + uneven final batch (VERDICT r03 item 5): after the
+    first (signature-establishing) batch, payloads go over the raw-array
+    channel — broadcast_object_list must NOT be called per batch — and the
+    ragged final global batch is padded on the wire but trimmed by
+    ``gather_for_metrics`` so every sample appears exactly once."""
+    import numpy as np
+
+    import accelerate_tpu.utils.operations as ops
+    from accelerate_tpu import DataLoader
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    n_rows = 10  # global bs 4 -> batches of 4, 4, then a ragged 2
+    global_bs = 4  # dispatch mode: the base loader reads GLOBAL batches
+    me = accelerator.process_index
+
+    class RankZeroOnlyDS:
+        def __len__(self):
+            return n_rows
+
+        def __getitem__(self, i):
+            if me != 0:
+                raise RuntimeError(f"dataset read on non-main rank {me}")
+            return {"x": np.full((4,), float(i), dtype=np.float32), "idx": np.int32(i)}
+
+    object_casts = {"n": 0}
+    real_bcast = ops.broadcast_object_list
+
+    def counting_bcast(object_list, from_process=0):
+        object_casts["n"] += 1
+        return real_bcast(object_list, from_process)
+
+    ops.broadcast_object_list = counting_bcast
+    try:
+        dl = DataLoader(RankZeroOnlyDS(), batch_size=global_bs, drop_last=False)
+        prepared = prepare_data_loader(
+            dl,
+            state=accelerator.state,
+            mesh=accelerator.mesh,
+            parallelism_config=accelerator.parallelism_config,
+            dispatch_batches=True,
+        )
+        seen = []
+        n_batches = 0
+        for batch in prepared:
+            n_batches += 1
+            g = accelerator.gather_for_metrics({"idx": batch["idx"]})
+            seen.extend(np.asarray(g["idx"]).reshape(-1).tolist())
+    finally:
+        ops.broadcast_object_list = real_bcast
+    assert n_batches == 3, n_batches
+    # padded duplicates trimmed: exact cover, each row exactly once
+    assert sorted(seen) == list(range(n_rows)), sorted(seen)
+    if accelerator.num_processes > 1:
+        # one object broadcast to establish the signature; the 2 remaining
+        # batches (incl. the padded ragged one) ride the array fast-path
+        assert object_casts["n"] == 1, object_casts["n"]
+
+    # object-dtype leaves (strings) cannot ride the raw-bytes channel: the
+    # dispatcher must keep them on the object channel, not crash mid-protocol
+    class StringDS:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if me != 0:
+                raise RuntimeError(f"dataset read on non-main rank {me}")
+            return {"text": f"doc-{i}", "idx": np.int32(i)}
+
+    dl2 = DataLoader(StringDS(), batch_size=2)
+    prepared2 = prepare_data_loader(
+        dl2,
+        state=accelerator.state,
+        mesh=accelerator.mesh,
+        parallelism_config=accelerator.parallelism_config,
+        dispatch_batches=True,
+        device_placement=False,  # object leaves cannot be device-placed
+    )
+    texts = []
+    for batch in prepared2:
+        assert len(batch["text"]) == 2
+        texts.extend(str(t) for t in np.asarray(batch["text"]).tolist())
+    assert sorted(texts) == [f"doc-{i}" for i in range(4)], texts
+    accelerator.wait_for_everyone()
+
+
 def check_training(accelerator, tmpdir: str):
     """DP training across processes; writes the loss trajectory so the harness
     can diff process counts (parity = the reference's training_check)."""
@@ -468,7 +554,8 @@ def main():
     accelerator = Accelerator(mixed_precision="no", rng_seed=0)
 
     scenarios = args.scenario.split(",") if args.scenario != "all" else [
-        "topology", "ops", "local_sgd", "dataloader", "dispatcher", "training",
+        "topology", "ops", "local_sgd", "dataloader", "dispatcher",
+        "dispatcher_ragged", "training",
         "checkpoint", "sharded_checkpoint", "generate", "zigzag",
     ]
     params = opt_state = None
@@ -483,6 +570,8 @@ def main():
             check_dataloader(accelerator, dispatch=False)
         elif scenario == "dispatcher":
             check_dispatcher(accelerator)
+        elif scenario == "dispatcher_ragged":
+            check_dispatcher_ragged(accelerator)
         elif scenario == "training":
             params, opt_state = check_training(accelerator, args.tmpdir)
         elif scenario == "checkpoint":

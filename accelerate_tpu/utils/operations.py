@@ -127,6 +127,9 @@ def send_to_device(tree, device=None, non_blocking: bool = True, skip_keys=None)
         )
 
     def _put(x):
+        arr = np.asarray(x) if not hasattr(x, "dtype") else x
+        if getattr(arr, "dtype", None) is not None and arr.dtype.kind in "USO":
+            return x  # strings/objects have no device representation
         return jax.device_put(x, device)
 
     return recursively_apply(_put, tree)

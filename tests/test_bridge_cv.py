@@ -279,6 +279,15 @@ class TestResNetBlockParity:
         bm.eval()
         eval_out = bm(**{"pixel_values": batch["pixel_values"]})
         assert np.asarray(eval_out["logits"]).shape == (4, 4)
+        # sync_to_torch must carry the LIVE buffers (not just params) so a
+        # torch-side state_dict save reflects training
+        torch_mod = bm.sync_to_torch()
+        tstats = dict(torch_mod.named_buffers())
+        for k in moved:
+            np.testing.assert_allclose(
+                tstats[k].detach().numpy(), after[k], atol=1e-6,
+                err_msg=f"{k} not synced back to torch",
+            )
 
 
     def test_train_forward_without_labels_updates_running_stats(self):

@@ -31,7 +31,8 @@ class TestTwoProcesses:
 
     def test_dataloader_and_dispatcher(self, shared_tmpdir):
         outs = execute_multiprocess(
-            SCRIPT + ["--scenario", "dataloader,dispatcher", "--tmpdir", shared_tmpdir],
+            SCRIPT + ["--scenario", "dataloader,dispatcher,dispatcher_ragged",
+                      "--tmpdir", shared_tmpdir],
             num_processes=2,
         )
         for out in outs:
