@@ -126,6 +126,68 @@ def _is_torch_lr_scheduler(obj) -> bool:
         return False
 
 
+class StepProfiler:
+    """Step-windowed ``jax.profiler`` driver (reference ``ProfileKwargs``
+    schedule semantics, ``utils/dataclasses.py:484-599``): each cycle is
+    ``wait`` untraced steps, ``warmup`` untraced steps (compile/cache settle),
+    then ``active`` traced steps; ``repeat`` cycles (0 = until the context
+    ends), all after ``skip_first`` initial steps. Call :meth:`step` once per
+    training step."""
+
+    def __init__(self, config: ProfileConfig, out_dir: str):
+        self.config = config
+        self.out_dir = out_dir
+        self.step_num = 0  # completed work steps (= index of the UPCOMING one)
+        self.cycle = -1
+        self.tracing = False
+        self.trace_dirs: list = []
+        self._update()  # the very first work step may already be active
+
+    def _position(self):
+        """(cycle_index, step_within_cycle) of the UPCOMING work step after
+        skip_first, or None (before skip_first / past the last repeat)."""
+        cfg = self.config
+        n = self.step_num - cfg.skip_first
+        if n < 0:
+            return None
+        cycle_len = cfg.wait + cfg.warmup + cfg.active
+        cycle, within = divmod(n, cycle_len)
+        if cfg.repeat and cycle >= cfg.repeat:
+            return None
+        return cycle, within
+
+    def _update(self) -> None:
+        import jax
+
+        cfg = self.config
+        pos = self._position()
+        should_trace = pos is not None and pos[1] >= cfg.wait + cfg.warmup
+        # close the trace when leaving a window OR crossing into the next
+        # cycle's window (back-to-back actives must produce per-cycle traces)
+        if self.tracing and (not should_trace or pos[0] != self.cycle):
+            jax.profiler.stop_trace()
+            self.tracing = False
+        if should_trace and not self.tracing:
+            trace_dir = os.path.join(self.out_dir, f"cycle{pos[0]}")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir, create_perfetto_link=cfg.create_perfetto_link)
+            self.trace_dirs.append(trace_dir)
+            self.tracing = True
+            self.cycle = pos[0]
+
+    def step(self) -> None:
+        """Mark the end of a work step; starts/stops traces at window boundaries."""
+        self.step_num += 1
+        self._update()
+
+    def close(self) -> None:
+        import jax
+
+        if self.tracing:
+            jax.profiler.stop_trace()
+            self.tracing = False
+
+
 class Accelerator:
     """Single facade for mesh setup, precision, prepare, train-step compilation,
     metrics gathering and checkpointing (reference ``accelerator.py:183``)."""
@@ -1200,16 +1262,32 @@ class Accelerator:
     def profile(self, profile_config: Optional[ProfileConfig] = None, trace_dir: Optional[str] = None):
         """``jax.profiler`` trace context (reference ``profile:4148`` exporting
         Chrome traces). Writes a TensorBoard/Perfetto trace to ``trace_dir`` or
-        ``<project_dir>/profile``."""
+        ``<project_dir>/profile``.
+
+        Whole-context mode (default): the entire block is traced. Step-windowed
+        mode (``ProfileConfig(active>0)``, mirroring the reference's
+        ``ProfileKwargs`` schedule ``utils/dataclasses.py:484-599``): the
+        yielded :class:`StepProfiler` traces only the active window of each
+        ``skip_first → [wait → warmup → active] x repeat`` cycle — call
+        ``prof.step()`` once per training step. Traces land in per-rank,
+        per-cycle dirs ``<out>/rank<r>/cycle<c>``."""
         import jax
 
         cfg = profile_config or self.profile_handler or ProfileConfig()
         out = trace_dir or cfg.output_trace_dir or os.path.join(self.project_dir or ".", "profile")
+        if cfg.schedule_enabled:
+            prof = StepProfiler(cfg, os.path.join(out, f"rank{self.process_index}"))
+            try:
+                yield prof
+            finally:
+                prof.close()
+            self.wait_for_everyone()
+            return
         if self.is_main_process:
             os.makedirs(out, exist_ok=True)
         jax.profiler.start_trace(out, create_perfetto_link=cfg.create_perfetto_link)
         try:
-            yield
+            yield None
         finally:
             jax.profiler.stop_trace()
         self.wait_for_everyone()
@@ -1232,6 +1310,31 @@ class Accelerator:
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def log_images(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
+        """Log images on every tracker that supports them (reference
+        ``tracking.py:272/364`` — trackers without image support warn+skip)."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log_images(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        log_kwargs: Optional[dict] = None,
+    ):
+        """Log a table (columns+data or dataframe) on every tracker that
+        supports tables (reference ``tracking.py:383``)."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log_table(
+                    table_name, columns=columns, data=data, dataframe=dataframe,
+                    step=step, **((log_kwargs or {}).get(tracker.name, {})),
+                )
 
     def end_training(self):
         if self.is_main_process:

@@ -698,6 +698,7 @@ class DataLoaderDispatcher(DataLoaderShard):
     def _iter_base(self):
         # non-main processes NEVER iterate the base loader
         state = PartialState()
+        self._fetched_rows = 0  # per-epoch: finality proof for ragged padding
         return iter(self.base_dataloader) if state.is_main_process else iter(())
 
     # -- signature registry (identical on every rank by construction) ---------
@@ -786,6 +787,22 @@ class DataLoaderDispatcher(DataLoaderShard):
                 return batch
             key = (treedef, tuple(self._leaf_meta(x, real_bs) for x in leaves))
             sig_id = self._sig_keys.get(key)
+            rows_before = getattr(self, "_fetched_rows", 0)
+            self._fetched_rows = rows_before + real_bs
+            is_final = (
+                self.total_dataset_length is not None
+                and rows_before + real_bs >= self.total_dataset_length
+            )
+            if sig_id is not None and real_bs < self._sigs[sig_id]["bs"] and not is_final:
+                # an undersized batch we cannot PROVE is the epoch's last (a
+                # custom sampler's mid-epoch size change, or unknown length):
+                # padding it would silently duplicate rows that no trimming
+                # step ever removes — ship the real rows on the object channel
+                bcast_header([self._H_OBJECT, 0, real_bs])
+                broadcast_object_list([batch])
+                self._last_data_real_bs = real_bs
+                self._last_data_global_bs = real_bs
+                return batch
             if sig_id is None or real_bs > self._sigs[sig_id]["bs"]:
                 # first sighting of this structure: object channel, then every
                 # rank derives the signature from the same batch
@@ -796,7 +813,7 @@ class DataLoaderDispatcher(DataLoaderShard):
                 self._last_data_global_bs = real_bs
                 return batch
             sig = self._sigs[sig_id]
-            if real_bs < sig["bs"]:  # ragged final batch: pad rows
+            if real_bs < sig["bs"]:  # PROVABLY-final ragged batch: pad rows
                 leaves = [self._pad_rows(x, real_bs, sig["bs"]) for x in leaves]
             bcast_header([self._H_DATA, sig_id, real_bs])
             buf = np.frombuffer(

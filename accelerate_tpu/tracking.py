@@ -48,7 +48,12 @@ def on_main_process(function):
 
 
 class GeneralTracker:
-    """Base tracker API (reference ``GeneralTracker tracking.py:101``)."""
+    """Base tracker API (reference ``GeneralTracker tracking.py:101``).
+
+    Two-phase lifecycle (reference ``start:142``): ``__init__`` only records
+    configuration; :meth:`start` performs the SDK/run initialization. The
+    ``Accelerator`` calls ``start()`` from ``init_trackers``; direct users may
+    skip it — every logging method lazily starts on first use."""
 
     main_process_only = True
 
@@ -57,6 +62,22 @@ class GeneralTracker:
 
     def __init__(self, run_name: str, **kwargs):
         self.run_name = run_name
+        self._started = False
+
+    def start(self) -> None:
+        """Deferred (idempotent) initialization — the heavy SDK setup lives in
+        ``_do_start`` so constructing a tracker stays side-effect free."""
+        if getattr(self, "_started", False):
+            return
+        self._started = True
+        if PartialState().is_main_process:
+            self._do_start()
+
+    def _do_start(self) -> None:
+        pass
+
+    def _ensure_started(self) -> None:
+        self.start()
 
     @property
     def tracker(self):
@@ -68,8 +89,34 @@ class GeneralTracker:
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         pass
 
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """Log named images/image-lists (reference e.g. ``tracking.py:272``).
+        Trackers without image support warn and skip."""
+        logger.warning(f"tracker {self.name!r} does not support log_images; skipping")
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe: Any = None,
+        step: Optional[int] = None,
+        **kwargs,
+    ) -> None:
+        """Log a table by columns+data or dataframe (reference
+        ``tracking.py:383``). Trackers without table support warn and skip."""
+        logger.warning(f"tracker {self.name!r} does not support log_table; skipping")
+
     def finish(self) -> None:
         pass
+
+
+def _table_rows(columns, data, dataframe):
+    """Normalize (columns, data) | dataframe to (columns, rows-of-lists)."""
+    if dataframe is not None:
+        cols = [str(c) for c in dataframe.columns]
+        return cols, dataframe.values.tolist()
+    return list(columns or []), [list(r) for r in (data or [])]
 
 
 class JSONLTracker(GeneralTracker):
@@ -81,21 +128,60 @@ class JSONLTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
         super().__init__(run_name)
-        os.makedirs(logging_dir, exist_ok=True)
-        self.path = os.path.join(logging_dir, f"{run_name}.jsonl")
+        self._logging_dir = logging_dir
+
+    def _do_start(self) -> None:
+        os.makedirs(self._logging_dir, exist_ok=True)
+        self.path = os.path.join(self._logging_dir, f"{self.run_name}.jsonl")
         self._file = open(self.path, "a")
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self._file
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self._write({"_type": "config", **_jsonable(values)})
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         entry = {"_type": "log", "_time": time.time(), **_jsonable(values)}
+        if step is not None:
+            entry["step"] = step
+        self._write(entry)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """Images go to ``<dir>/<run>_media/*.npy`` sidecars; the jsonl records
+        their paths and shapes (dependency-free — no image codec needed)."""
+        import numpy as np
+
+        self._ensure_started()
+        media_dir = os.path.join(self._logging_dir, f"{self.run_name}_media")
+        os.makedirs(media_dir, exist_ok=True)
+        entry = {"_type": "images", "_time": time.time()}
+        if step is not None:
+            entry["step"] = step
+        for k, imgs in values.items():
+            paths = []
+            for i, img in enumerate(imgs):
+                arr = np.asarray(img)
+                fname = f"{k.replace('/', '_')}_{step if step is not None else 'x'}_{i}.npy"
+                np.save(os.path.join(media_dir, fname), arr)
+                paths.append({"path": os.path.join(media_dir, fname), "shape": list(arr.shape)})
+            entry[k] = paths
+        self._write(entry)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None,
+                  step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
+        cols, rows = _table_rows(columns, data, dataframe)
+        entry = {"_type": "table", "name": table_name,
+                 "columns": cols, "rows": _jsonable({"r": rows})["r"]}
         if step is not None:
             entry["step"] = step
         self._write(entry)
@@ -106,7 +192,8 @@ class JSONLTracker(GeneralTracker):
 
     @on_main_process
     def finish(self) -> None:
-        self._file.close()
+        if getattr(self, "_started", False) and getattr(self, "_file", None):
+            self._file.close()
 
 
 class TensorBoardTracker(GeneralTracker):
@@ -118,26 +205,37 @@ class TensorBoardTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
         super().__init__(run_name)
+        self._logging_dir = logging_dir
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         try:
             from torch.utils import tensorboard
 
-            self.writer = tensorboard.SummaryWriter(os.path.join(logging_dir, run_name), **kwargs)
+            self.writer = tensorboard.SummaryWriter(
+                os.path.join(self._logging_dir, self.run_name), **self._init_kwargs
+            )
         except ImportError:
             from tensorboardX import SummaryWriter
 
-            self.writer = SummaryWriter(os.path.join(logging_dir, run_name), **kwargs)
+            self.writer = SummaryWriter(
+                os.path.join(self._logging_dir, self.run_name), **self._init_kwargs
+            )
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.writer
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.writer.add_hparams(_flatten_scalars(values), metric_dict={})
         self.writer.flush()
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         for k, v in _flatten_scalars(values).items():
             if isinstance(v, str):
                 self.writer.add_text(k, v, global_step=step)
@@ -146,8 +244,24 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:272`` — ``SummaryWriter.add_images``;
+        NHWC is detected and passed as ``dataformats`` unless given."""
+        import numpy as np
+
+        self._ensure_started()
+        for k, v in values.items():
+            arr = np.asarray(v)
+            kw = dict(kwargs)
+            if "dataformats" not in kw and arr.ndim == 4 and arr.shape[-1] in (1, 3, 4):
+                kw["dataformats"] = "NHWC"
+            self.writer.add_images(k, arr, global_step=step, **kw)
+        self.writer.flush()
+
+    @on_main_process
     def finish(self) -> None:
-        self.writer.close()
+        if getattr(self, "_started", False) and getattr(self, "writer", None):
+            self.writer.close()
 
 
 class WandBTracker(GeneralTracker):
@@ -159,27 +273,53 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         import wandb
 
-        self.run = wandb.init(project=run_name, **kwargs)
+        self.run = wandb.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.run
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
         import wandb
 
+        self._ensure_started()
         wandb.config.update(values, allow_val_change=True)
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         self.run.log(values, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:364`` — each value list becomes wandb.Image s."""
+        import wandb
+
+        self._ensure_started()
+        for k, v in values.items():
+            self.run.log({k: [wandb.Image(img) for img in v]}, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None,
+                  step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:383`` — wandb.Table by columns+data or df."""
+        import wandb
+
+        self._ensure_started()
+        table = wandb.Table(columns=columns, data=data, dataframe=dataframe)
+        self.run.log({table_name: table}, step=step, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
-        self.run.finish()
+        if getattr(self, "_started", False) and getattr(self, "run", None):
+            self.run.finish()
 
 
 class MLflowTracker(GeneralTracker):
@@ -191,19 +331,24 @@ class MLflowTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         import mlflow
 
-        mlflow.set_experiment(run_name)
-        self.run = mlflow.start_run(**kwargs)
+        mlflow.set_experiment(self.run_name)
+        self.run = mlflow.start_run(**self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.run
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
         import mlflow
 
+        self._ensure_started()
         for k, v in _flatten_scalars(values).items():
             mlflow.log_param(k, v)
 
@@ -211,15 +356,43 @@ class MLflowTracker(GeneralTracker):
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         import mlflow
 
+        self._ensure_started()
         mlflow.log_metrics(
             {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)}, step=step
         )
 
     @on_main_process
-    def finish(self) -> None:
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """``mlflow.log_image`` per image, named ``<key>_<step>_<i>.png``."""
+        import mlflow
+        import numpy as np
+
+        self._ensure_started()
+        for k, v in values.items():
+            for i, img in enumerate(v):
+                fname = f"{k.replace('/', '_')}_{step if step is not None else 'x'}_{i}.png"
+                mlflow.log_image(np.asarray(img), fname)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None,
+                  step: Optional[int] = None, **kwargs) -> None:
+        """``mlflow.log_table`` from a dict or dataframe."""
         import mlflow
 
-        mlflow.end_run()
+        self._ensure_started()
+        if dataframe is not None:
+            mlflow.log_table(dataframe, artifact_file=f"{table_name}.json")
+        else:
+            cols, rows = _table_rows(columns, data, None)
+            payload = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+            mlflow.log_table(payload, artifact_file=f"{table_name}.json")
+
+    @on_main_process
+    def finish(self) -> None:
+        if getattr(self, "_started", False):
+            import mlflow
+
+            mlflow.end_run()
 
 
 class CometMLTracker(GeneralTracker):
@@ -231,20 +404,26 @@ class CometMLTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         from comet_ml import start
 
-        self.experiment = start(project_name=run_name, **kwargs)
+        self.experiment = start(project_name=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.experiment
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.experiment.log_parameters(_jsonable(values))
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         if step is not None:
             self.experiment.set_step(step)
         for k, v in _flatten_scalars(values).items():
@@ -254,8 +433,16 @@ class CometMLTracker(GeneralTracker):
                 self.experiment.log_metric(k, v, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
+        for k, v in values.items():
+            for i, img in enumerate(v):
+                self.experiment.log_image(img, name=f"{k}_{i}", step=step, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
-        self.experiment.end()
+        if getattr(self, "_started", False) and getattr(self, "experiment", None):
+            self.experiment.end()
 
 
 class AimTracker(GeneralTracker):
@@ -267,27 +454,48 @@ class AimTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
         super().__init__(run_name)
+        self._logging_dir = logging_dir
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         from aim import Run
 
-        self.writer = Run(repo=logging_dir, **kwargs)
-        self.writer.name = run_name
+        self.writer = Run(repo=self._logging_dir, **self._init_kwargs)
+        self.writer.name = self.run_name
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.writer
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.writer["hparams"] = _jsonable(values)
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         for k, v in _flatten_scalars(values).items():
             self.writer.track(v, name=k, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:657`` — aim.Image per value. Optional
+        ``aim_image``/``track`` sub-dicts route kwargs to the Image ctor and
+        ``Run.track`` respectively (same split the reference exposes)."""
+        import aim
+
+        self._ensure_started()
+        aim_image_kw = kwargs.pop("aim_image", {})
+        track_kw = kwargs.pop("track", {})
+        for k, v in values.items():
+            self.writer.track(aim.Image(v, **aim_image_kw), name=k, step=step, **track_kw)
+
+    @on_main_process
     def finish(self) -> None:
-        self.writer.close()
+        if getattr(self, "_started", False) and getattr(self, "writer", None):
+            self.writer.close()
 
 
 class ClearMLTracker(GeneralTracker):
@@ -299,20 +507,26 @@ class ClearMLTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         from clearml import Task
 
-        self.task = Task.init(project_name=run_name, **kwargs)
+        self.task = Task.init(project_name=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.task
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.task.connect_configuration(_jsonable(values))
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         clearml_logger = self.task.get_logger()
         for k, v in _flatten_scalars(values).items():
             if isinstance(v, str):
@@ -326,8 +540,39 @@ class ClearMLTracker(GeneralTracker):
                 )
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:989`` — ``Logger.report_image``."""
+        self._ensure_started()
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            title, _, series = k.rpartition("/")
+            for i, img in enumerate(v):
+                clearml_logger.report_image(
+                    title=title or k, series=f"{series or k}_{i}",
+                    iteration=step, image=img, **kwargs
+                )
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None,
+                  step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:1007`` — ``Logger.report_table``."""
+        self._ensure_started()
+        clearml_logger = self.task.get_logger()
+        if dataframe is not None:
+            payload = dataframe
+        else:
+            cols, rows = _table_rows(columns, data, None)
+            payload = [cols] + rows  # first row = header, clearml convention
+        title, _, series = table_name.rpartition("/")
+        clearml_logger.report_table(
+            title=title or table_name, series=series or table_name,
+            iteration=step, table_plot=payload, **kwargs,
+        )
+
+    @on_main_process
     def finish(self) -> None:
-        self.task.close()
+        if getattr(self, "_started", False) and getattr(self, "task", None):
+            self.task.close()
 
 
 class DVCLiveTracker(GeneralTracker):
@@ -339,20 +584,27 @@ class DVCLiveTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, live=None, **kwargs):
         super().__init__(run_name)
+        self._live_arg = live
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         from dvclive import Live
 
-        self.live = live if live is not None else Live(**kwargs)
+        self.live = self._live_arg if self._live_arg is not None else Live(**self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.live
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.live.log_params(_flatten_scalars(values))
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         if step is not None:
             self.live.step = step
         for k, v in _flatten_scalars(values).items():
@@ -360,8 +612,18 @@ class DVCLiveTracker(GeneralTracker):
         self.live.next_step()
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            for i, img in enumerate(v):
+                self.live.log_image(f"{k}_{i}.png", img, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
-        self.live.end()
+        if getattr(self, "_started", False) and getattr(self, "live", None):
+            self.live.end()
 
 
 class SwanLabTracker(GeneralTracker):
@@ -373,32 +635,48 @@ class SwanLabTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         import swanlab
 
-        self.run = swanlab.init(project=run_name, **kwargs)
+        self.run = swanlab.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.run
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
         import swanlab
 
+        self._ensure_started()
         swanlab.config.update(_jsonable(values))
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self._ensure_started()
         self.run.log(
             {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)},
             step=step,
         )
 
     @on_main_process
-    def finish(self) -> None:
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        """reference ``tracking.py:1220`` — swanlab.Image per value."""
         import swanlab
 
-        swanlab.finish()
+        self._ensure_started()
+        for k, v in values.items():
+            self.run.log({k: [swanlab.Image(img) for img in v]}, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        if getattr(self, "_started", False):
+            import swanlab
+
+            swanlab.finish()
 
 
 class TrackioTracker(GeneralTracker):
@@ -410,22 +688,28 @@ class TrackioTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__(run_name)
+        self._init_kwargs = kwargs
+
+    def _do_start(self) -> None:
         import trackio
 
-        self.run = trackio.init(project=run_name, **kwargs)
+        self.run = trackio.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
+        self._ensure_started()
         return self.run
 
     @on_main_process
     def store_init_configuration(self, values: dict) -> None:
+        self._ensure_started()
         self.run.config.update(_jsonable(values))
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         # trackio's run.log has no step parameter (auto-incremented internally)
         # — the reference drops it too (tracking.py:487)
+        self._ensure_started()
         self.run.log(
             {k: v for k, v in _flatten_scalars(values).items() if not isinstance(v, str)},
             **kwargs,
@@ -433,7 +717,8 @@ class TrackioTracker(GeneralTracker):
 
     @on_main_process
     def finish(self) -> None:
-        self.run.finish()
+        if getattr(self, "_started", False) and getattr(self, "run", None):
+            self.run.finish()
 
 
 LOGGER_TYPE_TO_CLASS = {
@@ -480,6 +765,7 @@ def filter_trackers(
     instances: list[GeneralTracker] = []
     for entry in log_with:
         if isinstance(entry, GeneralTracker):
+            entry.start()  # two-phase init; idempotent for pre-started ones
             instances.append(entry)
             continue
         value = str(entry)
@@ -498,6 +784,7 @@ def filter_trackers(
         if cls.requires_logging_directory:
             kwargs.setdefault("logging_dir", logging_dir or ".")
         tracker = cls(project_name, **kwargs)
+        tracker.start()  # two-phase init (reference Accelerator calls start())
         if config:
             tracker.store_init_configuration(config)
         instances.append(tracker)

@@ -217,6 +217,19 @@ class ProfileConfig(KwargsHandler):
     host_tracer_level: int = 2
     python_tracer_level: int = 0
     device_tracer_level: int = 1
+    # step-windowed schedule (reference ProfileKwargs wait/warmup/active/
+    # repeat/skip_first, ``utils/dataclasses.py:484-599``): when ``active > 0``
+    # the profile context traces only the active window of each cycle, driven
+    # by ``prof.step()`` calls; ``repeat=0`` cycles until the context exits
+    skip_first: int = 0
+    wait: int = 0
+    warmup: int = 0
+    active: int = 0
+    repeat: int = 0
+
+    @property
+    def schedule_enabled(self) -> bool:
+        return self.active > 0
 
     def build_options(self):
         import jax

@@ -40,7 +40,22 @@ def training_function(args):
         float(metrics["loss"])  # force completion inside the window
     produced = any(os.scandir(args.trace_dir)) if os.path.isdir(args.trace_dir) else False
     accelerator.print(f"trace written to {args.trace_dir}: {produced}")
-    return {"trace_written": produced}
+
+    # step-windowed schedule (reference ProfileKwargs wait/warmup/active/
+    # repeat): only the active window of each cycle is traced — the way to
+    # profile steady-state steps inside a long training loop
+    from accelerate_tpu.utils import ProfileKwargs
+
+    sched_cfg = ProfileKwargs(
+        output_trace_dir=args.trace_dir + "_sched", wait=1, warmup=1, active=2, repeat=1
+    )
+    with accelerator.profile(sched_cfg) as prof:
+        for _ in range(5):
+            params, opt_state, metrics = step(params, opt_state, next(it))
+            float(metrics["loss"])  # force completion before the step boundary
+            prof.step()
+    accelerator.print(f"scheduled traces: {prof.trace_dirs}")
+    return {"trace_written": produced, "scheduled_traces": len(prof.trace_dirs)}
 
 
 if __name__ == "__main__":

@@ -382,6 +382,87 @@ def test_profile_handler_routed_from_kwargs(tmp_path):
     assert acc.profile_handler is handler
 
 
+def test_step_windowed_profile_schedule(tmp_path):
+    """Reference ProfileKwargs(wait/warmup/active/repeat/skip_first) schedule
+    (``utils/dataclasses.py:484-599``): only the active windows are traced,
+    one trace dir per cycle, per rank."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils import ProfileKwargs
+
+    acc = Accelerator(cpu=True)
+    cfg = ProfileKwargs(
+        output_trace_dir=str(tmp_path), skip_first=1, wait=1, warmup=1, active=2, repeat=2
+    )
+    f = jax.jit(lambda x: jnp.sin(x) * 2)
+    x = jnp.ones((8,))
+    with acc.profile(cfg) as prof:
+        assert prof is not None
+        for _ in range(12):
+            x = f(x)
+            x.block_until_ready()
+            prof.step()
+        # repeat=2 exhausted: tracing must be off even mid-loop
+        assert not prof.tracing
+    assert len(prof.trace_dirs) == 2
+    for d in prof.trace_dirs:
+        assert os.path.isdir(d) and any(os.scandir(d)), d
+    # cycle dirs live under the per-rank dir
+    assert all(f"rank{acc.process_index}" in d for d in prof.trace_dirs)
+
+
+def test_step_windowed_profile_schedule_math():
+    from accelerate_tpu.accelerator import StepProfiler
+    from accelerate_tpu.utils.dataclasses import ProfileConfig
+
+    cfg = ProfileConfig(skip_first=2, wait=1, warmup=1, active=2, repeat=0)
+    prof = StepProfiler(cfg, "/tmp/unused")
+    # step() is called AFTER each work step; work step k is traced iff the
+    # profiler is tracing between calls k and k+1
+    traced_work_steps = []
+    import unittest.mock as mock
+
+    with mock.patch("jax.profiler.start_trace"), mock.patch("jax.profiler.stop_trace"), \
+         mock.patch("os.makedirs"):
+        for k in range(14):
+            prof.step()
+            if prof.tracing:
+                traced_work_steps.append(k + 1)  # the upcoming work step
+        prof.close()
+    # skip_first=2, cycle = wait 1 + warmup 1 + active 2: active work steps are
+    # 4,5 then 8,9 then 12,13 ...
+    assert traced_work_steps == [4, 5, 8, 9, 12, 13], traced_work_steps
+
+
+def test_step_profiler_traces_first_step_and_splits_cycles():
+    import unittest.mock as mock
+
+    from accelerate_tpu.accelerator import StepProfiler
+    from accelerate_tpu.utils.dataclasses import ProfileConfig
+
+    with mock.patch("jax.profiler.start_trace") as start, \
+         mock.patch("jax.profiler.stop_trace") as stop, mock.patch("os.makedirs"):
+        # active window starting at position 0: the FIRST work step is traced
+        prof = StepProfiler(ProfileConfig(active=1, repeat=1), "/tmp/unused")
+        assert prof.tracing  # tracing from context entry, before any step()
+        prof.step()
+        assert not prof.tracing
+        prof.close()
+        assert start.call_count == 1 and stop.call_count == 1
+        assert len(prof.trace_dirs) == 1
+
+        # back-to-back active windows (wait=warmup=0) split per cycle
+        start.reset_mock(), stop.reset_mock()
+        prof = StepProfiler(ProfileConfig(active=2, repeat=3), "/tmp/unused")
+        for _ in range(8):
+            prof.step()
+        prof.close()
+        assert len(prof.trace_dirs) == 3, prof.trace_dirs
+        assert [d.rsplit("cycle", 1)[1] for d in prof.trace_dirs] == ["0", "1", "2"]
+        assert start.call_count == 3 and stop.call_count == 3
+
+
 # ------------------------------------------------------------------- lomo --
 
 

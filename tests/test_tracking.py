@@ -60,6 +60,102 @@ def test_jsonl_tracker_roundtrip(tmp_path):
     assert [entry["step"] for entry in lines[1:]] == [0, 1]
 
 
+def test_deferred_start_lifecycle(tmp_path):
+    """Two-phase init (reference GeneralTracker.start tracking.py:142):
+    construction is side-effect free; start() creates the run; logging before
+    start() lazily starts."""
+    tracker = JSONLTracker("run", logging_dir=str(tmp_path))
+    assert not (tmp_path / "run.jsonl").exists()  # __init__ wrote nothing
+    tracker.start()
+    assert (tmp_path / "run.jsonl").exists()
+    tracker.start()  # idempotent
+    tracker.log({"a": 1}, step=0)
+    tracker.finish()
+    # lazy-start path: no explicit start() before log
+    lazy = JSONLTracker("lazy", logging_dir=str(tmp_path))
+    lazy.log({"b": 2})
+    lazy.finish()
+    assert (tmp_path / "lazy.jsonl").exists()
+    # finish() on a never-started tracker is a harmless no-op
+    JSONLTracker("unused", logging_dir=str(tmp_path)).finish()
+    assert not (tmp_path / "unused.jsonl").exists()
+
+
+def test_api_surface_includes_media_methods():
+    for name, cls in LOGGER_TYPE_TO_CLASS.items():
+        for method in ("start", "log_images", "log_table"):
+            assert callable(getattr(cls, method)), (name, method)
+
+
+def test_jsonl_log_images_writes_sidecars(tmp_path):
+    import numpy as np
+
+    tracker = JSONLTracker("run", logging_dir=str(tmp_path))
+    imgs = [np.zeros((4, 4, 3), np.uint8), np.ones((4, 4, 3), np.uint8)]
+    tracker.log_images({"samples": imgs}, step=3)
+    tracker.finish()
+    lines = [json.loads(line) for line in (tmp_path / "run.jsonl").read_text().splitlines()]
+    entry = next(e for e in lines if e["_type"] == "images")
+    assert entry["step"] == 3 and len(entry["samples"]) == 2
+    back = np.load(entry["samples"][1]["path"])
+    np.testing.assert_array_equal(back, imgs[1])
+
+
+def test_jsonl_log_table_rows_and_dataframe(tmp_path):
+    tracker = JSONLTracker("run", logging_dir=str(tmp_path))
+    tracker.log_table("preds", columns=["text", "label"],
+                      data=[["a", 0], ["b", 1]], step=1)
+    tracker.finish()
+    lines = [json.loads(line) for line in (tmp_path / "run.jsonl").read_text().splitlines()]
+    entry = next(e for e in lines if e["_type"] == "table")
+    assert entry["name"] == "preds"
+    assert entry["columns"] == ["text", "label"]
+    assert entry["rows"] == [["a", 0], ["b", 1]]
+
+
+def test_tensorboard_log_images(tmp_path):
+    import numpy as np
+    import pytest
+
+    from accelerate_tpu.tracking import _AVAILABILITY, TensorBoardTracker
+
+    if not _AVAILABILITY["tensorboard"]():
+        pytest.skip("tensorboard unavailable")
+    tracker = TensorBoardTracker("run", logging_dir=str(tmp_path))
+    tracker.start()
+    imgs = np.random.default_rng(0).integers(0, 255, (2, 8, 8, 3)).astype(np.uint8)
+    tracker.log_images({"samples": imgs}, step=0)
+    tracker.log({"loss": 1.0}, step=0)
+    tracker.finish()
+    event_files = list((tmp_path / "run").glob("events*"))
+    assert event_files and event_files[0].stat().st_size > 0
+
+
+def test_base_tracker_media_methods_warn_not_raise():
+    t = GeneralTracker("run")
+    t.start()
+    t.log_images({"x": []})  # warns, must not raise
+    t.log_table("t", columns=["a"], data=[[1]])
+
+
+def test_accelerator_log_images_and_table(tmp_path):
+    import numpy as np
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("proj")
+    acc.log_images({"img": [np.zeros((2, 2), np.uint8)]}, step=0)
+    acc.log_table("tbl", columns=["k"], data=[["v"]], step=0)
+    acc.end_training()
+    text = (tmp_path / "proj.jsonl").read_text()
+    assert '"_type": "images"' in text and '"_type": "table"' in text
+
+
 def test_all_resolves_to_available_only():
     from accelerate_tpu.utils.dataclasses import LoggerType
 
