@@ -1,13 +1,19 @@
 # Test/benchmark targets (reference Makefile:23-58 split: core vs cli vs
 # big-modeling vs examples, for CI sharding).
 
-.PHONY: test test_core test_cli test_big_modeling test_examples test_models \
-        test_multihost test_checkpoint quality bench
+.PHONY: test test_smoke test_core test_cli test_big_modeling test_examples \
+        test_models test_multihost test_checkpoint quality bench
 
 PYTEST := python -m pytest -q
 
 test:
 	$(PYTEST) tests/
+
+# <60s cross-subsystem signal: one marked test per subsystem (mesh, collectives,
+# data loader, train step, bridge incl. CV, models, long-context, quantization,
+# checkpointing, tracking, CLI, native C++, memory, utils)
+test_smoke:
+	$(PYTEST) tests/ -m smoke
 
 test_core:
 	$(PYTEST) tests/ --ignore=tests/test_big_modeling.py \

@@ -56,6 +56,7 @@ def run_training(pc, batch_size, epochs=2, accum=1, precision="no", lr=1e-2):
     return jax.tree_util.tree_map(np.asarray, params), float(metrics["loss"])
 
 
+@pytest.mark.smoke
 def test_dp_parity_with_single_device():
     """8-way DP on global batch 64 == single-device on batch 64 (same samples,
     same order, sequential sampler)."""

@@ -47,6 +47,7 @@ def _batch(n=4, seq=16, vocab=100, num_labels=2, seed=0):
     }
 
 
+@pytest.mark.smoke
 def test_slice_scatter_negative_end_matches_aten():
     # end=-1 means size-1 in ATen slice semantics (ADVICE r03)
     import jax.numpy as jnp

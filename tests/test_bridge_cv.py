@@ -47,6 +47,7 @@ def _img(shape=(2, 3, 16, 16), seed=0):
 
 
 class TestConvLowering:
+    @pytest.mark.smoke
     def test_conv2d_stride_padding(self):
         torch.manual_seed(0)
         _op_parity(_Op(nn.Conv2d(3, 8, 3, stride=2, padding=1)), _img())

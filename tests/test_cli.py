@@ -73,6 +73,7 @@ def test_env_probe_outcomes():
         assert "HUNG" in _probe_jax(timeout=5)["JAX"]
 
 
+@pytest.mark.smoke
 def test_env_command(monkeypatch):
     # keep the JAX backend probe short: on a hung TPU tunnel the killable
     # subprocess waits out its budget before reporting the outage

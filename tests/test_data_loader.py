@@ -239,6 +239,7 @@ def test_seedable_sampler_epoch_reshuffle():
     assert list(s) == first
 
 
+@pytest.mark.smoke
 def test_default_collate_nested():
     samples = [{"x": np.ones(2), "y": (1, 2)}, {"x": np.zeros(2), "y": (3, 4)}]
     batch = default_collate(samples)

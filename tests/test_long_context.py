@@ -63,6 +63,7 @@ def test_cp_sp_gradients_match(strategy):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.smoke
 def test_ring_with_gqa():
     pc = ParallelismConfig(cp_size=4, dp_shard_size=2)
     mesh = pc.build_mesh()

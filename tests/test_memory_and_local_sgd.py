@@ -26,6 +26,7 @@ class FakeOOM(RuntimeError):
 
 
 class TestFindExecutableBatchSize:
+    @pytest.mark.smoke
     def test_halves_until_fit(self):
         sizes = []
 

@@ -22,6 +22,7 @@ from accelerate_tpu.models import (
 )
 
 
+@pytest.mark.smoke
 def test_llama_forward_shapes_and_init_loss():
     cfg = LlamaConfig.tiny()
     params = init_llama(cfg, jax.random.PRNGKey(0))

@@ -13,6 +13,7 @@ from accelerate_tpu.native import (
 )
 
 
+@pytest.mark.smoke
 def test_native_builds():
     # the build toolchain exists in CI/dev images; if this fails the fallback
     # path still works but we want to know

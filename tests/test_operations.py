@@ -33,6 +33,7 @@ def test_recursively_apply_preserves_structure():
     np.testing.assert_array_equal(out["a"], np.full(3, 2.0))
 
 
+@pytest.mark.smoke
 def test_gather_replicates_sharded_array():
     mesh = ParallelismConfig(dp_shard_size=8).build_mesh()
     x = jax.device_put(jnp.arange(16.0).reshape(16, 1), NamedSharding(mesh, P("dp_shard")))

@@ -28,6 +28,7 @@ def _rand(shape, seed=0, scale=1.0):
 
 
 class TestBlockwise:
+    @pytest.mark.smoke
     def test_int8_roundtrip_error(self):
         w = _rand((128, 256))
         cfg = QuantizationConfig(load_in_8bit=True, block_size=64)

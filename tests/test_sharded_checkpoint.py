@@ -186,6 +186,7 @@ class TestAcceleratorShardedState:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.smoke
 def test_checkpoint_dir_reuse_scrubs_stale_format(tmp_path):
     """A reused output_dir must not leave the previous save's format behind:
     load prefers model.npz, so a sharded save over an old npz save (or vice

@@ -47,6 +47,7 @@ def test_parallelism_config_infer_dp_shard():
     assert pc.fsdp_enabled and pc.tp_enabled and not pc.cp_enabled
 
 
+@pytest.mark.smoke
 def test_build_mesh_axes():
     pc = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, tp_size=2)
     mesh = pc.build_mesh()

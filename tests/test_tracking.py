@@ -5,6 +5,8 @@ structurally, since their libraries are not installed in this image)."""
 
 import json
 
+import pytest
+
 from accelerate_tpu.tracking import (
     _AVAILABILITY,
     LOGGER_TYPE_TO_CLASS,
@@ -48,6 +50,7 @@ def test_filter_trackers_unknown_name_raises(tmp_path):
         filter_trackers(["definitely_not_a_tracker"], project_name="run")
 
 
+@pytest.mark.smoke
 def test_jsonl_tracker_roundtrip(tmp_path):
     tracker = JSONLTracker("run", logging_dir=str(tmp_path))
     tracker.store_init_configuration({"lr": 1e-3, "nested": {"bs": 8}})

@@ -90,6 +90,7 @@ def test_clean_state_dict_dedups_tied():
     assert len(clean) == 2  # one of w/tied dropped, other kept
 
 
+@pytest.mark.smoke
 def test_save_load_round_trip(tmp_path):
     tree = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
     npz = str(tmp_path / "s.npz")
