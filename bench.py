@@ -395,59 +395,94 @@ def run_bench():
     on_tpu = backend == "tpu"
     if on_tpu:
         config = BertConfig.base()
-        batch_size = 64
+        # ladder: larger global batches raise MXU utilization (VERDICT r03:
+        # MFU 0.544 @ bs64 — the chip has headroom); first size that
+        # compiles+runs wins, OOM degrades to the next
+        batch_sizes = [256, 128, 64]
         steps = 30
     else:
         config = BertConfig.tiny()
-        batch_size = 16
+        batch_sizes = [16]
         steps = 10
     import dataclasses
 
     seq_len = 128
     config = dataclasses.replace(config, max_seq_len=seq_len)
-
-    accelerator = Accelerator(mixed_precision="bf16", rng_seed=0)
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
     from nlp_example import DictDataset, make_synthetic_mrpc
 
-    n_chips = len(jax.devices())
-    data = make_synthetic_mrpc(batch_size * n_chips * 4, seq_len, config.vocab_size, seed=0)
-    params = init_bert(config, jax.random.PRNGKey(0))
-    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
-    params, opt, dl = accelerator.prepare(
-        params,
-        optax.adamw(2e-5),
-        DataLoader(DictDataset(data), batch_size=batch_size),
-        shard_rules=bert_shard_rules(),
-    )
-    opt_state = opt.opt_state
-
-    batches = list(dl)
-    global_batch = batches[0]["labels"].shape[0]
-    # The hot loop runs through prepare_train_loop: K steps scanned inside ONE
-    # jitted dispatch, so per-step host/dispatch latency (≈9 ms/step through a
-    # remote-tunneled runtime) is amortized away. Parity with the per-step path
-    # is pinned by tests/test_accelerator.py::test_train_loop_matches_per_step_calls.
     from accelerate_tpu.utils.operations import stack_batches
 
-    steps_per_call = 10
-    stacked = stack_batches([batches[i % len(batches)] for i in range(steps_per_call)])
-    loop = accelerator.prepare_train_loop(lambda p, b: bert_loss(p, b, config), opt)
-    n_calls = max(1, steps // steps_per_call)
-    # compile (value fetch, not block_until_ready: remote-tunneled TPU backends
-    # can report ready before execution completes — a host transfer cannot lie)
-    params, opt_state, m = loop(params, opt_state, stacked)
-    float(np.asarray(m["loss"][-1]))
-    # one warm pass: the first post-compile dispatch carries one-time runtime
-    # setup (~25% on the tunneled runtime) that is not steady-state throughput
-    params, opt_state, m = loop(params, opt_state, stacked)
-    float(np.asarray(m["loss"][-1]))
-    t0 = time.time()
-    for _ in range(n_calls):
+    n_chips = len(jax.devices())
+
+    def run_at(batch_size: int):
+        _reset_state()
+        accelerator = Accelerator(mixed_precision="bf16", rng_seed=0)
+        data = make_synthetic_mrpc(batch_size * n_chips * 4, seq_len, config.vocab_size, seed=0)
+        params = init_bert(config, jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+        params, opt, dl = accelerator.prepare(
+            params,
+            optax.adamw(2e-5),
+            DataLoader(DictDataset(data), batch_size=batch_size),
+            shard_rules=bert_shard_rules(),
+        )
+        opt_state = opt.opt_state
+        batches = list(dl)
+        global_batch = batches[0]["labels"].shape[0]
+        # The hot loop runs through prepare_train_loop: K steps scanned inside
+        # ONE jitted dispatch, so per-step host/dispatch latency (≈9 ms/step
+        # through a remote-tunneled runtime) is amortized away. Parity with the
+        # per-step path is pinned by
+        # tests/test_accelerator.py::test_train_loop_matches_per_step_calls.
+        steps_per_call = 10
+        stacked = stack_batches([batches[i % len(batches)] for i in range(steps_per_call)])
+        loop = accelerator.prepare_train_loop(lambda p, b: bert_loss(p, b, config), opt)
+        n_calls = max(1, steps // steps_per_call)
+        # compile (value fetch, not block_until_ready: remote-tunneled TPU
+        # backends can report ready before execution completes — a host
+        # transfer cannot lie)
         params, opt_state, m = loop(params, opt_state, stacked)
-    final_loss = float(np.asarray(m["loss"][-1]))
-    elapsed = time.time() - t0
-    samples_per_sec = n_calls * steps_per_call * global_batch / elapsed
+        float(np.asarray(m["loss"][-1]))
+        # one warm pass: the first post-compile dispatch carries one-time
+        # runtime setup (~25% on the tunneled runtime), not steady-state
+        params, opt_state, m = loop(params, opt_state, stacked)
+        float(np.asarray(m["loss"][-1]))
+        # optional profiler capture (VERDICT r04 item 2: trace-verified
+        # kernel engagement): ACCELERATE_BENCH_TRACE=<dir> wraps ONE timed
+        # dispatch in jax.profiler so the claimed hot path is inspectable
+        trace_dir = os.environ.get("ACCELERATE_BENCH_TRACE", "").strip() or None
+        if trace_dir:
+            jax.profiler.start_trace(trace_dir)
+            try:
+                params, opt_state, m = loop(params, opt_state, stacked)
+                float(np.asarray(m["loss"][-1]))
+            finally:
+                # a failure mid-trace must not leave the profiler running — the
+                # next ladder attempt's start_trace would fail
+                jax.profiler.stop_trace()
+        t0 = time.time()
+        for _ in range(n_calls):
+            params, opt_state, m = loop(params, opt_state, stacked)
+        final_loss = float(np.asarray(m["loss"][-1]))
+        elapsed = time.time() - t0
+        samples_per_sec = n_calls * steps_per_call * global_batch / elapsed
+        return samples_per_sec, final_loss, n_params, trace_dir
+
+    last_msg = None
+    for batch_size in batch_sizes:
+        try:
+            samples_per_sec, final_loss, n_params, trace_dir = run_at(batch_size)
+            break
+        except Exception as e:  # OOM at this size: degrade down the ladder
+            # keep only the MESSAGE: holding the exception would pin the OOM'd
+            # attempt's device buffers alive (via __traceback__ frame locals)
+            # through the next, smaller attempt
+            last_msg = f"{type(e).__name__}: {str(e)[:300]}"
+            print(f"headline bs={batch_size} failed ({last_msg}); trying next",
+                  file=sys.stderr)
+    else:
+        raise RuntimeError(f"no headline batch size ran (last: {last_msg})")
     per_chip = samples_per_sec / n_chips
 
     peak = _peak_flops(jax.devices()[0])
@@ -460,10 +495,12 @@ def run_bench():
         "backend": backend,
         "n_chips": n_chips,
         "model": "bert-base" if on_tpu else "bert-tiny",
+        "batch_size": batch_size,
         "final_loss": final_loss,
         "mfu": mfu,
         "n_params": n_params,
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        **({"trace_dir": trace_dir} if trace_dir else {}),
     }
 
 
@@ -618,8 +655,9 @@ def run_bench_compile_time(on_tpu: bool) -> dict:
         "scan_step_ms": round(scan_step_ms, 2) if scan_step_ms is not None else None,
     }
     if scan_s is None:
-        # 0.0 would read as a PERFECT lower-is-better result: say what happened
-        out["note"] = f"scan compile exceeded {budget}s budget (killed); value=0 is a failure sentinel"
+        # 0.0 would read as a PERFECT lower-is-better result: null it instead
+        out["value"] = None
+        out["note"] = f"scan compile exceeded {budget}s budget (killed)"
         return out
     projected_full = scan_s * base.n_layers
     if projected_full > 2 * budget:
@@ -668,8 +706,22 @@ def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> f
         vs_baseline = (
             result["per_chip"] / baseline["per_chip"] if _finite(result["per_chip"]) else 0.0
         )
+        anchor_bs = baseline.get("batch_size")
+        if anchor_bs is not None and result.get("batch_size") not in (None, anchor_bs):
+            # the batch ladder may land on a different size than the anchor
+            # run — that ratio mixes config change with real perf change
+            result["vs_baseline_note"] = (
+                f"batch size differs from anchor (bs{result.get('batch_size')} "
+                f"vs anchor bs{anchor_bs})"
+            )
     elif _finite(result["per_chip"]):
-        baseline.update({"per_chip": result["per_chip"], "model": result["model"]})
+        baseline.update(
+            {
+                "per_chip": result["per_chip"],
+                "model": result["model"],
+                "batch_size": result.get("batch_size"),
+            }
+        )
         dirty = True
     cfg_anchor = baseline.setdefault("configs", {})
     if not isinstance(cfg_anchor, dict):
@@ -678,9 +730,15 @@ def apply_baseline_anchors(result: dict, configs: dict, baseline_path: str) -> f
     if not isinstance(cfg_meta, dict):
         cfg_meta = baseline["configs_meta"] = {}
     for name, entry in configs.items():
-        value = entry.get("value") or 0.0
+        raw_value = entry.get("value")
+        value = raw_value or 0.0
         if _finite(cfg_anchor.get(name)) and cfg_anchor.get(name):
-            entry["vs_baseline"] = round(value / cfg_anchor[name], 4) if _finite(value) else 0.0
+            if raw_value is None:
+                # explicit null (e.g. compile budget blown): null ratio too —
+                # 0.0 would read as "infinitely fast" for lower-is-better
+                entry["vs_baseline"] = None
+            else:
+                entry["vs_baseline"] = round(value / cfg_anchor[name], 4) if _finite(value) else 0.0
             # self-tuning configs: a ratio against an anchor measured under a
             # DIFFERENT remat policy is not a like-for-like comparison — say so
             prev_meta = cfg_meta.get(name)
@@ -829,7 +887,14 @@ def main():
                 "mfu": _num(result["mfu"]),
                 "device_kind": result["device_kind"],
                 "n_chips": result["n_chips"],
+                "batch_size": result.get("batch_size"),
                 "final_loss": _num(result["final_loss"]),
+                **({"trace_dir": result["trace_dir"]} if result.get("trace_dir") else {}),
+                **(
+                    {"vs_baseline_note": result["vs_baseline_note"]}
+                    if result.get("vs_baseline_note")
+                    else {}
+                ),
                 # this environment has no hub access: data is synthetic
                 # MRPC-shaped, so loss/accuracy are parity signals between
                 # configs/rounds, not real-GLUE numbers

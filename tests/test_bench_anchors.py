@@ -138,6 +138,30 @@ class TestBaselineAnchors:
         assert configs["inference"]["vs_baseline"] == 0.0
 
 
+class TestAnchorNotes:
+    def test_headline_batch_size_mismatch_noted(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump({"per_chip": 800.0, "model": "bert-base", "batch_size": 64}, open(path, "w"))
+        result = _result()
+        result["batch_size"] = 256
+        apply_baseline_anchors(result, {}, path)
+        assert "batch size differs" in result.get("vs_baseline_note", "")
+
+    def test_headline_anchor_seeds_batch_size(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        result = _result()
+        result["batch_size"] = 128
+        apply_baseline_anchors(result, {}, path)
+        assert json.load(open(path))["batch_size"] == 128
+
+    def test_null_config_value_gives_null_ratio(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump({"per_chip": 800.0, "configs": {"compile_time_llama1b": 5.0}}, open(path, "w"))
+        configs = {"compile_time_llama1b": {"value": None, "note": "budget blown"}}
+        apply_baseline_anchors(_result(), configs, path)
+        assert configs["compile_time_llama1b"]["vs_baseline"] is None
+
+
 class TestProbeRecovery:
     """Round-4 hardening: probe failure reasons are captured and the degraded
     path can adopt a recovered-TPU child run's output — but ONLY a real one."""
