@@ -97,6 +97,19 @@ class ClusterConfig:
 
 
 def _ask(prompt: str, default, cast=str, choices=None):
+    if choices is not None:
+        import sys
+
+        try:
+            tty = sys.stdin.isatty() and sys.stdout.isatty()
+        except (ValueError, OSError):
+            tty = False
+        if tty:
+            # arrow-key cursor menu (reference commands/menu/); plain input()
+            # keeps working for pipes/CI via the fallback below
+            from .menu import select
+
+            return cast(select(prompt, [str(c) for c in choices], default=str(default)))
     suffix = f" [{default}]" if default is not None else ""
     while True:
         raw = input(f"{prompt}{suffix}: ").strip()

@@ -93,13 +93,58 @@ def _sizes_from_checkpoint(path: str) -> dict:
             with np.load(f) as z:
                 for name in z.files:
                     total_f32_elems += int(np.prod(z[name].shape))
+    return _sizes_from_numel(total_f32_elems)
+
+
+def _sizes_from_numel(n: int) -> dict:
+    """Per-dtype byte sizes for ``n`` parameters — the single multiplier table
+    shared by the checkpoint-header and hub-config paths."""
     return {
-        "float32": total_f32_elems * 4,
-        "bfloat16": total_f32_elems * 2,
-        "float16": total_f32_elems * 2,
-        "int8": total_f32_elems,
-        "int4": total_f32_elems // 2,
+        "float32": n * 4,
+        "bfloat16": n * 2,
+        "float16": n * 2,
+        "int8": n,
+        "int4": n // 2,
     }
+
+
+def _sizes_from_hub(model_id: str, trust_remote_code: bool = False) -> dict:
+    """Any Hub model id (reference ``commands/estimate.py:316``): download the
+    CONFIG only, build the architecture on torch's meta device (zero RAM, zero
+    weight download — the reference's ``init_empty_weights`` moral twin) and
+    count parameters + buffers. Also works fully offline on a local directory
+    holding a ``config.json``."""
+    try:
+        import torch
+        import transformers
+        from transformers import AutoConfig, AutoModel
+    except ImportError as e:  # pragma: no cover - both installed in CI image
+        raise SystemExit(f"hub estimation needs transformers+torch ({e})")
+    try:
+        cfg = AutoConfig.from_pretrained(model_id, trust_remote_code=trust_remote_code)
+    except Exception as e:
+        raise SystemExit(
+            f"could not load a config for {model_id!r} ({type(e).__name__}: {e}). "
+            "Offline? Use a builtin model (llama|bert), a local checkpoint "
+            "path, or a local directory containing config.json."
+        )
+    try:
+        model = None
+        # the TASK class (config.architectures) counts untied heads the bare
+        # AutoModel base would miss — the reference picks it the same way
+        arch = (getattr(cfg, "architectures", None) or [None])[0]
+        cls = getattr(transformers, arch, None) if isinstance(arch, str) else None
+        with torch.device("meta"):
+            model = cls(cfg) if cls is not None else AutoModel.from_config(
+                cfg, trust_remote_code=trust_remote_code
+            )
+    except Exception as e:
+        raise SystemExit(
+            f"could not build {model_id!r} from its config ({type(e).__name__}: {e})"
+        )
+    n = sum(p.numel() for p in model.parameters())
+    n += sum(b.numel() for b in model.buffers())
+    return _sizes_from_numel(n)
 
 
 def _fmt(nbytes: float) -> str:
@@ -115,12 +160,14 @@ def estimate_command(args) -> int:
     if model in ("llama", "bert"):
         sizes = _sizes_from_builtin(model, args)
     elif os.path.exists(model):
-        sizes = _sizes_from_checkpoint(model)
+        try:
+            sizes = _sizes_from_checkpoint(model)
+        except FileNotFoundError:
+            # a model DIRECTORY without weight files may still carry a
+            # config.json — estimate from the architecture alone
+            sizes = _sizes_from_hub(model, trust_remote_code=getattr(args, "trust_remote_code", False))
     else:
-        raise SystemExit(
-            f"{model!r} is not a builtin model (llama|bert) or a local checkpoint path. "
-            "Hub ids require network access."
-        )
+        sizes = _sizes_from_hub(model, trust_remote_code=getattr(args, "trust_remote_code", False))
     wanted = args.dtypes or list(DTYPES)
     rows = []
     for d in wanted:
@@ -140,9 +187,15 @@ def estimate_command(args) -> int:
 
 def register_parser(subparsers) -> argparse.ArgumentParser:
     p = subparsers.add_parser("estimate-memory", help="Estimate model memory per dtype")
-    p.add_argument("model_name", help="builtin model (llama|bert), or checkpoint path")
+    p.add_argument(
+        "model_name",
+        help="builtin model (llama|bert), checkpoint path, Hub model id, or a "
+             "directory containing config.json",
+    )
     p.add_argument("--dtypes", nargs="+", choices=DTYPES, default=None)
     p.add_argument("--json", action="store_true")
+    p.add_argument("--trust_remote_code", action="store_true",
+                   help="allow custom modeling code from the Hub config")
     for k in ("vocab_size", "hidden_size", "num_layers", "num_heads", "intermediate_size"):
         p.add_argument(f"--{k}", type=int, default=None)
     p.set_defaults(func=estimate_command)

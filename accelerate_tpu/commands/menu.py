@@ -1,0 +1,148 @@
+"""Arrow-key selection menu for the interactive questionnaire.
+
+Counterpart of the reference's ``commands/menu/`` package (cursor-driven
+selection in ``accelerate config``), reimplemented minimally: a raw-mode
+cursor menu on ANSI terminals with a numbered-``input()`` fallback whenever
+stdin is not a TTY (CI, pipes, tests) — the questionnaire must never hang on
+a non-interactive stream.
+
+Keys: Up/Down (or k/j) move, digits jump, Enter confirms, q/Esc cancels back
+to the default.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+_UP = "up"
+_DOWN = "down"
+_ENTER = "enter"
+_CANCEL = "cancel"
+
+
+def _pending_input(stream, timeout: float = 0.05) -> bool:
+    """True when more bytes are already queued on ``stream`` — distinguishes a
+    bare Esc press from the head of an arrow escape sequence without blocking
+    the read. Streams without a selectable fd (StringIO in tests) report
+    whatever read() yields, which is non-blocking there anyway."""
+    try:
+        import select
+
+        r, _, _ = select.select([stream], [], [], timeout)
+        return bool(r)
+    except (ValueError, OSError, TypeError):
+        return True
+
+
+def _read_key(stream) -> str:
+    """Decode one keypress from ``stream`` into a symbolic name. Separated
+    from the terminal handling so the escape-sequence parsing is testable on
+    plain strings."""
+    ch = stream.read(1)
+    if not ch:
+        return _CANCEL
+    if ch in ("\r", "\n"):
+        return _ENTER
+    if ch in ("q", "Q", "\x03"):  # q / Ctrl-C
+        return _CANCEL
+    if ch == "\x1b":  # escape sequence (arrows) or bare Esc
+        if not _pending_input(stream):
+            return _CANCEL  # a lone Esc press: nothing follows
+        nxt = stream.read(1)
+        if nxt != "[":
+            return _CANCEL
+        final = stream.read(1)
+        return {"A": _UP, "B": _DOWN}.get(final, "")
+    if ch in ("k", "K"):
+        return _UP
+    if ch in ("j", "J"):
+        return _DOWN
+    if ch.isdigit():
+        return ch
+    return ""
+
+
+def _next_index(key: str, index: int, n: int) -> int:
+    """Pure cursor arithmetic (wrap-around; digit keys jump 1-based)."""
+    if key == _UP:
+        return (index - 1) % n
+    if key == _DOWN:
+        return (index + 1) % n
+    if key.isdigit():
+        j = int(key) - 1
+        if 0 <= j < n:
+            return j
+    return index
+
+
+def _render(prompt: str, options: Sequence[str], index: int, first: bool) -> None:
+    out = sys.stdout
+    if not first:
+        out.write(f"\x1b[{len(options)}A")  # cursor back up over the options
+    for i, opt in enumerate(options):
+        marker = "➤" if i == index else " "
+        style = ("\x1b[7m", "\x1b[0m") if i == index else ("", "")
+        out.write(f"\x1b[2K {marker} {style[0]}{opt}{style[1]}\n")
+    out.flush()
+
+
+def _interactive_select(prompt: str, options: Sequence[str], default_index: int) -> int:
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    saved = termios.tcgetattr(fd)
+    index = default_index
+    print(f"{prompt} (arrows + Enter; q for default)")
+    _render(prompt, options, index, first=True)
+    try:
+        tty.setcbreak(fd)
+        while True:
+            key = _read_key(sys.stdin)
+            if key == _ENTER:
+                return index
+            if key == _CANCEL:
+                index = default_index
+                _render(prompt, options, index, first=False)
+                return index
+            new = _next_index(key, index, len(options))
+            if new != index:
+                index = new
+                _render(prompt, options, index, first=False)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, saved)
+
+
+def _fallback_select(prompt: str, options: Sequence[str], default_index: int) -> int:
+    print(prompt)
+    for i, opt in enumerate(options):
+        marker = "*" if i == default_index else " "
+        print(f" {marker} {i + 1}) {opt}")
+    raw = input(f"choose 1-{len(options)} [{default_index + 1}]: ").strip()
+    if not raw:
+        return default_index
+    try:
+        j = int(raw) - 1
+    except ValueError:
+        return default_index
+    return j if 0 <= j < len(options) else default_index
+
+
+def select(prompt: str, options: Sequence[str], default: Optional[str] = None) -> str:
+    """Pick one of ``options``; returns the chosen string. Arrow-key cursor on
+    a TTY, numbered fallback otherwise."""
+    options = list(options)
+    if not options:
+        raise ValueError("select() needs at least one option")
+    default_index = options.index(default) if default in options else 0
+    try:
+        interactive = sys.stdin.isatty() and sys.stdout.isatty()
+    except (ValueError, OSError):  # closed/replaced streams
+        interactive = False
+    if interactive:
+        try:
+            return options[_interactive_select(prompt, options, default_index)]
+        except (ImportError, OSError):  # no termios (non-POSIX) / odd terminal
+            pass
+    return options[_fallback_select(prompt, options, default_index)]

@@ -13,11 +13,95 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_cli(*argv, **kw):
-    env = {**os.environ, "PYTHONPATH": REPO}
+    env = kw.pop("env", None) or {**os.environ, "PYTHONPATH": REPO}
     return subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", *argv],
         capture_output=True, text=True, env=env, timeout=300, **kw,
     )
+
+
+class TestArrowKeyMenu:
+    """reference ``commands/menu/`` counterpart: cursor-key selection with a
+    numbered non-TTY fallback."""
+
+    def test_key_decoding(self):
+        import io
+
+        from accelerate_tpu.commands.menu import _CANCEL, _DOWN, _ENTER, _UP, _read_key
+
+        assert _read_key(io.StringIO("\x1b[A")) == _UP
+        assert _read_key(io.StringIO("\x1b[B")) == _DOWN
+        assert _read_key(io.StringIO("\r")) == _ENTER
+        assert _read_key(io.StringIO("\n")) == _ENTER
+        assert _read_key(io.StringIO("q")) == _CANCEL
+        assert _read_key(io.StringIO("\x1b")) == _CANCEL  # bare Esc
+        assert _read_key(io.StringIO("k")) == _UP
+        assert _read_key(io.StringIO("j")) == _DOWN
+        assert _read_key(io.StringIO("3")) == "3"
+        assert _read_key(io.StringIO("")) == _CANCEL  # EOF
+        assert _read_key(io.StringIO("x")) == ""  # ignored
+
+    def test_cursor_arithmetic_wraps(self):
+        from accelerate_tpu.commands.menu import _DOWN, _UP, _next_index
+
+        assert _next_index(_DOWN, 0, 3) == 1
+        assert _next_index(_DOWN, 2, 3) == 0  # wrap
+        assert _next_index(_UP, 0, 3) == 2  # wrap
+        assert _next_index("2", 0, 3) == 1  # digit jump (1-based)
+        assert _next_index("9", 1, 3) == 1  # out of range: stay
+        assert _next_index("", 1, 3) == 1
+
+    def test_non_tty_fallback(self, monkeypatch):
+        from accelerate_tpu.commands import menu
+
+        monkeypatch.setattr("builtins.input", lambda *_: "2")
+        assert menu.select("pick", ["a", "b", "c"]) == "b"
+        monkeypatch.setattr("builtins.input", lambda *_: "")
+        assert menu.select("pick", ["a", "b", "c"], default="c") == "c"
+        monkeypatch.setattr("builtins.input", lambda *_: "nope")
+        assert menu.select("pick", ["a", "b"], default="b") == "b"
+
+    def test_ask_with_choices_uses_fallback_off_tty(self, monkeypatch):
+        from accelerate_tpu.commands.config import _ask
+
+        monkeypatch.setattr("builtins.input", lambda *_: "")
+        assert _ask("Mixed precision", "bf16", str, ("no", "bf16", "fp16")) == "bf16"
+
+
+def test_estimate_memory_from_config_json(tmp_path):
+    """Hub-style estimation (reference commands/estimate.py:316): architecture
+    built on the meta device from a config.json alone — works offline on a
+    local model directory, and on any Hub id when network exists."""
+    import json as _json
+
+    cfgdir = tmp_path / "tiny-bert"
+    cfgdir.mkdir()
+    (cfgdir / "config.json").write_text(_json.dumps({
+        "model_type": "bert",
+        "vocab_size": 128,
+        "hidden_size": 32,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 2,
+        "intermediate_size": 64,
+        "max_position_embeddings": 64,
+    }))
+    r = run_cli("estimate-memory", str(cfgdir), "--json")
+    assert r.returncode == 0, r.stderr
+    import json
+
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    n_f32 = out["float32"]["inference_bytes"]
+    assert n_f32 > 0 and out["bfloat16"]["inference_bytes"] == n_f32 // 2
+    assert out["float32"]["adam_training_bytes"] == n_f32 * 4
+
+
+def test_estimate_memory_unreachable_hub_id_fails_cleanly():
+    # HF_HUB_OFFLINE makes the failure deterministic and instant (no network
+    # retry cycle in sandboxes where outbound traffic hangs)
+    env = {**os.environ, "PYTHONPATH": REPO, "HF_HUB_OFFLINE": "1"}
+    r = run_cli("estimate-memory", "no-such-org/no-such-model", env=env)
+    assert r.returncode != 0
+    assert "could not load a config" in (r.stderr + r.stdout)
 
 
 def test_config_default_roundtrip(tmp_path):
