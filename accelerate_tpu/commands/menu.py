@@ -87,19 +87,44 @@ def _render(prompt: str, options: Sequence[str], index: int, first: bool) -> Non
     out.flush()
 
 
+class _FdStream:
+    """Unbuffered reader over a file descriptor. sys.stdin's text layer
+    buffers the '[A' tail of an arrow escape sequence after read(1), which
+    makes select() report nothing pending and a real arrow press look like a
+    bare Esc — raw os.read never over-reads, so the fd state stays honest."""
+
+    def __init__(self, fd: int):
+        self._fd = fd
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def read(self, n: int = 1) -> str:
+        import os
+
+        return os.read(self._fd, n).decode("utf-8", errors="ignore")
+
+
 def _interactive_select(prompt: str, options: Sequence[str], default_index: int) -> int:
     import termios
     import tty
 
     fd = sys.stdin.fileno()
-    saved = termios.tcgetattr(fd)
+    try:
+        saved = termios.tcgetattr(fd)
+    except termios.error as e:  # isatty lied (restricted pty/IDE console)
+        raise OSError(str(e))  # -> select() falls back to the numbered menu
     index = default_index
     print(f"{prompt} (arrows + Enter; q for default)")
     _render(prompt, options, index, first=True)
+    stream = _FdStream(fd)
     try:
-        tty.setcbreak(fd)
+        try:
+            tty.setcbreak(fd)
+        except termios.error as e:
+            raise OSError(str(e))
         while True:
-            key = _read_key(sys.stdin)
+            key = _read_key(stream)
             if key == _ENTER:
                 return index
             if key == _CANCEL:

@@ -22,10 +22,17 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _reset_singletons():
-    """Each test gets fresh state singletons (reference tests use _reset_state too)."""
+    """Each test gets fresh state singletons (reference tests use _reset_state
+    too) and an unchanged global jax config: a test exercising
+    ``JitConfig(disable_jit=True)`` must not leave the WHOLE remaining suite
+    running eager (observed: the dryrun's shard_map PP leg needs a jit
+    context and failed suite-only)."""
+    prev_disable_jit = bool(jax.config.jax_disable_jit)
     yield
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+    if bool(jax.config.jax_disable_jit) != prev_disable_jit:
+        jax.config.update("jax_disable_jit", prev_disable_jit)

@@ -61,6 +61,44 @@ class TestArrowKeyMenu:
         monkeypatch.setattr("builtins.input", lambda *_: "nope")
         assert menu.select("pick", ["a", "b"], default="b") == "b"
 
+    def test_arrow_keys_on_a_real_pty(self):
+        """Down + Enter over a pty must select the second option — guards the
+        buffered-stdin regression where an arrow press read as bare Esc."""
+        import pty
+        import time
+
+        pid, fd = pty.fork()
+        if pid == 0:  # child
+            try:
+                # pytest's capture machinery replaced sys.stdin/stdout with
+                # non-tty objects; rebind them to the pty fds
+                sys.stdin = os.fdopen(0, "r")
+                sys.stdout = os.fdopen(1, "w", buffering=1)
+                from accelerate_tpu.commands.menu import select
+
+                choice = select("pick", ["alpha", "beta", "gamma"], default="alpha")
+                os.write(1, f"CHOSEN={choice}".encode())
+            except BaseException as e:  # surface child failures to the parent
+                os.write(1, f"CHILD-ERROR {type(e).__name__}: {e}".encode())
+            finally:
+                os._exit(0)
+        time.sleep(1.0)
+        os.write(fd, b"\x1b[B")
+        time.sleep(0.3)
+        os.write(fd, b"\r")
+        out = b""
+        t0 = time.time()
+        while time.time() - t0 < 15 and b"CHOSEN=" not in out:
+            try:
+                chunk = os.read(fd, 4096)
+            except OSError:
+                break
+            if not chunk:
+                break
+            out += chunk
+        os.waitpid(pid, 0)
+        assert b"CHOSEN=beta" in out, out[-500:]
+
     def test_ask_with_choices_uses_fallback_off_tty(self, monkeypatch):
         from accelerate_tpu.commands.config import _ask
 

@@ -78,6 +78,19 @@ class TestTwoProcesses:
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
 
+    def test_hybrid_mesh_process_granule(self, shared_tmpdir):
+        """2 procs x 2 local devices: the DCN-aware hybrid mesh places
+        dp_replicate across process granules and a real sharded train step
+        runs over it (the single-machine analogue of a 2-slice pod)."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "hybrid_mesh", "--tmpdir", shared_tmpdir],
+            num_processes=2,
+            devices_per_process=2,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+            assert "hybrid mesh (process granule) train step OK" in out, out[-2000:]
+
     def test_sharded_generate(self, shared_tmpdir):
         """TP-sharded KV-cache decode across 2 processes: the row-parallel psum
         rides the cross-process collective backend inside the compiled decode
