@@ -52,6 +52,8 @@ __all__ = [
     "load_checkpoint_and_dispatch",
     "load_checkpoint_in_model",
     "prepare_pipeline",
+    "prepare_pippy",
+    "rich",
     "synchronize_rng_states",
     "LocalSGD",
     "find_executable_batch_size",
@@ -135,10 +137,17 @@ def __getattr__(name):
         from .utils.imports import is_rich_available
 
         return is_rich_available
-    if name == "prepare_pipeline":
+    if name in ("prepare_pipeline", "prepare_pippy"):
+        # reference spelling `accelerate.prepare_pippy` (inference.py:126)
+        # resolves to the native pipeline prep
         from .parallel.pipeline import prepare_pipeline
 
         return prepare_pipeline
+    if name == "rich":
+        # reference exports the rich helper module at top level
+        from .utils import rich
+
+        return rich
     if name in _BIG_MODELING:
         from . import big_modeling
 
@@ -152,6 +161,24 @@ def __getattr__(name):
 
         return getattr(quantization, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")
+
+
+# lazy names served by __getattr__ that are not in __all__ — keep in sync
+# when adding a new branch there, or dir() will hide the new export
+_LAZY_EXTRAS = {"tqdm", "rich_print", "get_console", "clear_device_cache"}
+
+
+def __dir__():
+    # make the lazy names introspectable: dir(accelerate_tpu) must show the
+    # full public surface, not just what's been imported eagerly
+    return sorted(
+        set(globals())
+        | set(__all__)
+        | _LAZY_EXTRAS
+        | _BIG_MODELING
+        | _MODELING_UTILS
+        | _QUANTIZATION
+    )
 
 
 _BIG_MODELING = {

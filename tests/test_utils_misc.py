@@ -114,14 +114,15 @@ class TestLogging:
 class TestPublicAPI:
     def test_reference_top_level_names_resolve(self):
         """The reference's own top-level exports (its ``__init__.py``) must all
-        exist here — ``prepare_pippy`` excepted, whose analog is
-        ``parallel.pipeline.make_pipeline_forward`` (trainable, unlike PiPPy)."""
+        exist here — incl. ``prepare_pippy``, aliased to the native
+        ``prepare_pipeline`` (trainable, unlike PiPPy); the exhaustive sweep
+        lives in test_api_parity.py."""
         import accelerate_tpu as at
 
         for name in ("Accelerator", "PartialState", "ParallelismConfig",
-                     "notebook_launcher", "debug_launcher", "skip_first_batches"):
+                     "notebook_launcher", "debug_launcher", "skip_first_batches",
+                     "prepare_pippy"):
             assert getattr(at, name) is not None, name
-        from accelerate_tpu.parallel.pipeline import make_pipeline_forward  # noqa: F401
 
     def test_all_exports_resolve(self):
         import accelerate_tpu as at
