@@ -447,15 +447,27 @@ class Accelerator:
                 prepared = self.prepare_model(obj, shard_rules=shard_rules)
                 params_seen = prepared
                 results[i] = prepared
+        from .utils.dataclasses import DummyOptim, DummyScheduler
+
+        # reference DeepSpeed flow: placeholder optimizer/scheduler become real
+        # at prepare time. When BOTH are present, the schedule is baked into
+        # the optax optimizer as its learning_rate fn — the update really
+        # follows warmup/decay, not just the reported get_last_lr()
+        dummy_scheds = [o for o in args if isinstance(o, DummyScheduler)]
+        schedule_fn = self._dummy_schedule_fn(dummy_scheds[0]) if dummy_scheds else None
         for i, obj in enumerate(args):
             if results[i] is not _todo:
                 continue
             if _is_torch_optimizer(obj):
                 results[i] = self.prepare_torch_optimizer(obj, module=bridged_module)
+            elif isinstance(obj, DummyOptim):
+                results[i] = self.prepare_optimizer(obj.to_optax(learning_rate=schedule_fn))
             elif _is_dataloader(obj):
                 results[i] = self.prepare_data_loader(obj)
             elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
                 results[i] = self.prepare_optimizer(obj)
+            elif isinstance(obj, DummyScheduler):
+                results[i] = self.prepare_scheduler(self._dummy_schedule_fn(obj))
             elif isinstance(obj, AcceleratedScheduler) or _is_torch_lr_scheduler(obj):
                 results[i] = self.prepare_scheduler(obj)
             else:
@@ -584,6 +596,37 @@ class Accelerator:
         optimizer.accelerator_state = self.state
         self._optimizers.append(optimizer)
         return optimizer
+
+    @staticmethod
+    def _dummy_schedule_fn(dummy):
+        """Reference ``DummyScheduler`` flow (``utils/deepspeed.py``): linear
+        warmup over ``warmup_num_steps`` then linear decay to 0 at
+        ``total_num_steps`` (the DS ``WarmupDecayLR`` shape), around the
+        paired optimizer's base learning rate. Returned as a pure
+        ``step -> lr`` fn so it can serve BOTH as the optax learning_rate and
+        as the AcceleratedScheduler's reporting schedule."""
+        if dummy.lr_scheduler_callable is not None:
+            return dummy.lr_scheduler_callable()
+        paired = getattr(dummy, "optimizer", None)
+        base_lr = getattr(paired, "lr", None)
+        if base_lr is None:
+            base_lr = 1e-3
+        total = dummy.total_num_steps if dummy.total_num_steps is not None else 1000
+        warmup = min(dummy.warmup_num_steps, total)
+
+        def schedule_fn(step):
+            import jax.numpy as jnp
+
+            step = jnp.asarray(step, jnp.float32)
+            warm = base_lr * (step + 1) / max(warmup, 1)
+            if total > warmup:
+                frac = (step - warmup) / (total - warmup)
+                decay = base_lr * jnp.maximum(0.0, 1.0 - frac)
+            else:
+                decay = jnp.asarray(base_lr, jnp.float32)
+            return jnp.where(step < warmup, warm, decay) if warmup else decay
+
+        return schedule_fn
 
     def prepare_scheduler(self, scheduler) -> AcceleratedScheduler:
         if not isinstance(scheduler, AcceleratedScheduler):

@@ -35,6 +35,19 @@ SAMPLER_NAME = "dataloader"
 RNG_NAME = "random_states"
 CUSTOM_NAME = "custom_checkpoint"
 
+# reference utils/constants.py:20-33 spellings, reflecting THIS framework's
+# file layout (safetensors for interop, npz for the dependency-free path)
+SAFE_MODEL_NAME = MODEL_NAME
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+SAFE_WEIGHTS_PATTERN_NAME = "model{suffix}.safetensors"
+WEIGHTS_NAME = "model.npz"
+WEIGHTS_INDEX_NAME = "model.npz.index.json"
+WEIGHTS_PATTERN_NAME = "model{suffix}.npz"
+RNG_STATE_NAME = RNG_NAME
+SCALER_NAME = "scaler"  # fp16 scale state lives inside the optimizer state
+PROFILE_PATTERN_NAME = "profile_{suffix}.json"
+
 
 # ---------------------------------------------------------------------------
 # pytree <-> flat dict
@@ -398,7 +411,7 @@ def save_model(
         from safetensors.numpy import save_file
 
         if len(shards) == 1:
-            path = os.path.join(save_directory, "model.safetensors")
+            path = os.path.join(save_directory, SAFE_WEIGHTS_NAME)
             save_file(_safetensors_compat(shards[0]), path)
             written.append(path)
         else:
@@ -409,10 +422,10 @@ def save_model(
                 written.append(os.path.join(save_directory, name))
                 for key in shard:
                     index["weight_map"][key] = name
-            with open(os.path.join(save_directory, "model.safetensors.index.json"), "w") as f:
+            with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
                 json.dump(index, f, indent=2)
     else:
-        path = os.path.join(save_directory, "model.npz")
+        path = os.path.join(save_directory, WEIGHTS_NAME)
         np.savez(path, **flat)
         written.append(path)
     return written
@@ -433,9 +446,9 @@ def load_checkpoint_in_model(params_template, checkpoint_path: str):
     """Load a safetensors/npz checkpoint into a params pytree template
     (reference ``load_checkpoint_in_model utils/modeling.py:1788``)."""
     if os.path.isdir(checkpoint_path):
-        index_file = os.path.join(checkpoint_path, "model.safetensors.index.json")
-        single = os.path.join(checkpoint_path, "model.safetensors")
-        npz = os.path.join(checkpoint_path, "model.npz")
+        index_file = os.path.join(checkpoint_path, SAFE_WEIGHTS_INDEX_NAME)
+        single = os.path.join(checkpoint_path, SAFE_WEIGHTS_NAME)
+        npz = os.path.join(checkpoint_path, WEIGHTS_NAME)
         if os.path.exists(index_file):
             from safetensors.numpy import load_file
 

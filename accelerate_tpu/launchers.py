@@ -48,6 +48,24 @@ def notebook_launcher(
         return function(*args)
 
 
+class PrepareForLaunch:
+    """reference ``PrepareForLaunch utils/launch.py``: a picklable wrapper that
+    sets the per-process env protocol before calling ``function`` — used when
+    a launcher spawns worker processes for multi-host rendezvous."""
+
+    def __init__(self, launcher: Callable, distributed_type: str = "NO", debug: bool = False):
+        self.launcher = launcher
+        self.distributed_type = str(distributed_type)
+        self.debug = debug
+
+    def __call__(self, index: int, *args):
+        env: dict[str, Any] = {"ACCELERATE_PROCESS_ID": index}
+        if self.debug:
+            env["ACCELERATE_DEBUG_MODE"] = "true"
+        with patch_environment(**env):
+            return self.launcher(*args)
+
+
 def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2):
     """Run ``function`` on a virtual ``num_processes``-device CPU mesh
     (reference ``debug_launcher:276`` forks CPU processes; here XLA fakes the

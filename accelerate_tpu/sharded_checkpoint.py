@@ -402,3 +402,51 @@ def merge_sharded_checkpoint(directory: str, output_path: str, prefix: str = "mo
         np.savez(output_path, **flat)
     logger.info(f"consolidated {len(flat)} leaves → {output_path}")
     return output_path
+
+
+# ---------------------------------------------------------------------------
+# reference utils/fsdp_utils.py spellings: the DCP-style sharded save/load
+# entry points, mapped onto the native per-host shard format
+
+
+def _fsdp_prefix(base: str, index: int) -> str:
+    return base if index == 0 else f"{base}_{index}"
+
+
+def save_fsdp_model(fsdp_plugin, accelerator, model, output_dir: str, model_index: int = 0,
+                    adapter_only: bool = False) -> str:
+    """reference ``save_fsdp_model utils/fsdp_utils.py:103``: sharded save of a
+    (possibly multi-host-sharded) param pytree; no host materializes the full
+    state. ``fsdp_plugin`` is accepted for signature parity — sharding layout
+    comes from the arrays themselves under GSPMD."""
+    return save_sharded_pytree(model, output_dir, prefix=_fsdp_prefix("model", model_index))
+
+
+def load_fsdp_model(fsdp_plugin, accelerator, model, input_dir: str, model_index: int = 0,
+                    adapter_only: bool = False):
+    """reference ``load_fsdp_model``: reload onto the live tree's shardings
+    (works across a different mesh factorization — resharding reads only the
+    needed chunk regions)."""
+    return load_sharded_pytree(model, input_dir, prefix=_fsdp_prefix("model", model_index))
+
+
+def save_fsdp_optimizer(fsdp_plugin, accelerator, optimizer, model, output_dir: str,
+                        optimizer_index: int = 0) -> str:
+    """reference ``save_fsdp_optimizer utils/fsdp_utils.py:233``."""
+    opt_state = getattr(optimizer, "opt_state", optimizer)
+    return save_sharded_pytree(
+        opt_state, output_dir, prefix=_fsdp_prefix("optimizer", optimizer_index)
+    )
+
+
+def load_fsdp_optimizer(fsdp_plugin, accelerator, optimizer, model, input_dir: str,
+                        optimizer_index: int = 0, adapter_only: bool = False):
+    """reference ``load_fsdp_optimizer``: restores into the wrapper's live
+    ``opt_state`` (and returns it)."""
+    template = getattr(optimizer, "opt_state", optimizer)
+    state = load_sharded_pytree(
+        template, input_dir, prefix=_fsdp_prefix("optimizer", optimizer_index)
+    )
+    if hasattr(optimizer, "opt_state"):
+        optimizer.opt_state = state
+    return state

@@ -1,11 +1,17 @@
 from .dataclasses import (
     AutocastConfig,
     AutocastKwargs,
+    ComputeEnvironment,
+    CustomDtype,
     DDPCommunicationHookType,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
+    DeepSpeedSequenceParallelConfig,
     DistributedDataParallelKwargs,
     DistributedType,
+    DummyOptim,
+    DummyScheduler,
+    DynamoBackend,
     FullyShardedDataParallelPlugin,
     GradScalerConfig,
     GradScalerKwargs,
@@ -20,9 +26,14 @@ from .dataclasses import (
     ProfileKwargs,
     ProjectConfiguration,
     RNGType,
+    SageMakerDistributedType,
     SaveFormat,
+    TorchContextParallelConfig,
+    TorchDynamoPlugin,
+    TorchTensorParallelConfig,
+    TorchTensorParallelPlugin,
 )
-from .versions import compare_versions, is_jax_version
+from .versions import compare_versions, is_jax_version, is_torch_version
 from .environment import (
     are_libraries_initialized,
     clear_environment,
@@ -40,14 +51,19 @@ from .environment import (
 # import gather, set_seed, ...` spellings resolve the same either way.
 _OPERATIONS = {
     "DistributedOperationException",
+    "TensorInformation",
+    "avg_losses_across_data_parallel_group",
     "broadcast",
     "broadcast_object_list",
     "concatenate",
     "find_batch_size",
     "gather",
+    "gather_across_data_parallel_groups",
     "gather_object",
     "get_data_structure",
+    "ignorant_find_batch_size",
     "initialize_tensors",
+    "is_tensor_information",
     "pad_across_processes",
     "pad_input_tensors",
     "recursively_apply",
@@ -69,6 +85,7 @@ _RANDOM = {
 # are the ones with native counterparts here).
 _MODELING = {
     "abstract_params",
+    "align_module_device",
     "clean_device_map",
     "compute_module_sizes",
     "compute_parameter_sizes",
@@ -77,6 +94,11 @@ _MODELING = {
     "find_tied_parameters",
     "get_balanced_memory",
     "get_max_memory",
+    "has_offloaded_params",
+    "id_tensor_storage",
+    "load_offloaded_weights",
+    "named_module_tensors",
+    "set_module_tensor_to_device",
     "infer_auto_device_map",
     "load_checkpoint_in_params",
     "load_state_dict",
@@ -99,6 +121,8 @@ _QUANT = {"QuantizationConfig", "QuantizedArray", "load_and_quantize_model", "qu
 _PACKING = {"pack_sequences", "unpack_logits"}
 _OTHER = {
     "check_os_kernel",
+    "is_compiled_module",
+    "is_torch_tensor",
     "clean_state_dict_for_safetensors",
     "convert_bytes",
     "convert_outputs_to_fp32",
@@ -116,7 +140,14 @@ _OTHER = {
     "save",
 }
 # checkpoint-layout constants (reference utils/constants.py:20-33)
-_CONSTANTS = {"MODEL_NAME", "OPTIMIZER_NAME", "SCHEDULER_NAME", "SAMPLER_NAME", "RNG_NAME"}
+_CONSTANTS = {
+    "MODEL_NAME", "OPTIMIZER_NAME", "SCHEDULER_NAME", "SAMPLER_NAME", "RNG_NAME",
+    "SAFE_MODEL_NAME", "SAFE_WEIGHTS_NAME", "SAFE_WEIGHTS_INDEX_NAME",
+    "SAFE_WEIGHTS_PATTERN_NAME", "WEIGHTS_NAME", "WEIGHTS_INDEX_NAME",
+    "WEIGHTS_PATTERN_NAME", "RNG_STATE_NAME", "SCALER_NAME", "PROFILE_PATTERN_NAME",
+}
+# sharded save/load reference spellings (utils/fsdp_utils.py)
+_FSDP_CKPT = {"save_fsdp_model", "load_fsdp_model", "save_fsdp_optimizer", "load_fsdp_optimizer"}
 
 
 def __getattr__(name):
@@ -156,6 +187,22 @@ def __getattr__(name):
         from .. import checkpointing
 
         return getattr(checkpointing, name)
+    if name in _FSDP_CKPT:
+        from .. import sharded_checkpoint
+
+        return getattr(sharded_checkpoint, name)
+    if name == "ParallelismConfig":  # reference re-exports it from utils too
+        from ..parallelism_config import ParallelismConfig
+
+        return ParallelismConfig
+    if name == "PrepareForLaunch":
+        from ..launchers import PrepareForLaunch
+
+        return PrepareForLaunch
+    if name == "load_checkpoint_in_model":
+        from ..checkpointing import load_checkpoint_in_model
+
+        return load_checkpoint_in_model
     if name == "BnbQuantizationConfig":  # reference name for the quant config
         from .quantization import QuantizationConfig
 
@@ -185,17 +232,24 @@ def __getattr__(name):
 
 
 from .imports import (
+    is_aim_available,
     is_bf16_available,
     is_bnb_available,
+    is_boto3_available,
     is_chex_available,
+    is_clearml_available,
+    is_comet_ml_available,
     is_cpu_only,
     is_cuda_available,
     is_datasets_available,
     is_deepspeed_available,
+    is_dvclive_available,
     is_flax_available,
     is_fp8_available,
     is_fp16_available,
     is_gpu_available,
+    is_import_timer_available,
+    is_lomo_available,
     is_matplotlib_available,
     is_megatron_lm_available,
     is_mlflow_available,
@@ -204,18 +258,31 @@ from .imports import (
     is_optax_available,
     is_orbax_available,
     is_pallas_available,
+    is_pandas_available,
     is_peft_available,
+    is_pippy_available,
+    is_pynvml_available,
+    is_pytest_available,
     is_rich_available,
     is_safetensors_available,
+    is_sagemaker_available,
+    is_schedulefree_available,
+    is_swanlab_available,
     is_tensorboard_available,
     is_timm_available,
     is_torch_available,
     is_torch_xla_available,
+    is_torchdata_available,
+    is_torchdata_stateful_dataloader_available,
     is_torchvision_available,
     is_tpu_available,
     is_tqdm_available,
+    is_trackio_available,
     is_transformers_available,
+    is_triton_available,
     is_wandb_available,
+    is_weights_only_available,
+    is_xccl_available,
 )
 
 # __all__ spans the eager imports above AND the lazy names (star-import
@@ -227,10 +294,13 @@ _LAZY_EXTRA = {
     "wait_for_everyone",
     "merge_fsdp_weights",
     "tqdm",
+    "ParallelismConfig",
+    "PrepareForLaunch",
+    "load_checkpoint_in_model",
 }
 _ALL_LAZY = (
     _OPERATIONS | _RANDOM | _MODELING | _OFFLOAD | _MEMORY | _QUANT | _OTHER | _PACKING
-    | _CONSTANTS | _LAZY_EXTRA
+    | _CONSTANTS | _FSDP_CKPT | _LAZY_EXTRA
 )
 
 __all__ = sorted(

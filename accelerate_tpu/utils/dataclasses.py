@@ -587,6 +587,215 @@ GradScalerKwargs = GradScalerConfig
 ProfileKwargs = ProfileConfig
 
 
+class CustomDtype(BaseEnum):
+    """reference ``CustomDtype`` — sub-byte / fp8 markers for memory-size
+    accounting (``dtype_byte_size``/``infer_auto_device_map``): these have no
+    numpy dtype, so device-map math names them explicitly."""
+
+    FP8 = "fp8"
+    INT4 = "int4"
+    INT2 = "int2"
+
+
+class ComputeEnvironment(BaseEnum):
+    """reference ``utils/dataclasses.py`` — config-file field; SageMaker
+    clusters are not a TPU deployment target but configs naming them parse."""
+
+    LOCAL_MACHINE = "LOCAL_MACHINE"
+    AMAZON_SAGEMAKER = "AMAZON_SAGEMAKER"
+
+
+class SageMakerDistributedType(BaseEnum):
+    """reference config-file enum (parsed, not acted on — no SageMaker on TPU)."""
+
+    NO = "NO"
+    DATA_PARALLEL = "DATA_PARALLEL"
+    MODEL_PARALLEL = "MODEL_PARALLEL"
+
+
+class DynamoBackend(BaseEnum):
+    """reference ``DynamoBackend:684``. On TPU there is exactly one compiler —
+    XLA via jit, on by default — so these values only steer :class:`JitConfig`:
+    ``EAGER`` disables jit (debugging), everything else keeps it on."""
+
+    NO = "NO"
+    EAGER = "EAGER"
+    AOT_EAGER = "AOT_EAGER"
+    INDUCTOR = "INDUCTOR"
+    AOT_TS_NVFUSER = "AOT_TS_NVFUSER"
+    NVPRIMS_NVFUSER = "NVPRIMS_NVFUSER"
+    CUDAGRAPHS = "CUDAGRAPHS"
+    OFI = "OFI"
+    FX2TRT = "FX2TRT"
+    ONNXRT = "ONNXRT"
+    TENSORRT = "TENSORRT"
+    IPEX = "IPEX"
+    TVM = "TVM"
+
+
+@dataclass
+class TorchDynamoPlugin(KwargsHandler):
+    """Migration shim for reference ``TorchDynamoPlugin:1024``. XLA compilation
+    is default-on; the one actionable knob is ``backend=EAGER`` → run eager
+    (:class:`JitConfig` ``disable_jit``). ``mode``/``fullgraph``/``dynamic``
+    have no XLA meaning (jit always captures the full graph with static
+    shapes) and are accepted for config compatibility."""
+
+    backend: Any = DynamoBackend.NO
+    mode: str = "default"
+    fullgraph: bool = False
+    dynamic: Optional[bool] = None
+    options: Optional[dict] = None
+    disable: bool = False
+
+    def to_jit_config(self) -> JitConfig:
+        backend = str(self.backend).rsplit(".", 1)[-1].upper()
+        return JitConfig(disable_jit=(backend == "EAGER"))
+
+
+@dataclass
+class TorchContextParallelConfig(KwargsHandler):
+    """Migration shim for reference ``TorchContextParallelConfig:2186``:
+    ``cp_comm_strategy`` maps onto the native ``cp_rotate_method`` —
+    ``allgather`` → allgather rotation, ``alltoall`` → the zig-zag
+    load-balanced ring (the rotation-style strategy here)."""
+
+    cp_comm_strategy: Optional[str] = None
+
+    def __post_init__(self):
+        if self.cp_comm_strategy is None:
+            self.cp_comm_strategy = os.environ.get(
+                "PARALLELISM_CONFIG_CP_COMM_STRATEGY", "allgather"
+            )
+        if self.cp_comm_strategy not in ("allgather", "alltoall"):
+            raise ValueError(
+                f"cp_comm_strategy must be 'allgather' or 'alltoall', got "
+                f"{self.cp_comm_strategy!r}"
+            )
+
+    @property
+    def cp_rotate_method(self) -> str:
+        return "allgather" if self.cp_comm_strategy == "allgather" else "zigzag"
+
+
+@dataclass
+class TorchTensorParallelConfig(KwargsHandler):
+    """Migration shim for reference ``TorchTensorParallelConfig:2264``.
+    ``enable_async_tp`` is accepted and ignored with the same warning the
+    reference emits — XLA already overlaps TP collectives with compute."""
+
+    enable_async_tp: bool = False
+
+    def __post_init__(self):
+        if self.enable_async_tp:
+            import warnings
+
+            warnings.warn(
+                "async tensor parallelism is not a knob under XLA (collective "
+                "overlap is compiler-scheduled); ignoring enable_async_tp",
+                stacklevel=2,
+            )
+
+
+@dataclass
+class TorchTensorParallelPlugin(KwargsHandler):
+    """Migration shim: reference TP plugin → ``tp`` mesh axis size."""
+
+    tp_size: int = 1
+    torch_device_mesh: Any = None  # accepted for signature parity
+
+    def to_parallelism_config(self):
+        from ..parallelism_config import ParallelismConfig
+
+        return ParallelismConfig(tp_size=self.tp_size, dp_shard_size=-1)
+
+
+@dataclass
+class DeepSpeedSequenceParallelConfig(KwargsHandler):
+    """Migration shim for reference ``DeepSpeedSequenceParallelConfig:2214``
+    (Ulysses/ALST). Sequence-length knobs are accepted (our Ulysses works at
+    any length divisible by ``sp``); ``sp_attn_implementation`` maps onto the
+    native ``attention_impl``."""
+
+    sp_seq_length: Optional[int] = None
+    sp_seq_length_is_variable: Optional[bool] = None
+    sp_attn_implementation: Optional[str] = None
+
+    def __post_init__(self):
+        if self.sp_seq_length_is_variable is None:
+            self.sp_seq_length_is_variable = (
+                os.environ.get("PARALLELISM_CONFIG_SP_SEQ_LENGTH_IS_VARIABLE", "true").lower()
+                == "true"
+            )
+        if self.sp_attn_implementation is None:
+            self.sp_attn_implementation = os.environ.get(
+                "PARALLELISM_CONFIG_SP_ATTN_IMPLEMENTATION", None
+            )
+        if self.sp_attn_implementation is not None and self.sp_attn_implementation not in (
+            "flash_attention_2", "flash_attention_3", "sdpa"
+        ):
+            raise ValueError(
+                f"invalid sp_attn_implementation {self.sp_attn_implementation!r}"
+            )
+
+    @property
+    def attention_impl(self) -> str:
+        """Native ``attention_impl`` for the model forward."""
+        if self.sp_attn_implementation in ("flash_attention_2", "flash_attention_3"):
+            return "flash"
+        return "xla"
+
+
+class DummyOptim:
+    """Placeholder optimizer (reference ``utils/deepspeed.py`` ``DummyOptim``):
+    in the reference the real optimizer comes from the DeepSpeed config; here
+    ``Accelerator.prepare`` materializes an optax AdamW from the recorded
+    hyperparameters — user scripts written against the reference's
+    DummyOptim/prepare flow run unchanged."""
+
+    def __init__(self, params=None, lr: float = 1e-3, weight_decay: float = 0.0, **kwargs):
+        self.params = params
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.kwargs = kwargs
+
+    def to_optax(self, learning_rate=None):
+        """Materialize as optax AdamW. ``learning_rate`` (a schedule fn)
+        overrides the constant ``lr`` — the paired-DummyScheduler case.
+        Recorded betas/eps hyperparameters carry over; other kwargs warn."""
+        import optax
+
+        kwargs = dict(self.kwargs)
+        b1, b2 = kwargs.pop("betas", (0.9, 0.999))
+        eps = kwargs.pop("eps", 1e-8)
+        kwargs.pop("params", None)
+        if kwargs:
+            import warnings
+
+            warnings.warn(
+                f"DummyOptim: ignoring unsupported hyperparameters {sorted(kwargs)}",
+                stacklevel=2,
+            )
+        return optax.adamw(
+            learning_rate if learning_rate is not None else self.lr,
+            b1=b1, b2=b2, eps=eps, weight_decay=self.weight_decay,
+        )
+
+
+class DummyScheduler:
+    """Placeholder scheduler (reference ``DummyScheduler``): ``prepare`` turns
+    it into a linear warmup→decay optax schedule over ``total_num_steps`` with
+    ``warmup_num_steps`` of warmup applied to the paired optimizer's LR."""
+
+    def __init__(self, optimizer=None, total_num_steps: Optional[int] = None,
+                 warmup_num_steps: int = 0, lr_scheduler_callable=None, **kwargs):
+        self.optimizer = optimizer
+        self.total_num_steps = total_num_steps
+        self.warmup_num_steps = warmup_num_steps
+        self.lr_scheduler_callable = lr_scheduler_callable
+        self.kwargs = kwargs
+
+
 def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
     raise NotImplementedError(
         "Megatron-LM is a CUDA engine; its TP/PP/EP capabilities are provided natively "

@@ -79,10 +79,16 @@ def abstract_params(init_fn, *args, **kwargs):
 def dtype_byte_size(dtype) -> float:
     """Bytes per element, fractional for sub-byte dtypes (reference
     ``dtype_byte_size`` handles int4/fp8 the same way)."""
+    if dtype.__class__.__name__ == "CustomDtype":  # enum marker (fp8/int4/int2)
+        dtype = dtype.value
     name = str(np.dtype(dtype)) if not isinstance(dtype, str) else dtype
     name = name.replace("jax.numpy.", "")
+    if name == "int2":
+        return 0.25
     if name in ("int4", "uint4"):
         return 0.5
+    if name == "fp8":
+        return 1
     if "float8" in name or name in ("int8", "uint8", "bool"):
         return 1
     bits = re.search(r"[^\d](\d+)(_.*)?$", name)
@@ -511,3 +517,119 @@ def _resolve_checkpoint_files(checkpoint: str) -> list[str]:
         files = sorted(set(index_data["weight_map"].values()))
         return [os.path.join(folder, f) for f in files]
     return [checkpoint]
+
+
+# ---------------------------------------------------------------------------
+# torch-module helpers (reference utils/modeling.py spellings) — the bridge
+# story accepts nn.Modules, so the reference's module-walking utilities exist
+# here too, operating on torch objects directly
+
+
+def named_module_tensors(module, include_buffers: bool = True, recurse: bool = False,
+                         remove_non_persistent: bool = False):
+    """reference ``named_module_tensors``: yield (name, tensor) for params and
+    (optionally) buffers of ``module``."""
+    yield from module.named_parameters(recurse=recurse)
+    if include_buffers:
+        non_persistent: set = set()
+        if remove_non_persistent:
+            # collect with DOTTED prefixes so submodule buffers filter too
+            submods = module.named_modules() if recurse else [("", module)]
+            for prefix, sub in submods:
+                for bname in getattr(sub, "_non_persistent_buffers_set", set()):
+                    non_persistent.add(f"{prefix}.{bname}" if prefix else bname)
+        for name, buf in module.named_buffers(recurse=recurse):
+            if name not in non_persistent:
+                yield name, buf
+
+
+def set_module_tensor_to_device(module, tensor_name: str, device, value=None, dtype=None,
+                                **kwargs):
+    """reference ``set_module_tensor_to_device:217``: (re)place one named
+    param/buffer of a torch module, optionally with a new value/dtype."""
+    import torch
+
+    if "." in tensor_name:
+        splits = tensor_name.split(".")
+        for split in splits[:-1]:
+            module = getattr(module, split)
+        tensor_name = splits[-1]
+    is_buffer = tensor_name in getattr(module, "_buffers", {})
+    if not is_buffer and tensor_name not in getattr(module, "_parameters", {}):
+        # unknown name must fail LOUDLY (reference raises too) — silently
+        # attaching a fresh Parameter would leave the real weight untrained
+        raise ValueError(f"{tensor_name} is not a parameter or buffer of {module}")
+    old = module._buffers[tensor_name] if is_buffer else module._parameters.get(tensor_name)
+    if old is None and value is None:
+        raise ValueError(f"{tensor_name} has no existing value; pass value=")
+    with torch.no_grad():
+        if value is not None:
+            t = torch.as_tensor(value)
+        else:
+            t = old
+        if dtype is not None:
+            t = t.to(dtype)
+        t = t.to(device)
+        if is_buffer:
+            module._buffers[tensor_name] = t
+        else:
+            requires_grad = old.requires_grad if old is not None else False
+            module._parameters[tensor_name] = torch.nn.Parameter(t, requires_grad=requires_grad)
+
+
+def id_tensor_storage(tensor):
+    """reference ``id_tensor_storage``: a (device, storage-ptr, nbytes) key that
+    identifies shared storage across tensor views (tied-weight detection)."""
+    try:
+        storage = tensor.untyped_storage()
+        return tensor.device, storage.data_ptr(), storage.nbytes()
+    except Exception:
+        return tensor.device, id(tensor), tensor.numel() * tensor.element_size()
+
+
+def has_offloaded_params(module) -> bool:
+    """reference ``has_offloaded_params``: True when the module's weights are
+    managed by an offload hook (paged in per forward)."""
+    hook = getattr(module, "_hf_hook", None) or getattr(module, "_accelerate_hook", None)
+    return bool(hook is not None and getattr(hook, "offload", False))
+
+
+class align_module_device:
+    """reference ``align_module_device:2151``: context manager moving a torch
+    module's tensors to ``execution_device`` for the duration of the block,
+    restoring original devices afterwards."""
+
+    def __init__(self, module, execution_device=None):
+        self.module = module
+        self.execution_device = execution_device
+        self._orig = {}
+
+    def __enter__(self):
+        if self.execution_device is None:
+            return self.module
+        for name, t in named_module_tensors(self.module, recurse=True):
+            self._orig[name] = t.device
+            set_module_tensor_to_device(self.module, name, self.execution_device)
+        return self.module
+
+    def __exit__(self, *exc):
+        for name, dev in self._orig.items():
+            set_module_tensor_to_device(self.module, name, dev)
+        self._orig.clear()
+        return False
+
+
+def load_offloaded_weights(model, index: dict, offload_folder: str) -> None:
+    """reference ``load_offloaded_weights``: page every weight recorded in an
+    offload ``index`` back into a torch module (bridge interop; the pytree
+    path uses :class:`~accelerate_tpu.utils.offload.OffloadedWeightsLoader`)."""
+    import os
+
+    from .offload import load_offloaded_weight
+
+    if not index:
+        return
+    for name, meta in index.items():
+        tensor_file = os.path.join(offload_folder, f"{name}.dat")
+        value = load_offloaded_weight(tensor_file, meta)
+        set_module_tensor_to_device(model, name, "cpu", value=value)

@@ -392,6 +392,45 @@ def find_batch_size(data) -> Optional[int]:
     return None
 
 
+def gather_across_data_parallel_groups(tree):
+    """reference ``utils/deepspeed.py gather_across_data_parallel_groups``:
+    gather each leaf across the data-parallel replicas. Under SPMD the dp axes
+    are the only cross-process batch axes, so this is :func:`gather`."""
+    return gather(tree)
+
+
+def avg_losses_across_data_parallel_group(losses):
+    """reference ``avg_losses_across_data_parallel_group``: elementwise mean of
+    the per-replica loss values across the data-parallel group."""
+    if isinstance(losses, (list, tuple)):
+        losses = np.stack([np.asarray(v) for v in losses])
+    return reduce(losses, "mean")
+
+
+def ignorant_find_batch_size(data) -> Optional[int]:
+    """reference ``ignorant_find_batch_size:262``: like :func:`find_batch_size`
+    but never raises — any structure without an array leaf yields None."""
+    try:
+        return find_batch_size(data)
+    except Exception:
+        return None
+
+
+# reference spelling for the shape/dtype skeleton leaves: TensorInformation is
+# the metadata record the dispatcher's sideband exchanges; here jax's
+# ShapeDtypeStruct IS that record
+def TensorInformation(shape, dtype):  # noqa: N802 - reference class name
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def is_tensor_information(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.ShapeDtypeStruct)
+
+
 def get_data_structure(data):
     """Shape/dtype skeleton of a pytree, for dispatch-mode metadata exchange
     (reference ``get_data_structure:188``)."""

@@ -192,6 +192,29 @@ def save(obj, f: str, save_on_each_node: bool = False, safe_serialization: bool 
             np.savez(fh, **flat)
 
 
+def is_compiled_module(module) -> bool:
+    """reference ``is_compiled_module``: True for a torch.compile-wrapped
+    module. Bridged modules are always XLA-compiled, so this only reports the
+    torch-side wrapper."""
+    import sys
+
+    torch = sys.modules.get("torch")
+    if torch is None:
+        return False
+    dynamo = getattr(torch, "_dynamo", None)
+    opt = getattr(getattr(dynamo, "eval_frame", None), "OptimizedModule", None)
+    return opt is not None and isinstance(module, opt)
+
+
+def is_torch_tensor(x) -> bool:
+    """reference ``operations.py is_torch_tensor`` — without importing torch
+    when it isn't already loaded."""
+    import sys
+
+    torch = sys.modules.get("torch")
+    return torch is not None and isinstance(x, torch.Tensor)
+
+
 def load(f: str):
     """Load a flat state-dict saved by :func:`save` (npz or safetensors)."""
     if str(f).endswith(".safetensors"):

@@ -91,3 +91,11 @@ def is_jax_version(op: str, version: str) -> bool:
     import jax
 
     return compare_versions(jax.__version__, op, version)
+
+
+def is_torch_version(op: str, version: str) -> bool:
+    """reference ``is_torch_version`` — torch matters here for the interop
+    bridge (torch.export) and DLPack paths."""
+    import torch
+
+    return compare_versions(torch.__version__, op, version)
