@@ -230,6 +230,11 @@ class Accelerator:
 
                 deepspeed_plugin = DeepSpeedPlugin.from_env()
         plugin = fsdp_plugin or deepspeed_plugin
+        self.deepspeed_plugin = deepspeed_plugin  # reference exposes it too
+        if mixed_precision is None:
+            # ds config bf16/fp16 sections set the precision when the user
+            # didn't (reference: config drives precision under DeepSpeed)
+            mixed_precision = getattr(deepspeed_plugin, "mixed_precision", None)
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
         # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state
         _offload_dev = getattr(deepspeed_plugin, "offload_optimizer_device", None)
@@ -453,21 +458,79 @@ class Accelerator:
         # at prepare time. When BOTH are present, the schedule is baked into
         # the optax optimizer as its learning_rate fn — the update really
         # follows warmup/decay, not just the reported get_last_lr()
+        # ds-config-driven hyperparameters (reference: when the ds config
+        # defines optimizer/scheduler sections, THEY are the source of truth
+        # and the placeholders carry only what the config marks "auto")
+        dsp = getattr(self, "deepspeed_plugin", None)
+        for obj in args:
+            if isinstance(obj, DummyOptim) and dsp is not None:
+                for k, v in dsp.dummy_optim_kwargs().items():
+                    if k in ("lr", "weight_decay"):
+                        setattr(obj, k, v)
+                    else:
+                        obj.kwargs[k] = v
+            if isinstance(obj, DummyScheduler) and dsp is not None:
+                for k, v in dsp.dummy_scheduler_kwargs().items():
+                    setattr(obj, k, v)
         dummy_scheds = [o for o in args if isinstance(o, DummyScheduler)]
-        schedule_fn = self._dummy_schedule_fn(dummy_scheds[0]) if dummy_scheds else None
+        dummy_optims = [o for o in args if isinstance(o, DummyOptim)]
+        schedule_fn = None
+        if dummy_scheds:
+            lead = dummy_scheds[0]
+            if lead.optimizer is None and dummy_optims:
+                # pair with the co-prepared placeholder so base_lr is ITS lr
+                lead.optimizer = dummy_optims[0]
+            if lead.lr_scheduler_callable is None:
+                schedule_fn = self._dummy_schedule_fn(lead)
+            if not dummy_optims:
+                import warnings
+
+                warnings.warn(
+                    "DummyScheduler prepared without a DummyOptim in the SAME "
+                    "prepare() call: the schedule cannot be baked into an "
+                    "already-materialized optimizer — get_last_lr() will "
+                    "report the schedule but updates keep the optimizer's "
+                    "own learning rate. Prepare them together.",
+                    stacklevel=2,
+                )
         for i, obj in enumerate(args):
             if results[i] is not _todo:
                 continue
             if _is_torch_optimizer(obj):
                 results[i] = self.prepare_torch_optimizer(obj, module=bridged_module)
             elif isinstance(obj, DummyOptim):
+                if dummy_scheds and dummy_scheds[0].lr_scheduler_callable is not None:
+                    import warnings
+
+                    warnings.warn(
+                        "DummyScheduler.lr_scheduler_callable cannot modulate "
+                        "an optax optimizer's learning rate; the DummyOptim "
+                        "materializes at its constant lr",
+                        stacklevel=2,
+                    )
                 results[i] = self.prepare_optimizer(obj.to_optax(learning_rate=schedule_fn))
             elif _is_dataloader(obj):
                 results[i] = self.prepare_data_loader(obj)
             elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
                 results[i] = self.prepare_optimizer(obj)
             elif isinstance(obj, DummyScheduler):
-                results[i] = self.prepare_scheduler(self._dummy_schedule_fn(obj))
+                if obj.lr_scheduler_callable is not None:
+                    # reference contract: the callable takes the optimizer and
+                    # returns a torch-style scheduler object
+                    results[i] = self.prepare_scheduler(
+                        obj.lr_scheduler_callable(obj.optimizer)
+                    )
+                    continue
+                # DS schedulers advance once per OPTIMIZER step (no
+                # num_processes scaling — the schedule is written in optimizer
+                # steps, and the optax-side schedule counts the same way)
+                sched = AcceleratedScheduler(
+                    self._dummy_schedule_fn(obj),
+                    step_with_optimizer=self.step_scheduler_with_optimizer,
+                    num_processes=1,
+                )
+                self._schedulers.append(sched)
+                results[i] = sched
             elif isinstance(obj, AcceleratedScheduler) or _is_torch_lr_scheduler(obj):
                 results[i] = self.prepare_scheduler(obj)
             else:
@@ -605,26 +668,27 @@ class Accelerator:
         paired optimizer's base learning rate. Returned as a pure
         ``step -> lr`` fn so it can serve BOTH as the optax learning_rate and
         as the AcceleratedScheduler's reporting schedule."""
-        if dummy.lr_scheduler_callable is not None:
-            return dummy.lr_scheduler_callable()
         paired = getattr(dummy, "optimizer", None)
         base_lr = getattr(paired, "lr", None)
         if base_lr is None:
             base_lr = 1e-3
-        total = dummy.total_num_steps if dummy.total_num_steps is not None else 1000
-        warmup = min(dummy.warmup_num_steps, total)
+        total = dummy.total_num_steps
+        # total known -> WarmupDecayLR (decay to 0 at total); total unknown ->
+        # WarmupLR (hold base_lr after warmup) — matching the DS schedule the
+        # config would have named
+        warmup = dummy.warmup_num_steps if total is None else min(dummy.warmup_num_steps, total)
 
         def schedule_fn(step):
             import jax.numpy as jnp
 
             step = jnp.asarray(step, jnp.float32)
             warm = base_lr * (step + 1) / max(warmup, 1)
-            if total > warmup:
+            if total is not None and total > warmup:
                 frac = (step - warmup) / (total - warmup)
-                decay = base_lr * jnp.maximum(0.0, 1.0 - frac)
+                after = base_lr * jnp.maximum(0.0, 1.0 - frac)
             else:
-                decay = jnp.asarray(base_lr, jnp.float32)
-            return jnp.where(step < warmup, warm, decay) if warmup else decay
+                after = jnp.asarray(base_lr, jnp.float32)
+            return jnp.where(step < warmup, warm, after) if warmup else after
 
         return schedule_fn
 

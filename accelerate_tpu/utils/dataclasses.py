@@ -575,6 +575,48 @@ class DeepSpeedPlugin(KwargsHandler):
             return ParallelismConfig(dp_replicate_size=num_devices)
         return ParallelismConfig(dp_shard_size=-1)
 
+    @property
+    def mixed_precision(self) -> Optional[str]:
+        """Precision requested by the ds config's ``bf16``/``fp16`` sections
+        (None when absent — the Accelerator's own setting then applies)."""
+        cfg = self.hf_ds_config or {}
+        if cfg.get("bf16", {}).get("enabled") is True:
+            return "bf16"
+        if cfg.get("fp16", {}).get("enabled") is True:
+            return "fp16"
+        return None
+
+    def dummy_optim_kwargs(self) -> dict:
+        """Hyperparameters for a :class:`DummyOptim` from the ds config's
+        ``optimizer`` section (the reference's config-is-source-of-truth flow:
+        ``examples/by_feature/deepspeed_with_config_support.py``). ``auto``
+        values are omitted so the placeholder's own values fill them."""
+        params = (self.hf_ds_config or {}).get("optimizer", {}).get("params", {})
+        out: dict = {}
+        for src, dst, cast in (
+            ("lr", "lr", float),
+            ("weight_decay", "weight_decay", float),
+            ("betas", "betas", tuple),
+            ("eps", "eps", float),
+        ):
+            v = params.get(src)
+            if v is not None and not _is_auto(v):
+                out[dst] = cast(v)
+        return out
+
+    def dummy_scheduler_kwargs(self) -> dict:
+        """``DummyScheduler`` fields from the ds config's ``scheduler`` section
+        (WarmupLR / WarmupDecayLR shapes)."""
+        params = (self.hf_ds_config or {}).get("scheduler", {}).get("params", {})
+        out: dict = {}
+        total = params.get("total_num_steps")
+        if total is not None and not _is_auto(total):
+            out["total_num_steps"] = int(total)
+        warm = params.get("warmup_num_steps")
+        if warm is not None and not _is_auto(warm):
+            out["warmup_num_steps"] = int(warm)
+        return out
+
 
 def _is_auto(v) -> bool:
     return isinstance(v, str) and v == "auto"

@@ -161,6 +161,94 @@ def test_dummy_optim_and_scheduler_through_prepare():
     assert d1 > d0 * 1.5, (d0, d1)  # lr(1)=2*lr(0) during the 2-step warmup
 
 
+def test_dummy_scheduler_warmuplr_holds_after_warmup():
+    """No total_num_steps = DS WarmupLR: hold base lr after warmup, never
+    decay to zero."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils import DummyOptim, DummyScheduler
+
+    acc = Accelerator(cpu=True)
+    do = DummyOptim(lr=1e-2)
+    ds = DummyScheduler(do, warmup_num_steps=2)  # no total
+    fn = acc._dummy_schedule_fn(ds)
+    assert float(fn(0)) < 1e-2
+    assert float(fn(2)) == pytest.approx(1e-2)
+    assert float(fn(5000)) == pytest.approx(1e-2)  # holds, no decay-to-zero
+
+    # unpaired scheduler picks up the co-prepared DummyOptim's lr
+    do2 = DummyOptim(lr=1e-5)
+    ds2 = DummyScheduler(total_num_steps=100, warmup_num_steps=10)
+    params, opt, sched = acc.prepare({"w": jnp.ones((2,))}, do2, ds2)
+    assert ds2.optimizer is do2
+    assert float(sched.schedule_fn(50)) <= 1e-5  # scaled by the REAL base lr
+
+
+def test_dummy_scheduler_alone_warns_about_unbaked_lr():
+    from accelerate_tpu.utils import DummyScheduler
+
+    acc = Accelerator(cpu=True)
+    with pytest.warns(UserWarning, match="cannot be baked"):
+        acc.prepare(DummyScheduler(total_num_steps=10))
+
+
+def test_dummy_scheduler_callable_receives_optimizer():
+    from accelerate_tpu.utils import DummyOptim, DummyScheduler
+
+    acc = Accelerator(cpu=True)
+    seen = {}
+
+    class FakeSched:
+        def step(self):
+            pass
+
+    def make(optimizer):
+        seen["opt"] = optimizer
+        return FakeSched()
+
+    do = DummyOptim(lr=1e-3)
+    import jax.numpy as jnp
+
+    with pytest.warns(UserWarning, match="cannot modulate"):
+        params, opt, sched = acc.prepare(
+            {"w": jnp.ones((2,))}, do, DummyScheduler(do, lr_scheduler_callable=make)
+        )
+    assert seen["opt"] is do
+
+
+def test_ds_config_drives_dummy_hyperparams_and_precision():
+    """The ds config's optimizer/scheduler/bf16 sections are the source of
+    truth for placeholders (reference deepspeed_with_config_support flow)."""
+    from accelerate_tpu.utils import DeepSpeedPlugin, DummyOptim, DummyScheduler
+
+    ds = {
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "AdamW", "params": {
+            "lr": 5e-4, "betas": [0.9, 0.95], "eps": 1e-6, "weight_decay": 0.05}},
+        "scheduler": {"type": "WarmupDecayLR", "params": {
+            "warmup_num_steps": 3, "total_num_steps": "auto"}},
+    }
+    plugin = DeepSpeedPlugin(hf_ds_config=ds)
+    assert plugin.mixed_precision == "bf16"
+    assert plugin.dummy_optim_kwargs() == {
+        "lr": 5e-4, "betas": (0.9, 0.95), "eps": 1e-6, "weight_decay": 0.05
+    }
+    assert plugin.dummy_scheduler_kwargs() == {"warmup_num_steps": 3}  # auto omitted
+
+    acc = Accelerator(cpu=True, deepspeed_plugin=plugin)
+    assert acc.mixed_precision == "bf16"  # config set it, user didn't
+    do = DummyOptim(lr=9.0)  # placeholder value loses to the config
+    dsc = DummyScheduler(do, total_num_steps=10, warmup_num_steps=99)
+    import jax.numpy as jnp
+
+    params, opt, sched = acc.prepare({"w": jnp.ones((2, 2))}, do, dsc)
+    assert do.lr == 5e-4 and do.kwargs["betas"] == (0.9, 0.95)
+    assert dsc.warmup_num_steps == 3 and dsc.total_num_steps == 10  # auto kept user value
+    # ds schedulers advance once per optimizer step (no num_processes scaling)
+    assert sched.num_processes == 1
+
+
 def test_fsdp_ckpt_spellings_round_trip(tmp_path):
     import jax.numpy as jnp
 

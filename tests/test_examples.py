@@ -216,6 +216,16 @@ class TestNewByFeature:
         mod, ns = self._run("by_feature/schedule_free.py", epochs=2)
         assert "eval_accuracy" in mod.training_function(ns)
 
+    def test_deepspeed_with_config_support(self):
+        mod, ns = self._run(
+            "by_feature/deepspeed_with_config_support.py", epochs=3, train_size=512
+        )
+        ns.ds_config = os.path.join(
+            EXAMPLES, "deepspeed_config_templates", "zero_stage1_config.json"
+        )
+        out = mod.training_function(ns)
+        assert out["final_loss"] < out["first_loss"]
+
     def test_cross_validation(self):
         mod, ns = self._run("by_feature/cross_validation.py", epochs=1)
         ns.folds = 2
