@@ -232,9 +232,21 @@ class Accelerator:
         plugin = fsdp_plugin or deepspeed_plugin
         self.deepspeed_plugin = deepspeed_plugin  # reference exposes it too
         if mixed_precision is None:
-            # ds config bf16/fp16 sections set the precision when the user
-            # didn't (reference: config drives precision under DeepSpeed)
-            mixed_precision = getattr(deepspeed_plugin, "mixed_precision", None)
+            # ds config bf16/fp16 sections set the precision when NEITHER the
+            # constructor NOR the launcher env set one; an explicit
+            # --mixed_precision that disagrees wins with a warning (the
+            # reference errors on such flag/config mismatches)
+            plugin_mp = getattr(deepspeed_plugin, "mixed_precision", None)
+            env_mp = os.environ.get("ACCELERATE_MIXED_PRECISION")
+            if plugin_mp is not None and env_mp and env_mp != plugin_mp:
+                import warnings
+
+                warnings.warn(
+                    f"--mixed_precision {env_mp!r} disagrees with the ds config's "
+                    f"{plugin_mp!r} section; keeping the explicit {env_mp!r}"
+                )
+            elif plugin_mp is not None:
+                mixed_precision = plugin_mp
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
         # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state
         _offload_dev = getattr(deepspeed_plugin, "offload_optimizer_device", None)
@@ -514,18 +526,18 @@ class Accelerator:
             elif isinstance(obj, AcceleratedOptimizer) or _is_optax_transform(obj):
                 results[i] = self.prepare_optimizer(obj)
             elif isinstance(obj, DummyScheduler):
-                if obj.lr_scheduler_callable is not None:
-                    # reference contract: the callable takes the optimizer and
-                    # returns a torch-style scheduler object
-                    results[i] = self.prepare_scheduler(
-                        obj.lr_scheduler_callable(obj.optimizer)
-                    )
-                    continue
                 # DS schedulers advance once per OPTIMIZER step (no
                 # num_processes scaling — the schedule is written in optimizer
-                # steps, and the optax-side schedule counts the same way)
+                # steps, and the optax-side schedule counts the same way);
+                # a callable takes the optimizer and returns a torch-style
+                # scheduler object (reference contract), same stepping rule
+                underlying = (
+                    obj.lr_scheduler_callable(obj.optimizer)
+                    if obj.lr_scheduler_callable is not None
+                    else self._dummy_schedule_fn(obj)
+                )
                 sched = AcceleratedScheduler(
-                    self._dummy_schedule_fn(obj),
+                    underlying,
                     step_with_optimizer=self.step_scheduler_with_optimizer,
                     num_processes=1,
                 )

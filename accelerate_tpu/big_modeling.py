@@ -325,3 +325,23 @@ def load_checkpoint_and_dispatch(
 # Reference name: a "model" here is its param tree, so dispatching a model is
 # dispatching its params (reference ``dispatch_model:309``).
 dispatch_model = dispatch_params
+
+
+def attach_layerwise_casting_hooks(
+    fn,
+    storage_dtype,
+    compute_dtype,
+    stage_name: str = "",
+):
+    """reference ``attach_layerwise_casting_hooks big_modeling.py:653``: wrap a
+    stage fn so its params live in ``storage_dtype`` (fp8/bf16) and upcast to
+    ``compute_dtype`` only for the call — layerwise memory savings for
+    inference. Returns ``(wrapped_fn, cast_params_fn)``: apply
+    ``cast_params_fn`` once to your params to move storage to the narrow
+    dtype."""
+    from .hooks import LayerwiseCastingHook, add_hook_to_fn
+
+    hook = LayerwiseCastingHook(storage_dtype, compute_dtype)
+    return add_hook_to_fn(fn, hook, stage_name), (
+        lambda params: hook.init_hook(stage_name, params)
+    )

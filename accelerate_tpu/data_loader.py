@@ -907,6 +907,31 @@ def skip_first_batches(dataloader, num_batches: int = 0):
     return DataLoaderShard(dataloader, skip_batches=num_batches)
 
 
+class SkipDataLoader(DataLoaderShard):
+    """reference ``SkipDataLoader:1335``: skips its first ``skip_batches``
+    batches on EVERY iteration (unlike :func:`skip_first_batches`' prepared
+    loaders, whose skip is one-shot for resume)."""
+
+    def __init__(self, dataloader, skip_batches: int = 0, **kwargs):
+        super().__init__(dataloader, skip_batches=skip_batches, **kwargs)
+        self._persistent_skip = skip_batches
+
+    def __iter__(self):
+        self.skip_batches = self._persistent_skip  # re-arm each epoch
+        yield from super().__iter__()
+
+
+def get_sampler(dataloader):
+    """reference ``get_sampler``: the (batch) sampler behind a prepared or
+    native loader, for seed/state introspection."""
+    base = getattr(dataloader, "base_dataloader", dataloader)
+    sampler = getattr(base, "batch_sampler", None)
+    if sampler is None:
+        sampler = getattr(base, "sampler", None)
+    inner = getattr(sampler, "sampler", None)
+    return inner if inner is not None else sampler
+
+
 # ---------------------------------------------------------------------------
 # prepare entry point
 

@@ -770,7 +770,7 @@ def filter_trackers(
             continue
         value = str(entry)
         if value == str(LoggerType.ALL):
-            names.extend(n for n in LOGGER_TYPE_TO_CLASS if _AVAILABILITY[n]())
+            names.extend(get_available_trackers())
         else:
             names.append(value)
     for name in dict.fromkeys(names):
@@ -821,3 +821,9 @@ def _flatten_scalars(values: dict, prefix: str = "") -> dict:
             if isinstance(v, (int, float, str, bool)):
                 flat[key] = v
     return flat
+
+
+def get_available_trackers() -> list[str]:
+    """Names of tracker integrations whose libraries are importable
+    (reference ``get_available_trackers``)."""
+    return [name for name in LOGGER_TYPE_TO_CLASS if _AVAILABILITY[name]()]

@@ -43,9 +43,11 @@ def training_function(args):
     # placeholders: real hyperparameters come from the ds config; "auto"
     # values fall back to these
     optimizer = DummyOptim(lr=args.lr)
+    # the schedule counts OPTIMIZER steps: micro-batches / accumulation
+    micro_steps = args.epochs * max(len(setup["train_dl"]), 1)
     scheduler = DummyScheduler(
         optimizer,
-        total_num_steps=args.epochs * max(len(setup["train_dl"]), 1),
+        total_num_steps=max(micro_steps // plugin.gradient_accumulation_steps, 1),
         warmup_num_steps=2,
     )
     params, optimizer, scheduler = accelerator.prepare(

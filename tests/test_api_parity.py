@@ -106,6 +106,29 @@ def test_utils_reference_surface_resolves_broadly():
         assert name in dir(u), f"{name} invisible to dir()"
 
 
+def test_module_level_reference_spellings():
+    from accelerate_tpu.big_modeling import attach_layerwise_casting_hooks
+    from accelerate_tpu.data_loader import SkipDataLoader, get_sampler
+    from accelerate_tpu.tracking import get_available_trackers
+
+    assert callable(attach_layerwise_casting_hooks)
+    assert "jsonl" in get_available_trackers()
+    from accelerate_tpu.data_loader import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    dl = DataLoader(DS(), batch_size=2)
+    skipper = SkipDataLoader(dl, skip_batches=1)
+    assert len(list(skipper)) == 3
+    assert len(list(skipper)) == 3  # reference: skips EVERY epoch, not once
+    assert get_sampler(dl) is not None
+
+
 def test_shim_configs_map_to_native_semantics():
     from accelerate_tpu.utils import (
         DynamoBackend,
@@ -214,6 +237,8 @@ def test_dummy_scheduler_callable_receives_optimizer():
             {"w": jnp.ones((2,))}, do, DummyScheduler(do, lr_scheduler_callable=make)
         )
     assert seen["opt"] is do
+    # callable-built schedulers follow the same once-per-optimizer-step rule
+    assert sched.num_processes == 1
 
 
 def test_ds_config_drives_dummy_hyperparams_and_precision():
