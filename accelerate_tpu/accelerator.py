@@ -231,22 +231,29 @@ class Accelerator:
                 deepspeed_plugin = DeepSpeedPlugin.from_env()
         plugin = fsdp_plugin or deepspeed_plugin
         self.deepspeed_plugin = deepspeed_plugin  # reference exposes it too
-        if mixed_precision is None:
-            # ds config bf16/fp16 sections set the precision when NEITHER the
-            # constructor NOR the launcher env set one; an explicit
-            # --mixed_precision that disagrees wins with a warning (the
-            # reference errors on such flag/config mismatches)
-            plugin_mp = getattr(deepspeed_plugin, "mixed_precision", None)
+        plugin_mp = getattr(deepspeed_plugin, "mixed_precision", None)
+        if plugin_mp is not None:
+            # the ds config's bf16/fp16 section is the source of truth under
+            # DeepSpeed. A CONSTRUCTOR value that disagrees is a hard config
+            # mismatch (the reference's fill_match raises the same way). The
+            # launcher env is NOT treated as explicit — launchers always set
+            # ACCELERATE_MIXED_PRECISION, defaults included — so the config
+            # simply wins over it, with a note when they disagree.
+            if mixed_precision is not None and str(mixed_precision) != plugin_mp:
+                raise ValueError(
+                    f"mixed_precision={mixed_precision!r} disagrees with the ds "
+                    f"config's {plugin_mp!r} section; align them (the reference "
+                    "errors on this mismatch too)"
+                )
             env_mp = os.environ.get("ACCELERATE_MIXED_PRECISION")
-            if plugin_mp is not None and env_mp and env_mp != plugin_mp:
+            if env_mp and env_mp != plugin_mp:
                 import warnings
 
                 warnings.warn(
-                    f"--mixed_precision {env_mp!r} disagrees with the ds config's "
-                    f"{plugin_mp!r} section; keeping the explicit {env_mp!r}"
+                    f"launcher mixed precision {env_mp!r} differs from the ds "
+                    f"config's {plugin_mp!r} section; the ds config wins"
                 )
-            elif plugin_mp is not None:
-                mixed_precision = plugin_mp
+            mixed_precision = plugin_mp
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
         # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state
         _offload_dev = getattr(deepspeed_plugin, "offload_optimizer_device", None)

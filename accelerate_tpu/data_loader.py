@@ -910,26 +910,53 @@ def skip_first_batches(dataloader, num_batches: int = 0):
 class SkipDataLoader(DataLoaderShard):
     """reference ``SkipDataLoader:1335``: skips its first ``skip_batches``
     batches on EVERY iteration (unlike :func:`skip_first_batches`' prepared
-    loaders, whose skip is one-shot for resume)."""
+    loaders, whose skip is one-shot for resume). A checkpoint resume
+    (``load_state_dict``) takes precedence for its one epoch, then the
+    persistent skip resumes."""
 
     def __init__(self, dataloader, skip_batches: int = 0, **kwargs):
         super().__init__(dataloader, skip_batches=skip_batches, **kwargs)
         self._persistent_skip = skip_batches
+        self._resume_pending = False
+
+    def load_state_dict(self, state: dict) -> None:
+        super().load_state_dict(state)
+        self._resume_pending = True
+
+    def _effective_skip(self) -> int:
+        return self.skip_batches if self._resume_pending else self._persistent_skip
+
+    def __len__(self) -> int:
+        # the base finally-block zeroes skip_batches after an epoch; length
+        # must keep reporting the EVERY-epoch skip
+        return len(self.base_dataloader) - self._effective_skip()
 
     def __iter__(self):
-        self.skip_batches = self._persistent_skip  # re-arm each epoch
+        self.skip_batches = self._effective_skip()
+        self._resume_pending = False
         yield from super().__iter__()
 
 
 def get_sampler(dataloader):
-    """reference ``get_sampler``: the (batch) sampler behind a prepared or
-    native loader, for seed/state introspection."""
+    """reference ``get_sampler``: the innermost stateful sampler behind a
+    prepared or native loader, for seed/state introspection."""
+    if isinstance(dataloader, DataLoaderShard):
+        inner = dataloader._find_stateful_sampler()
+        if inner is not None:
+            return inner
     base = getattr(dataloader, "base_dataloader", dataloader)
     sampler = getattr(base, "batch_sampler", None)
     if sampler is None:
         sampler = getattr(base, "sampler", None)
-    inner = getattr(sampler, "sampler", None)
-    return inner if inner is not None else sampler
+    # walk to the innermost sampler (BatchSampler -> RandomSampler etc.)
+    seen = set()
+    while sampler is not None and id(sampler) not in seen:
+        seen.add(id(sampler))
+        child = getattr(sampler, "sampler", None)
+        if child is None:
+            break
+        sampler = child
+    return sampler
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,7 @@ def training_function(args):
     opt_state = optimizer.opt_state
 
     first = last = None
+    micro = 0
     for epoch in range(args.epochs):
         for batch in setup["train_dl"]:
             params, opt_state, metrics = step(params, opt_state, batch)
@@ -64,7 +65,11 @@ def training_function(args):
             if first is None:
                 first = loss
             last = loss
-            scheduler.step()
+            micro += 1
+            # the schedule counts OPTIMIZER steps; the compiled step applies
+            # the inner update only on accumulation boundaries
+            if micro % plugin.gradient_accumulation_steps == 0:
+                scheduler.step()
     accelerator.print(f"loss {first:.4f} -> {last:.4f} (lr now {scheduler.get_last_lr()})")
     assert last < first, "no learning"
     return {"first_loss": first, "final_loss": last}

@@ -124,9 +124,50 @@ def test_module_level_reference_spellings():
 
     dl = DataLoader(DS(), batch_size=2)
     skipper = SkipDataLoader(dl, skip_batches=1)
+    assert len(skipper) == 3
     assert len(list(skipper)) == 3
+    assert len(skipper) == 3  # len stays consistent AFTER an epoch too
     assert len(list(skipper)) == 3  # reference: skips EVERY epoch, not once
+    # a checkpoint resume takes precedence for one epoch, then persistent skip
+    skipper.load_state_dict({"batches_seen": 3, "iteration": 0})
+    assert len(skipper) == 1
+    assert len(list(skipper)) == 1
+    assert len(list(skipper)) == 3  # back to the persistent every-epoch skip
     assert get_sampler(dl) is not None
+
+
+def test_get_sampler_reaches_innermost_stateful_sampler():
+    from accelerate_tpu.data_loader import DataLoader, get_sampler
+
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    acc = Accelerator(cpu=True)
+    dl = acc.prepare(DataLoader(DS(), batch_size=2, shuffle=True, seed=7))
+    sampler = get_sampler(dl)
+    assert hasattr(sampler, "state_dict"), type(sampler)  # the REAL sampler
+    assert sampler.state_dict().get("seed") == 7
+
+
+def test_ds_config_precision_conflicts():
+    from accelerate_tpu.utils import DeepSpeedPlugin
+
+    plugin = DeepSpeedPlugin(hf_ds_config={"fp16": {"enabled": True},
+                                           "zero_optimization": {"stage": 2}})
+    # constructor conflict: hard error (reference fill_match parity)
+    with pytest.raises(ValueError, match="disagrees"):
+        Accelerator(cpu=True, mixed_precision="bf16", deepspeed_plugin=plugin)
+    # launcher env is NOT explicit (always set): config wins with a warning
+    from accelerate_tpu.utils import patch_environment
+
+    with patch_environment(ACCELERATE_MIXED_PRECISION="bf16"):
+        with pytest.warns(UserWarning, match="ds config wins"):
+            acc = Accelerator(cpu=True, deepspeed_plugin=plugin)
+    assert acc.mixed_precision == "fp16"
 
 
 def test_shim_configs_map_to_native_semantics():
