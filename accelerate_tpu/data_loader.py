@@ -903,6 +903,10 @@ def skip_first_batches(dataloader, num_batches: int = 0):
     """Return a loader resuming ``num_batches`` in (reference ``:1375``)."""
     if isinstance(dataloader, DataLoaderShard):
         dataloader.skip_batches = num_batches
+        if isinstance(dataloader, SkipDataLoader):
+            # flag the one-shot resume so __iter__ honors it over (max'd
+            # with) the loader's persistent every-epoch skip
+            dataloader._resume_pending = True
         return dataloader
     return DataLoaderShard(dataloader, skip_batches=num_batches)
 
@@ -924,7 +928,11 @@ class SkipDataLoader(DataLoaderShard):
         self._resume_pending = True
 
     def _effective_skip(self) -> int:
-        return self.skip_batches if self._resume_pending else self._persistent_skip
+        if self._resume_pending:
+            # an epoch-boundary checkpoint records batches_seen=0; the
+            # persistent skip still applies (it applies EVERY epoch)
+            return max(self.skip_batches, self._persistent_skip)
+        return self._persistent_skip
 
     def __len__(self) -> int:
         # the base finally-block zeroes skip_batches after an epoch; length

@@ -43,11 +43,14 @@ def training_function(args):
     # placeholders: real hyperparameters come from the ds config; "auto"
     # values fall back to these
     optimizer = DummyOptim(lr=args.lr)
-    # the schedule counts OPTIMIZER steps: micro-batches / accumulation
+    # the schedule counts OPTIMIZER steps: micro-batches / accumulation (the
+    # ACCELERATOR's resolved value — env protocol may set it, not just the
+    # ds config)
+    accum = accelerator.gradient_accumulation_steps
     micro_steps = args.epochs * max(len(setup["train_dl"]), 1)
     scheduler = DummyScheduler(
         optimizer,
-        total_num_steps=max(micro_steps // plugin.gradient_accumulation_steps, 1),
+        total_num_steps=max(micro_steps // accum, 1),
         warmup_num_steps=2,
     )
     params, optimizer, scheduler = accelerator.prepare(
@@ -68,7 +71,7 @@ def training_function(args):
             micro += 1
             # the schedule counts OPTIMIZER steps; the compiled step applies
             # the inner update only on accumulation boundaries
-            if micro % plugin.gradient_accumulation_steps == 0:
+            if micro % accum == 0:
                 scheduler.step()
     accelerator.print(f"loss {first:.4f} -> {last:.4f} (lr now {scheduler.get_last_lr()})")
     assert last < first, "no learning"

@@ -133,6 +133,15 @@ def test_module_level_reference_spellings():
     assert len(skipper) == 1
     assert len(list(skipper)) == 1
     assert len(list(skipper)) == 3  # back to the persistent every-epoch skip
+    # an EPOCH-BOUNDARY checkpoint (batches_seen=0) still honors the
+    # persistent skip — it applies every epoch
+    skipper.load_state_dict({"batches_seen": 0, "iteration": 1})
+    assert len(list(skipper)) == 3
+    # skip_first_batches on a SkipDataLoader is honored (not silently reset)
+    from accelerate_tpu.data_loader import skip_first_batches
+
+    assert len(list(skip_first_batches(skipper, 3))) == 1
+    assert len(list(skipper)) == 3  # one-shot, then persistent again
     assert get_sampler(dl) is not None
 
 

@@ -76,6 +76,21 @@ def test_ring_with_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("strategy", ["ring", "zigzag", "allgather"])
+def test_cp_strategies_extreme_gqa_non_causal(strategy):
+    # H=8 down to ONE kv head, non-causal: the repeat-kv folding and the
+    # non-causal block schedules must agree with dense attention exactly
+    pc = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = pc.build_mesh()
+    q, k, v = _make_qkv(B=2, S=32, H=8, Hkv=1)
+    ref = dot_product_attention(q, k, v, causal=False, impl="xla")
+    attn = make_context_parallel_attention(mesh, strategy=strategy)
+    spec = P(("dp_replicate", "dp_shard"), "cp", None, None)
+    qs, ks, vs = (_shard(x, mesh, spec) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: attn(a, b, c, causal=False))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
 def test_zigzag_with_gqa_and_dp():
     pc = ParallelismConfig(cp_size=4, dp_shard_size=2)
     mesh = pc.build_mesh()

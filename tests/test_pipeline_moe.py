@@ -209,6 +209,40 @@ class Test1F1B:
         )
         return acc, layers, stages, x, targets, loss_fn, step
 
+    @pytest.mark.parametrize("pp,micro", [(2, 6), (4, 24), (2, 3), (4, 6)])
+    def test_grads_match_single_device_autodiff_uneven_microbatches(self, pp, micro):
+        """M >> pp (steady-state 1F1B interleave) and M NOT a multiple of pp
+        ((2,3), (4,6)): gradients must equal single-device autodiff exactly."""
+        from accelerate_tpu.parallel.pipeline import make_pipeline_train_step_1f1b
+
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(pp_size=pp, dp_shard_size=8 // pp), cpu=True
+        )
+        d, bs = 8, 24
+        layers = make_layers(8, d, jax.random.PRNGKey(0))
+        stages = split_into_stages(layers, pp)
+        x = jax.random.normal(jax.random.PRNGKey(1), (bs, d))
+        targets = jax.random.normal(jax.random.PRNGKey(2), (bs, d))
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        full = jax.tree_util.tree_map(
+            lambda s: s.reshape((-1,) + s.shape[2:]), split_into_stages(layers, 1)
+        )
+
+        def full_loss(stack):
+            return loss_fn(stage_fn(stack, x), targets)
+
+        ref_grads = jax.grad(full_loss)(full)
+        step = make_pipeline_train_step_1f1b(stage_fn, loss_fn, acc.mesh, num_microbatches=micro)
+        loss, grads = step(stages, x, targets)
+        assert abs(float(loss) - float(full_loss(full))) < 1e-5
+        for g, r in zip(jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_grads)):
+            np.testing.assert_allclose(
+                np.asarray(g).reshape(np.asarray(r).shape), np.asarray(r), atol=1e-5
+            )
+
     def test_loss_and_grads_match_gpipe_autodiff(self):
         acc, layers, stages, x, targets, loss_fn, step = self._setup()
         micro = 8

@@ -28,6 +28,25 @@ def _rand(shape, seed=0, scale=1.0):
 
 
 class TestBlockwise:
+    @pytest.mark.parametrize("kind", ["int8", "nf4"])
+    def test_zero_blocks_stay_finite(self, kind):
+        # an all-zero block has absmax 0: the scale math must not divide by
+        # zero, and mixed zero/nonzero blocks must round-trip the nonzero part
+        cfg = QuantizationConfig(**{f"load_in_{'8bit' if kind == 'int8' else '4bit'}": True},
+                                 block_size=64)
+        w = jnp.zeros((64, 128), jnp.float32)
+        back = quantize(w, cfg).dequantize(jnp.float32)
+        assert bool(jnp.all(jnp.isfinite(back))) and float(jnp.abs(back).max()) == 0.0
+        mixed = jnp.concatenate([jnp.zeros((64, 64)), jnp.ones((64, 64))], axis=1)
+        backm = quantize(mixed, cfg).dequantize(jnp.float32)
+        assert bool(jnp.all(jnp.isfinite(backm)))
+        assert float(jnp.abs(backm[:, 64:] - 1).max()) < 0.1
+
+    def test_non_divisible_block_size(self):
+        w = jnp.full((10, 100), 0.5, jnp.float32)
+        q = quantize(w, QuantizationConfig(load_in_8bit=True, block_size=64))
+        assert float(jnp.abs(q.dequantize(jnp.float32) - 0.5).max()) < 1e-2
+
     @pytest.mark.smoke
     def test_int8_roundtrip_error(self):
         w = _rand((128, 256))
