@@ -58,15 +58,40 @@ def _sizes_from_builtin(model: str, args) -> dict:
         params = abstract_params(lambda: init_bert(cfg, jr.PRNGKey(0)))
     else:
         raise ValueError(f"unknown builtin model {model!r}; use llama|bert or a path/hub id")
-    return {d: total_byte_size(params, getattr(jnp, d, None) if d not in ("int8", "int4") else d)
-            for d in DTYPES}
+    import numpy as np
+
+    from ..utils.modeling import named_parameters
+
+    # "largest layer" = largest unsplittable unit (reference get_max_layer_size):
+    # stacked-layer subtrees (every leaf carries leading dim L) count PER LAYER,
+    # everything else (embeddings, heads) as a whole top-level subtree
+    flat = named_parameters(params)
+    L = cfg.n_layers
+    by_top: dict = {}
+    for path, leaf in flat.items():
+        by_top.setdefault(path.split("/")[0], []).append(leaf)
+    largest = 0
+    for leaves in by_top.values():
+        elems = sum(int(np.prod(x.shape)) for x in leaves if hasattr(x, "shape"))
+        stacked = L > 0 and all(
+            getattr(x, "ndim", 0) >= 1 and x.shape[0] == L for x in leaves
+        )
+        largest = max(largest, elems // L if stacked else elems)
+    out = {d: total_byte_size(params, getattr(jnp, d, None) if d not in ("int8", "int4") else d)
+           for d in DTYPES}
+    out["_largest_elems"] = largest
+    return out
 
 
 def _sizes_from_checkpoint(path: str) -> dict:
-    """Parameter bytes from safetensors headers / npz metadata — no tensor reads."""
+    """Parameter bytes from safetensors headers / npz metadata — no tensor
+    reads. Headers carry no module structure, so the largest-layer column
+    reports the largest single TENSOR here (a lower bound on the layer
+    reserve the structured sources report)."""
     import numpy as np
 
     total_f32_elems = 0
+    largest = 0
     files = []
     if os.path.isdir(path):
         files = [os.path.join(path, f) for f in sorted(os.listdir(path))
@@ -89,11 +114,16 @@ def _sizes_from_checkpoint(path: str) -> dict:
                 for s in meta["shape"]:
                     elems *= s
                 total_f32_elems += elems
+                largest = max(largest, elems)
         else:
             with np.load(f) as z:
                 for name in z.files:
-                    total_f32_elems += int(np.prod(z[name].shape))
-    return _sizes_from_numel(total_f32_elems)
+                    elems = int(np.prod(z[name].shape))
+                    total_f32_elems += elems
+                    largest = max(largest, elems)
+    out = _sizes_from_numel(total_f32_elems)
+    out["_largest_elems"] = largest
+    return out
 
 
 def _sizes_from_numel(n: int) -> dict:
@@ -144,7 +174,23 @@ def _sizes_from_hub(model_id: str, trust_remote_code: bool = False) -> dict:
         )
     n = sum(p.numel() for p in model.parameters())
     n += sum(b.numel() for b in model.buffers())
-    return _sizes_from_numel(n)
+    out = _sizes_from_numel(n)
+
+    # largest unsplittable unit: an element of a repeated block (ModuleList
+    # item) or a leaf module (embedding/head) — params AND buffers counted,
+    # matching the reference's get_max_layer_size semantics
+    def _module_elems(m):
+        return sum(p.numel() for p in m.parameters()) + sum(b.numel() for b in m.buffers())
+
+    largest = 0
+    for mod in model.modules():
+        if isinstance(mod, torch.nn.ModuleList):
+            for item in mod:
+                largest = max(largest, _module_elems(item))
+        elif not any(True for _ in mod.children()):
+            largest = max(largest, _module_elems(mod))
+    out["_largest_elems"] = largest
+    return out
 
 
 def _fmt(nbytes: float) -> str:
@@ -168,20 +214,27 @@ def estimate_command(args) -> int:
             sizes = _sizes_from_hub(model, trust_remote_code=getattr(args, "trust_remote_code", False))
     else:
         sizes = _sizes_from_hub(model, trust_remote_code=getattr(args, "trust_remote_code", False))
+    largest_elems = sizes.pop("_largest_elems", 0)
+    from ..utils.modeling import dtype_byte_size
+
     wanted = args.dtypes or list(DTYPES)
     rows = []
     for d in wanted:
         total = sizes[d]
-        rows.append((d, total, total * 4 if d in ("float32", "bfloat16", "float16") else None))
+        largest = int(largest_elems * dtype_byte_size(d))
+        rows.append((d, largest, total,
+                     total * 4 if d in ("float32", "bfloat16", "float16") else None))
     if args.json:
-        print(json.dumps({d: {"inference_bytes": t, "adam_training_bytes": tr}
-                          for d, t, tr in rows}))
+        print(json.dumps({d: {"largest_layer_bytes": lg, "inference_bytes": t,
+                              "adam_training_bytes": tr}
+                          for d, lg, t, tr in rows}))
         return 0
     name_w = max(len(r[0]) for r in rows)
     print(f"Memory usage for `{model}`:\n")
-    print(f"{'dtype':<{name_w}}  {'inference':>12}  {'Adam training':>14}")
-    for d, total, train in rows:
-        print(f"{d:<{name_w}}  {_fmt(total):>12}  {(_fmt(train) if train else '-'):>14}")
+    print(f"{'dtype':<{name_w}}  {'largest layer':>14}  {'inference':>12}  {'Adam training':>14}")
+    for d, largest, total, train in rows:
+        print(f"{d:<{name_w}}  {_fmt(largest):>14}  {_fmt(total):>12}  "
+              f"{(_fmt(train) if train else '-'):>14}")
     return 0
 
 

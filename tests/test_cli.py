@@ -131,6 +131,8 @@ def test_estimate_memory_from_config_json(tmp_path):
     n_f32 = out["float32"]["inference_bytes"]
     assert n_f32 > 0 and out["bfloat16"]["inference_bytes"] == n_f32 // 2
     assert out["float32"]["adam_training_bytes"] == n_f32 * 4
+    # reference table's largest-layer column (device-map planning)
+    assert 0 < out["float32"]["largest_layer_bytes"] <= n_f32
 
 
 def test_estimate_memory_unreachable_hub_id_fails_cleanly():
