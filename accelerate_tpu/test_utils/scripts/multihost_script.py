@@ -184,8 +184,10 @@ def check_dispatcher_ragged(accelerator):
     from accelerate_tpu import DataLoader
     from accelerate_tpu.data_loader import prepare_data_loader
 
-    n_rows = 10  # global bs 4 -> batches of 4, 4, then a ragged 2
-    global_bs = 4  # dispatch mode: the base loader reads GLOBAL batches
+    # adaptive to the process count (2 procs: bs 4, rows 10; 3 procs: bs 6,
+    # rows 15 — always 2 full batches + a ragged half batch)
+    global_bs = 2 * accelerator.num_processes
+    n_rows = global_bs * 2 + global_bs // 2
     me = accelerator.process_index
 
     class RankZeroOnlyDS:
@@ -232,16 +234,18 @@ def check_dispatcher_ragged(accelerator):
 
     # object-dtype leaves (strings) cannot ride the raw-bytes channel: the
     # dispatcher must keep them on the object channel, not crash mid-protocol
+    n_str = 2 * accelerator.num_processes
+
     class StringDS:
         def __len__(self):
-            return 4
+            return n_str
 
         def __getitem__(self, i):
             if me != 0:
                 raise RuntimeError(f"dataset read on non-main rank {me}")
             return {"text": f"doc-{i}", "idx": np.int32(i)}
 
-    dl2 = DataLoader(StringDS(), batch_size=2)
+    dl2 = DataLoader(StringDS(), batch_size=accelerator.num_processes)
     prepared2 = prepare_data_loader(
         dl2,
         state=accelerator.state,
@@ -252,9 +256,9 @@ def check_dispatcher_ragged(accelerator):
     )
     texts = []
     for batch in prepared2:
-        assert len(batch["text"]) == 2
+        assert len(batch["text"]) == accelerator.num_processes
         texts.extend(str(t) for t in np.asarray(batch["text"]).tolist())
-    assert sorted(texts) == [f"doc-{i}" for i in range(4)], texts
+    assert sorted(texts) == sorted(f"doc-{i}" for i in range(n_str)), texts
     accelerator.wait_for_everyone()
 
 

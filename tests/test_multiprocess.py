@@ -78,6 +78,17 @@ class TestTwoProcesses:
         for out in outs:
             assert "ALL OK" in out, out[-2000:]
 
+    def test_three_process_ragged_dispatcher(self, shared_tmpdir):
+        """3 OS processes: the dispatcher tensor fast-path (bs 6, ragged tail
+        of 3) — odd world sizes catch divisibility slips the 2-process runs
+        cannot (ops at np=3 is covered by test_ops_three_processes)."""
+        outs = execute_multiprocess(
+            SCRIPT + ["--scenario", "dispatcher_ragged", "--tmpdir", shared_tmpdir],
+            num_processes=3,
+        )
+        for out in outs:
+            assert "ALL OK" in out, out[-2000:]
+
     def test_hybrid_mesh_process_granule(self, shared_tmpdir):
         """2 procs x 2 local devices: the DCN-aware hybrid mesh places
         dp_replicate across process granules and a real sharded train step
