@@ -538,11 +538,12 @@ class Accelerator:
                 # steps, and the optax-side schedule counts the same way);
                 # a callable takes the optimizer and returns a torch-style
                 # scheduler object (reference contract), same stepping rule
-                underlying = (
-                    obj.lr_scheduler_callable(obj.optimizer)
-                    if obj.lr_scheduler_callable is not None
-                    else self._dummy_schedule_fn(obj)
-                )
+                if obj.lr_scheduler_callable is not None:
+                    underlying = obj.lr_scheduler_callable(obj.optimizer)
+                elif obj is dummy_scheds[0] and schedule_fn is not None:
+                    underlying = schedule_fn  # the already-built (baked) one
+                else:
+                    underlying = self._dummy_schedule_fn(obj)
                 sched = AcceleratedScheduler(
                     underlying,
                     step_with_optimizer=self.step_scheduler_with_optimizer,

@@ -76,7 +76,7 @@ def _next_index(key: str, index: int, n: int) -> int:
     return index
 
 
-def _render(prompt: str, options: Sequence[str], index: int, first: bool) -> None:
+def _render(options: Sequence[str], index: int, first: bool) -> None:
     out = sys.stdout
     if not first:
         out.write(f"\x1b[{len(options)}A")  # cursor back up over the options
@@ -116,7 +116,7 @@ def _interactive_select(prompt: str, options: Sequence[str], default_index: int)
         raise OSError(str(e))  # -> select() falls back to the numbered menu
     index = default_index
     print(f"{prompt} (arrows + Enter; q for default)")
-    _render(prompt, options, index, first=True)
+    _render(options, index, first=True)
     stream = _FdStream(fd)
     try:
         try:
@@ -129,12 +129,12 @@ def _interactive_select(prompt: str, options: Sequence[str], default_index: int)
                 return index
             if key == _CANCEL:
                 index = default_index
-                _render(prompt, options, index, first=False)
+                _render(options, index, first=False)
                 return index
             new = _next_index(key, index, len(options))
             if new != index:
                 index = new
-                _render(prompt, options, index, first=False)
+                _render(options, index, first=False)
     finally:
         termios.tcsetattr(fd, termios.TCSADRAIN, saved)
 
