@@ -341,6 +341,75 @@ def run_bench_fsdp_lm(on_tpu: bool) -> dict:
     return out
 
 
+def run_bench_grad_accum(on_tpu: bool) -> dict:
+    """Config #3 (BASELINE: by_feature/gradient_accumulation.py + bf16):
+    BERT with 4-step MultiSteps accumulation, timed with the SAME methodology
+    as the headline (micro-steps fused 12-per-dispatch via
+    ``prepare_train_loop``) so the number isolates the accumulation
+    boundary's cost rather than dispatch latency."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import BertConfig, bert_loss, bert_shard_rules, init_bert
+    from accelerate_tpu.utils.operations import stack_batches
+
+    _reset_state()
+    import dataclasses
+
+    seq_len = 128
+    if on_tpu:
+        config = dataclasses.replace(BertConfig.base(), max_seq_len=seq_len)
+        micro_bs, accum, n_calls = 16, 4, 4
+    else:
+        config = dataclasses.replace(BertConfig.tiny(), max_seq_len=seq_len)
+        micro_bs, accum, n_calls = 4, 4, 2
+    steps_per_call = 12  # 3 full accumulation cycles per dispatch
+    accelerator = Accelerator(
+        mixed_precision="bf16", gradient_accumulation_steps=accum, rng_seed=0
+    )
+    params = init_bert(config, jax.random.PRNGKey(0))
+    params, opt = accelerator.prepare(
+        params, optax.adamw(2e-5), shard_rules=bert_shard_rules()
+    )
+    rng = np.random.default_rng(0)
+
+    def micro_batch(seed):
+        r = np.random.default_rng(seed)
+        return {
+            "input_ids": jnp.asarray(r.integers(0, config.vocab_size, (micro_bs, seq_len)), jnp.int32),
+            "attention_mask": jnp.ones((micro_bs, seq_len), jnp.int32),
+            "token_type_ids": jnp.zeros((micro_bs, seq_len), jnp.int32),
+            "labels": jnp.asarray(r.integers(0, 2, (micro_bs,)), jnp.int32),
+        }
+
+    stacked = stack_batches([micro_batch(i) for i in range(steps_per_call)])
+    loop = accelerator.prepare_train_loop(lambda p, b: bert_loss(p, b, config), opt)
+    opt_state = opt.opt_state
+    params, opt_state, m = loop(params, opt_state, stacked)  # compile
+    float(np.asarray(m["loss"][-1]))
+    params, opt_state, m = loop(params, opt_state, stacked)  # warm
+    float(np.asarray(m["loss"][-1]))
+    t0 = _t.time()
+    for _ in range(n_calls):
+        params, opt_state, m = loop(params, opt_state, stacked)
+    final = float(np.asarray(m["loss"][-1]))
+    elapsed = _t.time() - t0
+    n_chips = len(jax.devices())
+    samples = n_calls * steps_per_call * micro_bs
+    return {
+        "metric": f"bert grad-accum x{accum} train throughput (bf16, loop-fused)",
+        "value": round(samples / elapsed / n_chips, 2),
+        "unit": "samples/sec/chip",
+        "micro_batch": micro_bs,
+        "accum_steps": accum,
+        "final_loss": round(final, 4),
+    }
+
+
 def run_bench_inference(on_tpu: bool) -> dict:
     """Config #5 (BASELINE: big-model-inference Llama dispatch generate):
     load seconds + seconds/token, the reference's benchmark table columns
@@ -850,6 +919,7 @@ def main():
     configs = {}
     for name, fn in (
         ("resnet_dp", run_bench_resnet),
+        ("grad_accum", run_bench_grad_accum),
         ("fsdp_lm", run_bench_fsdp_lm),
         ("inference", run_bench_inference),
         ("long_context", run_bench_longcontext),
