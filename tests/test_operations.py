@@ -120,3 +120,34 @@ def test_rng_set_seed_and_capture():
     np.testing.assert_array_equal(np.random.rand(3), b)
     np.testing.assert_array_equal(np.asarray(next_rng_key()), np.asarray(k2))
     assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_tensor_information_round_trip():
+    from accelerate_tpu.utils.operations import (
+        TensorInformation,
+        get_data_structure,
+        initialize_tensors,
+        is_tensor_information,
+    )
+
+    info = TensorInformation((2, 3), "float32")
+    assert is_tensor_information(info)
+    skel = get_data_structure({"a": np.ones((2, 3), np.float32)})
+    assert is_tensor_information(skel["a"])
+    zeros = initialize_tensors(skel)
+    assert zeros["a"].shape == (2, 3) and float(zeros["a"].sum()) == 0.0
+
+
+def test_dp_group_ops_single_process():
+    from accelerate_tpu.utils.operations import (
+        avg_losses_across_data_parallel_group,
+        gather_across_data_parallel_groups,
+        ignorant_find_batch_size,
+    )
+
+    losses = [np.float32(1.0), np.float32(3.0)]
+    avg = np.asarray(avg_losses_across_data_parallel_group(losses))
+    np.testing.assert_allclose(avg, [1.0, 3.0])  # single process: per-entry identity
+    g = gather_across_data_parallel_groups({"x": np.ones((2,))})
+    assert np.asarray(g["x"]).shape[0] >= 2
+    assert ignorant_find_batch_size(object()) is None
