@@ -123,12 +123,7 @@ def test_rng_set_seed_and_capture():
 
 
 def test_tensor_information_round_trip():
-    from accelerate_tpu.utils.operations import (
-        TensorInformation,
-        get_data_structure,
-        initialize_tensors,
-        is_tensor_information,
-    )
+    from accelerate_tpu.utils.operations import TensorInformation, is_tensor_information
 
     info = TensorInformation((2, 3), "float32")
     assert is_tensor_information(info)
