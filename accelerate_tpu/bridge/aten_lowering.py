@@ -295,6 +295,39 @@ def _aten_handlers() -> dict[str, Callable]:
 
     reg("aten.cross_entropy_loss.default", _ce)
 
+    def _reduce_loss(err, reduction, out_dtype):
+        # torch reduction codes: 0=none, 1=mean, 2=sum. Scalars stay f32;
+        # 'none' keeps the input dtype (torch parity)
+        if reduction in (1, "mean"):
+            return jnp.mean(err)
+        if reduction in (2, "sum"):
+            return jnp.sum(err)
+        if reduction in (0, "none"):
+            return err.astype(out_dtype)
+        raise LoweringError(f"unknown loss reduction {reduction!r}")
+
+    def _elementwise_loss(op):
+        def handler(ctx, pred, target, reduction=1):
+            err = op(pred.astype(jnp.float32), target.astype(jnp.float32))
+            return _reduce_loss(err, reduction, pred.dtype)
+
+        return handler
+
+    _l1 = _elementwise_loss(lambda p, t: jnp.abs(p - t))
+    reg("aten.mse_loss.default", _elementwise_loss(lambda p, t: (p - t) ** 2))
+    reg("aten.l1_loss.default", _l1)
+
+    def _smooth_l1(ctx, pred, target, reduction=1, beta=1.0):
+        if beta == 0:  # torch: beta=0 IS l1 (and /beta would NaN the grads)
+            return _l1(ctx, pred, target, reduction)
+        d = pred.astype(jnp.float32) - target.astype(jnp.float32)
+        err = jnp.where(
+            jnp.abs(d) < beta, 0.5 * d * d / beta, jnp.abs(d) - 0.5 * beta
+        )
+        return _reduce_loss(err, reduction, pred.dtype)
+
+    reg("aten.smooth_l1_loss.default", _smooth_l1)
+
     # -- factories / dtype --------------------------------------------------------
     def _factory_kw(kw):
         dtype = kw.get("dtype")
@@ -464,6 +497,43 @@ def _aten_handlers() -> dict[str, Callable]:
         return _bn_apply(x, mean, var, weight, bias, eps)
 
     reg("aten.batch_norm.default", _batch_norm)
+
+    def _group_norm_stats(x, num_groups, weight, bias, eps):
+        # [N, C, *spatial] normalized per (sample, group) — the UNet-family
+        # norm (GroupNorm is batch-independent: same math train and eval).
+        # Returns (out, mean[N,g], rstd[N,g]).
+        N, C = x.shape[:2]
+        g = int(num_groups)
+        xf = x.astype(jnp.float32).reshape((N, g, C // g) + x.shape[2:])
+        axes = tuple(range(2, xf.ndim))
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        rstd = lax.rsqrt(var + eps)
+        out = ((xf - mean) * rstd).reshape(x.shape)
+        shape = (1, C) + (1,) * (x.ndim - 2)
+        if weight is not None:
+            out = out * weight.astype(jnp.float32).reshape(shape)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32).reshape(shape)
+        return out.astype(x.dtype), mean.reshape(N, g), rstd.reshape(N, g)
+
+    reg(
+        "aten.group_norm.default",
+        lambda ctx, x, num_groups, weight=None, bias=None, eps=1e-5,
+               cudnn_enabled=True:
+            _group_norm_stats(x, num_groups, weight, bias, eps)[0],
+    )
+    reg(
+        "aten.native_group_norm.default",
+        # decomposed form: (x, weight, bias, N, C, HxW, group, eps) ->
+        # (out, mean[N,g], rstd[N,g])
+        lambda ctx, x, weight, bias, N, C, HxW, group, eps:
+            _group_norm_stats(x, group, weight, bias, eps),
+    )
+    reg(
+        "aten.broadcast_tensors.default",
+        lambda ctx, tensors: list(jnp.broadcast_arrays(*tensors)),
+    )
 
     def _bn_legit_functional(ctx, x, weight, bias, running_mean, running_var,
                              training, momentum, eps):

@@ -132,6 +132,105 @@ class TestPoolingLowering:
         )
 
 
+class TestGroupNorm:
+    def test_group_norm_parity(self):
+        torch.manual_seed(7)
+        gn = nn.GroupNorm(4, 8)
+        with torch.no_grad():
+            gn.weight.mul_(1.3).add_(0.1)
+            gn.bias.add_(0.2)
+        _op_parity(_Op(gn), _img((2, 8, 6, 6)), atol=1e-5)
+
+    def test_group_norm_unet_block_with_grads(self):
+        """GroupNorm + silu + conv (the UNet-family block shape): forward and
+        grad parity vs torch — GroupNorm is batch-independent so train==eval."""
+        import jax
+
+        torch.manual_seed(8)
+
+        class Block(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2d(3, 8, 3, padding=1)
+                self.gn = nn.GroupNorm(2, 8)
+                self.up = nn.ConvTranspose2d(8, 4, 4, stride=2, padding=1)
+
+            def forward(self, pixel_values, labels=None):
+                h = nn.functional.silu(self.gn(self.conv(pixel_values)))
+                out = {"logits": self.up(h)}
+                if labels is not None:
+                    out["loss"] = nn.functional.mse_loss(out["logits"], labels)
+                return out
+
+        m = Block().eval()
+        x = _img((2, 3, 8, 8), seed=8)
+        y = _img((2, 4, 16, 16), seed=9)
+        batch = {"pixel_values": x, "labels": y}
+        fn, params, buffers = _lower(m, batch)
+        out = fn(params, buffers, batch, train=False)
+        tout = m(torch.from_numpy(x), torch.from_numpy(y))
+        np.testing.assert_allclose(
+            float(np.asarray(out["loss"])), float(tout["loss"]), atol=1e-5
+        )
+        grads = jax.grad(lambda p: fn(p, buffers, batch, train=False)["loss"])(params)
+        tout["loss"].backward()
+        for name, p in m.named_parameters():
+            np.testing.assert_allclose(
+                np.asarray(grads[name]), p.grad.numpy(), atol=2e-4, err_msg=name
+            )
+
+
+class TestLossLowerings:
+    def test_smooth_l1_beta_zero_is_l1_with_finite_grads(self):
+        import jax
+        import jax.numpy as jnp
+
+        from accelerate_tpu.bridge.aten_lowering import _aten_handlers
+
+        h = _aten_handlers()["aten.smooth_l1_loss.default"]
+        p = jnp.asarray(np.random.default_rng(0).normal(size=(4,)).astype(np.float32))
+        t = jnp.zeros((4,))
+        assert abs(float(h(None, p, t, 1, 0.0)) - float(jnp.mean(jnp.abs(p)))) < 1e-6
+        g = jax.grad(lambda p: h(None, p, t, 1, 0.0))(p)
+        assert bool(jnp.all(jnp.isfinite(g)))  # /beta NaN-grad guard
+
+    def test_loss_reduction_none_keeps_input_dtype(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.bridge.aten_lowering import _aten_handlers
+
+        h = _aten_handlers()
+        p = jnp.ones((4,), jnp.bfloat16)
+        t = jnp.zeros((4,), jnp.bfloat16)
+        for op in ("aten.mse_loss.default", "aten.l1_loss.default"):
+            assert h[op](None, p, t, 0).dtype == jnp.bfloat16
+            assert h[op](None, p, t, 1).dtype == jnp.float32  # scalar stays f32
+
+    def test_smooth_l1_matches_torch(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.bridge.aten_lowering import _aten_handlers
+
+        h = _aten_handlers()["aten.smooth_l1_loss.default"]
+        p = np.random.default_rng(2).normal(size=(8,)).astype(np.float32)
+        got = float(h(None, jnp.asarray(p), jnp.zeros((8,)), 1, 0.5))
+        ref = float(nn.functional.smooth_l1_loss(
+            torch.from_numpy(p.copy()), torch.zeros(8), beta=0.5))
+        assert abs(got - ref) < 1e-6
+
+    def test_native_group_norm_returns_real_stats(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu.bridge.aten_lowering import _aten_handlers
+
+        h = _aten_handlers()["aten.native_group_norm.default"]
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 4, 4)).astype(np.float32))
+        out, mean, rstd = h(None, x, None, None, 2, 8, 16, 4, 1e-5)
+        assert mean.shape == (2, 4) and rstd.shape == (2, 4)
+        ref = nn.functional.group_norm(torch.from_numpy(np.asarray(x)), 4)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), atol=1e-5)
+
+
 class TestInterpolateLowering:
     def test_nearest_scale2(self):
         _op_parity(
