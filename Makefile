@@ -34,7 +34,7 @@ test_examples:
 
 test_models:
 	$(PYTEST) tests/test_models.py tests/test_t5.py tests/test_convert.py \
-	  tests/test_bridge.py
+	  tests/test_bridge.py tests/test_bridge_cv.py
 
 test_multihost:
 	$(PYTEST) tests/test_multiprocess.py
