@@ -155,6 +155,28 @@ class AcceleratedOptimizer:
             self.opt_state = loaded
 
 
+def move_to_device(opt_state, device):
+    """reference ``optimizer.py move_to_device``: place every array leaf of an
+    optimizer state on ``device`` (a ``jax.Device`` or ``Sharding``).
+    Delegates to the shared pytree placement helper."""
+    from .utils.operations import send_to_device
+
+    return send_to_device(opt_state, device)
+
+
+def patch_optimizer_step(accelerated_optimizer: "AcceleratedOptimizer", method):
+    """reference ``patch_optimizer_step:208``: wrap ``method`` so calling it
+    marks ``_accelerate_step_called`` on the optimizer — how the reference's
+    scaler path detects whether a step was actually taken vs overflow-skipped.
+    Returns the wrapped method (the caller decides where to put it)."""
+
+    def patched_step(*args, **kwargs):
+        accelerated_optimizer._accelerate_step_called = True
+        return method(*args, **kwargs)
+
+    return patched_step
+
+
 def _placed_like(current, new):
     import jax
 

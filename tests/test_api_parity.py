@@ -827,3 +827,26 @@ def test_prepare_pipeline_matches_sequential():
     for lp in layer_params:
         ref = jnp.tanh(ref @ lp["w"])
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_optimizer_module_spellings():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.optimizer import (
+        AcceleratedOptimizer,
+        move_to_device,
+        patch_optimizer_step,
+    )
+
+    opt = AcceleratedOptimizer(optax.sgd(0.1))
+    opt.init({"w": jnp.ones((2,))})
+    moved = move_to_device(opt.opt_state, jax.devices()[0])
+    assert jax.tree_util.tree_structure(moved) == jax.tree_util.tree_structure(opt.opt_state)
+    # reference contract: returns a wrapped method flagging the optimizer
+    calls = []
+    patched = patch_optimizer_step(opt, lambda *a: calls.append(a))
+    assert not getattr(opt, "_accelerate_step_called", False)
+    patched("g", "p")
+    assert opt._accelerate_step_called and calls == [("g", "p")]
