@@ -945,6 +945,14 @@ class SkipDataLoader(DataLoaderShard):
         yield from super().__iter__()
 
 
+# reference base-class spellings (data_loader.py:365/:408): user code does
+# `isinstance(dl, DataLoaderStateMixin)` / subclass checks — here every
+# prepared loader is a DataLoaderShard carrying the same surface
+# (end_of_dataloader/remainder/state_dict), so both names resolve to it
+DataLoaderStateMixin = DataLoaderShard
+DataLoaderAdapter = DataLoaderShard
+
+
 def get_sampler(dataloader):
     """reference ``get_sampler``: the innermost stateful sampler behind a
     prepared or native loader, for seed/state introspection."""

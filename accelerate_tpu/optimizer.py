@@ -56,6 +56,7 @@ class AcceleratedOptimizer:
         self._mesh = None
         self._param_specs = None
         self._fp16_scaler_config = None  # set by Accelerator.prepare_train_step (fp16)
+        self._accelerate_step_called = False  # set by patch_optimizer_step wrappers
         self.accelerator_state = None  # set by Accelerator.prepare
 
     # ------------------------------------------------------------- functional --

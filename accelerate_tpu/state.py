@@ -39,6 +39,11 @@ def _jax():
     return jax
 
 
+def do_nothing(*args, **kwargs):
+    """reference ``state.py:86`` — the default no-op callback."""
+    return None
+
+
 def is_initialized() -> bool:
     return PartialState._shared_state.get("_initialized", False)
 
