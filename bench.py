@@ -3,14 +3,22 @@
 The driver's north-star metric (BASELINE.json): ``nlp_example.py`` (BERT-base,
 seq 128) training samples/sec/chip. Runs on whatever the default JAX backend is
 (the real TPU chip under the driver; CPU elsewhere with a tiny model), times the
-jitted train step after compilation, and prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}``.
+jitted train step after compilation, and emits cumulative JSON lines to stdout:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}`` —
+one after the headline and one after each completed breadth config, each a
+superset of the previous (intermediate lines carry ``"partial": true``). **The
+LAST parseable line is the record**; emitting incrementally means a driver
+`timeout` kill can no longer erase the round's data (round-4 postmortem:
+``parsed: null``).
 
-Hardening (round-1 postmortem: the TPU backend failed to initialize once and the
-bench died with a raw traceback, leaving the round with no number):
-- backend init is retried with backoff and a backend-cache clear between tries;
-- any terminal failure still prints ONE structured JSON line (with an "error"
-  key) so the driver's record is parseable either way.
+Hardening (round 1-4 postmortems):
+- backend init is probed in killable subprocesses with bounded retry, all under
+  one wall-clock budget (``ACCELERATE_BENCH_BUDGET``, default 25 min — inside
+  the driver's observed ~30 min timeout); probing can never starve measurement;
+- any terminal failure still prints a structured JSON line (with an "error"
+  key) so the driver's record is parseable either way;
+- if the TPU tunnel is down at bench time, a mid-round measurement cached by
+  ``tools/tpu_watcher.py`` is preferred over a CPU-degraded stand-in.
 
 ``vs_baseline`` anchors to ``BENCH_BASELINE.json`` (written on first TPU run) so
 round-over-round regressions are visible; the reference repo publishes no number
@@ -58,6 +66,27 @@ def _env_int(key: str, default: int) -> int:
         return default
 
 
+# ---- wall-clock budget (round-4 postmortem: the probe ladder alone outlived
+# the driver's ~30 min `timeout` and the run was killed with ZERO output).
+# Everything in this file is budgeted against one deadline; when it nears,
+# remaining work is skipped with a note instead of being killed mid-flight.
+_T0 = time.time()
+_BUDGET = _env_int("ACCELERATE_BENCH_BUDGET", 1500)  # 25 min < driver's ~30
+
+
+def _remaining() -> float:
+    return _BUDGET - (time.time() - _T0)
+
+
+def _emit(payload: dict) -> None:
+    """Print one parseable JSON line to stdout NOW (flush: a driver `timeout`
+    kill must not take buffered output with it). Called after the headline and
+    again after every completed config with the cumulative result, so however
+    the process dies, the last line standing carries everything measured so
+    far — the round can no longer end with `parsed: null`."""
+    print(json.dumps(sanitize_json(payload)), flush=True)
+
+
 @contextlib.contextmanager
 def _deadline(seconds: int):
     """Hard wall-clock limit for a blocking call (the axon tunnel has been
@@ -99,28 +128,35 @@ def _probe_backend_subprocess(timeout: int) -> "tuple[bool, str]":
 
 _BACKEND_DEGRADED: Optional[str] = None  # set when TPU probe failed -> CPU run
 _PROBE_HISTORY: list = []  # per-attempt failure details for the output JSON
-# default delay ladder: 15s,30s,...,90s cap — ~9 min of sleep across 8 probes
-# (plus up to 8x180s of probe wall time); a short transient outage is survived,
-# a dead-for-the-round tunnel still terminates in bounded time
+# probe phase is hard-capped (~5.5 min default): round-4's 8x180s ladder +
+# ~6 min of sleeps outlived the DRIVER's own timeout and the round recorded
+# nothing. A dead tunnel now costs minutes, then the CPU fallback runs and
+# emits incrementally; a mid-round recovery is caught by the watcher cache
+# and the end-of-round re-exec instead of by probe patience.
 
 
 def _init_backend(
-    retries: Optional[int] = None, delay: float = 15.0, init_timeout: Optional[int] = None
+    retries: Optional[int] = None, delay: float = 20.0, init_timeout: Optional[int] = None
 ) -> str:
-    """``jax.default_backend()`` with retry: a remote-tunneled TPU backend can be
-    transiently UNAVAILABLE (or hang); probe in a subprocess first (see
-    :func:`_probe_backend_subprocess`), clear the backend cache and back off
-    between tries. ``ACCELERATE_BENCH_RETRIES`` / ``ACCELERATE_BENCH_PROBE_TIMEOUT``
-    override the patience (the end-of-round bench is one-shot: waiting out a
-    transient tunnel outage beats recording a CPU number)."""
+    """``jax.default_backend()`` with bounded retry: a remote-tunneled TPU
+    backend can be transiently UNAVAILABLE (or hang); probe in a subprocess
+    first (see :func:`_probe_backend_subprocess`), clear the backend cache and
+    back off between tries. ``ACCELERATE_BENCH_RETRIES`` /
+    ``ACCELERATE_BENCH_PROBE_TIMEOUT`` / ``ACCELERATE_BENCH_PROBE_BUDGET``
+    override the patience; the whole phase additionally respects the global
+    ``ACCELERATE_BENCH_BUDGET`` deadline so probing can never starve the
+    measurement phase of its wall-clock."""
     import jax
 
     global _BACKEND_DEGRADED, _PROBE_HISTORY
     if retries is None:
-        retries = _env_int("ACCELERATE_BENCH_RETRIES", 8)
+        retries = _env_int("ACCELERATE_BENCH_RETRIES", 2)
     retries = max(retries, 1)  # 0 would skip probing entirely, last_err=None
     if init_timeout is None:
-        init_timeout = _env_int("ACCELERATE_BENCH_PROBE_TIMEOUT", 180)
+        init_timeout = _env_int("ACCELERATE_BENCH_PROBE_TIMEOUT", 150)
+    probe_deadline = time.time() + min(
+        _env_int("ACCELERATE_BENCH_PROBE_BUDGET", 330), max(_remaining() - 120, 60)
+    )
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # explicit CPU request: the axon sitecustomize ignores the env var, so
@@ -130,18 +166,21 @@ def _init_backend(
 
     last_err = None
     for attempt in range(retries):
-        ok, detail = _probe_backend_subprocess(init_timeout)
+        probe_left = int(probe_deadline - time.time())
+        if probe_left < 20:
+            last_err = last_err or TimeoutError("probe budget exhausted")
+            _PROBE_HISTORY.append("probe budget exhausted before attempt "
+                                  f"{attempt + 1}/{retries}")
+            break
+        ok, detail = _probe_backend_subprocess(min(init_timeout, probe_left))
         if not ok:
             last_err = TimeoutError(f"backend probe: {detail}")
             _PROBE_HISTORY.append(detail)
             print(
                 f"bench probe {attempt + 1}/{retries} failed: {detail}", file=sys.stderr
             )
-            # backoff spread across minutes, not seconds: a tunnel outage that
-            # clears within the round should still yield a TPU number (no
-            # sleep after the LAST attempt — the fallback starts immediately)
             if attempt + 1 < retries:
-                time.sleep(min(delay * (attempt + 1), 90.0))
+                time.sleep(min(delay, max(probe_deadline - time.time(), 0)))
             continue
         try:
             with _deadline(init_timeout):
@@ -154,7 +193,7 @@ def _init_backend(
             except Exception:
                 pass
             if attempt + 1 < retries:
-                time.sleep(min(delay * (attempt + 1), 90.0))
+                time.sleep(min(delay, max(probe_deadline - time.time(), 0)))
     # last resort: a CPU number is better than no number — but mark it degraded
     try:
         jax.config.update("jax_platforms", "cpu")
@@ -842,46 +881,111 @@ def sanitize_json(obj):
     return obj
 
 
-def _maybe_reexec_on_recovered_tpu() -> Optional[str]:
+def _maybe_reexec_on_recovered_tpu() -> Optional[dict]:
     """End-of-round re-probe (round-3 postmortem): the CPU-degraded path takes
     minutes to run its configs — if the TPU tunnel has RECOVERED by then, a
     whole-bench re-exec gets the round a real TPU number after all. Returns the
-    child's one-line JSON on success, else None. ``ACCELERATE_BENCH_REEXEC``
-    guards against recursion."""
+    child's best TPU result dict on success, else None. Budget-aware (round-4
+    postmortem): only attempted when enough of the global deadline is left, the
+    child inherits exactly that remainder, and a timed-out child's PARTIAL
+    stdout is still mined — the child emits incrementally, so a kill mid-config
+    can still hand back a real TPU headline. ``ACCELERATE_BENCH_REEXEC`` guards
+    against recursion."""
     import subprocess
 
     if os.environ.get("ACCELERATE_BENCH_REEXEC") == "1":
         return None
-    ok, _detail = _probe_backend_subprocess(_env_int("ACCELERATE_BENCH_PROBE_TIMEOUT", 180))
+    budget_left = _remaining() - 90  # leave room to print the fallback line
+    if budget_left < 240:  # a TPU headline needs ~3-4 min incl. compile
+        _PROBE_HISTORY.append(f"re-exec skipped: only {budget_left:.0f}s of budget left")
+        return None
+    ok, _detail = _probe_backend_subprocess(min(120, int(budget_left // 3)))
     if not ok:
         return None
+    budget_left = _remaining() - 90
     print("TPU recovered after degraded run: re-executing bench", file=sys.stderr)
-    env = dict(os.environ, ACCELERATE_BENCH_REEXEC="1", ACCELERATE_BENCH_RETRIES="2")
+    env = dict(
+        os.environ,
+        ACCELERATE_BENCH_REEXEC="1",
+        ACCELERATE_BENCH_RETRIES="1",
+        ACCELERATE_BENCH_BUDGET=str(max(int(budget_left - 60), 120)),
+    )
+    timeout_s = min(_env_int("ACCELERATE_BENCH_REEXEC_TIMEOUT", 3600), int(budget_left))
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             capture_output=True,
             text=True,
-            timeout=_env_int("ACCELERATE_BENCH_REEXEC_TIMEOUT", 3600),
+            timeout=timeout_s,
             env=env,
         )
+        stdout, stderr = res.stdout or "", res.stderr or ""
     except subprocess.TimeoutExpired as e:
-        timeout_s = _env_int("ACCELERATE_BENCH_REEXEC_TIMEOUT", 3600)
         _PROBE_HISTORY.append(f"re-exec child hung past {timeout_s}s (killed)")
-        partial = e.stderr if isinstance(e.stderr, str) else ""
-        print(
-            f"bench re-exec timed out after {timeout_s}s; keeping degraded result\n"
-            + (partial[-2000:] if partial else ""),
-            file=sys.stderr,
+        stdout = e.stdout if isinstance(e.stdout, str) else (
+            e.stdout.decode(errors="replace") if e.stdout else "")
+        stderr = e.stderr if isinstance(e.stderr, str) else (
+            e.stderr.decode(errors="replace") if e.stderr else "")
+        print(f"bench re-exec timed out after {timeout_s}s; mining partial output",
+              file=sys.stderr)
+    sys.stderr.write(stderr[-2000:] if stderr else "")
+    return _pick_tpu_json_line(stdout)
+
+
+_TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CACHE.json"
+)
+
+
+def _load_tpu_cache() -> Optional[dict]:
+    """Mid-round TPU result cached by ``tools/tpu_watcher.py``. The axon tunnel
+    has been down for 5+ hour stretches (round-4); the watcher probes all round
+    and runs the full bench the moment the chip is back, so the end-of-round
+    bench can fall back to a REAL measurement taken hours earlier instead of a
+    CPU-degraded stand-in. The cached line is labelled as such."""
+    try:
+        with open(_TPU_CACHE_PATH) as f:
+            cached = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(cached, dict) or cached.get("degraded"):
+        return None
+    if "TPU" not in str(cached.get("device_kind", "")):
+        return None
+    measured_at = cached.get("measured_at_unix")
+    if not isinstance(measured_at, (int, float)):
+        # age must come from INSIDE the JSON (watcher stamps it): file mtime
+        # resets on a fresh checkout, so a previous round's committed cache
+        # would look newborn. No stamp = not trustworthy = not used.
+        _PROBE_HISTORY.append("watcher cache rejected: no measured_at_unix stamp")
+        return None
+    age_min = (time.time() - measured_at) / 60.0
+    max_age_min = _env_int("ACCELERATE_BENCH_CACHE_MAX_AGE_MIN", 12 * 60)
+    if not (0 <= age_min <= max_age_min):
+        # a cache older than the ~12h round is a PREVIOUS round's measurement;
+        # emitting it would mask this round's regressions/improvements
+        _PROBE_HISTORY.append(
+            f"watcher cache rejected: {age_min:.0f} min old > {max_age_min} min"
         )
         return None
-    sys.stderr.write(res.stderr or "")
-    return _pick_tpu_json_line(res.stdout or "")
+    cached["cached"] = True
+    cached["cache_age_minutes"] = round(age_min, 1)
+    cached.pop("partial", None)  # promotion to final record: the flag means
+    # "superseded by a later line", which no longer holds
+    cached["note"] = (
+        "TPU result measured mid-round by tools/tpu_watcher.py (tunnel was down "
+        "at bench time); " + str(cached.get("note", ""))
+    )
+    return cached
 
 
-def _pick_tpu_json_line(stdout: str) -> Optional[str]:
-    """Last stdout line that parses as a NON-degraded real-TPU bench result —
-    only such a line may replace the parent's degraded output."""
+def _pick_tpu_json_line(stdout: str) -> Optional[dict]:
+    """Last stdout line that parses as a NON-degraded, NON-cached real-TPU
+    bench result — only a live measurement may replace the caller's degraded
+    output. ``cached: true`` lines are rejected: a child that itself degraded
+    and fell back to the watcher cache would otherwise launder an hours-old
+    number as freshly recovered. Shared by the re-exec path here and by
+    ``tools/tpu_watcher.py``."""
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
@@ -890,9 +994,70 @@ def _pick_tpu_json_line(stdout: str) -> Optional[str]:
             parsed = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if "TPU" in str(parsed.get("device_kind", "")) and not parsed.get("degraded"):
-            return line
+        if (
+            "TPU" in str(parsed.get("device_kind", ""))
+            and not parsed.get("degraded")
+            and not parsed.get("cached")
+        ):
+            return parsed
     return None
+
+
+def _num(x):  # NaN/Inf would make json.dumps emit a non-parseable token
+    return None if x is None or not isinstance(x, (int, float)) or not math.isfinite(x) else round(x, 4)
+
+
+def _headline_payload(result: dict, vs_baseline, configs: dict, partial: bool) -> dict:
+    payload = {
+        "metric": f"{result['model']} mrpc-shaped train throughput ({result['backend']}, bf16)",
+        "value": _num(result["per_chip"]) or 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": _num(vs_baseline) or 0.0,
+        "mfu": _num(result["mfu"]),
+        "device_kind": result["device_kind"],
+        "n_chips": result["n_chips"],
+        "batch_size": result.get("batch_size"),
+        "final_loss": _num(result["final_loss"]),
+        **({"trace_dir": result["trace_dir"]} if result.get("trace_dir") else {}),
+        **(
+            {"vs_baseline_note": result["vs_baseline_note"]}
+            if result.get("vs_baseline_note")
+            else {}
+        ),
+        # this environment has no hub access: data is synthetic
+        # MRPC-shaped, so loss/accuracy are parity signals between
+        # configs/rounds, not real-GLUE numbers
+        "note": "synthetic data (no hub access); loss comparable across rounds only",
+        **({"degraded": _BACKEND_DEGRADED} if _BACKEND_DEGRADED else {}),
+        **({"probe_history": _PROBE_HISTORY[-8:]} if _PROBE_HISTORY else {}),
+        "configs": configs,  # _emit sanitizes the whole payload
+    }
+    if partial:
+        payload["partial"] = True  # superseded by a later cumulative line
+    return payload
+
+
+def _provisional_vs_baseline(result: dict, baseline_path: str) -> float:
+    """Read-only headline ratio for the early incremental lines; the final line
+    recomputes via :func:`apply_baseline_anchors` (which may also write).
+    CPU-degraded runs report 1.0 like the final line does — a CPU-vs-TPU-anchor
+    ratio would mix hardware change with perf change. The anchor cannot change
+    mid-run, so it is read once and memoized."""
+    if result.get("backend") != "tpu":
+        return 1.0
+    if "anchor" not in _provisional_vs_baseline.__dict__:
+        try:
+            with open(baseline_path) as f:
+                _provisional_vs_baseline.anchor = json.load(f).get("per_chip")
+        except (OSError, json.JSONDecodeError, AttributeError):
+            _provisional_vs_baseline.anchor = None
+    anchor = _provisional_vs_baseline.anchor
+    if isinstance(anchor, (int, float)) and math.isfinite(anchor) and anchor:
+        per_chip = result.get("per_chip")
+        if isinstance(per_chip, (int, float)) and math.isfinite(per_chip):
+            return per_chip / anchor
+        return 0.0
+    return 1.0
 
 
 def main():
@@ -907,16 +1072,25 @@ def main():
                     "unit": "samples/sec/chip",
                     "vs_baseline": 0.0,
                     "error": f"{type(e).__name__}: {e}",
+                    **({"degraded": _BACKEND_DEGRADED} if _BACKEND_DEGRADED else {}),
+                    **({"probe_history": _PROBE_HISTORY[-8:]} if _PROBE_HISTORY else {}),
                 }
-            )
+            ),
+            flush=True,
         )
         sys.exit(1)
 
-    # benchmark breadth (BASELINE configs 2/4/5): progress lines go to STDERR
-    # (humans/logs); stdout stays ONE JSON line — the driver contract — with
-    # the per-config results embedded under "configs"
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     on_tpu = result["backend"] == "tpu"
+    # the headline is the round's must-have number: emit it the moment it
+    # exists, then re-emit cumulatively as each breadth config lands
     configs = {}
+    _emit(_headline_payload(
+        result, _provisional_vs_baseline(result, baseline_path), configs, partial=True
+    ))
+    # benchmark breadth (BASELINE configs 2/4/5): progress lines go to STDERR
+    # (humans/logs); stdout carries cumulative JSON lines, the LAST of which is
+    # the driver's record
     for name, fn in (
         ("resnet_dp", run_bench_resnet),
         ("grad_accum", run_bench_grad_accum),
@@ -928,53 +1102,37 @@ def main():
         # not like-for-like; a fresh anchor is seeded on the next TPU run
         ("compile_time_llama1b", run_bench_compile_time),
     ):
+        if _remaining() < 120:
+            configs[name] = {
+                "metric": name, "value": None,
+                "note": f"skipped: bench wall-clock budget exhausted ({_remaining():.0f}s left)",
+            }
+            continue
         try:
             entry = fn(on_tpu)
         except Exception as e:  # one config failing must not kill the rest
             entry = {"metric": name, "value": 0.0, "error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(entry), file=sys.stderr, flush=True)
+        print(json.dumps(sanitize_json(entry)), file=sys.stderr, flush=True)
         configs[name] = entry
+        _emit(_headline_payload(
+            result, _provisional_vs_baseline(result, baseline_path), configs, partial=True
+        ))
     if _BACKEND_DEGRADED:
         # the CPU configs above took minutes — one more chance at a TPU number
         recovered = _maybe_reexec_on_recovered_tpu()
         if recovered is not None:
-            print(recovered)
+            recovered.pop("partial", None)  # now the final record, not superseded
+            _emit(recovered)
             return
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+        cached = _load_tpu_cache()
+        if cached is not None:
+            # a real mid-round TPU measurement beats a live CPU stand-in
+            _emit(cached)
+            return
     vs_baseline = 1.0
-    if result["backend"] == "tpu":
+    if on_tpu:
         vs_baseline = apply_baseline_anchors(result, configs, baseline_path)
-    def _num(x):  # NaN/Inf would make json.dumps emit a non-parseable token
-        return None if x is None or not math.isfinite(x) else round(x, 4)
-
-    print(
-        json.dumps(
-            {
-                "metric": f"{result['model']} mrpc-shaped train throughput ({result['backend']}, bf16)",
-                "value": _num(result["per_chip"]) or 0.0,
-                "unit": "samples/sec/chip",
-                "vs_baseline": _num(vs_baseline) or 0.0,
-                "mfu": _num(result["mfu"]),
-                "device_kind": result["device_kind"],
-                "n_chips": result["n_chips"],
-                "batch_size": result.get("batch_size"),
-                "final_loss": _num(result["final_loss"]),
-                **({"trace_dir": result["trace_dir"]} if result.get("trace_dir") else {}),
-                **(
-                    {"vs_baseline_note": result["vs_baseline_note"]}
-                    if result.get("vs_baseline_note")
-                    else {}
-                ),
-                # this environment has no hub access: data is synthetic
-                # MRPC-shaped, so loss/accuracy are parity signals between
-                # configs/rounds, not real-GLUE numbers
-                "note": "synthetic data (no hub access); loss comparable across rounds only",
-                **({"degraded": _BACKEND_DEGRADED} if _BACKEND_DEGRADED else {}),
-                **({"probe_history": _PROBE_HISTORY[-8:]} if _PROBE_HISTORY else {}),
-                "configs": sanitize_json(configs),
-            }
-        )
-    )
+    _emit(_headline_payload(result, vs_baseline, configs, partial=False))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,121 @@
+"""Background TPU-availability watcher (round-5, VERDICT item 2).
+
+The axon TPU tunnel has been observed down for 5+ hour stretches (round-4
+postmortem). The end-of-round driver bench is one-shot: if the tunnel happens
+to be down at that moment, the round records a CPU-degraded stand-in no matter
+how much perf work landed. This watcher closes that gap:
+
+- probes the TPU backend every ``--interval`` seconds in a KILLABLE subprocess
+  (an in-process hang inside backend init cannot be interrupted — the C call
+  never returns to the interpreter);
+- the moment the chip answers, runs the FULL ``bench.py`` and caches its last
+  TPU JSON line at ``BENCH_TPU_CACHE.json`` (atomic replace);
+- keeps the cache fresh by re-running when it is older than ``--refresh``
+  seconds and the chip is still up.
+
+``bench.py`` prefers this cache over a CPU-degraded fallback (clearly labelled
+``cached: true`` with its age), so a mid-round measurement survives an
+end-of-round outage.
+
+Run it detached for the whole round:
+
+    nohup python tools/tpu_watcher.py >/tmp/tpu_watcher.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE = os.path.join(REPO, "BENCH_TPU_CACHE.json")
+
+sys.path.insert(0, REPO)
+# shared with bench.py: the watcher and bench's re-exec path must agree both on
+# what counts as a usable live TPU line (non-degraded, non-cached) and on what
+# counts as the backend being up
+from bench import _pick_tpu_json_line as pick_tpu_line  # noqa: E402
+from bench import _probe_backend_subprocess  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_watcher {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout: int) -> bool:
+    """True iff `jax.devices()` answers with a real backend within timeout."""
+    ok, _detail = _probe_backend_subprocess(timeout)
+    return ok
+
+
+def run_bench(bench_budget: int) -> dict | None:
+    env = dict(
+        os.environ,
+        ACCELERATE_BENCH_RETRIES="2",
+        ACCELERATE_BENCH_BUDGET=str(bench_budget),
+    )
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=bench_budget + 300, env=env,
+        )
+        stdout = res.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout if isinstance(e.stdout, str) else (
+            e.stdout.decode(errors="replace") if e.stdout else "")
+        log(f"bench hung past {bench_budget + 300}s; mining partial output")
+    return pick_tpu_line(stdout)
+
+
+def cache_age() -> float:
+    try:
+        return time.time() - os.path.getmtime(CACHE)
+    except OSError:
+        return float("inf")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=int, default=600, help="probe period (s)")
+    ap.add_argument("--refresh", type=int, default=5400,
+                    help="re-measure when the cache is older than this (s)")
+    ap.add_argument("--probe-timeout", type=int, default=240)
+    ap.add_argument("--bench-budget", type=int, default=2400)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+bench attempt, then exit")
+    args = ap.parse_args()
+
+    while True:
+        if cache_age() > args.refresh:
+            log("probing TPU backend...")
+            if probe(args.probe_timeout):
+                log("TPU up: running full bench")
+                parsed = run_bench(args.bench_budget)
+                if parsed is not None:
+                    # age stamp lives INSIDE the JSON: file mtime resets on a
+                    # fresh checkout, so bench's staleness check must not rely
+                    # on it (a previous round's cache would look newborn)
+                    parsed["measured_at_unix"] = time.time()
+                    tmp = CACHE + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(parsed, f)
+                    os.replace(tmp, CACHE)
+                    log(f"cached TPU result: value={parsed.get('value')} "
+                        f"mfu={parsed.get('mfu')}")
+                else:
+                    log("bench produced no usable TPU line")
+            else:
+                log("TPU probe failed/hung")
+        else:
+            log(f"cache fresh ({cache_age() / 60:.0f} min old); sleeping")
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
