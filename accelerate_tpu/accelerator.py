@@ -214,6 +214,8 @@ class Accelerator:
         kwargs_handlers: Optional[Sequence[Any]] = None,
         fsdp_plugin: Optional[Any] = None,
         deepspeed_plugin: Optional[Any] = None,
+        dynamo_plugin: Optional[Any] = None,
+        megatron_lm_plugin: Optional[Any] = None,
     ):
         # Reference-compat plugins (accelerator.py:278 accepts both): each is a
         # sharding intent here — translate to ParallelismConfig unless the user
@@ -231,6 +233,33 @@ class Accelerator:
                 deepspeed_plugin = DeepSpeedPlugin.from_env()
         plugin = fsdp_plugin or deepspeed_plugin
         self.deepspeed_plugin = deepspeed_plugin  # reference exposes it too
+        # MegatronLMPlugin shim (reference accelerator.py routes prepare through
+        # the Megatron engine; here the plugin's degrees ARE the mesh config)
+        self.megatron_lm_plugin = megatron_lm_plugin
+        if megatron_lm_plugin is not None:
+            if plugin is not None:
+                raise ValueError(
+                    "megatron_lm_plugin cannot be combined with fsdp_plugin/"
+                    "deepspeed_plugin (the reference routes to ONE engine too)"
+                )
+            if parallelism_config is not None:
+                raise ValueError(
+                    "pass megatron_lm_plugin OR parallelism_config, not both — "
+                    "the plugin's tp/pp/ep/sp degrees define the mesh"
+                )
+            parallelism_config = megatron_lm_plugin.to_parallelism_config()
+            if (
+                gradient_accumulation_steps == 1
+                and megatron_lm_plugin.num_micro_batches > 1
+            ):
+                # Megatron's micro-batching is grad accumulation in mesh terms
+                gradient_accumulation_steps = megatron_lm_plugin.num_micro_batches
+        # TorchDynamoPlugin shim: the one actionable XLA knob is eager-vs-jit
+        self.dynamo_plugin = dynamo_plugin
+        if dynamo_plugin is not None:
+            if jit_config is not None:
+                raise ValueError("pass dynamo_plugin OR jit_config, not both")
+            jit_config = dynamo_plugin.to_jit_config()
         plugin_mp = getattr(deepspeed_plugin, "mixed_precision", None)
         if plugin_mp is not None:
             # the ds config's bf16/fp16 section is the source of truth under
@@ -255,6 +284,8 @@ class Accelerator:
                 )
             mixed_precision = plugin_mp
         self._plugin_grad_clip = getattr(deepspeed_plugin, "gradient_clipping", None)
+        if self._plugin_grad_clip is None:
+            self._plugin_grad_clip = getattr(megatron_lm_plugin, "gradient_clipping", None)
         # ZeRO-Offload / FSDP cpu_offload intent → host-resident optimizer state
         _offload_dev = getattr(deepspeed_plugin, "offload_optimizer_device", None)
         if _offload_dev == "nvme":
@@ -306,11 +337,14 @@ class Accelerator:
         self.ddp_handler = None
         self.autocast_handler = None
         self.profile_handler = None
+        self.fp8_recipe_handler = None
+        self.fp8_recipe = None
         init_pg_kwargs: dict[str, Any] = {}
         if kwargs_handlers:
             from .utils.dataclasses import (
                 AutocastConfig,
                 DistributedDataParallelKwargs,
+                FP8RecipeKwargs,
                 InitProcessGroupKwargs,
             )
 
@@ -333,6 +367,19 @@ class Accelerator:
                     self.ddp_handler = handler
                 elif isinstance(handler, ProfileConfig):
                     self.profile_handler = handler
+                elif isinstance(handler, FP8RecipeKwargs):
+                    # TE/AO/MSAMP recipe spellings all map onto the native
+                    # delayed-scaling recipe (ops/fp8.py); the `seen` set keys
+                    # on concrete type, so guard the base class explicitly —
+                    # two different recipe subclasses are still a conflict
+                    if self.fp8_recipe_handler is not None:
+                        raise ValueError(
+                            "multiple fp8 recipe handlers given "
+                            f"({type(self.fp8_recipe_handler).__name__} and "
+                            f"{type(handler).__name__}); pass exactly one"
+                        )
+                    self.fp8_recipe_handler = handler
+                    self.fp8_recipe = handler.to_native()
                 else:
                     raise ValueError(f"unsupported kwargs handler: {handler!r}")
         self.state = AcceleratorState(

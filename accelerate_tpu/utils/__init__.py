@@ -1,4 +1,6 @@
+from .api_boundary import EXCLUDED_REFERENCE_UTILS
 from .dataclasses import (
+    AORecipeKwargs,
     AutocastConfig,
     AutocastKwargs,
     ComputeEnvironment,
@@ -12,14 +14,18 @@ from .dataclasses import (
     DummyOptim,
     DummyScheduler,
     DynamoBackend,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradScalerConfig,
     GradScalerKwargs,
     GradientAccumulationPlugin,
+    HfDeepSpeedConfig,
     InitProcessGroupKwargs,
     JitConfig,
     KwargsHandler,
     LoggerType,
+    MSAMPRecipeKwargs,
+    MegatronLMPlugin,
     MixedPrecisionPolicy,
     PrecisionType,
     ProfileConfig,
@@ -28,21 +34,30 @@ from .dataclasses import (
     RNGType,
     SageMakerDistributedType,
     SaveFormat,
+    TERecipeKwargs,
     TorchContextParallelConfig,
     TorchDynamoPlugin,
     TorchTensorParallelConfig,
     TorchTensorParallelPlugin,
+    add_model_config_to_megatron_parser,
+    deepspeed_required,
+    disable_fsdp_ram_efficient_loading,
+    enable_fsdp_ram_efficient_loading,
+    get_active_deepspeed_plugin,
 )
 from .versions import compare_versions, is_jax_version, is_torch_version
 from .environment import (
     are_libraries_initialized,
     clear_environment,
     convert_dict_to_env_variables,
+    get_cpu_distributed_information,
+    get_current_device_type,
     get_int_from_env,
     parse_choice_from_env,
     parse_flag_from_env,
     patch_environment,
     purge_accelerate_environment,
+    set_numa_affinity,
     str_to_bool,
 )
 # Collectives and RNG helpers are re-exported LAZILY (module __getattr__
@@ -50,6 +65,7 @@ from .environment import (
 # eager imports here would cycle. Reference users' `from accelerate.utils
 # import gather, set_seed, ...` spellings resolve the same either way.
 _OPERATIONS = {
+    "CannotPadNestedTensorWarning",
     "DistributedOperationException",
     "TensorInformation",
     "avg_losses_across_data_parallel_group",
@@ -86,15 +102,31 @@ _RANDOM = {
 _MODELING = {
     "abstract_params",
     "align_module_device",
+    "calculate_maximum_sizes",
+    "check_device_map",
+    "check_tied_parameters_in_config",
+    "check_tied_parameters_on_same_device",
     "clean_device_map",
     "compute_module_sizes",
     "compute_parameter_sizes",
     "convert_file_size_to_int",
+    "copy_tensor_to_devices",
     "dtype_byte_size",
+    "ensure_weights_retied",
+    "extract_submodules_state_dict",
+    "filter_first_and_last_linear_layers",
     "find_tied_parameters",
     "get_balanced_memory",
+    "get_fsdp2_grad_scaler",
+    "get_grad_scaler",
+    "get_max_layer_size",
     "get_max_memory",
+    "get_mixed_precision_context_manager",
+    "get_module_children_bottom_up",
+    "has_4bit_bnb_layers",
+    "has_ao_layers",
     "has_offloaded_params",
+    "has_transformer_engine_layers",
     "id_tensor_storage",
     "load_offloaded_weights",
     "named_module_tensors",
@@ -107,6 +139,7 @@ _MODELING = {
     "total_byte_size",
     "unflatten_parameters",
 }
+_LAUNCH = {"prepare_multi_gpu_env", "prepare_simple_launcher_cmd_env", "prepare_tpu"}
 _OFFLOAD = {
     "OffloadedWeightsLoader",
     "PrefixedDataset",
@@ -121,6 +154,8 @@ _QUANT = {"QuantizationConfig", "QuantizedArray", "load_and_quantize_model", "qu
 _PACKING = {"pack_sequences", "unpack_logits"}
 _OTHER = {
     "check_os_kernel",
+    "compile_regions",
+    "has_compiled_regions",
     "is_compiled_module",
     "is_torch_tensor",
     "clean_state_dict_for_safetensors",
@@ -155,6 +190,10 @@ def __getattr__(name):
         from . import operations
 
         return getattr(operations, name)
+    if name in _LAUNCH:
+        from . import launch
+
+        return getattr(launch, name)
     if name in _RANDOM:
         from . import random
 
@@ -232,10 +271,27 @@ def __getattr__(name):
 
 
 from .imports import (
+    is_4bit_bnb_available,
+    is_8bit_bnb_available,
     is_aim_available,
     is_bf16_available,
+    is_bitsandbytes_multi_backend_available,
     is_bnb_available,
     is_boto3_available,
+    is_habana_gaudi1,
+    is_hpu_available,
+    is_mlu_available,
+    is_msamp_available,
+    is_musa_available,
+    is_npu_available,
+    is_peft_model,
+    is_sdaa_available,
+    is_torchao_available,
+    is_transformer_engine_available,
+    is_transformer_engine_mxfp8_available,
+    is_xpu_available,
+    model_has_dtensor,
+    torchao_required,
     is_chex_available,
     is_clearml_available,
     is_comet_ml_available,
@@ -300,7 +356,7 @@ _LAZY_EXTRA = {
 }
 _ALL_LAZY = (
     _OPERATIONS | _RANDOM | _MODELING | _OFFLOAD | _MEMORY | _QUANT | _OTHER | _PACKING
-    | _CONSTANTS | _FSDP_CKPT | _LAZY_EXTRA
+    | _CONSTANTS | _FSDP_CKPT | _LAUNCH | _LAZY_EXTRA
 )
 
 __all__ = sorted(

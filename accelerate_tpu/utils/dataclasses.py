@@ -432,11 +432,17 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     cpu_offload: bool = False
     activation_checkpointing: bool = False
     state_dict_type: str = "SHARDED_STATE_DICT"
-    cpu_ram_efficient_loading: bool = True
+    # None = unset: the FSDP_CPU_RAM_EFFICIENT_LOADING env flag (written by
+    # enable/disable_fsdp_ram_efficient_loading) supplies the default, True
+    # absent that; an EXPLICIT constructor value always wins over the env
+    cpu_ram_efficient_loading: Optional[bool] = None
 
     _STRATEGIES = {1: "FULL_SHARD", 2: "SHARD_GRAD_OP", 3: "NO_SHARD", 4: "HYBRID_SHARD"}
 
     def __post_init__(self):
+        if self.cpu_ram_efficient_loading is None:
+            env_flag = os.environ.get("FSDP_CPU_RAM_EFFICIENT_LOADING", "true")
+            self.cpu_ram_efficient_loading = env_flag.strip().lower() in ("1", "true", "yes")
         s = self.sharding_strategy
         if isinstance(s, int):
             if s not in self._STRATEGIES:
@@ -843,3 +849,214 @@ def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
         "Megatron-LM is a CUDA engine; its TP/PP/EP capabilities are provided natively "
         "via ParallelismConfig mesh axes on TPU."
     )
+
+
+# --------------------------------------------------- fp8 recipe kwargs shims --
+@dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """Migration shim for reference ``FP8RecipeKwargs`` (``utils/dataclasses.py:455``,
+    deprecated there in favor of backend-specific kwargs). Every backend maps to
+    the ONE native fp8 path: XLA fp8 ``dot_general`` with delayed scaling
+    (``ops/fp8.py``); :meth:`to_native` yields that recipe."""
+
+    backend: Optional[str] = None
+    margin: int = 0
+    interval: int = 1  # accepted: native scaling re-derives per step
+    fp8_format: str = "HYBRID"
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"
+    override_linear_precision: Any = None  # TE triple; see filter_first_and_last_linear_layers
+    use_autocast_during_eval: bool = False
+
+    def __post_init__(self):
+        if self.backend is not None:
+            self.backend = str(self.backend).upper()
+            if self.backend not in ("TE", "MSAMP", "AO"):
+                raise ValueError(f"unknown fp8 backend {self.backend!r}")
+        self.fp8_format = str(self.fp8_format).upper()
+        if self.fp8_format not in ("HYBRID", "E4M3"):
+            # same validation as the native FP8Recipe this builds — silently
+            # coercing would mask exactly the misconfigurations it rejects
+            raise ValueError(
+                f"unknown fp8_format {self.fp8_format!r} (valid: HYBRID, E4M3)"
+            )
+
+    def to_native(self):
+        from ..ops.fp8 import FP8Recipe
+
+        return FP8Recipe(
+            margin=self.margin,
+            amax_history_len=self.amax_history_len,
+            amax_compute_algo=self.amax_compute_algo,
+            fp8_format=self.fp8_format,
+        )
+
+
+@dataclass
+class TERecipeKwargs(FP8RecipeKwargs):
+    """TransformerEngine recipe spelling (reference ``utils/dataclasses.py:359``)."""
+
+    def __post_init__(self):
+        self.backend = "TE"
+        super().__post_init__()
+
+
+@dataclass
+class AORecipeKwargs(FP8RecipeKwargs):
+    """torchao Float8 recipe spelling (reference ``utils/dataclasses.py:311``).
+    ``config``/``module_filter_func`` accepted for signature parity."""
+
+    config: Any = None
+    module_filter_func: Any = None
+
+    def __post_init__(self):
+        self.backend = "AO"
+        super().__post_init__()
+
+
+@dataclass
+class MSAMPRecipeKwargs(FP8RecipeKwargs):
+    """MS-AMP recipe spelling (reference ``utils/dataclasses.py:438``).
+    ``opt_level`` accepted: optimizer-state precision is governed natively by
+    the optax transform chain."""
+
+    opt_level: str = "O2"
+
+    def __post_init__(self):
+        self.backend = "MSAMP"
+        super().__post_init__()
+
+
+# ------------------------------------------------------- Megatron-LM shim ----
+@dataclass
+class MegatronLMPlugin(KwargsHandler):
+    """Migration shim for reference ``MegatronLMPlugin`` (``utils/dataclasses.py:2286``).
+
+    The Megatron ENGINE (CUDA kernels, fused softmax, its own runtime) is not
+    ported — its capabilities are native here: TP/PP/EP/SP are mesh axes and
+    GSPMD shardings. This shim maps the plugin's parallelism degrees onto
+    :class:`~accelerate_tpu.parallelism_config.ParallelismConfig` so a script
+    that passes ``megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, ...)``
+    configures the same mesh. Engine-tuning knobs (fused kernels, selective
+    recompute spellings) are accepted and ignored; XLA owns those decisions.
+    """
+
+    tp_degree: int = 1
+    pp_degree: int = 1
+    num_micro_batches: int = 1
+    expert_model_parallel_size: int = 1
+    context_parallel_size: int = 1
+    sequence_parallelism: bool = False
+    gradient_clipping: Optional[float] = None
+    use_distributed_optimizer: bool = False  # ZeRO-style: opt state sharded anyway
+    recompute_activations: bool = False
+    other_megatron_args: Optional[dict] = None
+
+    @property
+    def remat(self) -> "bool | str":
+        return "dots_no_batch" if self.recompute_activations else False
+
+    def to_parallelism_config(self):
+        from ..parallelism_config import ParallelismConfig
+
+        # NOTE: Megatron's sequence_parallelism is a FLAG on the tp group
+        # (norm/dropout activations sharded along the existing tp axis, no
+        # extra devices) — NOT a Ulysses sp mesh axis. Under GSPMD the
+        # activation sharding it buys is compiler-inserted from the tp param
+        # specs, so the flag maps to nothing; mapping it to sp_size would
+        # demand tp*2 devices and build a different topology than asked for.
+        return ParallelismConfig(
+            tp_size=self.tp_degree,
+            pp_size=self.pp_degree,
+            ep_size=self.expert_model_parallel_size,
+            cp_size=self.context_parallel_size,
+            dp_shard_size=-1,
+        )
+
+
+# ------------------------------------------------ DeepSpeed-surface spellings --
+class HfDeepSpeedConfig:
+    """Thin holder for a ds_config dict/file (reference ``utils/deepspeed.py``
+    ``HfDeepSpeedConfig``): dotted-path access + stage probes. The values feed
+    :class:`DeepSpeedPlugin`'s config-file mapping; there is no engine to hand
+    the dict to."""
+
+    def __init__(self, config_file_or_dict):
+        import json as _json
+
+        if isinstance(config_file_or_dict, dict):
+            self.config = dict(config_file_or_dict)
+        else:
+            with open(config_file_or_dict) as f:
+                self.config = _json.load(f)
+
+    def get_value(self, ds_key_long: str, default=None):
+        node = self.config
+        for part in ds_key_long.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def is_true(self, ds_key_long: str) -> bool:
+        return bool(self.get_value(ds_key_long))
+
+    def is_false(self, ds_key_long: str) -> bool:
+        value = self.get_value(ds_key_long)
+        return value is not None and not bool(value)
+
+    def is_zero2(self) -> bool:
+        return self.get_value("zero_optimization.stage") == 2
+
+    def is_zero3(self) -> bool:
+        return self.get_value("zero_optimization.stage") == 3
+
+    def is_offload(self) -> bool:
+        for key in ("offload_optimizer", "offload_param"):
+            device = self.get_value(f"zero_optimization.{key}.device")
+            if device not in (None, "none"):
+                return True
+        return False
+
+
+def get_active_deepspeed_plugin(state_or_accelerator):
+    """The active :class:`DeepSpeedPlugin` (reference ``utils/deepspeed.py``
+    spelling). Accepts an ``Accelerator`` or anything exposing
+    ``deepspeed_plugin``; raises when no plugin is configured."""
+    plugin = getattr(state_or_accelerator, "deepspeed_plugin", None)
+    if isinstance(plugin, dict):  # reference multi-plugin dict: the selected one
+        for p in plugin.values():
+            if getattr(p, "selected", False):
+                return p
+        raise ValueError("no DeepSpeedPlugin in the dict is selected")
+    if plugin is None:
+        raise ValueError(
+            "no DeepSpeedPlugin is active; pass deepspeed_plugin= to Accelerator"
+        )
+    return plugin
+
+
+def deepspeed_required(func):
+    """Decorator: the wrapped method requires an active DeepSpeedPlugin
+    (reference ``utils/deepspeed.py`` spelling)."""
+    import functools as _functools
+
+    @_functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        get_active_deepspeed_plugin(self)  # raises with the actionable message
+        return func(self, *args, **kwargs)
+
+    return wrapper
+
+
+# --------------------------------------------- fsdp ram-efficient toggles ----
+def enable_fsdp_ram_efficient_loading() -> None:
+    """Set the env flag that makes :class:`FullyShardedDataParallelPlugin`
+    default to cpu-ram-efficient loading (reference ``utils/fsdp_utils.py``
+    spelling; the native mechanism is abstract init via ``jax.eval_shape`` +
+    per-shard reads in ``sharded_checkpoint``)."""
+    os.environ["FSDP_CPU_RAM_EFFICIENT_LOADING"] = "true"
+
+
+def disable_fsdp_ram_efficient_loading() -> None:
+    os.environ["FSDP_CPU_RAM_EFFICIENT_LOADING"] = "false"

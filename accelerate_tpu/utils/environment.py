@@ -168,3 +168,56 @@ def purge_accelerate_environment(func_or_cls):
             setattr(func_or_cls, name, wrapped)
         return func_or_cls
     return _wrap(func_or_cls)
+
+
+def get_current_device_type() -> str:
+    """Active accelerator platform string (reference ``utils/environment.py``
+    spelling, which maps torch device modules): ``"tpu"`` / ``"gpu"`` /
+    ``"cpu"`` from the live JAX backend."""
+    import jax
+
+    return jax.default_backend()
+
+
+def get_cpu_distributed_information() -> dict:
+    """Host-side process topology (reference ``utils/environment.py``
+    ``get_cpu_distributed_information`` reads MPI/torchrun env): rank / world
+    size / local counterparts from the launcher env protocol, falling back to
+    the live ``PartialState`` when one exists."""
+    info = {
+        "rank": get_int_from_env(("ACCELERATE_PROCESS_ID", "RANK"), 0),
+        "world_size": get_int_from_env(("ACCELERATE_NUM_PROCESSES", "WORLD_SIZE"), 1),
+        # the launcher/state spelling is ACCELERATE_LOCAL_PROCESS_INDEX
+        # (state.py consumes it); LOCAL_RANK covers torchrun-style callers
+        "local_rank": get_int_from_env(("ACCELERATE_LOCAL_PROCESS_INDEX", "LOCAL_RANK"), 0),
+        "local_world_size": get_int_from_env(("LOCAL_WORLD_SIZE",), 1),
+    }
+    from ..state import PartialState
+
+    if PartialState._shared_state:
+        state = PartialState()
+        info["rank"] = state.process_index
+        info["world_size"] = state.num_processes
+        info["local_rank"] = state.local_process_index
+    return info
+
+
+def set_numa_affinity(local_process_index: int, verbose: bool = False) -> None:
+    """Pin this process to an equal slice of the host's CPUs (reference
+    ``utils/environment.py`` ``set_numa_affinity`` pins to the GPU's NUMA
+    node via pynvml; TPU VMs expose no such mapping, so the slice is computed
+    from the local process count). No-op on platforms without
+    ``sched_setaffinity``."""
+    if not hasattr(os, "sched_getaffinity"):
+        return
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+        local_world = get_cpu_distributed_information()["local_world_size"]
+        per = max(len(cpus) // max(local_world, 1), 1)
+        start = (local_process_index * per) % len(cpus)
+        slice_ = cpus[start:start + per] or cpus
+        os.sched_setaffinity(0, slice_)
+        if verbose:
+            print(f"process {local_process_index}: CPU affinity {slice_}")
+    except OSError:
+        pass

@@ -294,3 +294,118 @@ def is_weights_only_available() -> bool:
         return compare_versions(torch.__version__, ">=", "2.4.0")
     except ImportError:
         return False
+
+
+# -- device-vendor probes (reference utils/imports.py:62-426): each reports
+# whether that accelerator stack is importable. On a TPU image none are, so
+# reference-written gates like ``if is_xpu_available(): ...`` fall through
+# honestly rather than raising ImportError at the import site.
+def is_xpu_available(check_device: bool = False) -> bool:
+    return _package_available("intel_extension_for_pytorch")
+
+
+def is_npu_available(check_device: bool = False) -> bool:
+    return _package_available("torch_npu")
+
+
+def is_mlu_available(check_device: bool = False) -> bool:
+    return _package_available("torch_mlu")
+
+
+def is_musa_available(check_device: bool = False) -> bool:
+    return _package_available("torch_musa")
+
+
+def is_sdaa_available(check_device: bool = False) -> bool:
+    return _package_available("torch_sdaa")
+
+
+def is_hpu_available(init_hccl: bool = False) -> bool:
+    return _package_available("habana_frameworks")
+
+
+def is_habana_gaudi1() -> bool:
+    """Gaudi1 detection requires the habana stack; absent it, not Gaudi1."""
+    return False
+
+
+# -- quantization/fp8 engine probes: the capabilities exist natively
+# (``ops/quantization.py`` int8/NF4 kernels, ``ops/fp8.py`` delayed-scaling
+# fp8 dot); these report whether the CUDA engines the reference delegates to
+# are importable, for scripts that branch on the engine rather than the
+# capability.
+def is_4bit_bnb_available() -> bool:
+    return is_bnb_available()
+
+
+def is_8bit_bnb_available() -> bool:
+    return is_bnb_available()
+
+
+def is_bitsandbytes_multi_backend_available() -> bool:
+    return is_bnb_available()
+
+
+def is_torchao_available() -> bool:
+    return _package_available("torchao")
+
+
+def is_msamp_available() -> bool:
+    return _package_available("msamp")
+
+
+def is_transformer_engine_available() -> bool:
+    return _package_available("transformer_engine")
+
+
+def is_transformer_engine_mxfp8_available() -> bool:
+    """MXFP8 needs TE + Blackwell-class hardware; without TE it is False."""
+    return False
+
+
+def is_peft_model(model) -> bool:
+    """True iff ``model`` is a PEFT-wrapped torch model (reference
+    ``utils/other.py`` spelling). Works through our torch bridge: unwraps
+    ``BridgedModule`` to the underlying torch module first."""
+    inner = getattr(model, "torch_module", model)
+    if not is_peft_available():
+        return False
+    try:
+        from peft import PeftModel  # type: ignore
+
+        return isinstance(inner, PeftModel)
+    except Exception:
+        return False
+
+
+def model_has_dtensor(model) -> bool:
+    """torch DTensor probe (reference ``utils/modeling.py``). Sharding here is
+    GSPMD ``jax.Array`` — a torch model routed through the bridge never holds
+    DTensors, and a plain torch model is checked directly."""
+    try:
+        from torch.distributed.tensor import DTensor  # type: ignore
+    except Exception:
+        return False
+    params = getattr(model, "parameters", None)
+    if params is None:
+        return False
+    return any(isinstance(p, DTensor) for p in model.parameters())
+
+
+def torchao_required(func):
+    """Decorator guard (reference ``utils/ao.py``): the wrapped function needs
+    the torchao CUDA engine, which has no TPU meaning — the native fp8 path is
+    ``ops/fp8.py``. Raises with that pointer when called without torchao."""
+    import functools as _functools
+
+    @_functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if not is_torchao_available():
+            raise ImportError(
+                f"{func.__name__} requires torchao (CUDA fp8 engine). On TPU use "
+                "the native fp8 path: ops/fp8.py (fp8_dot / make_fp8_optimizer) "
+                "with FP8RecipeKwargs/AORecipeKwargs."
+            )
+        return func(*args, **kwargs)
+
+    return wrapper

