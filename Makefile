@@ -1,8 +1,23 @@
 # Test/benchmark targets (reference Makefile:23-58 split: core vs cli vs
-# big-modeling vs examples, for CI sharding).
+# big-modeling vs examples, for CI sharding; reference test_utils/testing.py
+# @slow discipline: long-running tests carry -m slow and run in their own
+# shard so the core signal stays fast).
+#
+# Approximate shard wall-times (virtual 8-device CPU mesh, this container):
+#   test_smoke       ~1 min
+#   test_core        ~4 min   (slow-marked tests excluded)
+#   test_slow        ~3 min   (the excluded heavy MoE/decode/quant tests)
+#   test_cli         ~3 min
+#   test_big_modeling~2 min
+#   test_models      ~7 min
+#   test_checkpoint  ~2 min
+#   test_multihost   ~4 min   (real OS processes)
+#   test_examples    ~12 min  (30 example scripts end-to-end)
+# Run shards SEQUENTIALLY: concurrent shards starve each other on this
+# box (observed round 4).
 
-.PHONY: test test_smoke test_core test_cli test_big_modeling test_examples \
-        test_models test_multihost test_checkpoint quality bench
+.PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
+        test_examples test_models test_multihost test_checkpoint quality bench
 
 PYTEST := python -m pytest -q
 
@@ -16,7 +31,19 @@ test_smoke:
 	$(PYTEST) tests/ -m smoke
 
 test_core:
-	$(PYTEST) tests/ --ignore=tests/test_big_modeling.py \
+	$(PYTEST) tests/ -m "not slow" --ignore=tests/test_big_modeling.py \
+	  --ignore=tests/test_examples.py --ignore=tests/test_cli.py \
+	  --ignore=tests/test_multiprocess.py --ignore=tests/test_models.py \
+	  --ignore=tests/test_t5.py --ignore=tests/test_convert.py \
+	  --ignore=tests/test_bridge.py --ignore=tests/test_sharded_checkpoint.py \
+	  --ignore=tests/test_native.py
+
+# the slow-marked complement of test_core (heavy MoE/sharded-decode/quant
+# end-to-end parity tests) — run in CI's long lane, like the reference's @slow.
+# Same ignore list as test_core: slow tests living in the cli/models/etc
+# shards already run there, and running them twice would double-bill the lane.
+test_slow:
+	$(PYTEST) tests/ -m slow --ignore=tests/test_big_modeling.py \
 	  --ignore=tests/test_examples.py --ignore=tests/test_cli.py \
 	  --ignore=tests/test_multiprocess.py --ignore=tests/test_models.py \
 	  --ignore=tests/test_t5.py --ignore=tests/test_convert.py \

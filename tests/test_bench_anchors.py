@@ -199,7 +199,10 @@ class TestProbeRecovery:
     def test_probe_subprocess_reports_detail(self):
         from bench import _probe_backend_subprocess
 
-        ok, detail = _probe_backend_subprocess(timeout=60)
+        # tiny timeout: the contract under test is the (ok, detail) shape, and
+        # on a dead tunnel a long timeout just stalls the suite for its full
+        # length (observed: this one test cost the core shard 60s)
+        ok, detail = _probe_backend_subprocess(timeout=5)
         assert isinstance(ok, bool) and isinstance(detail, str)
         if not ok:
             assert detail  # a failed probe must say why

@@ -88,6 +88,7 @@ class TestGenerationShardings:
         assert cache_sh.spec == P(None, ("dp_replicate", "dp_shard"), None, "tp", None)
 
 
+@pytest.mark.slow
 class TestMoEDecode:
     """KV-cache decode for MoE configs must match full-forward recompute
     decoding token-for-token. ``moe_capacity_factor`` is set high enough that
@@ -207,6 +208,7 @@ class TestShardedDecodeParity:
         )
         np.testing.assert_array_equal(ref, got)
 
+    @pytest.mark.slow
     def test_sampled_parity_same_key(self, setup):
         config, params, prompt, mesh, sharded, _ = setup
         kwargs = dict(
@@ -217,6 +219,7 @@ class TestShardedDecodeParity:
         got = sample_generate(sharded, prompt, config, mesh=mesh, **kwargs)
         np.testing.assert_array_equal(ref, got)
 
+    @pytest.mark.slow
     def test_beam_parity(self, setup):
         config, params, prompt, mesh, sharded, _ = setup
         ref, ref_s = beam_generate(

@@ -36,6 +36,7 @@ def test_pack_sequences_rejects_empty_docs():
         pack_sequences([[1, 2], [], [3]], seq_len=8)
 
 
+@pytest.mark.slow
 def test_packed_forward_matches_separate_docs():
     """Logits of each packed document == logits of that document run alone."""
     cfg = LlamaConfig.tiny()

@@ -156,6 +156,7 @@ class TestMoE:
         # tighter capacity must change (zero-out) some outputs
         assert not np.allclose(np.asarray(y_small), np.asarray(y_big))
 
+    @pytest.mark.slow
     def test_ep_sharded_matches_unsharded(self):
         pc = ParallelismConfig(ep_size=8)
         acc = Accelerator(parallelism_config=pc)
@@ -314,6 +315,7 @@ class Test1F1B:
         assert mem_1f1b < mem_gpipe, (mem_1f1b, mem_gpipe)
 
 
+@pytest.mark.slow
 class TestMoEInModel:
     """MoE wired into the Llama family (LlamaConfig.moe_experts > 0)."""
 

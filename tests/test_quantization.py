@@ -182,6 +182,7 @@ class TestInt8Matmul:
         assert out.shape == (4, 32)
 
 
+@pytest.mark.slow
 class TestEndToEnd:
     def test_quantized_llama_forward(self):
         from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
