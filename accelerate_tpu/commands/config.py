@@ -86,6 +86,16 @@ class ClusterConfig:
             data = json.load(f) if path.endswith(".json") else yaml.safe_load(f)
         if data is None:  # empty/comment-only YAML
             data = {}
+        if str(data.get("compute_environment", "")).upper() == "AMAZON_SAGEMAKER":
+            # a reference SageMakerConfig must not be misread as a cluster
+            # config (its keys overlap enough to half-work); the exclusion is
+            # deliberate and documented — docs/launching.md, api_boundary.py
+            raise ValueError(
+                f"{path} is a SageMaker config (compute_environment: "
+                "AMAZON_SAGEMAKER). The SageMaker launch route is deliberately "
+                "not supported on this TPU framework — see docs/launching.md. "
+                "Target GCP TPU VMs, or use the reference package on AWS."
+            )
         known = {f.name for f in dataclasses.fields(cls)}
         extra = set(data) - known
         if extra:

@@ -1,0 +1,105 @@
+"""Example-corpus drift protection (reference ``tests/test_examples.py``
+``ExampleDifferenceTests``: the ``by_feature`` one-feature scripts are diffed
+against the ``complete_*`` examples so docs and examples cannot drift apart).
+
+The native spelling of that property: the set of ``accelerator.<api>`` calls
+(and ``Accelerator(...)`` kwargs) a by_feature script introduces BEYOND the
+base ``nlp_example.py`` must appear in the corresponding ``complete_*``
+example. If someone strips ``save_state`` from the complete example while the
+checkpointing lesson still teaches it, this fails.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def api_surface(path: pathlib.Path) -> "tuple[set, set]":
+    """(accelerator.<attr> call/attribute names, Accelerator(...) kwarg names)."""
+    tree = ast.parse(path.read_text())
+    attrs, kwargs = set(), set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "accelerator"
+        ):
+            attrs.add(node.attr)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Accelerator":
+            kwargs |= {k.arg for k in node.keywords if k.arg}
+    return attrs, kwargs
+
+
+# by_feature lesson -> the complete example that must demonstrate it.
+# Deliberately NOT mapped:
+# - engine-flavored lessons (fsdp_training, zero_offload, fp8_training,
+#   quantized_inference, sequence_packing, gradient_compression,
+#   deepspeed_with_config_support, fsdp_with_peak_mem_tracking): they
+#   configure the mesh/plugins rather than new Accelerator APIs, and
+#   tests/test_examples.py runs them end-to-end;
+# - auxiliary-utility lessons (memory + cross_validation -> free_memory,
+#   profiler -> profile, local_sgd/schedule_free/automatic_gradient_
+#   accumulation/gradient_accumulation_for_autoregressive_models): they teach
+#   utilities the complete examples deliberately do not demonstrate (a
+#   complete example with profiling/OOM-retry would obscure its own lesson).
+# Every other lesson must be covered by a complete example, asserted below.
+FEATURE_TO_COMPLETE = {
+    "checkpointing.py": "complete_nlp_example.py",
+    "early_stopping.py": "complete_nlp_example.py",
+    "tracking.py": "complete_nlp_example.py",
+    "gradient_accumulation.py": "complete_nlp_example.py",
+    "multi_process_metrics.py": "complete_nlp_example.py",
+}
+
+
+@pytest.mark.parametrize("feature,complete", sorted(FEATURE_TO_COMPLETE.items()))
+def test_complete_examples_cover_by_feature_lessons(feature, complete):
+    base_attrs, base_kwargs = api_surface(EXAMPLES / "nlp_example.py")
+    feat_attrs, feat_kwargs = api_surface(EXAMPLES / "by_feature" / feature)
+    comp_attrs, comp_kwargs = api_surface(EXAMPLES / complete)
+    missing_attrs = (feat_attrs - base_attrs) - comp_attrs
+    missing_kwargs = (feat_kwargs - base_kwargs) - comp_kwargs
+    assert not missing_attrs, (
+        f"{complete} no longer demonstrates accelerator.{sorted(missing_attrs)} "
+        f"taught by by_feature/{feature}"
+    )
+    assert not missing_kwargs, (
+        f"{complete} no longer passes Accelerator({sorted(missing_kwargs)}) "
+        f"taught by by_feature/{feature}"
+    )
+
+
+def test_every_by_feature_script_keeps_the_base_skeleton():
+    """Each lesson stays a variation of the base training loop (reference
+    ExampleDifferenceTests' premise): constructs Accelerator, prepares, and
+    drives a train step through one of the supported spellings."""
+    step_spellings = {
+        "prepare_train_step", "prepare_train_loop", "_build_train_step",
+        "backward", "accumulate",
+    }
+    # inference-only lessons legitimately skip the Accelerator training loop
+    # (the reference's big-model-inference lessons do the same)
+    inference_lessons = {"quantized_inference.py"}
+    for script in sorted((EXAMPLES / "by_feature").glob("*.py")):
+        if script.name in inference_lessons:
+            continue
+        attrs, _ = api_surface(script)
+        # some lessons (memory/automatic accumulation) rebuild objects inside a
+        # retry decorator and only touch prepare_train_step — any prepare*
+        # spelling counts as "prepares through the Accelerator"
+        assert any(a.startswith("prepare") for a in attrs), (
+            f"{script.name} never prepares through the Accelerator"
+        )
+        assert attrs & step_spellings, (
+            f"{script.name} drives no train step (none of {sorted(step_spellings)})"
+        )
+
+
+def test_complete_examples_superset_of_base():
+    """complete_* must remain a strict superset of the base example's API use."""
+    base_attrs, _ = api_surface(EXAMPLES / "nlp_example.py")
+    comp_attrs, _ = api_surface(EXAMPLES / "complete_nlp_example.py")
+    assert base_attrs <= comp_attrs | {"print"}, sorted(base_attrs - comp_attrs)

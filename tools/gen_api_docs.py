@@ -1,0 +1,238 @@
+"""Generate docs/package_reference/*.md from the live package.
+
+Mirrors the reference's ``docs/source/package_reference/`` file set (15 pages:
+accelerator, state, big_modeling, cli, deepspeed, fp8, fsdp, inference,
+kwargs, launchers, logging, megatron_lm, torch_wrappers, tracking, utilities)
+but the content is INTROSPECTED from this package — signatures and first
+docstring paragraphs — so the reference pages can never drift from the code.
+``tests/test_docs.py`` regenerates into a temp dir and asserts zero diff.
+
+Run:  python tools/gen_api_docs.py [outdir]
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # introspection must not touch the TPU tunnel
+
+
+# page -> (title, intro, [(module, [names] | None=all public)])
+PAGES: "dict[str, tuple[str, str, list]]" = {
+    "accelerator": (
+        "Accelerator",
+        "The central orchestration facade (reference `accelerator.py:183`): "
+        "prepare assigns shardings, the hot path is one jitted train step.",
+        [("accelerate_tpu.accelerator", ["Accelerator", "StepProfiler", "RemovableHandle"])],
+    ),
+    "state": (
+        "State singletons",
+        "Process/mesh state (reference `state.py`): PartialState boots "
+        "`jax.distributed`, AcceleratorState owns the mesh, GradientState "
+        "tracks accumulation.",
+        [("accelerate_tpu.state", ["PartialState", "AcceleratorState", "GradientState"])],
+    ),
+    "big_modeling": (
+        "Big-model inference",
+        "Zero-RAM init, device maps, dispatch and offload "
+        "(reference `big_modeling.py`).",
+        [("accelerate_tpu.big_modeling", None), ("accelerate_tpu.hooks", None)],
+    ),
+    "cli": (
+        "CLI",
+        "`accelerate-tpu {config,launch,env,estimate-memory,merge-weights,"
+        "test,tpu-config}` (reference `commands/`). Each command module "
+        "exposes `main`/`*_command` entry points.",
+        [("accelerate_tpu.commands.launch", ["launch_command", "build_launch_env"]),
+         ("accelerate_tpu.commands.config", ["write_basic_config", "ClusterConfig"]),
+         ("accelerate_tpu.commands.estimate", None),
+         ("accelerate_tpu.commands.merge", None)],
+    ),
+    "deepspeed": (
+        "DeepSpeed (shim)",
+        "There is no DeepSpeed engine on TPU: the plugin maps ZeRO staging "
+        "onto GSPMD sharding (see `docs/concept_guides/fsdp_gspmd.md`).",
+        [("accelerate_tpu.utils.dataclasses",
+          ["DeepSpeedPlugin", "HfDeepSpeedConfig", "DummyOptim", "DummyScheduler",
+           "get_active_deepspeed_plugin", "deepspeed_required"])],
+    ),
+    "fp8": (
+        "FP8",
+        "Native delayed-scaling fp8 over XLA's fp8 `dot_general` "
+        "(reference delegates to TE/torchao/MS-AMP CUDA).",
+        [("accelerate_tpu.ops.fp8", None),
+         ("accelerate_tpu.utils.dataclasses",
+          ["FP8RecipeKwargs", "TERecipeKwargs", "AORecipeKwargs", "MSAMPRecipeKwargs"])],
+    ),
+    "fsdp": (
+        "FSDP",
+        "FSDP is a NamedSharding assignment over the `dp_shard` mesh axis; "
+        "the FSDP1/FSDP2 split collapses under GSPMD.",
+        [("accelerate_tpu.utils.dataclasses", ["FullyShardedDataParallelPlugin"]),
+         ("accelerate_tpu.parallel.sharding", None),
+         ("accelerate_tpu.sharded_checkpoint", None)],
+    ),
+    "inference": (
+        "Inference",
+        "KV-cache generation and pipeline-parallel inference "
+        "(reference `inference.py` PiPPy route).",
+        [("accelerate_tpu.generation", None),
+         ("accelerate_tpu.parallel.pipeline", None)],
+    ),
+    "kwargs": (
+        "Kwargs handlers and plugins",
+        "Configuration dataclasses (reference `utils/dataclasses.py`).",
+        [("accelerate_tpu.utils.dataclasses", None)],
+    ),
+    "launchers": (
+        "Launchers",
+        "Notebook/debug launchers (reference `launchers.py`).",
+        [("accelerate_tpu.launchers", None)],
+    ),
+    "logging": (
+        "Logging",
+        "Rank-aware logging (reference `logging.py`).",
+        [("accelerate_tpu.logging", None)],
+    ),
+    "megatron_lm": (
+        "Megatron-LM (shim)",
+        "The Megatron engine is not ported; its TP/PP/EP degrees map onto the "
+        "native mesh. Engine internals are excluded with reasons in "
+        "`accelerate_tpu.utils.api_boundary.EXCLUDED_REFERENCE_UTILS`.",
+        [("accelerate_tpu.utils.dataclasses", ["MegatronLMPlugin"]),
+         ("accelerate_tpu.parallelism_config", ["ParallelismConfig"])],
+    ),
+    "torch_wrappers": (
+        "Training-object wrappers and the torch bridge",
+        "Data loader / optimizer / scheduler wrappers (reference "
+        "`data_loader.py`, `optimizer.py`, `scheduler.py`) and the "
+        "torch.export→JAX bridge that runs torch models on the TPU path.",
+        [("accelerate_tpu.data_loader", None),
+         ("accelerate_tpu.optimizer", None),
+         ("accelerate_tpu.scheduler", None),
+         ("accelerate_tpu.bridge.module", ["BridgedModule", "BridgedOutput"])],
+    ),
+    "tracking": (
+        "Experiment tracking",
+        "Tracker abstraction + integrations (reference `tracking.py`).",
+        [("accelerate_tpu.tracking", None)],
+    ),
+    "utilities": (
+        "Utilities",
+        "Collectives, modeling utils, memory, offload, environment "
+        "(reference `utils/`). The full reference-name boundary lives in "
+        "`accelerate_tpu/utils/api_boundary.py`.",
+        [("accelerate_tpu.utils.operations", None),
+         ("accelerate_tpu.utils.modeling", None),
+         ("accelerate_tpu.utils.memory", None),
+         ("accelerate_tpu.utils.offload", None),
+         ("accelerate_tpu.utils.environment", None),
+         ("accelerate_tpu.utils.random", None),
+         ("accelerate_tpu.utils.other", None)],
+    ),
+}
+
+
+def _first_paragraph(obj) -> str:
+    doc = inspect.getdoc(obj) or ""
+    para = doc.split("\n\n", 1)[0].strip()
+    return " ".join(para.split())
+
+
+def _signature(obj) -> str:
+    import re
+
+    try:
+        sig = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # function/object default reprs embed memory addresses — nondeterministic
+    # across runs, which would make the freshness test flap
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
+
+
+def _public_names(mod) -> list:
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n, v in vars(mod).items()
+                 if not n.startswith("_") and getattr(v, "__module__", None) == mod.__name__
+                 and (inspect.isclass(v) or inspect.isfunction(v))]
+    return names
+
+
+def _render_entry(name: str, obj) -> list:
+    lines = []
+    if inspect.isclass(obj):
+        lines.append(f"### `class {name}{_signature(obj)}`\n")
+        para = _first_paragraph(obj)
+        if para:
+            lines.append(para + "\n")
+        methods = [
+            (mn, mv) for mn, mv in vars(obj).items()
+            if not mn.startswith("_")
+            and (inspect.isfunction(mv) or isinstance(mv, (property, classmethod, staticmethod)))
+        ]
+        for mn, mv in methods:
+            if isinstance(mv, property):
+                lines.append(f"- **`{mn}`** (property) — {_first_paragraph(mv.fget) or ''}")
+            elif isinstance(mv, (classmethod, staticmethod)):
+                kind = "classmethod" if isinstance(mv, classmethod) else "staticmethod"
+                fn = mv.__func__
+                lines.append(
+                    f"- **`{mn}{_signature(fn)}`** ({kind}) — {_first_paragraph(fn) or ''}"
+                )
+            else:
+                lines.append(f"- **`{mn}{_signature(mv)}`** — {_first_paragraph(mv) or ''}")
+        if methods:
+            lines.append("")
+    elif inspect.isfunction(obj):
+        lines.append(f"### `{name}{_signature(obj)}`\n")
+        para = _first_paragraph(obj)
+        if para:
+            lines.append(para + "\n")
+    else:
+        lines.append(f"### `{name}`\n")
+    return lines
+
+
+def render_page(page: str) -> str:
+    title, intro, sections = PAGES[page]
+    out = [
+        "<!-- GENERATED by tools/gen_api_docs.py — edit docstrings, not this file;",
+        "     tests/test_docs.py fails when this page is stale. -->",
+        f"# {title}\n",
+        intro + "\n",
+    ]
+    for module_name, names in sections:
+        mod = importlib.import_module(module_name)
+        out.append(f"## `{module_name}`\n")
+        mod_doc = _first_paragraph(mod)
+        if mod_doc:
+            out.append(mod_doc + "\n")
+        for name in names or _public_names(mod):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                raise SystemExit(f"{module_name} has no attribute {name!r}")
+            out.extend(_render_entry(name, obj))
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main(outdir: str) -> None:
+    os.makedirs(outdir, exist_ok=True)
+    for page in sorted(PAGES):
+        path = os.path.join(outdir, f"{page}.md")
+        with open(path, "w") as f:
+            f.write(render_page(page))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO, "docs", "package_reference"))
