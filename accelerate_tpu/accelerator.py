@@ -773,6 +773,18 @@ class Accelerator:
         if isinstance(dataloader, DataLoaderShard):  # already prepared
             return dataloader
         cfg = self.dataloader_config
+        if cfg.use_stateful_dataloader and not isinstance(dataloader, DataLoader) and not (
+            hasattr(dataloader, "state_dict") and hasattr(dataloader, "load_state_dict")
+        ):
+            # the reference raises the same way when torchdata is absent
+            # (DataLoaderAdapter:419); the native DataLoader already carries
+            # state machinery, so the flag only gates PLAIN torch loaders
+            raise ImportError(
+                "use_stateful_dataloader=True but this loader has no "
+                "state_dict/load_state_dict. Install torchdata>=0.8.0 and pass "
+                "a StatefulDataLoader, or use the native DataLoader (stateful "
+                "out of the box)."
+            )
         prepared = prepare_data_loader(
             dataloader,
             state=self.state,

@@ -238,8 +238,24 @@ def save_accelerator_state(
                 json.dump(sched.state_dict(), f)
         for i, dl in enumerate(accelerator._dataloaders):
             suffix = "" if i == 0 else f"_{i}"
-            with open(os.path.join(output_dir, f"{SAMPLER_NAME}{suffix}.json"), "w") as f:
-                json.dump(dl.state_dict(), f)
+            base = os.path.join(output_dir, f"{SAMPLER_NAME}{suffix}")
+            state = dl.state_dict()
+            try:
+                payload = json.dumps(state)
+            except (TypeError, ValueError):
+                # a stateful INNER loader (torchdata) may carry tensors/bytes
+                # in its opaque state — pickle those (RNG states already do)
+                import pickle as _pickle
+
+                with open(base + ".pkl", "wb") as f:
+                    _pickle.dump(state, f)
+                if os.path.exists(base + ".json"):  # overwritten checkpoint dir
+                    os.remove(base + ".json")
+            else:
+                with open(base + ".json", "w") as f:
+                    f.write(payload)
+                if os.path.exists(base + ".pkl"):
+                    os.remove(base + ".pkl")
         for i, obj in enumerate(accelerator._custom_objects):
             _save_custom(obj, os.path.join(output_dir, f"{CUSTOM_NAME}_{i}.npz"))
 
@@ -325,10 +341,15 @@ def load_accelerator_state(
                 sched.load_state_dict(json.load(f))
     for i, dl in enumerate(accelerator._dataloaders):
         suffix = "" if i == 0 else f"_{i}"
-        path = os.path.join(input_dir, f"{SAMPLER_NAME}{suffix}.json")
-        if os.path.exists(path):
-            with open(path) as f:
+        base = os.path.join(input_dir, f"{SAMPLER_NAME}{suffix}")
+        if os.path.exists(base + ".json"):
+            with open(base + ".json") as f:
                 dl.load_state_dict(json.load(f))
+        elif os.path.exists(base + ".pkl"):  # tensorful stateful-inner state
+            import pickle as _pickle
+
+            with open(base + ".pkl", "rb") as f:
+                dl.load_state_dict(_pickle.load(f))
     for i, obj in enumerate(accelerator._custom_objects):
         _load_custom(obj, os.path.join(input_dir, f"{CUSTOM_NAME}_{i}.npz"))
 

@@ -547,6 +547,15 @@ class DataLoaderShard:
         self.iteration = 0  # epoch counter
         self.total_dataset_length = total_dataset_length
         self._batches_seen = 0
+        # stateful-inner protocol (reference DataLoaderAdapter:408-497 wrapping
+        # torchdata StatefulDataLoader): when the WRAPPED loader carries its own
+        # state machinery, preserve it — state_dict() serves a snapshot taken at
+        # the correct yield boundary and load_state_dict() delegates inward.
+        self._stateful_inner = hasattr(base_dataloader, "state_dict") and hasattr(
+            base_dataloader, "load_state_dict"
+        )
+        self._inner_snapshot: Optional[dict] = None
+        self._inner_finished = False
 
     @property
     def batch_size(self):
@@ -585,7 +594,22 @@ class DataLoaderShard:
         return None
 
     def state_dict(self) -> dict:
-        """Resume info (reference ``DataLoaderAdapter`` state_dict ``:463-497``)."""
+        """Resume info (reference ``DataLoaderAdapter`` state_dict ``:463-497``).
+
+        With a stateful inner loader (torchdata ``StatefulDataLoader`` or any
+        loader exposing ``state_dict``/``load_state_dict``), ITS state dict is
+        served — from a snapshot captured before the one-ahead prefetch pulled
+        the next batch, so the recorded position matches what the user actually
+        consumed (the reference corrects the same off-by-one arithmetically in
+        ``adjust_state_dict_for_prefetch``); ``_iterator_finished`` is tagged
+        on top, as in the reference."""
+        if self._stateful_inner and self._snapshots_inner():
+            snap = self._inner_snapshot
+            if snap is None:  # not iterated yet: the inner's fresh state
+                snap = self.base_dataloader.state_dict()
+            state = dict(snap)
+            state["_iterator_finished"] = self._inner_finished or self.end_of_dataloader
+            return state
         state = {"batches_seen": self._batches_seen, "iteration": self.iteration}
         sampler = self._find_stateful_sampler()
         if sampler is not None:
@@ -593,6 +617,15 @@ class DataLoaderShard:
         return state
 
     def load_state_dict(self, state: dict) -> None:
+        if self._stateful_inner and self._snapshots_inner():
+            inner_state = dict(state)
+            self._inner_finished = bool(inner_state.pop("_iterator_finished", False))
+            self.base_dataloader.load_state_dict(inner_state)
+            # the loaded state IS the current position until iteration moves:
+            # a state_dict() before the next batch must echo it, not a stale
+            # pre-load snapshot
+            self._inner_snapshot = dict(inner_state)
+            return
         self.skip_batches = state.get("batches_seen", 0)
         self.iteration = state.get("iteration", 0)
         sampler = self._find_stateful_sampler()
@@ -622,17 +655,32 @@ class DataLoaderShard:
             return bs
         return bs * self.assembler.dp_size // len(self.assembler.local_dp_rows())
 
+    def _snapshots_inner(self) -> bool:
+        """Whether THIS process may touch the inner loader's state machinery
+        (the dispatcher's non-main ranks never iterate the base loader and
+        must not poke it — its source may be rank-0-only)."""
+        return self._stateful_inner
+
     def __iter__(self):
         self._sync_rng()
         self.gradient_state._add_dataloader(self)
         self.end_of_dataloader = False
         self.remainder = -1
+        self._inner_finished = False  # a fresh epoch is not finished
         try:
             base_iter = self._iter_base()
+            snapshots = self._snapshots_inner()
             # prefetch-one-ahead so the last batch is flagged (reference :558-592)
             current = self._fetch_batch(base_iter)
             n = 0
             while current is not _NO_BATCH:
+                if snapshots:
+                    # snapshot NOW — after `current` was pulled, before the
+                    # prefetch pulls `nxt` — so a resume from this snapshot
+                    # replays from the first un-consumed batch. Per-batch
+                    # snapshotting matches the reference adapter
+                    # (_update_state_dict per yield, data_loader.py:463-497).
+                    self._inner_snapshot = self.base_dataloader.state_dict()
                 nxt = self._fetch_batch(base_iter)
                 if n >= self.skip_batches:
                     if nxt is _NO_BATCH:
@@ -700,6 +748,13 @@ class DataLoaderDispatcher(DataLoaderShard):
         state = PartialState()
         self._fetched_rows = 0  # per-epoch: finality proof for ragged padding
         return iter(self.base_dataloader) if state.is_main_process else iter(())
+
+    def _snapshots_inner(self) -> bool:
+        # the contract above extends to state machinery: a non-main rank must
+        # not call state_dict() on a base loader it never iterates (stale
+        # position AND a possibly rank-0-only source); checkpoints are written
+        # by the main process, which holds the real position
+        return self._stateful_inner and PartialState().is_main_process
 
     # -- signature registry (identical on every rank by construction) ---------
     def _ensure_sig_state(self):
@@ -1044,6 +1099,24 @@ def prepare_data_loader(
         import torch.utils.data as tud
 
         if isinstance(dataloader, tud.DataLoader):
+            if hasattr(dataloader, "state_dict") and hasattr(dataloader, "load_state_dict"):
+                # torchdata StatefulDataLoader (or subclass carrying its own
+                # state machinery): PRESERVE that machinery instead of
+                # rebuilding — the wrapper serves prefetch-corrected snapshots
+                # of the inner state (reference DataLoaderAdapter:408-497).
+                # Resharding a stateful loader would orphan its state, so each
+                # yielded batch is treated as the per-host block.
+                if dp_size > 1 and not dispatch_batches:
+                    import warnings
+
+                    warnings.warn(
+                        "a stateful torch DataLoader keeps its own state "
+                        "machinery and is not resharded; each yielded batch is "
+                        "treated as the per-host block (use dispatch_batches "
+                        "or the native DataLoader for sharded reads)",
+                        stacklevel=2,
+                    )
+                return cls(dataloader, assembler=assembler, rng_types=rng_types)
             dataset = dataloader.dataset
             custom_batch_sampler = (
                 dataloader.batch_size is None  # torch sets None iff batch_sampler given

@@ -630,13 +630,20 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
     from accelerate_tpu.models.transformer import llama_loss
 
     _reset_state()
+    # ACCELERATE_BENCH_LONGCTX_SEQ: benchmarks/long_context/run.py --seq knob
+    # for the S-sweep (VERDICT r04 item 4: prove flash wins at long S); honored
+    # on CPU too so the knob plumbing is testable without a chip
     if on_tpu:
+        seq = _env_int("ACCELERATE_BENCH_LONGCTX_SEQ", 8192)
         config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
-                             n_kv_heads=8, max_seq_len=8192, unroll_layers=False)
-        bs, seq, steps = 1, 8192, 8
+                             n_kv_heads=8, max_seq_len=seq, unroll_layers=False)
+        bs, steps = 1, 8
     else:
-        config = LlamaConfig.tiny()
-        bs, seq, steps = 1, 256, 2
+        import dataclasses as _dc
+
+        seq = _env_int("ACCELERATE_BENCH_LONGCTX_SEQ", 256)
+        config = _dc.replace(LlamaConfig.tiny(), max_seq_len=max(seq, 256))
+        bs, steps = 1, 2
     params = init_llama(config, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
     params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)

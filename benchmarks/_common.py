@@ -1,0 +1,34 @@
+"""Shared plumbing for the user-runnable benchmark scripts: locate the repo,
+decide TPU-vs-CPU honestly (killable probe), emit one JSON line."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def detect_backend(probe_timeout: int = 120) -> bool:
+    """True iff a real TPU answers (killable subprocess probe — a dead tunnel
+    hangs inside backend init and must be killed from outside)."""
+    from bench import _probe_backend_subprocess  # shared predicate
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return False
+    ok, _ = _probe_backend_subprocess(probe_timeout)
+    if not ok:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("TPU unreachable: running the CPU-shaped variant", file=sys.stderr)
+    return ok
+
+
+def emit(entry: dict) -> None:
+    print(json.dumps(entry), flush=True)

@@ -1,0 +1,14 @@
+"""Big-model inference benchmark (reference ``benchmarks/big_model_inference``
+README table: load seconds + seconds/token): llama-1B-class kv-cache greedy
+generation, bf16 resident weights."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+from bench import run_bench_inference
+
+if __name__ == "__main__":
+    emit(run_bench_inference(on_tpu=detect_backend()))

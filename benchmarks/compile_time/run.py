@@ -1,0 +1,16 @@
+"""Regional-vs-full compilation benchmark (reference ``benchmarks/
+torch.compile`` README: 5-9x compile-time wins on Llama 1B-13B): scan-over-
+stacked-layers (one layer body compiled once) vs fully unrolled, plus the
+steady-state step time both ways — regional compilation must not cost
+runtime."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+from bench import run_bench_compile_time
+
+if __name__ == "__main__":
+    emit(run_bench_compile_time(on_tpu=detect_backend()))

@@ -1,0 +1,123 @@
+"""FP8 vs bf16 benchmark (reference ``benchmarks/fp8/{te,torchao,ms_amp}``:
+loss-parity comparison scripts): train the same MLP stack on the same data in
+bf16 and in fp8 (delayed-scaling ``fp8_dot``, ``ops/fp8.py``), report final-
+loss relative delta and steady-state step times.
+
+On CPU XLA emulates the fp8 dtypes, so the parity leg is meaningful
+everywhere; the step-time ratio is only meaningful on fp8-capable hardware.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+
+def build(depth: int, dim: int, fp8: bool, key):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.fp8 import fp8_dense_apply, fp8_dense_init
+
+    keys = jax.random.split(key, depth)
+    if fp8:
+        # standard recipe: first and last layers stay bf16, middles are fp8
+        # (the policy filter_first_and_last_linear_layers encodes; the
+        # reference's TE benchmarks do the same) — edge layers see the rawest
+        # activations/cotangents and dominate quantization error
+        def init_one(k, i):
+            if i in (0, depth - 1):
+                return {"kernel": jax.random.normal(k, (dim, dim)) / jnp.sqrt(dim),
+                        "bias": jnp.zeros((dim,))}
+            return fp8_dense_init(k, dim, dim)
+
+        params = [init_one(k, i) for i, k in enumerate(keys)]
+
+        def forward(ps, x):
+            h = x
+            for i, p in enumerate(ps):
+                if i in (0, depth - 1):
+                    h = jax.nn.gelu(
+                        (h.astype(jnp.bfloat16) @ p["kernel"].astype(jnp.bfloat16)
+                         + p["bias"].astype(jnp.bfloat16)).astype(jnp.float32))
+                else:
+                    h = jax.nn.gelu(fp8_dense_apply(p, h))
+            return h
+    else:
+        params = [
+            {"kernel": jax.random.normal(k, (dim, dim)) / jnp.sqrt(dim),
+             "bias": jnp.zeros((dim,))}
+            for k in keys
+        ]
+
+        def forward(ps, x):
+            h = x
+            for p in ps:
+                h = jax.nn.gelu(h.astype(jnp.bfloat16) @ p["kernel"].astype(jnp.bfloat16)
+                                + p["bias"].astype(jnp.bfloat16)).astype(jnp.float32)
+            return h
+    return params, forward
+
+
+def train(fp8: bool, depth: int, dim: int, batch: int, steps: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.ops.fp8 import make_fp8_optimizer
+
+    params, forward = build(depth, dim, fp8, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(batch, dim)), jnp.float32)
+    # learnable target (random linear teacher): a memorize-pure-noise target
+    # would measure quantization noise on an unlearnable task, not training
+    # parity — the reference's fp8 benchmarks also train a real objective
+    W_t = jnp.asarray(rng.normal(size=(dim, dim)) / np.sqrt(dim), jnp.float32)
+    Y = jnp.tanh(X @ W_t)
+
+    def loss_fn(ps):
+        return jnp.mean((forward(ps, X) - Y) ** 2)
+
+    inner = optax.adam(1e-3)
+    opt = make_fp8_optimizer(inner, params) if fp8 else inner
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(ps, s):
+        loss, grads = jax.value_and_grad(loss_fn)(ps)
+        updates, s = opt.update(grads, s, ps)
+        return optax.apply_updates(ps, updates), s, loss
+
+    params, opt_state, loss = step(params, opt_state)  # compile
+    float(np.asarray(loss))
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    final = float(np.asarray(loss))
+    elapsed = time.time() - t0
+    return final, elapsed / steps * 1e3
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    args = ap.parse_args()
+    on_tpu = detect_backend()
+    depth, dim, batch = (8, 2048, 512) if on_tpu else (3, 128, 64)
+    bf16_loss, bf16_ms = train(False, depth, dim, batch, args.steps)
+    fp8_loss, fp8_ms = train(True, depth, dim, batch, args.steps)
+    rel = abs(fp8_loss - bf16_loss) / max(abs(bf16_loss), 1e-9)
+    emit({
+        "metric": "fp8 vs bf16 train (loss parity + step time)",
+        "value": round(rel, 4),
+        "unit": "relative final-loss delta (lower is better)",
+        "bf16_final_loss": round(bf16_loss, 5),
+        "fp8_final_loss": round(fp8_loss, 5),
+        "bf16_step_ms": round(bf16_ms, 2),
+        "fp8_step_ms": round(fp8_ms, 2),
+        "depth": depth, "dim": dim, "batch": batch, "steps": args.steps,
+    })

@@ -1,0 +1,15 @@
+"""FSDP-scale LM training benchmark (reference ``benchmarks/fsdp2``):
+GPT-2-large-scale (774M) decoder train step, adafactor + remat ladder.
+Multi-chip FSDP sharding itself is validated by ``__graft_entry__.
+dryrun_multichip``; this measures the per-chip building block."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+from bench import run_bench_fsdp_lm
+
+if __name__ == "__main__":
+    emit(run_bench_fsdp_lm(on_tpu=detect_backend()))

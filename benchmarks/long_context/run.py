@@ -1,0 +1,25 @@
+"""Long-context training benchmark (reference CP/ALST scaling claims,
+``docs/source/concept_guides/{context,sequence}_parallelism.md``): decoder
+train step at --seq tokens with the flash-attention ladder (flash+light remat
+→ flash+full remat → einsum) — measures the best config that runs and
+reports which one won, so flash-vs-einsum is decided by measurement."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=None,
+                    help="sequence length (default: ACCELERATE_BENCH_LONGCTX_SEQ "
+                         "env, else 8192 on TPU / 256 on CPU)")
+    args = ap.parse_args()
+    if args.seq is not None:
+        # an explicit CLI value beats any ambient env setting
+        os.environ["ACCELERATE_BENCH_LONGCTX_SEQ"] = str(args.seq)
+    from bench import run_bench_longcontext
+
+    emit(run_bench_longcontext(on_tpu=detect_backend()))
