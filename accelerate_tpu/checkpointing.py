@@ -240,11 +240,11 @@ def save_accelerator_state(
             suffix = "" if i == 0 else f"_{i}"
             base = os.path.join(output_dir, f"{SAMPLER_NAME}{suffix}")
             state = dl.state_dict()
-            try:
-                payload = json.dumps(state)
-            except (TypeError, ValueError):
-                # a stateful INNER loader (torchdata) may carry tensors/bytes
-                # in its opaque state — pickle those (RNG states already do)
+            # a stateful INNER loader's (torchdata) state is OPAQUE: always
+            # pickle it — json "succeeding" can still be lossy (int dict keys
+            # coerce to strings, mangling worker-state maps), and tensors/bytes
+            # fail outright. Native wrapper states are plain and stay json.
+            if getattr(dl, "_stateful_inner", False):
                 import pickle as _pickle
 
                 with open(base + ".pkl", "wb") as f:
@@ -253,7 +253,7 @@ def save_accelerator_state(
                     os.remove(base + ".json")
             else:
                 with open(base + ".json", "w") as f:
-                    f.write(payload)
+                    f.write(json.dumps(state))
                 if os.path.exists(base + ".pkl"):
                     os.remove(base + ".pkl")
         for i, obj in enumerate(accelerator._custom_objects):

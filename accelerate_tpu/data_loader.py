@@ -619,7 +619,15 @@ class DataLoaderShard:
     def load_state_dict(self, state: dict) -> None:
         if self._stateful_inner and self._snapshots_inner():
             inner_state = dict(state)
-            self._inner_finished = bool(inner_state.pop("_iterator_finished", False))
+            finished = bool(inner_state.pop("_iterator_finished", False))
+            self._inner_finished = False
+            if finished:
+                # checkpoint taken at an epoch boundary: the next iteration is
+                # a FRESH epoch — pushing the exhausted position into the
+                # inner loader would replay an empty epoch (the legacy path's
+                # `_batches_seen = 0` at epoch end enforces the same invariant)
+                self._inner_snapshot = None
+                return
             self.base_dataloader.load_state_dict(inner_state)
             # the loaded state IS the current position until iteration moves:
             # a state_dict() before the next batch must echo it, not a stale

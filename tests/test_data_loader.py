@@ -561,3 +561,51 @@ class TestStatefulInnerLoader:
         acc.load_state(out)
         assert dl2.base_dataloader._pos == 1
         assert len(list(dl2)) == 3  # resumes past the consumed batch
+
+    def test_epoch_boundary_resume_replays_full_fresh_epoch(self):
+        """A checkpoint taken AFTER a completed epoch must resume at the next
+        epoch's first batch — loading the exhausted inner position would
+        silently yield an empty epoch."""
+        from accelerate_tpu.data_loader import DataLoaderShard
+
+        dl = DataLoaderShard(_FakeStatefulDataLoader(n_batches=2))
+        assert len(list(dl)) == 2  # complete the epoch
+        state = dl.state_dict()
+        assert state["_iterator_finished"] is True
+        dl2 = DataLoaderShard(_FakeStatefulDataLoader(n_batches=2))
+        dl2.load_state_dict(state)
+        assert len(list(dl2)) == 2  # fresh full epoch, not zero batches
+        # and a mid-epoch checkpoint right after still reports unfinished
+        it = iter(dl2)
+        next(it)
+        assert dl2.state_dict()["_iterator_finished"] is False
+
+    def test_stateful_inner_state_always_pickled(self, tmp_path):
+        """Opaque inner states must never round-trip through json: int dict
+        keys would coerce to strings and mangle worker-state maps."""
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.data_loader import DataLoaderShard
+
+        class IntKeyStateDL(_FakeStatefulDataLoader):
+            def state_dict(self):
+                return {"_num_yielded": getattr(self, "_yielded", 0),
+                        "workers": {0: "a", 1: "b"}}  # int keys
+
+            def load_state_dict(self, state):
+                assert 0 in state["workers"], state  # keys must survive as ints
+                self._pos = state["_num_yielded"]
+
+        acc = Accelerator(cpu=True)
+        dl = DataLoaderShard(IntKeyStateDL(n_batches=4, batch_size=8))
+        acc._dataloaders.append(dl)
+        it = iter(dl)
+        next(it)
+        out = acc.save_state(str(tmp_path / "ckpt"))
+        import os as _os
+
+        assert any(f.endswith(".pkl") and f.startswith("dataloader")
+                   for f in _os.listdir(out))
+        dl2 = DataLoaderShard(IntKeyStateDL(n_batches=4, batch_size=8))
+        acc._dataloaders[0] = dl2
+        acc.load_state(out)  # would KeyError on '0' if json had mangled keys
+        assert len(list(dl2)) == 3
