@@ -244,7 +244,13 @@ def save_accelerator_state(
             # pickle it — json "succeeding" can still be lossy (int dict keys
             # coerce to strings, mangling worker-state maps), and tensors/bytes
             # fail outright. Native wrapper states are plain and stay json.
-            if getattr(dl, "_stateful_inner", False):
+            payload = None
+            if not getattr(dl, "_stateful_inner", False):
+                try:
+                    payload = json.dumps(state)
+                except (TypeError, ValueError):
+                    payload = None  # e.g. a custom sampler with tensor state
+            if payload is None:
                 import pickle as _pickle
 
                 with open(base + ".pkl", "wb") as f:
@@ -253,7 +259,7 @@ def save_accelerator_state(
                     os.remove(base + ".json")
             else:
                 with open(base + ".json", "w") as f:
-                    f.write(json.dumps(state))
+                    f.write(payload)
                 if os.path.exists(base + ".pkl"):
                     os.remove(base + ".pkl")
         for i, obj in enumerate(accelerator._custom_objects):

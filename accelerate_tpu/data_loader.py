@@ -618,21 +618,20 @@ class DataLoaderShard:
 
     def load_state_dict(self, state: dict) -> None:
         if self._stateful_inner and self._snapshots_inner():
-            inner_state = dict(state)
-            finished = bool(inner_state.pop("_iterator_finished", False))
-            self._inner_finished = False
-            if finished:
-                # checkpoint taken at an epoch boundary: the next iteration is
-                # a FRESH epoch — pushing the exhausted position into the
-                # inner loader would replay an empty epoch (the legacy path's
-                # `_batches_seen = 0` at epoch end enforces the same invariant)
-                self._inner_snapshot = None
-                return
-            self.base_dataloader.load_state_dict(inner_state)
+            self._inner_finished = bool(state.get("_iterator_finished", False))
+            # hand the state through VERBATIM (reference :448-449):
+            # _iterator_finished is torchdata's own field — a real
+            # StatefulDataLoader uses it to start the next epoch fresh with
+            # correctly-advanced sampler RNG. Popping it (or withholding the
+            # state) would replay epoch-0 shuffle order after a boundary
+            # resume. A custom stateful loader must honor the same contract.
+            self.base_dataloader.load_state_dict(dict(state))
             # the loaded state IS the current position until iteration moves:
             # a state_dict() before the next batch must echo it, not a stale
             # pre-load snapshot
-            self._inner_snapshot = dict(inner_state)
+            snap = dict(state)
+            snap.pop("_iterator_finished", None)  # re-tagged at serve time
+            self._inner_snapshot = snap
             return
         self.skip_batches = state.get("batches_seen", 0)
         self.iteration = state.get("iteration", 0)

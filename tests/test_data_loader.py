@@ -415,7 +415,12 @@ class _FakeStatefulDataLoader:
         return {"_num_yielded": getattr(self, "_yielded", 0)}
 
     def load_state_dict(self, state):
-        self._pos = state["_num_yielded"]
+        # torchdata contract: a finished-iterator state means the NEXT epoch
+        # starts fresh (with advanced sampler RNG); mid-epoch states resume
+        if state.get("_iterator_finished"):
+            self._pos = 0
+        else:
+            self._pos = state["_num_yielded"]
 
 
 class TestStatefulInnerLoader:
