@@ -248,6 +248,11 @@ def save_accelerator_state(
             if not getattr(dl, "_stateful_inner", False):
                 try:
                     payload = json.dumps(state)
+                    if json.loads(payload) != state:
+                        # dumps can "succeed" lossily (int dict keys coerce to
+                        # strings, tuples to lists) — only a clean round-trip
+                        # may use the json spelling
+                        payload = None
                 except (TypeError, ValueError):
                     payload = None  # e.g. a custom sampler with tensor state
             if payload is None:

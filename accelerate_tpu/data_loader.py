@@ -619,6 +619,10 @@ class DataLoaderShard:
     def load_state_dict(self, state: dict) -> None:
         if self._stateful_inner and self._snapshots_inner():
             self._inner_finished = bool(state.get("_iterator_finished", False))
+            # the loaded state replaces the wrapper's epoch bookkeeping too: a
+            # mid-epoch state loaded after a completed epoch must not inherit
+            # the stale end_of_dataloader and be re-tagged finished
+            self.end_of_dataloader = False
             # hand the state through VERBATIM (reference :448-449):
             # _iterator_finished is torchdata's own field — a real
             # StatefulDataLoader uses it to start the next epoch fresh with

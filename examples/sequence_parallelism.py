@@ -20,19 +20,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from example_utils import DictDataset, add_common_args, maybe_force_cpu
-
-
-def make_synthetic_lm(n: int, seq_len: int, vocab: int, seed: int = 0) -> dict:
-    """Learnable LM task: each sequence repeats a per-sample period-4 motif, so
-    next-token loss falls quickly once the model attends a few tokens back."""
-    import numpy as np
-
-    rng = np.random.default_rng(seed)
-    motif = rng.integers(2, vocab, size=(n, 4), dtype=np.int32)
-    reps = int(np.ceil(seq_len / 4))
-    ids = np.tile(motif, (1, reps))[:, :seq_len]
-    return {"input_ids": ids}
+from example_utils import DictDataset, add_common_args, maybe_force_cpu, make_synthetic_lm
 
 
 def training_function(args):

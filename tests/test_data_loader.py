@@ -452,6 +452,11 @@ class TestStatefulInnerLoader:
         dl2.load_state_dict(mid_state)
         rest = [float(b["x"][0, 0]) for b in dl2]
         assert consumed == [0.0, 1.0, 2.0] and rest == [3.0, 4.0, 5.0]
+        # loading a MID-epoch state after a completed epoch clears the
+        # wrapper's end-of-epoch bookkeeping: the state must not re-serve as
+        # finished (which would resume as a fresh epoch and skip batches)
+        dl2.load_state_dict(mid_state)
+        assert dl2.state_dict()["_iterator_finished"] is False
 
     def test_finished_epoch_is_tagged(self):
         from accelerate_tpu.data_loader import DataLoaderShard

@@ -177,6 +177,16 @@ class TestByFeature:
         ns.fsdp = 0
         assert "eval_accuracy" in mod.training_function(ns)
 
+    def test_megatron_lm_gpt_pretraining(self):
+        mod, ns = self._run(
+            "by_feature/megatron_lm_gpt_pretraining.py",
+            epochs=3, batch_size=2, train_size=64,
+        )
+        ns.tp, ns.num_micro_batches, ns.seq_len, ns.lr = 2, 2, 64, 3e-3
+        out = mod.training_function(ns)
+        assert out["tp_sharded"] is True  # the plugin's tp degree reached the mesh
+        assert out["train_loss"] < 6.0  # init ~log(512)=6.24, drops fast
+
     def test_fp8_training(self):
         mod, ns = self._run("by_feature/fp8_training.py")
         ns.steps = 30

@@ -61,14 +61,16 @@ def run_bench(bench_budget: int) -> dict | None:
     # capture a profiler trace of the headline's hot dispatch while we have
     # the chip (VERDICT r04 item 3: a documented MFU claim needs a trace in
     # the repo); bench wraps exactly one timed dispatch in jax.profiler.
-    # Rotated: only the LATEST capture is kept — each xplane capture is
-    # multi-MB and the watcher re-benches whenever its cache goes stale.
-    trace_dir = os.path.join(REPO, "traces", "watcher")
+    # Captured to a staging dir and swapped in only on SUCCESS, so a bench
+    # that dies mid-run (the tunnel's signature failure mode) cannot destroy
+    # the last good trace; only the latest capture is kept (multi-MB each).
+    trace_staging = None
     if "ACCELERATE_BENCH_TRACE" not in env:
+        trace_staging = os.path.join(REPO, "traces", ".staging")
         import shutil
 
-        shutil.rmtree(trace_dir, ignore_errors=True)
-        env["ACCELERATE_BENCH_TRACE"] = trace_dir
+        shutil.rmtree(trace_staging, ignore_errors=True)
+        env["ACCELERATE_BENCH_TRACE"] = trace_staging
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -79,7 +81,18 @@ def run_bench(bench_budget: int) -> dict | None:
         stdout = e.stdout if isinstance(e.stdout, str) else (
             e.stdout.decode(errors="replace") if e.stdout else "")
         log(f"bench hung past {bench_budget + 300}s; mining partial output")
-    return pick_tpu_line(stdout)
+    parsed = pick_tpu_line(stdout)
+    if trace_staging is not None:
+        import shutil
+
+        final_dir = os.path.join(REPO, "traces", "watcher")
+        if parsed is not None and os.path.isdir(trace_staging) and os.listdir(trace_staging):
+            shutil.rmtree(final_dir, ignore_errors=True)
+            os.replace(trace_staging, final_dir)
+            parsed["trace_dir"] = final_dir
+        else:
+            shutil.rmtree(trace_staging, ignore_errors=True)
+    return parsed
 
 
 def cache_age() -> float:
