@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import argparse
 
-from . import config, env, estimate, launch, merge, test, tpu
+from . import config, env, estimate, launch, merge, test, to_fsdp2, tpu
 
 
 def main():
@@ -14,7 +14,7 @@ def main():
         allow_abbrev=False,
     )
     subparsers = parser.add_subparsers(dest="command", required=True)
-    for mod in (config, launch, env, estimate, merge, test, tpu):
+    for mod in (config, launch, env, estimate, merge, test, to_fsdp2, tpu):
         mod.register_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args))

@@ -1,0 +1,27 @@
+"""``accelerate-tpu to-fsdp2`` (reference ``commands/to_fsdp2.py:172`` rewrites
+FSDP1 config keys to FSDP2). Here the FSDP1/FSDP2 distinction does not exist —
+both collapse to a NamedSharding over ``dp_shard`` under GSPMD — so there is
+nothing to migrate; the command exists to SAY so instead of being an unknown
+command or an ImportError."""
+
+from __future__ import annotations
+
+
+def to_fsdp2_command(args) -> int:
+    print(
+        "to-fsdp2 is not needed on this framework: FSDP1 and FSDP2 collapse "
+        "into the same GSPMD sharding (docs/concept_guides/fsdp_gspmd.md). "
+        "Your existing config works as-is — `fsdp_config:` keys map through "
+        "FullyShardedDataParallelPlugin unchanged."
+    )
+    return 0
+
+
+def register_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "to-fsdp2", help="(not needed here: FSDP1/2 collapse under GSPMD)"
+    )
+    p.add_argument("--config_file", default=None, help="accepted for parity; unused")
+    p.add_argument("--output_file", default=None, help="accepted for parity; unused")
+    p.add_argument("--overwrite", action="store_true", help="accepted for parity; unused")
+    p.set_defaults(func=to_fsdp2_command)

@@ -436,3 +436,23 @@ def test_tpu_pod_fanout_executes_through_real_transport(tmp_path, monkeypatch):
     assert "ACCELERATE_RESTART_COUNT=1" not in first
     assert "ACCELERATE_RESTART_COUNT=1" in second  # resume hint on retry only
     assert "ACCELERATE_RESUME_FROM_CHECKPOINT=latest" in second
+
+
+def test_to_fsdp2_is_an_explained_noop(capsys):
+    """The reference's to-fsdp2 config migrator has nothing to migrate here
+    (FSDP1/2 collapse under GSPMD); the subcommand exists and says so instead
+    of being an unknown command."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    import sys as _sys
+
+    old = _sys.argv
+    _sys.argv = ["accelerate-tpu", "to-fsdp2", "--config_file", "x.yaml"]
+    try:
+        with pytest.raises(SystemExit) as e:
+            main()
+        assert e.value.code == 0
+    finally:
+        _sys.argv = old
+    out = capsys.readouterr().out
+    assert "collapse" in out and "fsdp_gspmd" in out

@@ -49,12 +49,13 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
     "cli": (
         "CLI",
         "`accelerate-tpu {config,launch,env,estimate-memory,merge-weights,"
-        "test,tpu-config}` (reference `commands/`). Each command module "
-        "exposes `main`/`*_command` entry points.",
+        "test,tpu-config,to-fsdp2}` (reference `commands/`). Each command "
+        "module exposes `main`/`*_command` entry points.",
         [("accelerate_tpu.commands.launch", ["launch_command", "build_launch_env"]),
          ("accelerate_tpu.commands.config", ["write_basic_config", "ClusterConfig"]),
          ("accelerate_tpu.commands.estimate", None),
-         ("accelerate_tpu.commands.merge", None)],
+         ("accelerate_tpu.commands.merge", None),
+         ("accelerate_tpu.commands.to_fsdp2", ["to_fsdp2_command"])],
     ),
     "deepspeed": (
         "DeepSpeed (shim)",
