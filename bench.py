@@ -552,6 +552,27 @@ def run_bench_inference(on_tpu: bool) -> dict:
             out["hbm_roofline_frac"] = round(
                 (stats["decode_tokens_per_sec"] / bs) * (2.0 * n_params) / hbm_bw, 4
             )
+    # CPU-OFFLOAD leg: the reference table's actual subject (its GPU rows are
+    # offload-bound: OPT-30B fp16 cpu-offload = 2.37 s/token). Per-layer paged
+    # decode with one-ahead prefetch; optional under the global budget.
+    if _remaining() > 180:
+        try:
+            from accelerate_tpu.big_modeling import cpu_offload
+            from accelerate_tpu.generation import generate_dispatched, unstack_layer_params
+
+            off_tokens = min(new_tokens, 16)
+            with _deadline(int(max(_remaining() - 90, 60))):
+                # the D2H transfer of the whole param tree is python-level and
+                # tunnel-bound — it must sit INSIDE the deadline too
+                dp = cpu_offload(unstack_layer_params(params, config))
+                _, off_stats = generate_dispatched(
+                    dp, prompt, config, max_new_tokens=off_tokens,
+                    return_stats=True, warmup=True,
+                )
+            out["cpu_offload_tokens_per_sec"] = round(off_stats["decode_tokens_per_sec"], 1)
+            out["cpu_offload_seconds_per_token"] = round(off_stats["seconds_per_token"], 4)
+        except Exception as e:
+            out["cpu_offload_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     return out
 
 
