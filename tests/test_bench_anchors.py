@@ -6,6 +6,8 @@ import json
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import apply_baseline_anchors, sanitize_json
@@ -305,3 +307,43 @@ class TestProbeLadderBudget:
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         bench._init_backend()
         assert any("tunnel down" in h for h in bench._PROBE_HISTORY)
+
+
+@pytest.mark.slow
+def test_degraded_bench_end_to_end_contract(tmp_path):
+    """THE round-5 contract, end to end in a real subprocess: with the TPU
+    unreachable and a tight budget, bench.py must still exit 0 within the
+    budget, emit multiple cumulative JSON lines (a driver kill at any point
+    keeps data), mark the run degraded with probe reasons, skip configs with
+    budget notes instead of dying mid-flight, and finish with a non-partial
+    parseable record."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="tpu_nonexistent",  # deterministic probe failure
+        ACCELERATE_BENCH_BUDGET="150",
+        ACCELERATE_BENCH_RETRIES="1",
+        ACCELERATE_BENCH_PROBE_TIMEOUT="20",
+    )
+    env.pop("ACCELERATE_BENCH_TRACE", None)
+    res = subprocess.run(
+        [_sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=280, env=env, cwd=str(tmp_path),
+    )
+    assert res.returncode == 0, res.stderr[-1500:]
+    lines = [l for l in res.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) >= 2, "must emit incrementally, not one final line"
+    for line in lines:
+        json.loads(line)  # every emitted line is parseable on its own
+    final = json.loads(lines[-1])
+    assert final.get("partial") is None  # the record is not marked superseded
+    assert final["value"] > 0  # a real CPU measurement, not a zero sentinel
+    assert final.get("degraded"), "TPU-unreachable run must be labelled"
+    assert final.get("probe_history"), "the failure reasons must be recorded"
+    notes = [c.get("note", "") for c in final["configs"].values()]
+    assert any("budget exhausted" in n for n in notes), (
+        "tight budget must skip configs with notes, not run past the deadline"
+    )
