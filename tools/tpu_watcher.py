@@ -114,28 +114,34 @@ def main() -> None:
     args = ap.parse_args()
 
     while True:
-        if cache_age() > args.refresh:
-            log("probing TPU backend...")
-            if probe(args.probe_timeout):
-                log("TPU up: running full bench")
-                parsed = run_bench(args.bench_budget)
-                if parsed is not None:
-                    # age stamp lives INSIDE the JSON: file mtime resets on a
-                    # fresh checkout, so bench's staleness check must not rely
-                    # on it (a previous round's cache would look newborn)
-                    parsed["measured_at_unix"] = time.time()
-                    tmp = CACHE + ".tmp"
-                    with open(tmp, "w") as f:
-                        json.dump(parsed, f)
-                    os.replace(tmp, CACHE)
-                    log(f"cached TPU result: value={parsed.get('value')} "
-                        f"mfu={parsed.get('mfu')}")
+        try:
+            if cache_age() > args.refresh:
+                log("probing TPU backend...")
+                if probe(args.probe_timeout):
+                    log("TPU up: running full bench")
+                    parsed = run_bench(args.bench_budget)
+                    if parsed is not None:
+                        # age stamp lives INSIDE the JSON: file mtime resets
+                        # on a fresh checkout, so bench's staleness check must
+                        # not rely on it (a previous round's cache would look
+                        # newborn)
+                        parsed["measured_at_unix"] = time.time()
+                        tmp = CACHE + ".tmp"
+                        with open(tmp, "w") as f:
+                            json.dump(parsed, f)
+                        os.replace(tmp, CACHE)
+                        log(f"cached TPU result: value={parsed.get('value')} "
+                            f"mfu={parsed.get('mfu')}")
+                    else:
+                        log("bench produced no usable TPU line")
                 else:
-                    log("bench produced no usable TPU line")
+                    log("TPU probe failed/hung")
             else:
-                log("TPU probe failed/hung")
-        else:
-            log(f"cache fresh ({cache_age() / 60:.0f} min old); sleeping")
+                log(f"cache fresh ({cache_age() / 60:.0f} min old); sleeping")
+        except Exception as e:
+            # the watcher is the round's measurement insurance: one bad cycle
+            # (disk hiccup, weird subprocess error) must not kill the loop
+            log(f"cycle error ({type(e).__name__}: {e}); continuing")
         if args.once:
             break
         time.sleep(args.interval)
