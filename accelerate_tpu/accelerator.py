@@ -421,6 +421,16 @@ class Accelerator:
         self.flag_tensor = None
         self.trackers: list = []
         self.log_with = log_with
+        # Telemetry spine (telemetry/): honor the ACCELERATE_TELEMETRY kill
+        # switch — when off, the StepTelemetry handle costs one flag check per
+        # step and writes nothing.
+        from . import telemetry as _telemetry
+
+        _telemetry.maybe_enable_from_env(
+            default_dir=os.path.join(self.project_dir, "telemetry") if self.project_dir else None
+        )
+        self._step_telemetry = _telemetry.StepTelemetry()
+        self._compiled_counts: dict[str, int] = {}
         if rng_seed is not None:
             from .utils.random import set_seed
 
@@ -674,6 +684,7 @@ class Accelerator:
         :meth:`gradient_fn` instead.
         """
         from .bridge import BridgedModule, BridgedOptimizer
+        from .telemetry import events as _tel
 
         bridged = [m for m in self._models if isinstance(m, BridgedModule)]
         if not bridged:
@@ -682,13 +693,14 @@ class Accelerator:
                 "loops use prepare_train_step (grads are computed inside the "
                 "compiled step) or gradient_fn for imperative grads"
             )
-        for model in bridged:
-            grads = model.pop_pending_grads()
-            if grads is None:
-                continue
-            for opt in self._optimizers:
-                if isinstance(opt, BridgedOptimizer) and opt.module is model:
-                    opt.accumulate_grads(grads)
+        with _tel.span("backward"):
+            for model in bridged:
+                grads = model.pop_pending_grads()
+                if grads is None:
+                    continue
+                for opt in self._optimizers:
+                    if isinstance(opt, BridgedOptimizer) and opt.module is model:
+                        opt.accumulate_grads(grads)
 
     def prepare_optimizer(self, optimizer) -> AcceleratedOptimizer:
         if not isinstance(optimizer, AcceleratedOptimizer):
@@ -776,15 +788,33 @@ class Accelerator:
         if cfg.use_stateful_dataloader and not isinstance(dataloader, DataLoader) and not (
             hasattr(dataloader, "state_dict") and hasattr(dataloader, "load_state_dict")
         ):
-            # the reference raises the same way when torchdata is absent
-            # (DataLoaderAdapter:419); the native DataLoader already carries
-            # state machinery, so the flag only gates PLAIN torch loaders
-            raise ImportError(
-                "use_stateful_dataloader=True but this loader has no "
-                "state_dict/load_state_dict. Install torchdata>=0.8.0 and pass "
-                "a StatefulDataLoader, or use the native DataLoader (stateful "
-                "out of the box)."
-            )
+            # reference DataLoaderAdapter:414-431: with torchdata>=0.8.0
+            # installed, a PLAIN torch loader is rebuilt as a
+            # StatefulDataLoader; the ImportError is reserved for torchdata
+            # actually being absent. The native DataLoader already carries
+            # state machinery, so the flag only gates plain torch loaders.
+            from .data_loader import as_stateful_dataloader, stateful_dataloader_available
+
+            rebuilt = as_stateful_dataloader(dataloader)
+            if rebuilt is None:
+                if stateful_dataloader_available():
+                    # torchdata is fine — the LOADER is the problem; saying
+                    # "install torchdata" would send the user the wrong way
+                    raise TypeError(
+                        "use_stateful_dataloader=True: "
+                        f"{type(dataloader).__name__} cannot be rebuilt as a "
+                        "torchdata StatefulDataLoader (only plain torch "
+                        "DataLoaders are rebuildable). Pass a StatefulDataLoader "
+                        "directly, or use the native DataLoader (stateful out "
+                        "of the box)."
+                    )
+                raise ImportError(
+                    "use_stateful_dataloader=True but this loader has no "
+                    "state_dict/load_state_dict and torchdata>=0.8.0 is not "
+                    "installed to rebuild it. Install torchdata>=0.8.0, or use "
+                    "the native DataLoader (stateful out of the box)."
+                )
+            dataloader = rebuilt
         prepared = prepare_data_loader(
             dataloader,
             state=self.state,
@@ -802,6 +832,20 @@ class Accelerator:
         return prepared
 
     # ------------------------------------------------------------ train step --
+    def _register_compiled(self, kind: str, fn):
+        """Name + register a jitted function for telemetry recompile detection
+        (a later jit-cache miss on it is a silent reshape-driven recompile).
+        Registration pins the executable via the watcher, so it only happens
+        while telemetry is enabled — disabled runs must not accumulate refs."""
+        from .telemetry import events as _tel
+
+        if not _tel.is_enabled():
+            return fn
+        n = self._compiled_counts.get(kind, 0)
+        self._compiled_counts[kind] = n + 1
+        self._step_telemetry.register_compiled(f"{kind}#{n}", fn)
+        return fn
+
     def _resolve_optimizer(self, optimizer):
         if optimizer is None:
             if not self._optimizers:
@@ -935,9 +979,16 @@ class Accelerator:
         # so only track when unambiguous (callers with multiple models pass
         # params/opt_state to save_state explicitly)
         model_slot = 0 if len(self._models) == 1 else None
+        from .telemetry import events as _tel
+
+        step_telemetry = self._step_telemetry
 
         def step_and_track(params, opt_state, batch):
-            new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+            if _tel.is_enabled():
+                with step_telemetry.step():
+                    new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+            else:
+                new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
             optimizer.opt_state = new_opt_state
             if model_slot is not None:
                 self._models[model_slot] = new_params
@@ -1014,11 +1065,13 @@ class Accelerator:
                     train_step, optimizer.opt_state, donate=donate, mesh=self.mesh
                 )
                 optimizer.opt_state = host_state
+                self._register_compiled("train_step_offload", step)
                 return self._track_step(step, optimizer)
 
         if not self.jit_config.disable_jit:
             donate = self.jit_config.donate_params if donate is None else donate
             train_step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+            self._register_compiled("train_step", train_step)
 
         return self._track_step(train_step, optimizer)
 
@@ -1072,6 +1125,7 @@ class Accelerator:
         if not self.jit_config.disable_jit:
             donate = self.jit_config.donate_params if donate is None else donate
             train_loop = jax.jit(train_loop, donate_argnums=(0, 1) if donate else ())
+            self._register_compiled("train_loop", train_loop)
 
         return self._track_step(train_loop, optimizer)
 
@@ -1084,7 +1138,9 @@ class Accelerator:
         def eval_step(params, batch):
             return eval_fn(policy.cast_to_compute(params), policy.cast_to_compute(batch))
 
-        return eval_step if self.jit_config.disable_jit else jax.jit(eval_step)
+        if self.jit_config.disable_jit:
+            return eval_step
+        return self._register_compiled("eval_step", jax.jit(eval_step))
 
     # ------------------------------------------- imperative parity surface ----
     def gradient_fn(self, loss_fn: Callable, has_aux: bool = False) -> Callable:
@@ -1522,7 +1578,22 @@ class Accelerator:
                     step=step, **((log_kwargs or {}).get(tracker.name, {})),
                 )
 
+    def log_telemetry_summary(self, step: Optional[int] = None) -> dict:
+        """Mirror the telemetry report aggregates (step percentiles, recompile
+        totals, memory peaks, comms bytes) into the active trackers under a
+        ``telemetry/`` prefix. No-op (empty dict) when telemetry is disabled."""
+        from .telemetry import events as _tel
+        from .telemetry.tracker_bridge import mirror_to_trackers
+
+        if not _tel.is_enabled() or not self.is_main_process:
+            return {}
+        return mirror_to_trackers(self.trackers, step=step)
+
     def end_training(self):
+        from .telemetry import events as _tel
+
+        if _tel.is_enabled() and self.trackers:
+            self.log_telemetry_summary()
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()

@@ -23,12 +23,15 @@ never recompiles; ``GradientState.remainder`` records the duplicate count so
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
 from .parallelism_config import ParallelismConfig
 from .state import GradientState, PartialState
+from .telemetry import events as _tel
+from .telemetry.step_profiler import record_data_wait
 from .utils.dataclasses import DataLoaderConfiguration
 from .utils.operations import find_batch_size, recursively_apply, send_to_device
 
@@ -672,6 +675,27 @@ class DataLoaderShard:
         must not poke it — its source may be rank-0-only)."""
         return self._stateful_inner
 
+    # -- telemetry: data-wait accounting (step_profiler drains it per step) ----
+    def _timed_fetch(self, base_iter):
+        if not _tel.is_enabled():
+            return self._fetch_batch(base_iter)
+        t0 = time.monotonic()
+        batch = self._fetch_batch(base_iter)
+        dt = time.monotonic() - t0
+        record_data_wait(dt)
+        _tel.emit("data_wait", dur_s=round(dt, 6), phase="fetch")
+        return batch
+
+    def _timed_process(self, batch):
+        if not _tel.is_enabled():
+            return self._process(batch)
+        t0 = time.monotonic()
+        out = self._process(batch)
+        dt = time.monotonic() - t0
+        record_data_wait(dt)
+        _tel.emit("data_wait", dur_s=round(dt, 6), phase="device_put")
+        return out
+
     def __iter__(self):
         self._sync_rng()
         self.gradient_state._add_dataloader(self)
@@ -682,7 +706,7 @@ class DataLoaderShard:
             base_iter = self._iter_base()
             snapshots = self._snapshots_inner()
             # prefetch-one-ahead so the last batch is flagged (reference :558-592)
-            current = self._fetch_batch(base_iter)
+            current = self._timed_fetch(base_iter)
             n = 0
             while current is not _NO_BATCH:
                 if snapshots:
@@ -692,7 +716,7 @@ class DataLoaderShard:
                     # snapshotting matches the reference adapter
                     # (_update_state_dict per yield, data_loader.py:463-497).
                     self._inner_snapshot = self.base_dataloader.state_dict()
-                nxt = self._fetch_batch(base_iter)
+                nxt = self._timed_fetch(base_iter)
                 if n >= self.skip_batches:
                     if nxt is _NO_BATCH:
                         self.end_of_dataloader = True
@@ -708,7 +732,7 @@ class DataLoaderShard:
                             if real is not None and full and real < full:
                                 self.remainder = real
                     self._batches_seen = n + 1
-                    yield self._process(current)
+                    yield self._timed_process(current)
                 current = nxt
                 n += 1
         finally:
@@ -1011,6 +1035,97 @@ class SkipDataLoader(DataLoaderShard):
         yield from super().__iter__()
 
 
+def _stateful_dataloader_cls():
+    """torchdata's ``StatefulDataLoader`` when importable at >=0.8.0, else
+    None — the single probe both the rebuild and its error reporting use."""
+    try:
+        import torchdata
+        from torchdata.stateful_dataloader import StatefulDataLoader
+    except ImportError:
+        return None
+    from .utils.versions import compare_versions
+
+    try:
+        if not compare_versions(getattr(torchdata, "__version__", "0"), ">=", "0.8.0"):
+            return None
+    except Exception:
+        return None
+    return StatefulDataLoader
+
+
+def stateful_dataloader_available() -> bool:
+    return _stateful_dataloader_cls() is not None
+
+
+def as_stateful_dataloader(dataloader):
+    """Rebuild a plain ``torch.utils.data.DataLoader`` as a torchdata
+    ``StatefulDataLoader`` over the same dataset/sampler/collate (reference
+    ``DataLoaderAdapter:414-431`` does this whenever
+    ``use_stateful_dataloader=True`` and torchdata is installed).
+
+    Returns ``None`` when torchdata>=0.8.0 is not importable or the input is
+    not a torch DataLoader — the caller decides whether that is an
+    ImportError (it is, for ``use_stateful_dataloader=True``).
+    """
+    StatefulDataLoader = _stateful_dataloader_cls()
+    if StatefulDataLoader is None:
+        return None
+    try:
+        import torch.utils.data as tud
+    except ImportError:
+        return None
+    if not isinstance(dataloader, tud.DataLoader):
+        return None
+    if type(dataloader) is not tud.DataLoader:
+        import warnings
+
+        warnings.warn(
+            f"rebuilding {type(dataloader).__name__} as a StatefulDataLoader "
+            "keeps its dataset/sampler/collate but DROPS any overridden "
+            "loader behavior (custom __iter__ etc.)",
+            stacklevel=3,
+        )
+    common = dict(
+        num_workers=dataloader.num_workers,
+        collate_fn=dataloader.collate_fn,
+        pin_memory=dataloader.pin_memory,
+        timeout=dataloader.timeout,
+        worker_init_fn=dataloader.worker_init_fn,
+        generator=getattr(dataloader, "generator", None),
+        persistent_workers=getattr(dataloader, "persistent_workers", False),
+        multiprocessing_context=getattr(dataloader, "multiprocessing_context", None),
+    )
+    if dataloader.num_workers > 0 and getattr(dataloader, "prefetch_factor", None) is not None:
+        common["prefetch_factor"] = dataloader.prefetch_factor
+    pin_device = getattr(dataloader, "pin_memory_device", "")
+    if pin_device:
+        common["pin_memory_device"] = pin_device
+    if dataloader.batch_size is None and dataloader.batch_sampler is not None:
+        # user-supplied batch_sampler (torch zeroes batch_size for these)
+        return StatefulDataLoader(dataloader.dataset, batch_sampler=dataloader.batch_sampler, **common)
+    if isinstance(dataloader.dataset, tud.IterableDataset):
+        # iterable sources forbid any sampler argument
+        return StatefulDataLoader(
+            dataloader.dataset,
+            batch_size=dataloader.batch_size,
+            drop_last=dataloader.drop_last if dataloader.batch_size is not None else False,
+            **common,
+        )
+    if dataloader.batch_size is None:
+        # automatic batching disabled (batch_size=None, no batch_sampler):
+        # keep it disabled — drop_last is mutually exclusive with this mode
+        return StatefulDataLoader(
+            dataloader.dataset, batch_size=None, sampler=dataloader.sampler, **common
+        )
+    return StatefulDataLoader(
+        dataloader.dataset,
+        batch_size=dataloader.batch_size,
+        sampler=dataloader.sampler,
+        drop_last=dataloader.drop_last,
+        **common,
+    )
+
+
 # reference base-class spellings (data_loader.py:365/:408): user code does
 # `isinstance(dl, DataLoaderStateMixin)` / subclass checks — here every
 # prepared loader is a DataLoaderShard carrying the same surface
@@ -1095,8 +1210,21 @@ def prepare_data_loader(
             ]
             merged = _InterleavedBatchSampler(shards)
             new_dl = DataLoader(dataset, batch_sampler=merged, collate_fn=dataloader.collate_fn)
+            _tel.emit(
+                "dataloader_reshard",
+                decision="native_sampler_sharded",
+                dp_size=dp_size,
+                local_rows=len(local_rows),
+                split_batches=split_batches,
+            )
         else:
             new_dl = dataloader
+            _tel.emit(
+                "dataloader_reshard",
+                decision="dispatcher" if dispatch_batches else "no_reshard_needed",
+                dp_size=dp_size,
+                dispatch_batches=bool(dispatch_batches),
+            )
         return cls(
             new_dl,
             assembler=assembler,
@@ -1115,17 +1243,46 @@ def prepare_data_loader(
                 # state machinery): PRESERVE that machinery instead of
                 # rebuilding — the wrapper serves prefetch-corrected snapshots
                 # of the inner state (reference DataLoaderAdapter:408-497).
-                # Resharding a stateful loader would orphan its state, so each
-                # yielded batch is treated as the per-host block.
+                # Resharding a stateful loader would orphan its state. Under
+                # data parallelism it is ROUTED TO THE DISPATCHER (rank 0
+                # reads, the rest receive): iterating it on every rank would
+                # silently duplicate data across dp replicas.
                 if dp_size > 1 and not dispatch_batches:
+                    if dispatch_batches is False:
+                        raise ValueError(
+                            "a stateful torch DataLoader cannot be resharded "
+                            "(its state machinery would be orphaned) and "
+                            "iterating it on every rank would silently "
+                            "duplicate data across dp replicas. Drop "
+                            "dispatch_batches=False (the dispatcher route is "
+                            "the default for stateful loaders) or use the "
+                            "native DataLoader."
+                        )
                     import warnings
 
                     warnings.warn(
-                        "a stateful torch DataLoader keeps its own state "
-                        "machinery and is not resharded; each yielded batch is "
-                        "treated as the per-host block (use dispatch_batches "
-                        "or the native DataLoader for sharded reads)",
+                        "stateful torch DataLoader under data parallelism: "
+                        "routing through DataLoaderDispatcher (process 0 reads "
+                        "and broadcasts) so ranks do not duplicate data; each "
+                        "yielded batch is treated as the GLOBAL batch",
                         stacklevel=2,
+                    )
+                    cls = DataLoaderDispatcher
+                    _tel.emit(
+                        "dataloader_reshard",
+                        decision="stateful_to_dispatcher",
+                        dp_size=dp_size,
+                        dispatch_batches=True,
+                    )
+                else:
+                    _tel.emit(
+                        "dataloader_reshard",
+                        # dispatch_batches=True means rank 0 reads and
+                        # broadcasts; only without it is the loader truly
+                        # iterated per-host
+                        decision="stateful_dispatcher" if dispatch_batches else "stateful_preserved",
+                        dp_size=dp_size,
+                        dispatch_batches=bool(dispatch_batches),
                     )
                 return cls(dataloader, assembler=assembler, rng_types=rng_types)
             dataset = dataloader.dataset
@@ -1149,6 +1306,10 @@ def prepare_data_loader(
                     "iterable dataset cannot be resharded; iterating it as-is. "
                     "Each yielded batch is treated as the per-host block.",
                     stacklevel=2,
+                )
+                _tel.emit(
+                    "dataloader_reshard", decision="torch_as_is", dp_size=dp_size,
+                    dispatch_batches=bool(dispatch_batches),
                 )
                 return cls(dataloader, assembler=assembler, rng_types=rng_types)
             shuffle = isinstance(sampler, tud.RandomSampler)

@@ -19,6 +19,7 @@ so every instance in the process observes the same state.
 from __future__ import annotations
 
 import os
+import time
 from contextlib import contextmanager
 from functools import wraps
 from typing import Any, Callable, Optional
@@ -108,6 +109,11 @@ class PartialState:
         else:
             self.distributed_type = DistributedType.NO
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+        # Run identity (telemetry/tracking correlation): the launcher should
+        # set ACCELERATE_RUN_ID so all processes of one run agree; without it
+        # a process-local id is generated — exact for single-process runs,
+        # per-process otherwise.
+        self.run_id = os.environ.get("ACCELERATE_RUN_ID") or f"run-{int(time.time())}-{os.getpid()}"
         self.initialized = True
 
     # ------------------------------------------------------------------ info --

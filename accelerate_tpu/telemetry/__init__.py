@@ -1,0 +1,70 @@
+"""TPU-native observability: structured step events, recompile/memory/comms
+metrics, and a report CLI.
+
+The reference stack treats observability as an external concern (trackers
+only); here it is a subsystem, because the signals that decide TPU throughput
+— XLA recompiles, device-memory watermarks, collective traffic — are invisible
+to a loss-curve tracker. Layout:
+
+- :mod:`.events` — JSONL event log with an env kill switch
+  (``ACCELERATE_TELEMETRY=1`` to enable, ``ACCELERATE_TELEMETRY_DIR`` for the
+  output directory). Zero overhead when disabled.
+- :mod:`.step_profiler` — per-step wall/data-wait/compile/execute split plus
+  recompile detection (per-function jit cache-miss counting).
+- :mod:`.memory` — device/host memory watermarks sampled at step boundaries.
+- :mod:`.report` — ``python -m accelerate_tpu.telemetry report <dir>``
+  aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes).
+- :mod:`.tracker_bridge` — mirrors report summaries into ``tracking.py``
+  trackers so the metrics land wherever users already log.
+
+Comms counters live in :mod:`accelerate_tpu.utils.operations` (the ops being
+counted) and write through :mod:`.events`.
+"""
+
+from .events import (
+    TELEMETRY_DIR_ENV_VAR,
+    TELEMETRY_ENV_VAR,
+    TELEMETRY_SCHEMA_VERSION,
+    EventLog,
+    counter,
+    disable,
+    emit,
+    enable,
+    enabled_from_env,
+    gauge,
+    get_event_log,
+    is_enabled,
+    maybe_enable_from_env,
+    set_step,
+    span,
+)
+from .memory import MemoryMonitor, device_memory_stats, host_memory_bytes, live_array_bytes
+from .step_profiler import RecompileWatcher, StepTelemetry, record_data_wait
+from .tracker_bridge import mirror_to_trackers, summary_metrics
+
+__all__ = [
+    "TELEMETRY_DIR_ENV_VAR",
+    "TELEMETRY_ENV_VAR",
+    "TELEMETRY_SCHEMA_VERSION",
+    "EventLog",
+    "MemoryMonitor",
+    "RecompileWatcher",
+    "StepTelemetry",
+    "counter",
+    "device_memory_stats",
+    "disable",
+    "emit",
+    "enable",
+    "enabled_from_env",
+    "gauge",
+    "get_event_log",
+    "host_memory_bytes",
+    "is_enabled",
+    "live_array_bytes",
+    "maybe_enable_from_env",
+    "mirror_to_trackers",
+    "record_data_wait",
+    "set_step",
+    "span",
+    "summary_metrics",
+]

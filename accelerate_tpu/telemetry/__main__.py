@@ -1,0 +1,8 @@
+"""``python -m accelerate_tpu.telemetry report <dir>`` entry point."""
+
+import sys
+
+from .report import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

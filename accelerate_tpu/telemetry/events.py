@@ -1,0 +1,287 @@
+"""Structured JSONL telemetry event log — the measurement backbone.
+
+No reference counterpart: the reference treats observability as an external
+concern (trackers only, ``tracking.py``), but a TPU-native system lives or
+dies by visibility into XLA recompiles, device-memory watermarks and
+collective traffic. This module is the spine the rest of
+``accelerate_tpu.telemetry`` writes through.
+
+Design contract:
+
+- **One JSONL stream per process** (``events-rank<k>.jsonl``), opened lazily on
+  the first flush. The first line is a ``meta`` record carrying the schema
+  version, run id, process topology and wall-clock anchor; every subsequent
+  record carries a monotonic timestamp ``t`` (and the current ``step`` when one
+  has been set), so files from different ranks can be merged by the report CLI
+  without clock-skew lies.
+- **Kill switch**: telemetry is OFF unless ``ACCELERATE_TELEMETRY`` is truthy
+  (or :func:`enable` is called). When off, every module-level helper is a
+  single ``is None`` check — no allocation, no syscall, no file.
+- **Never crashes training**: writes are buffered and an ``OSError`` on flush
+  drops the buffer (counted in ``dropped_events``) instead of raising.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from typing import Any, Optional
+
+TELEMETRY_SCHEMA_VERSION = 1
+TELEMETRY_ENV_VAR = "ACCELERATE_TELEMETRY"
+TELEMETRY_DIR_ENV_VAR = "ACCELERATE_TELEMETRY_DIR"
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+
+
+class _NullSpan:
+    """No-op span handed out while telemetry is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """Timed region: ``with log.span("name"): ...`` emits one ``span`` record
+    with ``dur_s`` on exit. Extra attributes can be attached mid-flight via
+    :meth:`set` (e.g. the compile/execute split measured inside the region)."""
+
+    __slots__ = ("log", "name", "attrs", "t0")
+
+    def __init__(self, log: "EventLog", name: str, attrs: dict):
+        self.log = log
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.log.emit("span", name=self.name, dur_s=round(time.monotonic() - self.t0, 6), **self.attrs)
+        return False
+
+
+class EventLog:
+    """Buffered JSONL event writer for one process of one run."""
+
+    def __init__(self, out_dir: str, run_id: Optional[str] = None, flush_every: int = 64):
+        self.out_dir = out_dir
+        self.run_id = run_id or _default_run_id()
+        self.flush_every = max(1, int(flush_every))
+        self.step: Optional[int] = None
+        self.closed = False
+        self.dropped_events = 0
+        self._buffer: list[dict] = []
+        self._file = None
+
+    # ------------------------------------------------------------- identity --
+    @staticmethod
+    def _rank_world() -> "tuple[int, int]":
+        from ..state import PartialState
+
+        if PartialState._shared_state.get("_initialized"):
+            state = PartialState()
+            return state.process_index, state.num_processes
+        return (
+            int(os.environ.get("ACCELERATE_PROCESS_ID", 0)),
+            int(os.environ.get("ACCELERATE_NUM_PROCESSES", 1)),
+        )
+
+    @property
+    def path(self) -> str:
+        rank, _ = self._rank_world()
+        return os.path.join(self.out_dir, f"events-rank{rank}.jsonl")
+
+    # -------------------------------------------------------------- recording --
+    def emit(self, kind: str, **fields: Any) -> None:
+        """Append one record. ``t`` is monotonic; ``step`` rides along when set."""
+        if self.closed:
+            return
+        rec: dict = {"kind": kind, "t": round(time.monotonic(), 6)}
+        if self.step is not None:
+            rec["step"] = self.step
+        rec.update(fields)
+        self._buffer.append(rec)
+        if len(self._buffer) >= self.flush_every:
+            self.flush()
+
+    def counter(self, name: str, value, **attrs) -> None:
+        self.emit("counter", name=name, value=value, **attrs)
+
+    def gauge(self, name: str, value, **attrs) -> None:
+        self.emit("gauge", name=name, value=value, **attrs)
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def set_step(self, step: Optional[int]) -> None:
+        self.step = step
+
+    # -------------------------------------------------------------------- io --
+    def _open(self) -> None:
+        if self._file is not None:
+            return
+        os.makedirs(self.out_dir, exist_ok=True)
+        rank, world = self._rank_world()
+        self._file = open(self.path, "a")
+        header = {
+            "kind": "meta",
+            "schema": TELEMETRY_SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "process_index": rank,
+            "num_processes": world,
+            "pid": os.getpid(),
+            "t": round(time.monotonic(), 6),
+            "unix_time": time.time(),
+        }
+        self._file.write(json.dumps(header) + "\n")
+
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        try:
+            self._open()
+            self._file.write("".join(json.dumps(r, default=str) + "\n" for r in self._buffer))
+            self._file.flush()
+        except OSError:
+            self.dropped_events += len(self._buffer)
+        self._buffer.clear()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        if self.dropped_events:
+            self._buffer.append(
+                {"kind": "dropped", "t": round(time.monotonic(), 6), "count": self.dropped_events}
+            )
+        self.flush()
+        self.closed = True
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+
+
+def _default_run_id() -> str:
+    """``ACCELERATE_RUN_ID`` (launcher-provided, consistent across processes)
+    → the live :class:`~accelerate_tpu.state.PartialState` run id → a fresh
+    local ``run-<unix>-<pid>``."""
+    env = os.environ.get("ACCELERATE_RUN_ID")
+    if env:
+        return env
+    from ..state import PartialState
+
+    if PartialState._shared_state.get("_initialized"):
+        rid = getattr(PartialState(), "run_id", None)
+        if rid:
+            return rid
+    return f"run-{int(time.time())}-{os.getpid()}"
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton + zero-overhead shims. The hot-path contract: every
+# helper below costs exactly one attribute load + ``is None`` check when
+# telemetry is disabled.
+
+_ACTIVE: Optional[EventLog] = None
+_ATEXIT_REGISTERED = False
+
+
+def _close_active_at_exit() -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+
+
+def enabled_from_env() -> bool:
+    """The kill switch: ``ACCELERATE_TELEMETRY`` truthy?"""
+    return os.environ.get(TELEMETRY_ENV_VAR, "").strip().lower() in _TRUE
+
+
+def enable(out_dir: Optional[str] = None, run_id: Optional[str] = None, flush_every: int = 64) -> EventLog:
+    """Activate telemetry, writing to ``out_dir`` (defaults to
+    ``$ACCELERATE_TELEMETRY_DIR`` or ``./telemetry``)."""
+    global _ACTIVE, _ATEXIT_REGISTERED
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+    out_dir = out_dir or os.environ.get(TELEMETRY_DIR_ENV_VAR) or "telemetry"
+    _ACTIVE = EventLog(out_dir, run_id=run_id, flush_every=flush_every)
+    if not _ATEXIT_REGISTERED:
+        atexit.register(_close_active_at_exit)
+        _ATEXIT_REGISTERED = True
+    return _ACTIVE
+
+
+def disable() -> None:
+    """Deactivate telemetry (flushes and closes the active log)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+        _ACTIVE = None
+
+
+def maybe_enable_from_env(default_dir: Optional[str] = None) -> Optional[EventLog]:
+    """Honor the env kill switch: enable iff ``ACCELERATE_TELEMETRY`` is truthy
+    and telemetry is not already active. ``default_dir`` is used when
+    ``ACCELERATE_TELEMETRY_DIR`` is unset (the Accelerator passes
+    ``<project_dir>/telemetry``)."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    if not enabled_from_env():
+        return None
+    return enable(os.environ.get(TELEMETRY_DIR_ENV_VAR) or default_dir)
+
+
+def is_enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def get_event_log() -> Optional[EventLog]:
+    return _ACTIVE
+
+
+def emit(kind: str, **fields: Any) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.emit(kind, **fields)
+
+
+def counter(name: str, value, **attrs) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.counter(name, value, **attrs)
+
+
+def gauge(name: str, value, **attrs) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.gauge(name, value, **attrs)
+
+
+def span(name: str, **attrs):
+    return _NULL_SPAN if _ACTIVE is None else _ACTIVE.span(name, **attrs)
+
+
+def set_step(step: Optional[int]) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.set_step(step)
+
+
+def flush() -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.flush()

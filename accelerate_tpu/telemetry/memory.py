@@ -1,0 +1,114 @@
+"""Device/host memory watermarks sampled at step boundaries.
+
+TPU HBM is the binding resource; an OOM three hours into a run is a telemetry
+failure, not a model failure. Three complementary signals:
+
+- ``device.memory_stats()`` — the runtime allocator's view (``bytes_in_use``,
+  ``peak_bytes_in_use``, ``bytes_limit``). Authoritative on TPU/GPU; returns
+  nothing on the CPU emulation backend.
+- ``jax.live_arrays()`` — bytes held by live ``jax.Array`` objects. Works on
+  every backend (the CPU-test stand-in for HBM) and catches Python-side leaks
+  the allocator view can't attribute.
+- host RSS — the process's resident set, for host-offload and input-pipeline
+  bloat.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import events as tel
+
+
+def device_memory_stats() -> "list[dict]":
+    """Per-local-device allocator stats; fields missing on backends that don't
+    report them (CPU emulation reports none)."""
+    import jax
+
+    out = []
+    for i, dev in enumerate(jax.local_devices()):
+        rec: dict = {"device": i, "kind": getattr(dev, "device_kind", str(dev))}
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for src, dst in (
+                ("bytes_in_use", "bytes_in_use"),
+                ("peak_bytes_in_use", "peak_bytes_in_use"),
+                ("bytes_limit", "bytes_limit"),
+            ):
+                if stats.get(src) is not None:
+                    rec[dst] = int(stats[src])
+        out.append(rec)
+    return out
+
+
+def live_array_bytes() -> int:
+    """Total bytes of live ``jax.Array`` objects in this process."""
+    import jax
+
+    return sum(int(getattr(a, "nbytes", 0) or 0) for a in jax.live_arrays())
+
+
+def host_memory_bytes() -> Optional[int]:
+    """Current host RSS in bytes (Linux ``/proc``; ``getrusage`` peak as the
+    fallback), or None when neither source exists."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        return int(rss if sys.platform == "darwin" else rss * 1024)
+    except Exception:
+        return None
+
+
+class MemoryMonitor:
+    """Tracks watermarks across samples and emits one ``memory`` record per
+    :meth:`sample` (when telemetry is enabled)."""
+
+    def __init__(self):
+        self.device_peak_bytes = 0
+        self.live_array_peak_bytes = 0
+        self.host_peak_bytes = 0
+
+    def sample(self, emit: bool = True) -> dict:
+        devices = device_memory_stats()
+        in_use = sum(d.get("bytes_in_use", 0) for d in devices)
+        dev_peak = sum(d.get("peak_bytes_in_use", d.get("bytes_in_use", 0)) for d in devices)
+        live = live_array_bytes()
+        host = host_memory_bytes() or 0
+        self.device_peak_bytes = max(self.device_peak_bytes, dev_peak)
+        self.live_array_peak_bytes = max(self.live_array_peak_bytes, live)
+        self.host_peak_bytes = max(self.host_peak_bytes, host)
+        record = {
+            "device_bytes_in_use": in_use,
+            "device_peak_bytes": self.device_peak_bytes,
+            "live_array_bytes": live,
+            "host_rss_bytes": host,
+        }
+        if emit:
+            tel.emit("memory", **record)
+        return record
+
+    def watermarks(self) -> dict:
+        return {
+            "device_peak_bytes": self.device_peak_bytes,
+            "live_array_peak_bytes": self.live_array_peak_bytes,
+            "host_peak_bytes": self.host_peak_bytes,
+        }
+
+
+def log_memory_watermarks() -> dict:
+    """One-shot convenience: sample now, return the record."""
+    return MemoryMonitor().sample()

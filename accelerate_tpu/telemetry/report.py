@@ -1,0 +1,203 @@
+"""Aggregate telemetry JSONL streams into a human/driver-readable report.
+
+``python -m accelerate_tpu.telemetry report <dir-or-file>...`` reads every
+``*.jsonl`` stream (one per rank), merges them, and prints:
+
+- per-step wall-time / data-wait / execute percentiles (p50/p90/p99),
+- compile totals and the recompile count per compiled function — a nonzero
+  recompile total after warmup is the classic silent reshape cliff,
+- device/host memory peaks,
+- comms traffic per collective op (calls + payload bytes).
+
+``--json`` emits the raw report dict for drivers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Iterable, Optional
+
+PERCENTILES = (50, 90, 99)
+
+
+def iter_event_files(paths: Iterable[str]) -> "list[str]":
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(
+                sorted(
+                    os.path.join(path, name)
+                    for name in os.listdir(path)
+                    if name.endswith(".jsonl")
+                )
+            )
+        else:
+            files.append(path)
+    return files
+
+
+def load_events(paths: Iterable[str]) -> "list[dict]":
+    events: list[dict] = []
+    for file in iter_event_files(paths):
+        try:
+            with open(file) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a killed run
+                    if isinstance(rec, dict):
+                        rec.setdefault("_file", os.path.basename(file))
+                        events.append(rec)
+        except OSError:
+            continue
+    return events
+
+
+def percentile(values: "list[float]", p: int) -> float:
+    """Nearest-rank percentile (ceil rank) of an already-sorted list."""
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, max(0, math.ceil(p / 100.0 * len(values)) - 1))
+    return values[idx]
+
+
+def _dist(values: "list[float]") -> dict:
+    values = sorted(values)
+    if not values:
+        return {"count": 0}
+    return {
+        "count": len(values),
+        "mean": round(sum(values) / len(values), 6),
+        "max": round(values[-1], 6),
+        **{f"p{p}": round(percentile(values, p), 6) for p in PERCENTILES},
+    }
+
+
+def build_report(paths: Iterable[str]) -> dict:
+    events = load_events(paths)
+    metas = [e for e in events if e.get("kind") == "meta"]
+    steps = [e for e in events if e.get("kind") == "step"]
+    misses = [e for e in events if e.get("kind") == "jit_cache_miss"]
+    memory = [e for e in events if e.get("kind") == "memory"]
+    comms = [e for e in events if e.get("kind") == "comm"]
+    waits = [e for e in events if e.get("kind") == "data_wait"]
+
+    by_fn: dict = {}
+    for m in misses:
+        fn = str(m.get("fn", "?"))
+        by_fn[fn] = by_fn.get(fn, 0) + int(m.get("recompiles", 0))
+    comm_ops: dict = {}
+    for c in comms:
+        op = str(c.get("op", "?"))
+        rec = comm_ops.setdefault(op, {"calls": 0, "bytes": 0})
+        rec["calls"] += 1
+        rec["bytes"] += int(c.get("bytes", 0))
+
+    report = {
+        "schema": max((int(m.get("schema", 0)) for m in metas), default=0),
+        "runs": sorted({str(m.get("run_id")) for m in metas if m.get("run_id")}),
+        "processes": len({m.get("process_index") for m in metas}) or None,
+        "events": len(events),
+        "steps": {
+            "count": len(steps),
+            "wall_s": _dist([float(s.get("dur_s", 0.0)) for s in steps]),
+            "data_wait_s": _dist([float(s.get("data_wait_s", 0.0)) for s in steps]),
+            "execute_s": _dist([float(s.get("execute_s", 0.0)) for s in steps]),
+            "compile_s_total": round(sum(float(s.get("compile_s", 0.0)) for s in steps), 6),
+        },
+        "recompiles": {
+            "total": sum(by_fn.values()),
+            "initial_compiles": sum(1 for m in misses if m.get("first")),
+            "by_fn": dict(sorted(by_fn.items())),
+        },
+        "memory": {
+            "device_peak_bytes": max((int(m.get("device_peak_bytes", 0)) for m in memory), default=0),
+            "live_array_peak_bytes": max((int(m.get("live_array_bytes", 0)) for m in memory), default=0),
+            "host_rss_peak_bytes": max((int(m.get("host_rss_bytes", 0)) for m in memory), default=0),
+        },
+        "comms": {
+            "total_calls": sum(r["calls"] for r in comm_ops.values()),
+            "total_bytes": sum(r["bytes"] for r in comm_ops.values()),
+            "by_op": dict(sorted(comm_ops.items())),
+        },
+        "data_wait_events": len(waits),
+    }
+    return report
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def format_report(report: dict) -> str:
+    lines = []
+    runs = ", ".join(report.get("runs") or []) or "<none>"
+    lines.append(f"telemetry report — run(s): {runs}, "
+                 f"{report.get('processes') or 0} process(es), {report['events']} events")
+    s = report["steps"]
+    lines.append(f"steps: {s['count']}")
+    for key, label in (("wall_s", "step time"), ("data_wait_s", "data wait"), ("execute_s", "execute")):
+        d = s[key]
+        if d.get("count"):
+            lines.append(
+                f"  {label:<10} p50={d['p50'] * 1e3:.2f}ms  p90={d['p90'] * 1e3:.2f}ms  "
+                f"p99={d['p99'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
+            )
+    lines.append(f"  compile total: {s['compile_s_total'] * 1e3:.2f}ms")
+    r = report["recompiles"]
+    lines.append(f"recompiles: {r['total']} (initial compiles: {r['initial_compiles']})")
+    for fn, n in r["by_fn"].items():
+        if n:
+            lines.append(f"  {fn}: {n} recompile(s) — check for varying input shapes/dtypes")
+    m = report["memory"]
+    lines.append(
+        "memory peaks: device "
+        + _fmt_bytes(m["device_peak_bytes"])
+        + ", live arrays "
+        + _fmt_bytes(m["live_array_peak_bytes"])
+        + ", host rss "
+        + _fmt_bytes(m["host_rss_peak_bytes"])
+    )
+    c = report["comms"]
+    lines.append(f"comms: {c['total_calls']} call(s), {_fmt_bytes(c['total_bytes'])} total")
+    for op, rec in c["by_op"].items():
+        lines.append(f"  {op}: {rec['calls']} call(s), {_fmt_bytes(rec['bytes'])}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional["list[str]"] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m accelerate_tpu.telemetry",
+        description="Aggregate accelerate_tpu telemetry JSONL event streams.",
+    )
+    sub = parser.add_subparsers(dest="command")
+    rep = sub.add_parser("report", help="aggregate one or more event dirs/files")
+    rep.add_argument("paths", nargs="+", help="telemetry dir(s) or .jsonl file(s)")
+    rep.add_argument("--json", action="store_true", help="print the raw report dict")
+    args = parser.parse_args(argv)
+    if args.command != "report":
+        parser.print_help()
+        return 2
+    report = build_report(args.paths)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Mirror telemetry summaries into the existing experiment trackers.
+
+Users already have a logging destination (``tracking.py``: JSONL, TensorBoard,
+W&B, ...). This bridge flattens the report aggregates into scalar metrics under
+a ``telemetry/`` prefix and hands them to every tracker's ``log_telemetry``
+(default implementation: ``log``), so step-time percentiles, recompile counts
+and comms traffic land wherever the user's metrics already go — no second
+dashboard to remember.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import events as tel
+from .report import build_report
+
+
+def summary_metrics(report: Optional[dict] = None, out_dir: Optional[str] = None) -> "dict[str, float]":
+    """Flatten a telemetry report (built from ``out_dir`` or the active event
+    log's directory when not given) into scalar metrics. Empty dict when there
+    is nothing to summarize."""
+    if report is None:
+        if out_dir is None:
+            log = tel.get_event_log()
+            if log is None:
+                return {}
+            log.flush()
+            out_dir = log.out_dir
+        report = build_report([out_dir])
+    if not report.get("steps", {}).get("count") and not report.get("events"):
+        return {}
+    flat: dict = {}
+    steps = report["steps"]
+    flat["telemetry/steps"] = steps["count"]
+    for key in ("wall_s", "data_wait_s", "execute_s"):
+        dist = steps.get(key) or {}
+        for stat in ("p50", "p90", "p99", "mean", "max"):
+            if stat in dist:
+                flat[f"telemetry/{key}_{stat}"] = dist[stat]
+    flat["telemetry/compile_s_total"] = steps.get("compile_s_total", 0.0)
+    flat["telemetry/recompiles"] = report["recompiles"]["total"]
+    for name, count in report["recompiles"]["by_fn"].items():
+        if count:
+            flat[f"telemetry/recompiles/{name}"] = count
+    mem = report["memory"]
+    flat["telemetry/device_peak_bytes"] = mem["device_peak_bytes"]
+    flat["telemetry/live_array_peak_bytes"] = mem["live_array_peak_bytes"]
+    flat["telemetry/host_rss_peak_bytes"] = mem["host_rss_peak_bytes"]
+    comms = report["comms"]
+    flat["telemetry/comm_calls"] = comms["total_calls"]
+    flat["telemetry/comm_bytes"] = comms["total_bytes"]
+    for op, rec in comms["by_op"].items():
+        flat[f"telemetry/comm_bytes/{op}"] = rec["bytes"]
+    return flat
+
+
+def mirror_to_trackers(trackers, summary: Optional[dict] = None, step: Optional[int] = None,
+                       out_dir: Optional[str] = None) -> "dict[str, float]":
+    """Push the flattened summary into every tracker; returns what was logged."""
+    flat = summary if summary is not None else summary_metrics(out_dir=out_dir)
+    if not flat:
+        return {}
+    for tracker in trackers:
+        log_fn = getattr(tracker, "log_telemetry", None) or tracker.log
+        log_fn(flat, step=step)
+    return flat

@@ -89,6 +89,15 @@ class GeneralTracker:
     def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         pass
 
+    def log_telemetry(self, summary: dict, step: Optional[int] = None, **kwargs) -> None:
+        """Receive a flattened telemetry summary (``telemetry/...`` scalar
+        metrics from :mod:`accelerate_tpu.telemetry.tracker_bridge`). The
+        default routes through :meth:`log`, so every integration gets
+        step-time percentiles / recompile counts / comms bytes wherever its
+        metrics already go; trackers with a native concept of summaries may
+        override."""
+        self.log(summary, step=step, **kwargs)
+
     def log_images(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
         """Log named images/image-lists (reference e.g. ``tracking.py:272``).
         Trackers without image support warn and skip."""

@@ -199,6 +199,19 @@ def get_cpu_distributed_information() -> dict:
         info["rank"] = state.process_index
         info["world_size"] = state.num_processes
         info["local_rank"] = state.local_process_index
+        # local_world_size must agree with the live topology (ADVICE round 5):
+        # a stale or missing LOCAL_WORLD_SIZE would otherwise hand
+        # set_numa_affinity an inconsistent process count and mis-slice the
+        # CPUs. Only rank-INDEPENDENT corrections are applied (every rank must
+        # compute the same count or affinity slices overlap): a single-process
+        # state is exactly 1, and a declared count is bounded by the live
+        # world size. An undeclared count under multi-process stays at the env
+        # default of 1, where set_numa_affinity degrades to a neutral
+        # full-affinity no-op — declare LOCAL_WORLD_SIZE for exact pinning.
+        if state.num_processes == 1:
+            info["local_world_size"] = 1
+        else:
+            info["local_world_size"] = min(info["local_world_size"], state.num_processes)
     return info
 
 

@@ -27,12 +27,60 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..state import PartialState
+from ..telemetry import events as _telemetry
 from .environment import parse_flag_from_env
 
 
 class DistributedOperationException(Exception):
     """Raised when an operation cannot proceed consistently across processes
     (reference ``utils/operations.py:37``)."""
+
+
+# ---------------------------------------------------------------------------
+# Comms counters (telemetry): op type, payload bytes, call count for the
+# host-level collectives, so sharding regressions show up as traffic, not
+# vibes. Counting happens ONLY while telemetry is enabled — the disabled path
+# is one flag check per op call.
+
+_COMM_COUNTS: "dict[str, list]" = {}  # op -> [calls, bytes]
+
+
+def _tree_nbytes(tree) -> int:
+    total = 0
+
+    def _add(x):
+        nonlocal total
+        nbytes = getattr(x, "nbytes", None)
+        total += int(nbytes) if nbytes is not None else int(np.asarray(x).nbytes)
+        return x
+
+    recursively_apply(_add, tree)
+    return total
+
+
+def _record_comm(op: str, tree=None, nbytes: Optional[int] = None) -> None:
+    if not _telemetry.is_enabled():
+        return
+    try:
+        n = int(nbytes) if nbytes is not None else _tree_nbytes(tree)
+    except Exception:
+        n = 0
+    rec = _COMM_COUNTS.setdefault(op, [0, 0])
+    rec[0] += 1
+    rec[1] += n
+    # wire=False marks a single-process (loopback) call: the logical payload
+    # is counted — the regression signal the counters exist for — but no bytes
+    # crossed a host boundary
+    _telemetry.emit("comm", op=op, bytes=n, wire=PartialState().num_processes > 1)
+
+
+def get_comm_counters() -> "dict[str, dict]":
+    """Live per-op traffic counters: ``{op: {"calls": n, "bytes": b}}``."""
+    return {op: {"calls": rec[0], "bytes": rec[1]} for op, rec in _COMM_COUNTS.items()}
+
+
+def reset_comm_counters() -> None:
+    _COMM_COUNTS.clear()
 
 
 def _is_jax_array(x) -> bool:
@@ -156,6 +204,7 @@ def gather(tree):
     - host-local numpy (multi-process) → ``process_allgather`` concat along dim 0
     """
     tree = _normalize_foreign(tree)
+    _record_comm("gather", tree)
     state = PartialState()
 
     def _gather(x):
@@ -180,11 +229,14 @@ def gather_object(obj: Any) -> list[Any]:
     (reference ``gather_object:445``)."""
     state = PartialState()
     if state.num_processes == 1:
+        if _telemetry.is_enabled():
+            _record_comm("gather_object", nbytes=len(pickle.dumps(obj)))
         return [obj]
     # pragma: no cover - multihost only
     from jax.experimental import multihost_utils
 
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    _record_comm("gather_object", nbytes=payload.size)
     sizes = multihost_utils.process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
     max_size = int(sizes.max())
     padded = np.zeros(max_size, dtype=np.uint8)
@@ -198,6 +250,7 @@ def gather_object(obj: Any) -> list[Any]:
 def broadcast(tree, from_process: int = 0):
     """Broadcast array leaves from ``from_process`` to all processes
     (reference ``broadcast:539``). Single-process: identity."""
+    _record_comm("broadcast", tree)
     state = PartialState()
     if state.num_processes == 1:
         return tree
@@ -214,12 +267,15 @@ def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
     """Broadcast a list of picklable objects (reference ``broadcast_object_list:560``)."""
     state = PartialState()
     if state.num_processes == 1:
+        if _telemetry.is_enabled():
+            _record_comm("broadcast_object_list", nbytes=len(pickle.dumps(object_list)))
         return object_list
     # pragma: no cover - multihost only
     from jax.experimental import multihost_utils
 
     is_source = state.process_index == from_process
     payload = np.frombuffer(pickle.dumps(object_list), dtype=np.uint8)
+    _record_comm("broadcast_object_list", nbytes=payload.size)
     size = multihost_utils.broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
     buf = np.zeros(int(size[0]), dtype=np.uint8)
     if is_source:
@@ -276,7 +332,9 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
 
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
-    return recursively_apply(_reduce, _normalize_foreign(tree))
+    tree = _normalize_foreign(tree)
+    _record_comm("reduce", tree)
+    return recursively_apply(_reduce, tree)
 
 
 def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool = False):

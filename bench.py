@@ -23,6 +23,15 @@ Hardening (round 1-4 postmortems):
 ``vs_baseline`` anchors to ``BENCH_BASELINE.json`` (written on first TPU run) so
 round-over-round regressions are visible; the reference repo publishes no number
 for this metric (BASELINE.md).
+
+**Staleness contract for consumers** (see benchmarks/README.md "Reading
+cached records"): a record with ``"cached": true`` is a REAL TPU measurement
+taken mid-round by ``tools/tpu_watcher.py`` up to
+``ACCELERATE_BENCH_CACHE_MAX_AGE_MIN`` (default 720) minutes BEFORE bench
+time — it predates any code change landed since ``measured_at_unix`` and its
+``value``/``vs_baseline`` must not be read as a measurement of the current
+tree. Consumers parsing only ``value``/``vs_baseline`` MUST also check
+``cached`` (and ``cache_age_minutes``) before treating the number as current.
 """
 
 from __future__ import annotations

@@ -1,0 +1,43 @@
+"""Repo hygiene guards: compiled artifacts must never be tracked in git.
+
+A previous seed committed ``accelerate_tpu/telemetry/__pycache__`` with no
+matching source — stale bytecode that shadows nothing and confuses everyone.
+This guard fails the suite if any ``__pycache__``/``.pyc`` ever lands in the
+index again.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _git_ls_files():
+    try:
+        res = subprocess.run(
+            ["git", "ls-files"], cwd=REPO, capture_output=True, text=True, timeout=60
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        pytest.skip("git unavailable")
+    if res.returncode != 0:
+        pytest.skip("not a git checkout")
+    return res.stdout.splitlines()
+
+
+def test_no_compiled_artifacts_tracked():
+    tracked = _git_ls_files()
+    bad = [
+        path
+        for path in tracked
+        if "__pycache__" in path or path.endswith((".pyc", ".pyo", ".pyd"))
+    ]
+    assert bad == [], f"compiled artifacts tracked in git: {bad}"
+
+
+def test_pycache_is_gitignored():
+    gitignore = os.path.join(REPO, ".gitignore")
+    assert os.path.exists(gitignore)
+    patterns = [line.strip() for line in open(gitignore)]
+    assert "__pycache__/" in patterns and "*.pyc" in patterns

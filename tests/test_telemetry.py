@@ -1,0 +1,439 @@
+"""Telemetry subsystem: event-log round-trip, recompile counting, report CLI
+aggregation, disabled-mode zero-write behavior, comms counters, dataloader
+data-wait + reshard routing, and the tracker bridge."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, DataLoader, telemetry as tel
+from accelerate_tpu.telemetry import events as tel_events
+from accelerate_tpu.telemetry.report import build_report, format_report, main as report_main
+from accelerate_tpu.telemetry.step_profiler import RecompileWatcher, StepTelemetry
+from accelerate_tpu.utils import operations as ops
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_TELEMETRY", raising=False)
+    monkeypatch.delenv("ACCELERATE_TELEMETRY_DIR", raising=False)
+    monkeypatch.delenv("ACCELERATE_RUN_ID", raising=False)
+    yield
+    tel.disable()
+    ops.reset_comm_counters()
+
+
+# ---------------------------------------------------------------- event log --
+
+
+def test_event_log_round_trip(tmp_path):
+    log = tel.enable(str(tmp_path), run_id="run-test")
+    log.emit("custom", payload=42)
+    with tel.span("region", tag="a"):
+        pass
+    tel.set_step(7)
+    tel.counter("items", 3)
+    tel.gauge("temp", 1.5)
+    tel.disable()
+
+    files = os.listdir(tmp_path)
+    assert files == ["events-rank0.jsonl"]
+    records = [json.loads(line) for line in open(tmp_path / files[0])]
+    meta, rest = records[0], records[1:]
+    assert meta["kind"] == "meta"
+    assert meta["schema"] == tel_events.TELEMETRY_SCHEMA_VERSION
+    assert meta["run_id"] == "run-test"
+    assert meta["process_index"] == 0 and meta["num_processes"] >= 1
+    kinds = [r["kind"] for r in rest]
+    assert kinds == ["custom", "span", "counter", "gauge"]
+    assert all(isinstance(r["t"], float) for r in rest)
+    assert rest[1]["name"] == "region" and rest[1]["dur_s"] >= 0 and rest[1]["tag"] == "a"
+    # step rides along once set
+    assert rest[2]["step"] == 7 and rest[3]["step"] == 7
+    assert "step" not in rest[0]
+
+
+def test_disabled_mode_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.setenv(tel_events.TELEMETRY_DIR_ENV_VAR, str(tmp_path / "t"))
+    assert not tel.is_enabled()
+    assert tel.maybe_enable_from_env() is None  # kill switch: env unset
+    tel.emit("x", a=1)
+    tel.counter("c", 1)
+    tel.gauge("g", 1)
+    tel.set_step(3)
+    # the disabled span is one shared null object — no per-call allocation
+    assert tel.span("a") is tel.span("b")
+    with tel.span("a"):
+        pass
+    assert not (tmp_path / "t").exists()
+    assert tel.get_event_log() is None
+
+
+def test_kill_switch_enables_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(tel_events.TELEMETRY_ENV_VAR, "1")
+    monkeypatch.setenv(tel_events.TELEMETRY_DIR_ENV_VAR, str(tmp_path / "out"))
+    log = tel.maybe_enable_from_env()
+    assert log is not None and tel.is_enabled()
+    tel.emit("ping")
+    tel.disable()
+    assert (tmp_path / "out" / "events-rank0.jsonl").exists()
+
+
+def test_enabled_but_silent_run_creates_no_file(tmp_path):
+    tel.enable(str(tmp_path / "quiet"))
+    tel.disable()  # nothing emitted -> nothing opened
+    assert not (tmp_path / "quiet").exists()
+
+
+# ---------------------------------------------------- recompile detection ----
+
+
+def test_recompile_watcher_counts_cache_misses_per_function():
+    fn = jax.jit(lambda x: x * 2)
+    watcher = RecompileWatcher()
+    watcher.register("double", fn)
+    fn(jnp.ones((2, 2)))
+    # first entry is the expected initial compile, not a recompile
+    assert watcher.poll(emit=False) == {"double": 0}
+    fn(jnp.ones((2, 2)))
+    assert watcher.poll(emit=False) == {}
+    fn(jnp.ones((3, 3)))  # reshape -> cache miss
+    assert watcher.poll(emit=False) == {"double": 1}
+    assert watcher.recompile_total() >= 1
+
+
+def test_step_telemetry_records_compile_execute_split(tmp_path):
+    tel.enable(str(tmp_path))
+    st = StepTelemetry(memory_every=1)
+    fn = jax.jit(lambda x: jnp.sum(x * 2))
+    st.register_compiled("fn", fn)
+    for shape in ((4,), (4,), (5,)):
+        with st.step():
+            fn(jnp.ones(shape)).block_until_ready()
+    tel.disable()
+    records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    steps = [r for r in records if r["kind"] == "step"]
+    assert len(steps) == 3
+    assert steps[0]["compile_s"] > 0  # first call compiles
+    assert steps[1]["compiles"] == 0 and steps[1]["recompiles"] == 0
+    assert steps[2]["recompiles"] == 1  # the reshape
+    for s in steps:
+        assert s["dur_s"] >= s["execute_s"] >= 0
+    misses = [r for r in records if r["kind"] == "jit_cache_miss"]
+    assert [m["first"] for m in misses] == [True, False]
+    memory = [r for r in records if r["kind"] == "memory"]
+    assert len(memory) == 3 and memory[0]["host_rss_bytes"] > 0
+
+
+# ------------------------------------------------------------ comms counters --
+
+
+def test_comm_counters_on_cpu_backend(tmp_path):
+    tel.enable(str(tmp_path))
+    ops.reset_comm_counters()
+    ops.gather({"a": jnp.ones((4, 2), jnp.float32)})
+    ops.reduce(np.ones((8,), np.float32), "mean")
+    ops.broadcast(np.ones((2,), np.float32))
+    ops.gather_object({"k": 1})
+    ops.broadcast_object_list([1, 2, 3])
+    counters = ops.get_comm_counters()
+    tel.disable()
+    assert counters["gather"]["calls"] == 1 and counters["gather"]["bytes"] == 4 * 2 * 4
+    assert counters["reduce"]["bytes"] == 8 * 4
+    assert counters["broadcast"]["bytes"] == 2 * 4
+    assert counters["gather_object"]["bytes"] > 0
+    assert counters["broadcast_object_list"]["bytes"] > 0
+    records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    comm = [r for r in records if r["kind"] == "comm"]
+    assert sorted({c["op"] for c in comm}) == [
+        "broadcast", "broadcast_object_list", "gather", "gather_object", "reduce",
+    ]
+
+
+def test_comm_counters_idle_when_disabled():
+    ops.reset_comm_counters()
+    ops.gather(jnp.ones((4,)))
+    ops.reduce(np.ones((4,)), "sum")
+    assert ops.get_comm_counters() == {}
+
+
+# ------------------------------------------------------- dataloader hookup ---
+
+
+def test_dataloader_emits_data_wait(tmp_path):
+    tel.enable(str(tmp_path))
+    acc = Accelerator()
+    data = [{"x": np.ones((4,), np.float32)} for _ in range(64)]
+    dl = acc.prepare(DataLoader(data, batch_size=8))
+    for _ in dl:
+        pass
+    tel.disable()
+    records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    waits = [r for r in records if r["kind"] == "data_wait"]
+    assert waits and {w["phase"] for w in waits} == {"fetch", "device_put"}
+    reshard = [r for r in records if r["kind"] == "dataloader_reshard"]
+    assert reshard and reshard[0]["decision"] == "native_sampler_sharded"
+
+
+def test_stateful_loader_under_dp_routes_to_dispatcher(tmp_path):
+    import torch.utils.data as tud
+
+    from accelerate_tpu.data_loader import DataLoaderDispatcher, prepare_data_loader
+    from accelerate_tpu.state import AcceleratorState
+
+    class _TorchStateful(tud.DataLoader):
+        def state_dict(self):
+            return {}
+
+        def load_state_dict(self, state):
+            pass
+
+    tel.enable(str(tmp_path))
+    state = AcceleratorState()  # default: dp over all 8 virtual devices
+    loader = _TorchStateful(list(range(64)), batch_size=8)
+    with pytest.warns(UserWarning, match="routing through DataLoaderDispatcher"):
+        prepared = prepare_data_loader(loader, state=state)
+    assert isinstance(prepared, DataLoaderDispatcher)
+    # explicitly refusing the dispatcher is a hard error, not silent duplication
+    with pytest.raises(ValueError, match="duplicate data"):
+        prepare_data_loader(loader, state=state, dispatch_batches=False)
+    tel.disable()
+    records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    decisions = [r["decision"] for r in records if r["kind"] == "dataloader_reshard"]
+    assert "stateful_to_dispatcher" in decisions
+
+
+def test_use_stateful_dataloader_raises_only_without_torchdata(monkeypatch, tmp_path):
+    import torch.utils.data as tud
+
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    acc = Accelerator(dataloader_config=DataLoaderConfiguration(use_stateful_dataloader=True))
+    plain = tud.DataLoader(list(range(16)), batch_size=4)
+    # torchdata absent in this container: the ImportError path
+    if "torchdata" not in sys.modules:
+        with pytest.raises(ImportError, match="torchdata"):
+            acc.prepare_data_loader(plain)
+    # with torchdata>=0.8.0 importable the loader is rebuilt, not rejected
+    import types
+
+    class _StatefulDataLoader(tud.DataLoader):
+        def state_dict(self):
+            return {"pos": 0}
+
+        def load_state_dict(self, state):
+            pass
+
+    torchdata = types.ModuleType("torchdata")
+    torchdata.__version__ = "0.11.0"
+    sdl_mod = types.ModuleType("torchdata.stateful_dataloader")
+    sdl_mod.StatefulDataLoader = _StatefulDataLoader
+    torchdata.stateful_dataloader = sdl_mod
+    monkeypatch.setitem(sys.modules, "torchdata", torchdata)
+    monkeypatch.setitem(sys.modules, "torchdata.stateful_dataloader", sdl_mod)
+    with pytest.warns(UserWarning):  # dp>1: rebuilt loader routes to dispatcher
+        prepared = acc.prepare_data_loader(plain)
+    assert isinstance(prepared.base_dataloader, _StatefulDataLoader)
+    assert prepared.base_dataloader.dataset is plain.dataset
+    # a too-old torchdata is the same as absent
+    torchdata.__version__ = "0.7.1"
+    with pytest.raises(ImportError, match="torchdata"):
+        acc.prepare_data_loader(tud.DataLoader(list(range(8)), batch_size=4))
+
+
+# ------------------------------------------------------------------- report --
+
+
+def _run_training_with_telemetry(tmp_path, steps=5):
+    tel.enable(str(tmp_path))
+    acc = Accelerator()
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    optimizer = optax.sgd(1e-2)
+    n_samples = steps * 8 * acc.partial_state.num_devices
+    data = [
+        {"x": np.random.default_rng(i).standard_normal(4).astype(np.float32),
+         "y": np.float32(1.0)}
+        for i in range(n_samples)
+    ]
+    dl = DataLoader(data, batch_size=8)
+    params, optimizer, dl = acc.prepare(params, optimizer, dl)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"] + p["b"]
+        return jnp.mean((jnp.sum(pred, -1) - batch["y"]) ** 2)
+
+    step = acc.prepare_train_step(loss_fn, optimizer)
+    opt_state = optimizer.opt_state
+    for batch in dl:
+        params, opt_state, metrics = step(params, opt_state, batch)
+    # forced reshape -> the compiled step recompiles
+    reshaped = {"x": jnp.ones((4, 4)), "y": jnp.ones((4,))}
+    params, opt_state, metrics = step(params, opt_state, reshaped)
+    ops.gather(metrics["loss"])  # comms traffic
+    tel.get_event_log().flush()
+    return acc
+
+
+def test_training_loop_report_end_to_end(tmp_path):
+    """The acceptance scenario: 5-step CPU loop -> JSONL -> report with
+    step percentiles, >=1 detected recompile, and comms byte totals."""
+    _run_training_with_telemetry(tmp_path)
+    tel.disable()
+    report = build_report([str(tmp_path)])
+    assert report["steps"]["count"] >= 5
+    assert report["steps"]["wall_s"]["p50"] > 0
+    assert set(report["steps"]["wall_s"]) >= {"p50", "p90", "p99", "mean", "max"}
+    assert report["recompiles"]["total"] >= 1
+    assert any(n >= 1 for n in report["recompiles"]["by_fn"].values())
+    assert report["comms"]["total_bytes"] > 0
+    assert report["comms"]["by_op"]["gather"]["bytes"] > 0
+    assert report["memory"]["live_array_peak_bytes"] > 0
+    assert report["data_wait_events"] > 0
+    text = format_report(report)
+    assert "p50" in text and "recompile" in text and "comms" in text
+
+
+def test_report_cli_main(tmp_path, capsys):
+    tel.enable(str(tmp_path))
+    with tel.span("warm"):
+        pass
+    tel.emit("step", dur_s=0.01, data_wait_s=0.001, compile_s=0.0, execute_s=0.009,
+             compiles=0, recompiles=0)
+    tel.disable()
+    assert report_main(["report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry report" in out and "p50" in out
+    assert report_main(["report", str(tmp_path), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["steps"]["count"] == 1
+
+
+@pytest.mark.slow
+def test_report_cli_subprocess(tmp_path):
+    tel.enable(str(tmp_path))
+    tel.emit("step", dur_s=0.5, data_wait_s=0.0, compile_s=0.1, execute_s=0.4,
+             compiles=1, recompiles=0)
+    tel.disable()
+    res = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.telemetry", "report", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "p50" in res.stdout
+
+
+def test_report_tolerates_torn_and_foreign_lines(tmp_path):
+    path = tmp_path / "events-rank0.jsonl"
+    path.write_text(
+        json.dumps({"kind": "meta", "schema": 1, "run_id": "r", "process_index": 0}) + "\n"
+        + json.dumps({"kind": "step", "dur_s": 1.0}) + "\n"
+        + "{\"kind\": \"step\", \"dur_s\":"  # torn tail from a killed run
+    )
+    report = build_report([str(tmp_path)])
+    assert report["steps"]["count"] == 1
+
+
+# ----------------------------------------------------------- tracker bridge --
+
+
+def test_tracker_bridge_mirrors_summary(tmp_path):
+    from accelerate_tpu.telemetry.tracker_bridge import mirror_to_trackers, summary_metrics
+
+    tel.enable(str(tmp_path / "t"))
+    tel.emit("step", dur_s=0.02, data_wait_s=0.0, compile_s=0.0, execute_s=0.02,
+             compiles=0, recompiles=2)
+    tel.emit("jit_cache_miss", fn="train_step#0", count=2, recompiles=2, first=False)
+    tel.emit("comm", op="gather", bytes=1024)
+    tel.get_event_log().flush()
+    summary = summary_metrics()
+    assert summary["telemetry/steps"] == 1
+    assert summary["telemetry/recompiles"] == 2
+    assert summary["telemetry/comm_bytes"] == 1024
+    logged = {}
+
+    class _Recorder:
+        name = "rec"
+
+        def log(self, values, step=None, **kwargs):
+            logged.update(values)
+
+    assert mirror_to_trackers([_Recorder()], summary=summary) == summary
+    assert logged == summary
+    tel.disable()
+    # disabled + no dir: bridge degrades to a no-op
+    assert summary_metrics() == {}
+
+
+def test_accelerator_end_training_mirrors_into_trackers(tmp_path, monkeypatch):
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    monkeypatch.setenv(tel_events.TELEMETRY_ENV_VAR, "1")
+    monkeypatch.setenv(tel_events.TELEMETRY_DIR_ENV_VAR, str(tmp_path / "t"))
+    acc = Accelerator(
+        log_with="jsonl",
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), logging_dir=str(tmp_path)),
+    )
+    acc.init_trackers("proj")
+    tel.emit("step", dur_s=0.01, data_wait_s=0.0, compile_s=0.0, execute_s=0.01,
+             compiles=0, recompiles=0)
+    acc.end_training()
+    lines = [json.loads(l) for l in open(tmp_path / "proj.jsonl")]
+    tele_lines = [l for l in lines if any(k.startswith("telemetry/") for k in l)]
+    assert tele_lines and tele_lines[-1]["telemetry/steps"] == 1
+
+
+# ------------------------------------------------------------------- memory --
+
+
+def test_memory_monitor_watermarks():
+    from accelerate_tpu.telemetry.memory import MemoryMonitor, live_array_bytes
+
+    keep = jnp.ones((128, 128))  # noqa: F841 - held live on purpose
+    monitor = MemoryMonitor()
+    first = monitor.sample(emit=False)
+    assert first["live_array_bytes"] >= 128 * 128 * 4
+    assert first["host_rss_bytes"] > 0
+    marks = monitor.watermarks()
+    assert marks["live_array_peak_bytes"] >= first["live_array_bytes"] or marks[
+        "live_array_peak_bytes"
+    ] >= 128 * 128 * 4
+    assert live_array_bytes() >= 128 * 128 * 4
+
+
+# -------------------------------------------------------------- environment --
+
+
+def test_local_world_size_follows_partial_state(monkeypatch):
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils.environment import get_cpu_distributed_information
+
+    monkeypatch.setenv("LOCAL_WORLD_SIZE", "8")
+    PartialState._reset_state()
+    # env-only (no live state): the env value is served as-is
+    assert get_cpu_distributed_information()["local_world_size"] == 8
+    PartialState()  # single process
+    info = get_cpu_distributed_information()
+    assert info["world_size"] == 1
+    # a live single-process state overrides the stale env value
+    assert info["local_world_size"] == 1
+
+
+def test_partial_state_run_id(monkeypatch):
+    from accelerate_tpu.state import PartialState
+
+    monkeypatch.setenv("ACCELERATE_RUN_ID", "launcher-run-7")
+    PartialState._reset_state()
+    assert PartialState().run_id == "launcher-run-7"
+    PartialState._reset_state()
+    monkeypatch.delenv("ACCELERATE_RUN_ID")
+    assert PartialState().run_id.startswith("run-")

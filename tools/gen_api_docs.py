@@ -121,6 +121,23 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
          ("accelerate_tpu.scheduler", None),
          ("accelerate_tpu.bridge.module", ["BridgedModule", "BridgedOutput"])],
     ),
+    "telemetry": (
+        "Telemetry",
+        "Built-in observability (no reference counterpart): structured step "
+        "events, recompile/memory/comms metrics, and the "
+        "`python -m accelerate_tpu.telemetry report` CLI. See "
+        "`docs/telemetry.md` for the guide.",
+        [("accelerate_tpu.telemetry.events",
+          ["EventLog", "enable", "disable", "maybe_enable_from_env", "is_enabled",
+           "get_event_log", "emit", "counter", "gauge", "span", "set_step"]),
+         ("accelerate_tpu.telemetry.step_profiler",
+          ["StepTelemetry", "RecompileWatcher", "install_compile_listener",
+           "compile_snapshot", "record_data_wait"]),
+         ("accelerate_tpu.telemetry.memory", None),
+         ("accelerate_tpu.telemetry.report",
+          ["build_report", "format_report", "load_events", "percentile", "main"]),
+         ("accelerate_tpu.telemetry.tracker_bridge", None)],
+    ),
     "tracking": (
         "Experiment tracking",
         "Tracker abstraction + integrations (reference `tracking.py`).",
