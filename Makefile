@@ -17,7 +17,8 @@
 # box (observed round 4).
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
-        test_examples test_models test_multihost test_checkpoint quality bench
+        test_examples test_models test_multihost test_checkpoint quality bench \
+        bench-input
 
 PYTEST := python -m pytest -q
 
@@ -74,3 +75,7 @@ quality:
 
 bench:
 	python bench.py
+
+# sync-vs-prefetch input pipeline microbench (benchmarks/input_pipeline)
+bench-input:
+	python benchmarks/input_pipeline/run.py

@@ -827,6 +827,7 @@ class Accelerator:
             data_seed=cfg.data_seed,
             use_seedable_sampler=cfg.use_seedable_sampler,
             rng_types=self.rng_types if self.num_processes > 1 else None,
+            prefetch_depth=cfg.prefetch_depth,
         )
         self._dataloaders.append(prepared)
         return prepared

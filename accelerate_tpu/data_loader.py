@@ -18,11 +18,20 @@ Static-shape discipline: ``even_batches=True`` (wraparound, reference
 ``data_loader.py:236-262``) is the default so every step has identical shapes and
 never recompiles; ``GradientState.remainder`` records the duplicate count so
 ``gather_for_metrics`` can trim (reference ``accelerator.py:3020-3092``).
+
+Asynchronous prefetch: a bounded background producer pulls up to
+``prefetch_depth`` batches ahead (default 2), runs host-side processing and
+issues the sharded host→device transfer, so the transfer for batch N+1
+overlaps the jitted step for batch N and the consumer only pays a queue-pop
+("stall") when the producer cannot keep up. ``prefetch_depth=0`` restores the
+fully synchronous iteration byte-for-byte. See ``docs/data_pipeline.md``.
 """
 
 from __future__ import annotations
 
 import math
+import queue as _queue
+import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
@@ -421,6 +430,10 @@ class GlobalBatchAssembler:
         self._coords = {}
         for coord, dev in zip(np.ndindex(*mesh.devices.shape), mesh.devices.flat):
             self._coords[dev] = dict(zip(axis_names, coord))
+        # every mesh device addressable ⇒ the per-host block IS the global
+        # batch and one committed sharded device_put replaces the per-device
+        # shard loop (XLA splits + dispatches the transfer asynchronously)
+        self._fully_addressable = set(mesh.devices.flat) == set(mesh.local_devices)
 
     @property
     def dp_size(self) -> int:
@@ -474,27 +487,32 @@ class GlobalBatchAssembler:
                 )
             per_row = local_rows // len(rows)
             global_shape = (per_row * self._dp_size,) + x.shape[1:]
+            if self._seq_axis is not None and x.ndim > self.seq_dim and seq_size > 1:
+                seq_len = x.shape[self.seq_dim]
+                if seq_len % seq_size != 0:
+                    raise ValueError(
+                        f"sequence dim ({seq_len}) not divisible by {self._seq_axis} "
+                        f"size {seq_size}"
+                    )
             sharding = NamedSharding(self.mesh, self.batch_spec(x.ndim))
+            if self._fully_addressable:
+                # single committed sharded transfer: XLA splits the host array
+                # across the mesh and dispatches every per-device copy in one
+                # asynchronous call — no per-device Python loop on the hot path
+                return jax.device_put(x, sharding)
+            # multi-host: each process contributes only its addressable shards
             shards = []
-            devices = []
-            for dev in self.mesh.local_devices:
+            for dev in self.mesh.local_devices:  # pragma: no cover - multihost only
                 coords = self._coords[dev]
                 r = row_pos[self._dp_row(coords)]
                 shard = x[r * per_row : (r + 1) * per_row]
                 if self._seq_axis is not None and x.ndim > self.seq_dim and seq_size > 1:
                     s = coords[self._seq_axis]
-                    seq_len = x.shape[self.seq_dim]
-                    if seq_len % seq_size != 0:
-                        raise ValueError(
-                            f"sequence dim ({seq_len}) not divisible by {self._seq_axis} "
-                            f"size {seq_size}"
-                        )
-                    chunk = seq_len // seq_size
+                    chunk = x.shape[self.seq_dim] // seq_size
                     idx = [slice(None)] * x.ndim
                     idx[self.seq_dim] = slice(s * chunk, (s + 1) * chunk)
                     shard = shard[tuple(idx)]
                 shards.append(jax.device_put(shard, dev))
-                devices.append(dev)
             return jax.make_array_from_single_device_arrays(global_shape, sharding, shards)
 
         return recursively_apply(
@@ -525,6 +543,15 @@ class DataLoaderShard:
     Iteration protocol (reference ``__iter__:558-592``): fetch one batch ahead so
     ``GradientState.end_of_dataloader`` flips *on* the last batch (grad-accum must
     force a sync step there); sync host RNG across processes at epoch start.
+
+    With ``prefetch_depth > 0`` (default 2) the fetch + host-processing +
+    sharded transfer runs on a bounded background producer thread, so device
+    compute for batch N overlaps the input pipeline for batches N+1..N+depth.
+    Stateful snapshots, skip/resume, ``end_of_dataloader``/``remainder``
+    flagging and exception propagation are preserved exactly: every queue item
+    carries the snapshot taken right after ITS fetch, and flags are applied at
+    yield time on the consumer thread. ``prefetch_depth=0`` is the synchronous
+    path, byte-identical to the pre-prefetch behavior.
     """
 
     def __init__(
@@ -536,6 +563,7 @@ class DataLoaderShard:
         skip_batches: int = 0,
         total_expected_batches: Optional[int] = None,
         total_dataset_length: Optional[int] = None,
+        prefetch_depth: int = 2,
         _drop_last: bool = False,
         _non_blocking: bool = True,
     ):
@@ -545,6 +573,7 @@ class DataLoaderShard:
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
         self.gradient_state = GradientState()
+        self.prefetch_depth = max(0, int(prefetch_depth))
         self.end_of_dataloader = False
         self.remainder = -1
         self.iteration = 0  # epoch counter
@@ -675,25 +704,54 @@ class DataLoaderShard:
         must not poke it — its source may be rank-0-only)."""
         return self._stateful_inner
 
+    def _effective_prefetch_depth(self) -> int:
+        """How far the producer may run ahead this epoch (0 = synchronous)."""
+        return self.prefetch_depth
+
+    def _final_remainder(self, batch) -> Optional[int]:
+        """Real-row remainder of the epoch's final global batch, or None when
+        it cannot (or need not) be derived."""
+        if self.total_dataset_length is not None:
+            global_bs = self._global_batch_size(batch)
+            if global_bs:
+                return self.total_dataset_length % global_bs
+            return None
+        # unknown length (iterable source): the dispatcher header carried the
+        # final batch's REAL row count
+        real = getattr(self, "_last_data_real_bs", None)
+        full = getattr(self, "_last_data_global_bs", None)
+        if real is not None and full and real < full:
+            return real
+        return None
+
     # -- telemetry: data-wait accounting (step_profiler drains it per step) ----
-    def _timed_fetch(self, base_iter):
+    # ``critical=True`` (synchronous path) charges the duration to the step's
+    # ``data_wait_s``; the async producer emits the same phases off the
+    # critical path and only the consumer's queue-pop stall is charged.
+    def _timed_fetch(self, base_iter, critical: bool = True, totals: Optional[dict] = None):
         if not _tel.is_enabled():
             return self._fetch_batch(base_iter)
         t0 = time.monotonic()
         batch = self._fetch_batch(base_iter)
         dt = time.monotonic() - t0
-        record_data_wait(dt)
-        _tel.emit("data_wait", dur_s=round(dt, 6), phase="fetch")
+        if critical:
+            record_data_wait(dt)
+        if totals is not None:
+            totals["fetch_s"] += dt
+        _tel.emit("data_wait", dur_s=round(dt, 6), phase="fetch", critical=critical)
         return batch
 
-    def _timed_process(self, batch):
+    def _timed_process(self, batch, critical: bool = True, totals: Optional[dict] = None):
         if not _tel.is_enabled():
             return self._process(batch)
         t0 = time.monotonic()
         out = self._process(batch)
         dt = time.monotonic() - t0
-        record_data_wait(dt)
-        _tel.emit("data_wait", dur_s=round(dt, 6), phase="device_put")
+        if critical:
+            record_data_wait(dt)
+        if totals is not None:
+            totals["transfer_s"] += dt
+        _tel.emit("data_wait", dur_s=round(dt, 6), phase="transfer", critical=critical)
         return out
 
     def __iter__(self):
@@ -703,38 +761,10 @@ class DataLoaderShard:
         self.remainder = -1
         self._inner_finished = False  # a fresh epoch is not finished
         try:
-            base_iter = self._iter_base()
-            snapshots = self._snapshots_inner()
-            # prefetch-one-ahead so the last batch is flagged (reference :558-592)
-            current = self._timed_fetch(base_iter)
-            n = 0
-            while current is not _NO_BATCH:
-                if snapshots:
-                    # snapshot NOW — after `current` was pulled, before the
-                    # prefetch pulls `nxt` — so a resume from this snapshot
-                    # replays from the first un-consumed batch. Per-batch
-                    # snapshotting matches the reference adapter
-                    # (_update_state_dict per yield, data_loader.py:463-497).
-                    self._inner_snapshot = self.base_dataloader.state_dict()
-                nxt = self._timed_fetch(base_iter)
-                if n >= self.skip_batches:
-                    if nxt is _NO_BATCH:
-                        self.end_of_dataloader = True
-                        if self.total_dataset_length is not None:
-                            global_bs = self._global_batch_size(current)
-                            if global_bs:
-                                self.remainder = self.total_dataset_length % global_bs
-                        else:
-                            # unknown length (iterable source): the dispatcher
-                            # header carried the final batch's REAL row count
-                            real = getattr(self, "_last_data_real_bs", None)
-                            full = getattr(self, "_last_data_global_bs", None)
-                            if real is not None and full and real < full:
-                                self.remainder = real
-                    self._batches_seen = n + 1
-                    yield self._timed_process(current)
-                current = nxt
-                n += 1
+            if self._effective_prefetch_depth() > 0:
+                yield from self._iter_async()
+            else:
+                yield from self._iter_sync()
         finally:
             self.gradient_state._remove_dataloader(self)
             self.iteration += 1
@@ -745,6 +775,152 @@ class DataLoaderShard:
                 # a checkpoint taken after a COMPLETED epoch must resume at the
                 # next epoch's first batch, not skip a full epoch's worth
                 self._batches_seen = 0
+
+    def _iter_sync(self):
+        base_iter = self._iter_base()
+        snapshots = self._snapshots_inner()
+        # prefetch-one-ahead so the last batch is flagged (reference :558-592)
+        current = self._timed_fetch(base_iter)
+        n = 0
+        while current is not _NO_BATCH:
+            if snapshots:
+                # snapshot NOW — after `current` was pulled, before the
+                # prefetch pulls `nxt` — so a resume from this snapshot
+                # replays from the first un-consumed batch. Per-batch
+                # snapshotting matches the reference adapter
+                # (_update_state_dict per yield, data_loader.py:463-497).
+                self._inner_snapshot = self.base_dataloader.state_dict()
+            nxt = self._timed_fetch(base_iter)
+            if n >= self.skip_batches:
+                if nxt is _NO_BATCH:
+                    self.end_of_dataloader = True
+                    rem = self._final_remainder(current)
+                    if rem is not None:
+                        self.remainder = rem
+                self._batches_seen = n + 1
+                yield self._timed_process(current)
+            current = nxt
+            n += 1
+
+    def _iter_async(self):
+        """Bounded producer/consumer pipeline: the producer fetches, snapshots,
+        host-processes and issues the sharded device transfer for up to
+        ``prefetch_depth`` batches ahead; the consumer pops finished batches
+        and applies per-batch bookkeeping (snapshot served, end-of-epoch flags)
+        exactly where the synchronous path would."""
+        depth = self._effective_prefetch_depth()
+        q: _queue.Queue = _queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        skip = self.skip_batches
+        snapshots = self._snapshots_inner()
+        tel_on = _tel.is_enabled()
+        totals = {"fetch_s": 0.0, "transfer_s": 0.0}
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    if tel_on:
+                        _tel.gauge("prefetch_queue", q.qsize(), capacity=depth)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def _snap():
+            return self.base_dataloader.state_dict() if snapshots else None
+
+        def _produce():
+            try:
+                base_iter = self._iter_base()
+                current = self._timed_fetch(base_iter, critical=False, totals=totals)
+                snap = _snap() if current is not _NO_BATCH else None
+                n = 0
+                while current is not _NO_BATCH and not stop.is_set():
+                    nxt = self._timed_fetch(base_iter, critical=False, totals=totals)
+                    nxt_snap = _snap() if nxt is not _NO_BATCH else None
+                    if n >= skip:
+                        is_last = nxt is _NO_BATCH
+                        rem = self._final_remainder(current) if is_last else None
+                        processed = self._timed_process(current, critical=False, totals=totals)
+                        if not _put(("batch", (n, processed, snap, is_last, rem))):
+                            return
+                    current, snap = nxt, nxt_snap
+                    n += 1
+                if not stop.is_set():
+                    _put(("end", None))
+            except BaseException as exc:  # propagate into the consumer
+                _put(("exc", exc))
+
+        thread = threading.Thread(
+            target=_produce, name="accelerate-tpu-prefetch", daemon=True
+        )
+        thread.start()
+        stall_s = 0.0
+        yielded = 0
+        try:
+            while True:
+                t0 = time.monotonic()
+                while True:
+                    try:
+                        kind, payload = q.get(timeout=1.0)
+                        break
+                    except _queue.Empty:
+                        if not thread.is_alive():
+                            # the producer may have enqueued its final event in
+                            # the instant after our timeout — drain before
+                            # declaring it dead
+                            try:
+                                kind, payload = q.get_nowait()
+                                break
+                            except _queue.Empty:
+                                raise RuntimeError(
+                                    "prefetch producer thread died without a final event"
+                                ) from None
+                if _tel.is_enabled():
+                    dt = time.monotonic() - t0
+                    stall_s += dt
+                    record_data_wait(dt)
+                    _tel.emit(
+                        "data_wait", dur_s=round(dt, 6), phase="stall",
+                        critical=True, queued=q.qsize(),
+                    )
+                if kind == "end":
+                    return
+                if kind == "exc":
+                    raise payload
+                n, processed, snap, is_last, rem = payload
+                if snapshots and snap is not None:
+                    self._inner_snapshot = snap
+                if is_last:
+                    self.end_of_dataloader = True
+                    if rem is not None:
+                        self.remainder = rem
+                self._batches_seen = n + 1
+                yielded += 1
+                yield processed
+        finally:
+            stop.set()
+            while True:  # unblock a producer waiting on a full queue
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            thread.join(timeout=5.0)
+            if _tel.is_enabled():
+                busy = totals["fetch_s"] + totals["transfer_s"]
+                summary = dict(
+                    batches=yielded,
+                    depth=depth,
+                    fetch_s=round(totals["fetch_s"], 6),
+                    transfer_s=round(totals["transfer_s"], 6),
+                    stall_s=round(stall_s, 6),
+                )
+                if busy > 0:
+                    summary["overlap_ratio"] = round(
+                        max(0.0, min(1.0, 1.0 - stall_s / busy)), 6
+                    )
+                _tel.emit("prefetch_summary", **summary)
 
     def _process(self, batch):
         batch = _to_numpy_batch(batch)
@@ -790,6 +966,23 @@ class DataLoaderDispatcher(DataLoaderShard):
         # position AND a possibly rank-0-only source); checkpoints are written
         # by the main process, which holds the real position
         return self._stateful_inner and PartialState().is_main_process
+
+    def _effective_prefetch_depth(self) -> int:
+        depth = super()._effective_prefetch_depth()
+        if depth and PartialState().num_processes > 1:  # pragma: no cover - multihost only
+            # the dispatcher's per-batch rank-0 broadcast is a HOST collective:
+            # issuing it from a producer thread while user code (gather_for_
+            # metrics, broadcasts) runs collectives on the main thread would
+            # interleave differently per rank and deadlock. Broadcasts stay on
+            # the consumer thread, in iteration order — synchronous.
+            if not getattr(self, "_prefetch_downgrade_emitted", False):
+                self._prefetch_downgrade_emitted = True
+                _tel.emit(
+                    "prefetch_mode", mode="sync", requested_depth=depth,
+                    reason="dispatcher_multiprocess_collective_ordering",
+                )
+            return 0
+        return depth
 
     # -- signature registry (identical on every rank by construction) ---------
     def _ensure_sig_state(self):
@@ -847,8 +1040,8 @@ class DataLoaderDispatcher(DataLoaderShard):
         # pragma: no cover start - multihost only (exercised by the real
         # multi-process suite, tests/test_multiprocess.py)
         import jax
-        from jax.experimental import multihost_utils
 
+        from .utils.jax_compat import broadcast_one_to_all
         from .utils.operations import broadcast_object_list
 
         self._ensure_sig_state()
@@ -856,7 +1049,7 @@ class DataLoaderDispatcher(DataLoaderShard):
 
         def bcast_header(vals):
             arr = np.asarray(vals, np.int64)
-            return multihost_utils.broadcast_one_to_all(arr, is_source=is_main)
+            return broadcast_one_to_all(arr, is_source=is_main)
 
         if is_main:
             batch = next(base_iter, _NO_BATCH)
@@ -909,7 +1102,7 @@ class DataLoaderDispatcher(DataLoaderShard):
             buf = np.frombuffer(
                 b"".join(np.ascontiguousarray(x).tobytes() for x in leaves), np.uint8
             )
-            multihost_utils.broadcast_one_to_all(buf, is_source=True)
+            broadcast_one_to_all(buf, is_source=True)
             self._last_data_real_bs = real_bs
             self._last_data_global_bs = sig["bs"]
             return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -925,9 +1118,7 @@ class DataLoaderDispatcher(DataLoaderShard):
             self._last_data_global_bs = find_batch_size(batch) or 0
             return batch
         sig = self._sigs[sig_id]
-        buf = multihost_utils.broadcast_one_to_all(
-            np.zeros(sig["nbytes"], np.uint8), is_source=False
-        )
+        buf = broadcast_one_to_all(np.zeros(sig["nbytes"], np.uint8), is_source=False)
         # ONE host copy of the payload; per-leaf views via frombuffer offsets
         payload = np.asarray(buf).tobytes()
         leaves = [
@@ -1173,6 +1364,7 @@ def prepare_data_loader(
     data_seed: Optional[int] = None,
     use_seedable_sampler: bool = True,
     seq_dim: int = 1,
+    prefetch_depth: int = 2,
 ) -> DataLoaderShard:
     """Wrap a loader for the current mesh (reference ``prepare_data_loader:996``).
 
@@ -1216,6 +1408,7 @@ def prepare_data_loader(
                 dp_size=dp_size,
                 local_rows=len(local_rows),
                 split_batches=split_batches,
+                prefetch_depth=prefetch_depth,
             )
         else:
             new_dl = dataloader
@@ -1224,12 +1417,14 @@ def prepare_data_loader(
                 decision="dispatcher" if dispatch_batches else "no_reshard_needed",
                 dp_size=dp_size,
                 dispatch_batches=bool(dispatch_batches),
+                prefetch_depth=prefetch_depth,
             )
         return cls(
             new_dl,
             assembler=assembler,
             rng_types=rng_types,
             total_dataset_length=total_len,
+            prefetch_depth=prefetch_depth,
         )
 
     # torch DataLoader interop: rebuild a native loader over the same dataset when
@@ -1284,7 +1479,10 @@ def prepare_data_loader(
                         dp_size=dp_size,
                         dispatch_batches=bool(dispatch_batches),
                     )
-                return cls(dataloader, assembler=assembler, rng_types=rng_types)
+                return cls(
+                    dataloader, assembler=assembler, rng_types=rng_types,
+                    prefetch_depth=prefetch_depth,
+                )
             dataset = dataloader.dataset
             custom_batch_sampler = (
                 dataloader.batch_size is None  # torch sets None iff batch_sampler given
@@ -1311,7 +1509,10 @@ def prepare_data_loader(
                     "dataloader_reshard", decision="torch_as_is", dp_size=dp_size,
                     dispatch_batches=bool(dispatch_batches),
                 )
-                return cls(dataloader, assembler=assembler, rng_types=rng_types)
+                return cls(
+                    dataloader, assembler=assembler, rng_types=rng_types,
+                    prefetch_depth=prefetch_depth,
+                )
             shuffle = isinstance(sampler, tud.RandomSampler)
             native = DataLoader(
                 dataset,
@@ -1332,12 +1533,16 @@ def prepare_data_loader(
                 dispatch_batches=dispatch_batches,
                 rng_types=rng_types,
                 seq_dim=seq_dim,
+                prefetch_depth=prefetch_depth,
             )
     except ImportError:
         pass
 
     # generic iterable of batches
-    return cls(dataloader, assembler=assembler, rng_types=rng_types, total_dataset_length=total_len)
+    return cls(
+        dataloader, assembler=assembler, rng_types=rng_types,
+        total_dataset_length=total_len, prefetch_depth=prefetch_depth,
+    )
 
 
 class _InterleavedBatchSampler:

@@ -26,6 +26,28 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+_device_copy_fn = None
+
+
+def _device_copy(x):
+    """Fresh device buffer with identical value/dtype/sharding — never
+    concretizes to host (safe for multihost global arrays). One shared jitted
+    function so repeated leaves hit the trace cache instead of recompiling."""
+    global _device_copy_fn
+    if _device_copy_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _copy(a):
+            # a real computation, so XLA returns a new buffer instead of
+            # aliasing the input; dtype-exact (bool has no arithmetic `+ 0`)
+            if a.dtype == jnp.bool_:
+                return jnp.logical_and(a, True)
+            return a + jnp.zeros((), a.dtype)
+
+        _device_copy_fn = jax.jit(_copy)
+    return _device_copy_fn(x)
+
 
 class AcceleratedOptimizer:
     """Wraps an ``optax.GradientTransformation`` for mesh execution.
@@ -65,7 +87,23 @@ class AcceleratedOptimizer:
 
         ``zero1_axis``: shard otherwise-replicated state leaves over that mesh
         axis (ZeRO-1; see ``parallel.sharding.zero1_state_specs``)."""
+        import jax
+        import numpy as _np
+
         self.opt_state = self.optimizer.init(params)
+        # some optimizers (optax.contrib.schedule_free_*: z iterate) seed state
+        # leaves AS the param buffers; a donating train step would then donate
+        # the same buffer twice and XLA refuses. Copy aliased leaves once here.
+        param_ids = {id(x) for x in jax.tree_util.tree_leaves(params)}
+
+        def _unalias(x):
+            if id(x) not in param_ids or not hasattr(x, "dtype"):
+                return x
+            if isinstance(x, _np.ndarray):
+                return x.copy()
+            return _device_copy(x)
+
+        self.opt_state = jax.tree_util.tree_map(_unalias, self.opt_state)
         if mesh is not None and param_specs is not None:
             from .parallel.sharding import shard_like_params
 

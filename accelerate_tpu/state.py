@@ -71,7 +71,15 @@ class PartialState:
         coordinator = kwargs.pop("coordinator_address", None) or os.environ.get(
             "ACCELERATE_COORDINATOR_ADDRESS"
         )
-        if coordinator and not jax.distributed.is_initialized():
+        from .utils.jax_compat import distributed_is_initialized
+
+        if coordinator and not distributed_is_initialized():
+            if "cpu" in str(getattr(jax.config, "jax_platforms", "") or ""):
+                # CPU-backend multi-process (tests, dev boxes): collectives
+                # need an explicit implementation or the backend refuses them
+                from .utils.jax_compat import enable_cpu_multiprocess_collectives
+
+                enable_cpu_multiprocess_collectives()
             init_kwargs = {}
             if kwargs.get("local_device_ids") is not None:
                 init_kwargs["local_device_ids"] = kwargs.pop("local_device_ids")
@@ -241,8 +249,10 @@ class PartialState:
         yield chunk
 
     def destroy_process_group(self) -> None:
+        from .utils.jax_compat import distributed_is_initialized
+
         jax = _jax()
-        if jax.distributed.is_initialized():
+        if distributed_is_initialized():
             jax.distributed.shutdown()
 
     @classmethod

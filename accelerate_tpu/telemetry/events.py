@@ -26,6 +26,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import threading
 import time
 from typing import Any, Optional
 
@@ -91,6 +92,9 @@ class EventLog:
         self.dropped_events = 0
         self._buffer: list[dict] = []
         self._file = None
+        # the async data-pipeline producer emits from its own thread; buffer
+        # append + drain must not interleave
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------- identity --
     @staticmethod
@@ -119,8 +123,10 @@ class EventLog:
         if self.step is not None:
             rec["step"] = self.step
         rec.update(fields)
-        self._buffer.append(rec)
-        if len(self._buffer) >= self.flush_every:
+        with self._lock:
+            self._buffer.append(rec)
+            do_flush = len(self._buffer) >= self.flush_every
+        if do_flush:
             self.flush()
 
     def counter(self, name: str, value, **attrs) -> None:
@@ -155,30 +161,35 @@ class EventLog:
         self._file.write(json.dumps(header) + "\n")
 
     def flush(self) -> None:
-        if not self._buffer:
-            return
-        try:
-            self._open()
-            self._file.write("".join(json.dumps(r, default=str) + "\n" for r in self._buffer))
-            self._file.flush()
-        except OSError:
-            self.dropped_events += len(self._buffer)
-        self._buffer.clear()
+        with self._lock:
+            if not self._buffer:
+                return
+            pending, self._buffer = self._buffer, []
+            try:
+                self._open()
+                self._file.write("".join(json.dumps(r, default=str) + "\n" for r in pending))
+                self._file.flush()
+            except (OSError, ValueError):
+                # ValueError: write on a file another thread closed mid-race
+                self.dropped_events += len(pending)
 
     def close(self) -> None:
         if self.closed:
             return
         if self.dropped_events:
-            self._buffer.append(
-                {"kind": "dropped", "t": round(time.monotonic(), 6), "count": self.dropped_events}
-            )
+            with self._lock:
+                self._buffer.append(
+                    {"kind": "dropped", "t": round(time.monotonic(), 6), "count": self.dropped_events}
+                )
         self.flush()
         self.closed = True
-        if self._file is not None:
-            try:
-                self._file.close()
-            except OSError:
-                pass
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
 
 
 def _default_run_id() -> str:

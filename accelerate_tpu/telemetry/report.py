@@ -6,6 +6,9 @@
 - per-step wall-time / data-wait / execute percentiles (p50/p90/p99),
 - compile totals and the recompile count per compiled function — a nonzero
   recompile total after warmup is the classic silent reshape cliff,
+- a data-pipeline section: per-phase input wait (fetch / transfer / stall),
+  prefetch queue occupancy and the overlap ratio — how much of the input
+  pipeline was hidden behind device compute,
 - device/host memory peaks,
 - comms traffic per collective op (calls + payload bytes).
 
@@ -101,6 +104,37 @@ def build_report(paths: Iterable[str]) -> dict:
         rec["calls"] += 1
         rec["bytes"] += int(c.get("bytes", 0))
 
+    # -- data pipeline: per-phase waits + prefetch overlap --------------------
+    by_phase: dict = {}
+    critical_wait = 0.0
+    for w in waits:
+        phase = str(w.get("phase", "?"))
+        dur = float(w.get("dur_s", 0.0))
+        by_phase.setdefault(phase, []).append(dur)
+        # records predating the async pipeline carry no flag: they were
+        # synchronous, i.e. critical
+        if w.get("critical", True):
+            critical_wait += dur
+    summaries = [e for e in events if e.get("kind") == "prefetch_summary"]
+    occupancy = [
+        float(e.get("value", 0))
+        for e in events
+        if e.get("kind") == "gauge" and e.get("name") == "prefetch_queue"
+    ]
+    prefetch: dict = {
+        "epochs": len(summaries),
+        "batches": sum(int(s.get("batches", 0)) for s in summaries),
+        "fetch_s": round(sum(float(s.get("fetch_s", 0.0)) for s in summaries), 6),
+        "transfer_s": round(sum(float(s.get("transfer_s", 0.0)) for s in summaries), 6),
+        "stall_s": round(sum(float(s.get("stall_s", 0.0)) for s in summaries), 6),
+        "queue_occupancy": _dist(occupancy),
+    }
+    busy = prefetch["fetch_s"] + prefetch["transfer_s"]
+    if busy > 0:
+        prefetch["overlap_ratio"] = round(
+            max(0.0, min(1.0, 1.0 - prefetch["stall_s"] / busy)), 6
+        )
+
     report = {
         "schema": max((int(m.get("schema", 0)) for m in metas), default=0),
         "runs": sorted({str(m.get("run_id")) for m in metas if m.get("run_id")}),
@@ -127,6 +161,13 @@ def build_report(paths: Iterable[str]) -> dict:
             "total_calls": sum(r["calls"] for r in comm_ops.values()),
             "total_bytes": sum(r["bytes"] for r in comm_ops.values()),
             "by_op": dict(sorted(comm_ops.items())),
+        },
+        "data_pipeline": {
+            "phases": {
+                p: dict(_dist(v), total=round(sum(v), 6)) for p, v in sorted(by_phase.items())
+            },
+            "critical_wait_s": round(critical_wait, 6),
+            "prefetch": prefetch,
         },
         "data_wait_events": len(waits),
     }
@@ -162,6 +203,27 @@ def format_report(report: dict) -> str:
     for fn, n in r["by_fn"].items():
         if n:
             lines.append(f"  {fn}: {n} recompile(s) — check for varying input shapes/dtypes")
+    dp = report.get("data_pipeline") or {}
+    if dp.get("phases"):
+        lines.append(
+            f"data pipeline: critical wait {dp['critical_wait_s'] * 1e3:.2f}ms"
+        )
+        for phase, d in dp["phases"].items():
+            if d.get("count"):
+                lines.append(
+                    f"  {phase:<10} n={d['count']}  total={d['total'] * 1e3:.2f}ms  "
+                    f"p50={d['p50'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
+                )
+        pf = dp.get("prefetch") or {}
+        if pf.get("epochs"):
+            ratio = pf.get("overlap_ratio")
+            ratio_s = f"{ratio * 100:.1f}% of input work hidden" if ratio is not None else "n/a"
+            occ = pf.get("queue_occupancy") or {}
+            occ_s = f", queue occupancy p50={occ['p50']:.1f}" if occ.get("count") else ""
+            lines.append(
+                f"  prefetch: {pf['batches']} batch(es) over {pf['epochs']} epoch(s), "
+                f"overlap {ratio_s}{occ_s}"
+            )
     m = report["memory"]
     lines.append(
         "memory peaks: device "

@@ -140,6 +140,11 @@ class DataLoaderConfiguration(KwargsHandler):
     reference ``data_loader.py:704``); default per-process sharded reads.
     ``even_batches``: wrap around to equalize final batches (static shapes make this
     the strongly-recommended default under XLA).
+    ``prefetch_depth``: how many batches the background producer may fetch,
+    host-process and transfer to device ahead of the consuming step (no
+    reference counterpart — TPU-native async input pipeline, see
+    ``docs/data_pipeline.md``). ``0`` disables prefetching and restores fully
+    synchronous iteration.
     """
 
     split_batches: bool = False
@@ -149,6 +154,7 @@ class DataLoaderConfiguration(KwargsHandler):
     non_blocking: bool = True
     use_stateful_dataloader: bool = False
     data_seed: Optional[int] = None
+    prefetch_depth: int = 2
 
 
 @dataclass

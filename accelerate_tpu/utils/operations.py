@@ -211,14 +211,14 @@ def gather(tree):
         if _is_jax_array(x):
             x = _replicate_global_array(x)
             if not x.is_fully_addressable:  # pragma: no cover - multihost only
-                from jax.experimental import multihost_utils
+                from .jax_compat import process_allgather
 
-                return multihost_utils.process_allgather(x, tiled=True)
+                return process_allgather(x, tiled=True)
             return x
         if state.num_processes > 1:  # pragma: no cover - multihost only
-            from jax.experimental import multihost_utils
+            from .jax_compat import process_allgather
 
-            return multihost_utils.process_allgather(x, tiled=True)
+            return process_allgather(x, tiled=True)
         return x
 
     return recursively_apply(_gather, tree)
@@ -233,15 +233,15 @@ def gather_object(obj: Any) -> list[Any]:
             _record_comm("gather_object", nbytes=len(pickle.dumps(obj)))
         return [obj]
     # pragma: no cover - multihost only
-    from jax.experimental import multihost_utils
+    from .jax_compat import process_allgather
 
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     _record_comm("gather_object", nbytes=payload.size)
-    sizes = multihost_utils.process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
+    sizes = process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
     max_size = int(sizes.max())
     padded = np.zeros(max_size, dtype=np.uint8)
     padded[: payload.size] = payload
-    gathered = multihost_utils.process_allgather(padded, tiled=False)
+    gathered = process_allgather(padded, tiled=False)
     return [
         pickle.loads(gathered[i, : int(sizes[i])].tobytes()) for i in range(state.num_processes)
     ]
@@ -255,10 +255,10 @@ def broadcast(tree, from_process: int = 0):
     if state.num_processes == 1:
         return tree
     # pragma: no cover - multihost only
-    from jax.experimental import multihost_utils
+    from .jax_compat import broadcast_one_to_all
 
     def _bcast(x):
-        return multihost_utils.broadcast_one_to_all(x, is_source=state.process_index == from_process)
+        return broadcast_one_to_all(x, is_source=state.process_index == from_process)
 
     return recursively_apply(_bcast, tree)
 
@@ -271,16 +271,16 @@ def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
             _record_comm("broadcast_object_list", nbytes=len(pickle.dumps(object_list)))
         return object_list
     # pragma: no cover - multihost only
-    from jax.experimental import multihost_utils
+    from .jax_compat import broadcast_one_to_all
 
     is_source = state.process_index == from_process
     payload = np.frombuffer(pickle.dumps(object_list), dtype=np.uint8)
     _record_comm("broadcast_object_list", nbytes=payload.size)
-    size = multihost_utils.broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
+    size = broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
     buf = np.zeros(int(size[0]), dtype=np.uint8)
     if is_source:
         buf[:] = payload
-    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    buf = broadcast_one_to_all(buf, is_source=is_source)
     result = pickle.loads(buf.tobytes())
     object_list[:] = result
     return object_list
@@ -317,10 +317,11 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
             return x * scale
         if state.num_processes > 1:  # pragma: no cover - multihost only
             import jax
-            from jax.experimental import multihost_utils
+
+            from .jax_compat import process_allgather
 
             host_value = np.asarray(jax.device_get(x) if was_jax else x)
-            stacked = multihost_utils.process_allgather(host_value, tiled=False)
+            stacked = process_allgather(host_value, tiled=False)
             if reduction == "mean":
                 out = stacked.mean(axis=0) * scale
             elif reduction == "sum":
@@ -363,9 +364,9 @@ def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool
         if state.num_processes == 1:
             return x
         # pragma: no cover - multihost only
-        from jax.experimental import multihost_utils
+        from .jax_compat import process_allgather
 
-        sizes = multihost_utils.process_allgather(np.array([arr.shape[dim]]), tiled=False).reshape(-1)
+        sizes = process_allgather(np.array([arr.shape[dim]]), tiled=False).reshape(-1)
         max_size = int(sizes.max())
         if max_size == arr.shape[dim]:
             return x

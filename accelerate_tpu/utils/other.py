@@ -121,19 +121,25 @@ def is_port_in_use(port: int | None = None) -> bool:
         return s.connect_ex(("localhost", int(port))) == 0
 
 
-def check_os_kernel() -> None:
+def check_os_kernel(release: str | None = None) -> None:
     """Warn on Linux kernels older than 5.5 (reference ``check_os_kernel:501``:
-    MKL/threading stalls observed there affect host-side input pipelines)."""
+    MKL/threading stalls observed there affect host-side input pipelines).
+
+    ``release`` overrides the detected kernel release string (tests pin it so
+    the assertion does not depend on the host the suite happens to run on).
+    """
     info = platform.uname()
     if info.system != "Linux":
         return
+    if release is None:
+        release = info.release
     try:
-        version = tuple(int(p) for p in info.release.split(".")[:2])
+        version = tuple(int(p) for p in release.split(".")[:2])
     except ValueError:  # pragma: no cover - exotic kernel strings
         return
     if version < (5, 5):
         warnings.warn(
-            f"Detected Linux kernel {info.release} (< 5.5); host-side data "
+            f"Detected Linux kernel {release} (< 5.5); host-side data "
             "pipelines may stall on older kernels. Consider upgrading.",
             UserWarning,
         )

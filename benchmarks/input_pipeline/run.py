@@ -1,0 +1,104 @@
+"""Input-pipeline microbench: synchronous vs prefetched iteration.
+
+Measures end-to-end samples/sec of a ``DataLoaderShard`` loop whose dataset
+charges a per-item host cost (tokenization/disk stand-in) while each step
+pays a fixed compute cost — the exact shape the async prefetch pipeline
+(``docs/data_pipeline.md``) is built to hide. Emits one JSON line matching
+the bench.py conventions (``unit``/``value`` + per-variant detail), so the
+driver can track the overlap win across rounds.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+
+class _SleepyDataset:
+    def __init__(self, n, feat, delay_s):
+        self.n = n
+        self.feat = feat
+        self.delay_s = delay_s
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import numpy as np
+
+        time.sleep(self.delay_s)
+        return {"x": np.full((self.feat,), i, dtype=np.float32)}
+
+
+def _measure(steps, batch_size, feat, item_delay_s, compute_s, depth):
+    from accelerate_tpu.data_loader import DataLoader, DataLoaderShard
+
+    dl = DataLoaderShard(
+        DataLoader(_SleepyDataset(batch_size * steps, feat, item_delay_s), batch_size=batch_size),
+        prefetch_depth=depth,
+    )
+    it = iter(dl)
+    t0 = time.monotonic()
+    for _ in range(steps):
+        next(it)
+        time.sleep(compute_s)  # the "jitted step" the pipeline hides under
+    wall = time.monotonic() - t0
+    it.close()
+    return {
+        "samples_per_s": round(batch_size * steps / wall, 2),
+        "wall_s": round(wall, 4),
+        "step_ms": round(wall / steps * 1e3, 3),
+    }
+
+
+def run_bench_input_pipeline(
+    on_tpu: bool,
+    steps: int = 30,
+    batch_size: int = 16,
+    feat: int = 64,
+    item_delay_ms: float = 1.0,
+    compute_ms: float = 10.0,
+    depth: int = 2,
+) -> dict:
+    sync = _measure(steps, batch_size, feat, item_delay_ms / 1e3, compute_ms / 1e3, 0)
+    prefetch = _measure(steps, batch_size, feat, item_delay_ms / 1e3, compute_ms / 1e3, depth)
+    return {
+        "bench": "input_pipeline",
+        "unit": "speedup(prefetch/sync)",
+        "value": round(prefetch["samples_per_s"] / max(sync["samples_per_s"], 1e-9), 3),
+        "sync": sync,
+        "prefetch": prefetch,
+        "prefetch_depth": depth,
+        "steps": steps,
+        "batch_size": batch_size,
+        "item_delay_ms": item_delay_ms,
+        "compute_ms": compute_ms,
+        "on_tpu": on_tpu,
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--feat", type=int, default=64)
+    ap.add_argument("--item-delay-ms", type=float, default=1.0,
+                    help="per-item host cost the producer must hide")
+    ap.add_argument("--compute-ms", type=float, default=10.0,
+                    help="per-step compute the pipeline overlaps with")
+    ap.add_argument("--depth", type=int, default=2, help="prefetch_depth for the async variant")
+    args = ap.parse_args()
+    emit(
+        run_bench_input_pipeline(
+            on_tpu=detect_backend(),
+            steps=args.steps,
+            batch_size=args.batch_size,
+            feat=args.feat,
+            item_delay_ms=args.item_delay_ms,
+            compute_ms=args.compute_ms,
+            depth=args.depth,
+        )
+    )

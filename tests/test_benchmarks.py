@@ -36,6 +36,22 @@ def test_long_context_benchmark_honors_seq_knob():
     assert out["seq_len"] == 512  # the CLI knob actually reached the workload
 
 
+def test_input_pipeline_benchmark_smoke():
+    """Fast tier-1 smoke: the sync-vs-prefetch microbench runs and emits the
+    contract keys (overlap correctness itself is asserted by
+    test_data_loader's acceptance test; a loaded CI box makes speedup-margin
+    assertions here flaky)."""
+    out = run_script(
+        "benchmarks/input_pipeline/run.py",
+        "--steps", "6", "--item-delay-ms", "1", "--compute-ms", "5",
+    )
+    assert out["bench"] == "input_pipeline"
+    assert out["unit"] == "speedup(prefetch/sync)" and out["value"] > 0
+    assert out["sync"]["samples_per_s"] > 0
+    assert out["prefetch"]["samples_per_s"] > 0
+    assert out["prefetch_depth"] == 2
+
+
 def test_benchmark_dirs_are_documented():
     dirs = [p for p in (REPO / "benchmarks").iterdir() if p.is_dir() and p.name != "__pycache__"]
     assert len(dirs) >= 5

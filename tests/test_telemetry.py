@@ -179,9 +179,38 @@ def test_dataloader_emits_data_wait(tmp_path):
     tel.disable()
     records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
     waits = [r for r in records if r["kind"] == "data_wait"]
-    assert waits and {w["phase"] for w in waits} == {"fetch", "device_put"}
+    # async prefetch (the default): producer-side fetch/transfer are emitted
+    # off the critical path, the consumer's queue-pop stall is the only
+    # critical wait
+    assert waits and {w["phase"] for w in waits} == {"fetch", "transfer", "stall"}
+    assert all(not w["critical"] for w in waits if w["phase"] in ("fetch", "transfer"))
+    assert all(w["critical"] for w in waits if w["phase"] == "stall")
+    occupancy = [r for r in records if r["kind"] == "gauge" and r["name"] == "prefetch_queue"]
+    assert occupancy and all(0 <= g["value"] <= g["capacity"] for g in occupancy)
+    summary = [r for r in records if r["kind"] == "prefetch_summary"]
+    assert len(summary) == 1 and summary[0]["batches"] == 1 and summary[0]["depth"] == 2
     reshard = [r for r in records if r["kind"] == "dataloader_reshard"]
     assert reshard and reshard[0]["decision"] == "native_sampler_sharded"
+    assert reshard[0]["prefetch_depth"] == 2
+
+
+def test_dataloader_sync_path_data_wait(tmp_path):
+    """prefetch_depth=0: the synchronous path charges fetch + transfer to the
+    critical path (pre-prefetch behavior)."""
+    from accelerate_tpu.utils import DataLoaderConfiguration
+
+    tel.enable(str(tmp_path))
+    acc = Accelerator(dataloader_config=DataLoaderConfiguration(prefetch_depth=0))
+    data = [{"x": np.ones((4,), np.float32)} for _ in range(64)]
+    dl = acc.prepare(DataLoader(data, batch_size=8))
+    for _ in dl:
+        pass
+    tel.disable()
+    records = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    waits = [r for r in records if r["kind"] == "data_wait"]
+    assert waits and {w["phase"] for w in waits} == {"fetch", "transfer"}
+    assert all(w["critical"] for w in waits)
+    assert not [r for r in records if r["kind"] == "prefetch_summary"]
 
 
 def test_stateful_loader_under_dp_routes_to_dispatcher(tmp_path):

@@ -205,8 +205,18 @@ def test_get_pretty_name_and_recursive_getattr():
 
 
 def test_check_os_kernel_no_warning_on_modern_kernel(recwarn):
-    u.check_os_kernel()
+    # pin the release: the suite must not depend on the host's own kernel
+    u.check_os_kernel(release="5.15.0-1052-gcp")
     assert not [w for w in recwarn.list if "kernel" in str(w.message)]
+
+
+def test_check_os_kernel_warns_on_old_kernel():
+    import platform
+
+    if platform.system() != "Linux":
+        pytest.skip("kernel check is Linux-only")
+    with pytest.warns(UserWarning, match="kernel 4.4.0"):
+        u.check_os_kernel(release="4.4.0")
 
 
 def test_merge_fsdp_weights_is_shard_merge():
