@@ -41,6 +41,7 @@ from .utils.dataclasses import (
     PrecisionType,
     ProfileConfig,
     ProjectConfiguration,
+    WatchdogConfig,
 )
 from .utils import operations as ops
 
@@ -204,6 +205,7 @@ class Accelerator:
         project_dir: Optional[str] = None,
         jit_config: Optional[JitConfig] = None,
         grad_scaler_config: Optional[GradScalerConfig] = None,
+        watchdog_config: Optional[WatchdogConfig] = None,
         shard_rules: Optional[ShardingRules] = None,
         rng_types: Optional[Sequence[str]] = None,
         rng_seed: Optional[int] = None,
@@ -431,6 +433,36 @@ class Accelerator:
         )
         self._step_telemetry = _telemetry.StepTelemetry()
         self._compiled_counts: dict[str, int] = {}
+        # Hang/crash forensics (telemetry/flight_recorder.py, telemetry/
+        # watchdog.py): the ring buffer records regardless (pure memory); crash
+        # handlers and the heartbeat thread arm only when asked — a default run
+        # pays one env/flag check here and nothing per step.
+        from .telemetry import flight_recorder as _flight
+        from .telemetry import watchdog as _watchdog
+
+        self.watchdog_config = watchdog_config or WatchdogConfig()
+        flight_dir = self.watchdog_config.flight_dir
+        if flight_dir is None:
+            log = _telemetry.get_event_log()
+            if log is not None:
+                flight_dir = log.out_dir
+            elif self.project_dir:
+                flight_dir = os.path.join(self.project_dir, "telemetry")
+        if (
+            self.watchdog_config.enabled
+            or _flight.enabled_from_env()
+            or _telemetry.is_enabled()
+        ):
+            _flight.install(out_dir=flight_dir)
+        self._watchdog_started = False
+        if self.watchdog_config.enabled and not _watchdog.is_active():
+            _watchdog.start(
+                timeout=self.watchdog_config.timeout,
+                interval=self.watchdog_config.interval,
+                abort_on_stall=self.watchdog_config.abort_on_stall,
+                out_dir=flight_dir,
+            )
+            self._watchdog_started = True
         if rng_seed is not None:
             from .utils.random import set_seed
 
@@ -981,15 +1013,24 @@ class Accelerator:
         # params/opt_state to save_state explicitly)
         model_slot = 0 if len(self._models) == 1 else None
         from .telemetry import events as _tel
+        from .telemetry import flight_recorder as _flight
+        from .telemetry import watchdog as _watchdog
 
         step_telemetry = self._step_telemetry
+        flight = _flight.get_recorder()
 
         def step_and_track(params, opt_state, batch):
+            # forensics: the flight ring always knows the current step, and an
+            # active watchdog hears one beat per step (a rank whose beats stop
+            # is stalled; its open phases name what it is blocked in)
+            flight.step = step_telemetry.step_index
+            _watchdog.beat("train_step", step=step_telemetry.step_index)
             if _tel.is_enabled():
                 with step_telemetry.step():
                     new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
             else:
                 new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+                step_telemetry.step_index += 1
             optimizer.opt_state = new_opt_state
             if model_slot is not None:
                 self._models[model_slot] = new_params
@@ -1592,9 +1633,17 @@ class Accelerator:
 
     def end_training(self):
         from .telemetry import events as _tel
+        from .telemetry import watchdog as _watchdog
 
         if _tel.is_enabled() and self.trackers:
             self.log_telemetry_summary()
+        # forensics teardown: training no longer beats, so the train-step
+        # source must stop being watched (a finished run is not a stall) and a
+        # watchdog we started is stopped with it
+        _watchdog.unregister("train_step")
+        if self._watchdog_started:
+            _watchdog.stop()
+            self._watchdog_started = False
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()

@@ -40,11 +40,32 @@ import numpy as np
 from .parallelism_config import ParallelismConfig
 from .state import GradientState, PartialState
 from .telemetry import events as _tel
+from .telemetry import flight_recorder as _flight
+from .telemetry import watchdog as _watchdog
 from .telemetry.step_profiler import record_data_wait
 from .utils.dataclasses import DataLoaderConfiguration
 from .utils.operations import find_batch_size, recursively_apply, send_to_device
 
 _NO_BATCH = object()
+
+
+def _pop_next(q: "_queue.Queue", thread: threading.Thread):
+    """Block for the producer's next event, detecting a dead producer.
+    Annotated by the caller as the ``prefetch_wait`` flight phase, so a
+    consumer starved by a wedged (but alive) producer is diagnosable too."""
+    while True:
+        try:
+            return q.get(timeout=1.0)
+        except _queue.Empty:
+            if not thread.is_alive():
+                # the producer may have enqueued its final event in the
+                # instant after our timeout — drain before declaring it dead
+                try:
+                    return q.get_nowait()
+                except _queue.Empty:
+                    raise RuntimeError(
+                        "prefetch producer thread died without a final event"
+                    ) from None
 
 
 # ---------------------------------------------------------------------------
@@ -730,9 +751,14 @@ class DataLoaderShard:
     # critical path and only the consumer's queue-pop stall is charged.
     def _timed_fetch(self, base_iter, critical: bool = True, totals: Optional[dict] = None):
         if not _tel.is_enabled():
-            return self._fetch_batch(base_iter)
+            # flight-phase annotation survives the telemetry kill switch: a
+            # hang inside the dataset shows as "blocked in data_fetch" in a
+            # watchdog/crash dump even when no JSONL stream is being written
+            with _flight.phase("data_fetch"):
+                return self._fetch_batch(base_iter)
         t0 = time.monotonic()
-        batch = self._fetch_batch(base_iter)
+        with _flight.phase("data_fetch"):
+            batch = self._fetch_batch(base_iter)
         dt = time.monotonic() - t0
         if critical:
             record_data_wait(dt)
@@ -743,9 +769,11 @@ class DataLoaderShard:
 
     def _timed_process(self, batch, critical: bool = True, totals: Optional[dict] = None):
         if not _tel.is_enabled():
-            return self._process(batch)
+            with _flight.phase("data_transfer"):
+                return self._process(batch)
         t0 = time.monotonic()
-        out = self._process(batch)
+        with _flight.phase("data_transfer"):
+            out = self._process(batch)
         dt = time.monotonic() - t0
         if critical:
             record_data_wait(dt)
@@ -824,11 +852,21 @@ class DataLoaderShard:
                         _tel.gauge("prefetch_queue", q.qsize(), capacity=depth)
                     return True
                 except _queue.Full:
+                    # blocked on a full queue means the producer is *ahead* of
+                    # the consumer, not stalled — keep the heartbeat fresh so a
+                    # slow train step can't read as a producer stall
+                    _watchdog.beat(wd_source, queue_full=True)
                     continue
             return False
 
         def _snap():
             return self.base_dataloader.state_dict() if snapshots else None
+
+        # watchdog registration: the producer beats once per produced batch,
+        # under its own name — so a hang report distinguishes "the input
+        # pipeline stopped producing" from "a rank is blocked in a collective"
+        wd_source = f"prefetch_producer@{id(self):x}"
+        _watchdog.register(wd_source, depth=depth)
 
         def _produce():
             try:
@@ -837,6 +875,7 @@ class DataLoaderShard:
                 snap = _snap() if current is not _NO_BATCH else None
                 n = 0
                 while current is not _NO_BATCH and not stop.is_set():
+                    _watchdog.beat(wd_source, batch=n)
                     nxt = self._timed_fetch(base_iter, critical=False, totals=totals)
                     nxt_snap = _snap() if nxt is not _NO_BATCH else None
                     if n >= skip:
@@ -851,6 +890,11 @@ class DataLoaderShard:
                     _put(("end", None))
             except BaseException as exc:  # propagate into the consumer
                 _put(("exc", exc))
+            finally:
+                # the consumer may spend several step-times draining the queue
+                # after the final put; unregister from the producer's own exit
+                # so that healthy drain window cannot read as a producer stall
+                _watchdog.unregister(wd_source)
 
         thread = threading.Thread(
             target=_produce, name="accelerate-tpu-prefetch", daemon=True
@@ -861,22 +905,8 @@ class DataLoaderShard:
         try:
             while True:
                 t0 = time.monotonic()
-                while True:
-                    try:
-                        kind, payload = q.get(timeout=1.0)
-                        break
-                    except _queue.Empty:
-                        if not thread.is_alive():
-                            # the producer may have enqueued its final event in
-                            # the instant after our timeout — drain before
-                            # declaring it dead
-                            try:
-                                kind, payload = q.get_nowait()
-                                break
-                            except _queue.Empty:
-                                raise RuntimeError(
-                                    "prefetch producer thread died without a final event"
-                                ) from None
+                with _flight.phase("prefetch_wait"):
+                    kind, payload = _pop_next(q, thread)
                 if _tel.is_enabled():
                     dt = time.monotonic() - t0
                     stall_s += dt
@@ -901,6 +931,7 @@ class DataLoaderShard:
                 yield processed
         finally:
             stop.set()
+            _watchdog.unregister(wd_source)  # clean shutdown is not a stall
             while True:  # unblock a producer waiting on a full queue
                 try:
                     q.get_nowait()

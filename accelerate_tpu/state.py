@@ -49,6 +49,47 @@ def is_initialized() -> bool:
     return PartialState._shared_state.get("_initialized", False)
 
 
+def _forensic_env_int(key: str, default: int) -> int:
+    # a malformed launcher env (set-but-blank template var) must not crash the
+    # crash handler itself — identity degrades to the default, never raises
+    try:
+        return int(os.environ.get(key, "") or default)
+    except (TypeError, ValueError):
+        return default
+
+
+def process_identity() -> "dict[str, Any]":
+    """Rank/host identity for forensic artifacts (flight records, watchdog
+    dumps). Safe to call from signal handlers and background threads: when
+    :class:`PartialState` is not yet initialized it answers from the launcher
+    env protocol instead of booting ``jax.distributed`` (which could itself
+    hang — the exact failure being diagnosed)."""
+    import socket
+
+    ident: dict[str, Any] = {"pid": os.getpid()}
+    try:
+        ident["hostname"] = socket.gethostname()
+    except OSError:
+        ident["hostname"] = "?"
+    if is_initialized():
+        state = PartialState()
+        ident.update(
+            process_index=state.process_index,
+            num_processes=state.num_processes,
+            local_process_index=state.local_process_index,
+            backend=state.backend,
+            run_id=state.run_id,
+        )
+        return ident
+    ident.update(
+        process_index=_forensic_env_int("ACCELERATE_PROCESS_ID", 0),
+        num_processes=_forensic_env_int("ACCELERATE_NUM_PROCESSES", 1),
+        local_process_index=_forensic_env_int("ACCELERATE_LOCAL_PROCESS_INDEX", 0),
+        run_id=os.environ.get("ACCELERATE_RUN_ID"),
+    )
+    return ident
+
+
 class PartialState:
     """Singleton holding process topology: how many processes, which one am I,
     which devices are mine. First construction performs multi-host initialization

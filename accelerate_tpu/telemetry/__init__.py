@@ -12,8 +12,17 @@ to a loss-curve tracker. Layout:
 - :mod:`.step_profiler` — per-step wall/data-wait/compile/execute split plus
   recompile detection (per-function jit cache-miss counting).
 - :mod:`.memory` — device/host memory watermarks sampled at step boundaries.
+- :mod:`.flight_recorder` — always-on in-memory ring of recent events plus
+  crash handlers (SIGTERM / unhandled exception / faulthandler) that dump
+  ``flight-rank<k>.json`` post-mortems: ring, all-thread stacks, open phases,
+  memory snapshot.
+- :mod:`.watchdog` — heartbeat thread (``ACCELERATE_WATCHDOG_TIMEOUT``) that
+  detects stalled heartbeat sources and blocked phases (e.g. a rank stuck in
+  ``collective:gather``), dumps the flight record and optionally aborts.
 - :mod:`.report` — ``python -m accelerate_tpu.telemetry report <dir>``
-  aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes).
+  aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes;
+  ``--by-rank`` adds cross-rank straggler/heartbeat/flight forensics) and the
+  ``doctor`` self-check subcommand.
 - :mod:`.tracker_bridge` — mirrors report summaries into ``tracking.py``
   trackers so the metrics land wherever users already log.
 
@@ -21,6 +30,7 @@ Comms counters live in :mod:`accelerate_tpu.utils.operations` (the ops being
 counted) and write through :mod:`.events`.
 """
 
+from . import flight_recorder, watchdog
 from .events import (
     TELEMETRY_DIR_ENV_VAR,
     TELEMETRY_ENV_VAR,
@@ -33,31 +43,38 @@ from .events import (
     enabled_from_env,
     gauge,
     get_event_log,
+    hard_flush,
     is_enabled,
     maybe_enable_from_env,
     set_step,
     span,
 )
+from .flight_recorder import FlightRecorder
 from .memory import MemoryMonitor, device_memory_stats, host_memory_bytes, live_array_bytes
 from .step_profiler import RecompileWatcher, StepTelemetry, record_data_wait
 from .tracker_bridge import mirror_to_trackers, summary_metrics
+from .watchdog import Watchdog
 
 __all__ = [
     "TELEMETRY_DIR_ENV_VAR",
     "TELEMETRY_ENV_VAR",
     "TELEMETRY_SCHEMA_VERSION",
     "EventLog",
+    "FlightRecorder",
     "MemoryMonitor",
     "RecompileWatcher",
     "StepTelemetry",
+    "Watchdog",
     "counter",
     "device_memory_stats",
     "disable",
     "emit",
     "enable",
     "enabled_from_env",
+    "flight_recorder",
     "gauge",
     "get_event_log",
+    "hard_flush",
     "host_memory_bytes",
     "is_enabled",
     "live_array_bytes",
@@ -67,4 +84,5 @@ __all__ = [
     "set_step",
     "span",
     "summary_metrics",
+    "watchdog",
 ]

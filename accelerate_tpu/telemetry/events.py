@@ -173,6 +173,34 @@ class EventLog:
                 # ValueError: write on a file another thread closed mid-race
                 self.dropped_events += len(pending)
 
+    def hard_flush(self) -> None:
+        """Crash-handler flush: drain the buffer AND fsync so a dump written
+        moments before the process dies is actually on disk. May run inside a
+        signal handler that interrupted a frame already holding ``_lock``
+        (``emit`` flushes every 64 events), so the acquire is bounded — the
+        dying process must never deadlock on itself; worst case the buffered
+        tail is dropped, never the dump."""
+        if not self._lock.acquire(timeout=2.0):
+            return
+        try:
+            if self._buffer:
+                pending, self._buffer = self._buffer, []
+                try:
+                    self._open()
+                    self._file.write(
+                        "".join(json.dumps(r, default=str) + "\n" for r in pending)
+                    )
+                except (OSError, ValueError):
+                    self.dropped_events += len(pending)
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except (OSError, ValueError):
+                    pass
+        finally:
+            self._lock.release()
+
     def close(self) -> None:
         if self.closed:
             return
@@ -296,3 +324,9 @@ def set_step(step: Optional[int]) -> None:
 def flush() -> None:
     if _ACTIVE is not None:
         _ACTIVE.flush()
+
+
+def hard_flush() -> None:
+    """Crash-path flush+fsync of the active log (no-op when disabled)."""
+    if _ACTIVE is not None:
+        _ACTIVE.hard_flush()

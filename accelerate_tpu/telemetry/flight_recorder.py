@@ -1,0 +1,462 @@
+"""Flight recorder: an always-on in-memory ring of recent events plus crash
+handlers that turn a dead process into a post-mortem artifact.
+
+The JSONL event log (:mod:`.events`) explains a *slow* step; this module
+explains a *dead* one. Three observed failure modes motivate it (bench rounds
+3-5): a TPU probe that "hung past 150s (killed)" with zero evidence of where,
+a SIGTERM from the driver that took the buffered event log with it, and
+multihost stalls with no per-rank visibility.
+
+Design contract:
+
+- **The ring is always on.** :func:`record` appends a small dict to a bounded
+  ``deque`` — no lock, no syscall, no file — so the last
+  ``ACCELERATE_FLIGHT_CAPACITY`` (default 256) events exist in memory even
+  when JSONL telemetry is disabled. A dump written seconds after a hang
+  therefore shows the *minutes before* it.
+- **Phases name what a thread is blocked in.** ``with phase("collective:gather")``
+  marks a region a thread may block inside (collectives, backend init, data
+  fetch). :func:`current_phases` reports each thread's innermost open phase
+  and its age — the watchdog (:mod:`.watchdog`) uses exactly this to say
+  *which collective* a rank is stuck in.
+- **Crash handlers are opt-in** (:func:`install`): a SIGTERM handler and an
+  ``sys.excepthook`` wrapper dump ``flight-rank<k>.json`` and hard-flush the
+  EventLog before the process dies; ``faulthandler`` is enabled against
+  ``crash-rank<k>.stacks`` for the signals Python-level JSON cannot survive
+  (SIGSEGV/SIGABRT). Nothing is installed — no handler, no thread, no file —
+  until :func:`install` (or the Accelerator, when forensics are enabled) asks.
+
+The dump itself (:meth:`FlightRecorder.dump`) contains the ring, all-thread
+Python stacks, the current step and open phases, a device-memory snapshot
+(only when a jax backend is *already* initialized — dumping must never touch a
+possibly-hung backend), and the rank/host identity from
+:func:`accelerate_tpu.state.process_identity`.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Optional
+
+from . import events as tel
+
+FLIGHT_ENV_VAR = "ACCELERATE_FLIGHT"
+FLIGHT_DIR_ENV_VAR = "ACCELERATE_FLIGHT_DIR"
+FLIGHT_CAPACITY_ENV_VAR = "ACCELERATE_FLIGHT_CAPACITY"
+FLIGHT_SCHEMA_VERSION = 1
+FLIGHT_FILE_PREFIX = "flight-rank"
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+
+
+def _default_capacity() -> int:
+    try:
+        return max(16, int(os.environ.get(FLIGHT_CAPACITY_ENV_VAR, 256)))
+    except (TypeError, ValueError):
+        return 256
+
+
+class _Phase:
+    """Open-region marker: records enter/exit in the ring and exposes the
+    region to :func:`current_phases` while a thread is inside it."""
+
+    __slots__ = ("rec", "name", "attrs", "t0", "ident")
+
+    def __init__(self, rec: "FlightRecorder", name: str, attrs: dict):
+        self.rec = rec
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.ident = 0
+
+    def __enter__(self) -> "_Phase":
+        self.ident = threading.get_ident()
+        self.t0 = time.monotonic()
+        # per-thread stack: only this thread appends/pops its own list, so no
+        # lock is needed; readers (watchdog/dump) take snapshots
+        self.rec._phases.setdefault(self.ident, []).append(self)
+        self.rec.record("phase_enter", name=self.name, **self.attrs)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        stack = self.rec._phases.get(self.ident)
+        if stack and stack[-1] is self:
+            stack.pop()
+        self.rec.record(
+            "phase_exit", name=self.name, dur_s=round(time.monotonic() - self.t0, 6)
+        )
+        return False
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring + dump/crash-handler machinery for one
+    process. Normally used through the module-level singleton
+    (:func:`get_recorder` / :func:`record` / :func:`phase`)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.events: deque = deque(maxlen=capacity or _default_capacity())
+        self.step: Optional[int] = None
+        self.out_dir: Optional[str] = None
+        self.meta: dict = {}
+        self.dump_count = 0
+        self.last_dump_path: Optional[str] = None
+        self._phases: "dict[int, list[_Phase]]" = {}
+        self._installed = False
+        self._prev_sigterm = None
+        self._prev_excepthook = None
+        self._crash_stacks_file = None
+        self._prev_faulthandler_enabled = False
+
+    # ------------------------------------------------------------ recording --
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event to the ring. Allocation-cheap and thread-safe
+        (``deque.append`` is atomic); never touches a file."""
+        rec: dict = {"t": round(time.monotonic(), 6), "kind": kind}
+        if self.step is not None:
+            rec["step"] = self.step
+        if fields:
+            rec.update(fields)
+        self.events.append(rec)
+
+    def set_step(self, step: Optional[int]) -> None:
+        self.step = step
+
+    def phase(self, name: str, **attrs: Any) -> _Phase:
+        """``with recorder.phase("collective:gather", op="gather"): ...`` —
+        annotate a region this thread may block in."""
+        return _Phase(self, name, attrs)
+
+    def current_phases(self) -> "dict[str, dict]":
+        """Innermost open phase per thread: ``{thread_name: {"phase", "age_s",
+        "thread_id", ...attrs}}``. Safe to call from any thread."""
+        now = time.monotonic()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out: dict = {}
+        for ident, stack in list(self._phases.items()):
+            try:
+                ph = stack[-1]
+            except IndexError:  # owner thread popped between check and read
+                continue
+            key = names.get(ident, f"thread-{ident}")
+            if key in out:  # same-named threads (e.g. two prefetch producers)
+                key = f"{key}#{ident}"
+            out[key] = {
+                "phase": ph.name,
+                "age_s": round(now - ph.t0, 3),
+                "enter_t": round(ph.t0, 6),
+                "thread_id": ident,
+                **ph.attrs,
+            }
+        return out
+
+    def snapshot(self) -> "list[dict]":
+        # deque.append is atomic, but iterating while another thread appends
+        # raises RuntimeError — retry; the ring is bounded so a quiet window
+        # always comes
+        for _ in range(8):
+            try:
+                return list(self.events)
+            except RuntimeError:
+                continue
+        return []
+
+    # ----------------------------------------------------------------- dump --
+    @staticmethod
+    def _thread_stacks() -> "list[dict]":
+        names = {t.ident: (t.name, t.daemon) for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            name, daemon = names.get(ident, (f"thread-{ident}", None))
+            out.append(
+                {
+                    "thread_id": ident,
+                    "name": name,
+                    "daemon": daemon,
+                    "stack": traceback.format_stack(frame),
+                }
+            )
+        return out
+
+    @staticmethod
+    def _memory_snapshot() -> Optional[dict]:
+        """Memory view IF it can be taken without waking a possibly-hung
+        backend: device stats only when a jax backend already exists."""
+        snap: dict = {}
+        try:
+            from .memory import host_memory_bytes
+
+            snap["host_rss_bytes"] = host_memory_bytes()
+        except Exception:
+            pass
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                from jax._src import xla_bridge
+
+                initialized = bool(getattr(xla_bridge, "_backends", None))
+            except Exception:
+                initialized = False
+            if initialized:
+                try:
+                    from .memory import device_memory_stats, live_array_bytes
+
+                    snap["live_array_bytes"] = live_array_bytes()
+                    snap["devices"] = device_memory_stats()
+                except Exception:
+                    pass
+        return snap or None
+
+    def _resolve_out_dir(self, out_dir: Optional[str] = None) -> str:
+        if out_dir:
+            return out_dir
+        if self.out_dir:
+            return self.out_dir
+        env = os.environ.get(FLIGHT_DIR_ENV_VAR) or os.environ.get(
+            tel.TELEMETRY_DIR_ENV_VAR
+        )
+        if env:
+            return env
+        log = tel.get_event_log()
+        if log is not None:
+            return log.out_dir
+        return "telemetry"
+
+    def dump(
+        self, reason: str, out_dir: Optional[str] = None, extra: Optional[dict] = None
+    ) -> Optional[str]:
+        """Write ``flight-rank<k>.json`` (atomic replace) and hard-flush the
+        EventLog. Returns the path, or None — a dump must never raise into the
+        crashing/watching code path."""
+        def _part(fn, default):
+            # one torn section (a racing thread, a sick backend) must not cost
+            # the whole post-mortem
+            try:
+                return fn()
+            except Exception:
+                return default
+
+        try:
+            from ..state import process_identity
+
+            ident = dict(process_identity())
+            ident.update(self.meta)
+            out_dir = self._resolve_out_dir(out_dir)
+            payload = {
+                "kind": "flight_record",
+                "schema": FLIGHT_SCHEMA_VERSION,
+                "reason": reason,
+                "unix_time": time.time(),
+                "t": round(time.monotonic(), 6),
+                "meta": ident,
+                "step": self.step,
+                "phases": _part(self.current_phases, {}),
+                "events": _part(self.snapshot, []),
+                "threads": _part(self._thread_stacks, []),
+                "memory": _part(self._memory_snapshot, None),
+            }
+            if extra:
+                payload.update(extra)
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(
+                out_dir, f"{FLIGHT_FILE_PREFIX}{ident.get('process_index', 0)}.json"
+            )
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+            os.replace(tmp, path)
+            self.dump_count += 1
+            self.last_dump_path = path
+        except Exception:
+            return None
+        finally:
+            try:
+                tel.hard_flush()
+            except Exception:
+                pass
+        return path
+
+    # ------------------------------------------------------- crash handlers --
+    def install(self, out_dir: Optional[str] = None, meta: Optional[dict] = None) -> None:
+        """Arm the crash handlers (idempotent): SIGTERM → dump + chain,
+        unhandled exception → dump + chain, SIGSEGV/SIGABRT/... → faulthandler
+        stacks into ``crash-rank<k>.stacks``."""
+        if out_dir:
+            self.out_dir = out_dir
+        if meta:
+            self.meta.update(meta)
+        if self._installed:
+            return
+        self._installed = True
+
+        def _on_sigterm(signum, frame):
+            self.record("signal", signum=signum)
+            self.dump(f"signal {signal.Signals(signum).name}")
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)  # die with the signal's exit status
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not the main thread: signal handlers unavailable
+            self._prev_sigterm = None
+
+        prev_hook = sys.excepthook
+        self._prev_excepthook = prev_hook
+
+        def _on_exception(exc_type, exc, tb):
+            self.dump(f"unhandled exception: {exc_type.__name__}: {exc}")
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = _on_exception
+
+        try:
+            import faulthandler
+
+            from ..state import process_identity
+
+            self._prev_faulthandler_enabled = faulthandler.is_enabled()
+            rank = process_identity().get("process_index", 0)
+            stacks_dir = self._resolve_out_dir()
+            os.makedirs(stacks_dir, exist_ok=True)
+            self._crash_stacks_file = open(
+                os.path.join(stacks_dir, f"crash-rank{rank}.stacks"), "a"
+            )
+            faulthandler.enable(file=self._crash_stacks_file)
+        except Exception:
+            self._crash_stacks_file = None
+        atexit.register(self._at_exit)
+
+    def _at_exit(self) -> None:
+        # normal exits are not crashes: no dump, but nothing may stay buffered
+        self.record("atexit")
+        try:
+            tel.hard_flush()
+        except Exception:
+            pass
+
+    def uninstall(self) -> None:
+        """Restore the pre-install handlers (tests / explicit teardown)."""
+        if not self._installed:
+            return
+        self._installed = False
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if self._crash_stacks_file is not None:
+            try:
+                import faulthandler
+
+                if self._prev_faulthandler_enabled:
+                    faulthandler.enable()  # restore the user's stderr handler
+                else:
+                    faulthandler.disable()
+                self._crash_stacks_file.close()
+            except Exception:
+                pass
+            self._crash_stacks_file = None
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton: the ring exists from import (it is just a deque);
+# handlers/dirs are configured by install().
+
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, **fields: Any) -> None:
+    _RECORDER.record(kind, **fields)
+
+
+def set_step(step: Optional[int]) -> None:
+    _RECORDER.step = step
+
+
+def phase(name: str, **attrs: Any) -> _Phase:
+    return _RECORDER.phase(name, **attrs)
+
+
+def current_phases() -> "dict[str, dict]":
+    return _RECORDER.current_phases()
+
+
+def dump(reason: str, out_dir: Optional[str] = None, extra: Optional[dict] = None):
+    return _RECORDER.dump(reason, out_dir=out_dir, extra=extra)
+
+
+def install(out_dir: Optional[str] = None, meta: Optional[dict] = None) -> FlightRecorder:
+    _RECORDER.install(out_dir=out_dir, meta=meta)
+    return _RECORDER
+
+
+def uninstall() -> None:
+    _RECORDER.uninstall()
+
+
+def installed() -> bool:
+    return _RECORDER.installed
+
+
+def enabled_from_env() -> bool:
+    """Forensics opt-in: ``ACCELERATE_FLIGHT`` truthy or a flight dir given."""
+    if os.environ.get(FLIGHT_ENV_VAR, "").strip().lower() in _TRUE:
+        return True
+    return bool(os.environ.get(FLIGHT_DIR_ENV_VAR))
+
+
+def iter_flight_files(paths) -> "list[str]":
+    """All ``flight-rank*.json`` files under the given dirs (files pass
+    through) — the report CLI's merge input."""
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(
+                sorted(
+                    os.path.join(path, name)
+                    for name in os.listdir(path)
+                    if name.startswith(FLIGHT_FILE_PREFIX) and name.endswith(".json")
+                )
+            )
+        elif os.path.basename(path).startswith(FLIGHT_FILE_PREFIX) and path.endswith(
+            ".json"
+        ):
+            files.append(path)
+    return files
+
+
+def load_flight_records(paths) -> "list[dict]":
+    records: list[dict] = []
+    for file in iter_flight_files(paths):
+        try:
+            with open(file) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rec, dict):
+            rec.setdefault("_file", os.path.basename(file))
+            records.append(rec)
+    return records

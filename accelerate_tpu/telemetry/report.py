@@ -10,9 +10,16 @@
   prefetch queue occupancy and the overlap ratio — how much of the input
   pipeline was hidden behind device compute,
 - device/host memory peaks,
-- comms traffic per collective op (calls + payload bytes).
+- comms traffic per collective op (calls + payload bytes),
+- per-rank event counts and the dropped-event total in the header — silent
+  data loss must read as a warning, not as "clean run".
 
-``--json`` emits the raw report dict for drivers.
+``--by-rank`` adds the cross-rank forensics section: per-step rank skew with
+slowest-rank attribution (the straggler), per-rank heartbeat-gap timelines
+from the watchdog's records, and merged ``flight-rank<k>.json`` crash/hang
+post-mortems. ``--json`` emits the raw report dict for drivers. The
+``doctor`` subcommand self-checks the forensics pipeline end to end
+(flight dump → watchdog stall detection → straggler report).
 """
 
 from __future__ import annotations
@@ -21,6 +28,7 @@ import argparse
 import json
 import math
 import os
+import re
 import sys
 from typing import Iterable, Optional
 
@@ -84,7 +92,133 @@ def _dist(values: "list[float]") -> dict:
     }
 
 
-def build_report(paths: Iterable[str]) -> dict:
+def _rank_of_event(event: dict, file_rank: "dict[str, int]") -> Optional[int]:
+    """Rank attribution for a merged event: the stream's ``meta`` record wins,
+    the ``events-rank<k>`` filename is the fallback for torn streams whose
+    meta line never made it to disk."""
+    file = event.get("_file")
+    if file in file_rank:
+        return file_rank[file]
+    m = re.search(r"rank(\d+)", file or "")
+    return int(m.group(1)) if m else None
+
+
+def _per_rank_counts(events: "list[dict]", file_rank: "dict[str, int]") -> "dict":
+    per_rank: dict = {}
+    for e in events:
+        rank = _rank_of_event(e, file_rank)
+        key = "?" if rank is None else str(rank)
+        rec = per_rank.setdefault(key, {"events": 0, "dropped": 0})
+        rec["events"] += 1
+        if e.get("kind") == "dropped":
+            rec["dropped"] += int(e.get("count", 0))
+    return dict(sorted(per_rank.items()))
+
+
+def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> dict:
+    """Cross-rank straggler forensics: per-step skew + slowest-rank
+    attribution, heartbeat-gap timelines, and merged flight records."""
+    from .flight_recorder import load_flight_records
+
+    steps_by_rank: "dict[int, dict[int, float]]" = {}
+    heartbeats: "dict[int, list[float]]" = {}
+    ranks: "dict[int, dict]" = {}
+    for e in events:
+        rank = _rank_of_event(e, file_rank)
+        if rank is None:
+            continue
+        info = ranks.setdefault(rank, {"events": 0, "steps": 0, "dropped": 0})
+        info["events"] += 1
+        kind = e.get("kind")
+        if kind == "step":
+            info["steps"] += 1
+            if e.get("step") is not None:
+                steps_by_rank.setdefault(rank, {})[int(e["step"])] = float(
+                    e.get("dur_s", 0.0)
+                )
+        elif kind == "heartbeat":
+            heartbeats.setdefault(rank, []).append(float(e.get("t", 0.0)))
+        elif kind == "dropped":
+            info["dropped"] += int(e.get("count", 0))
+
+    # per-step skew over the steps at least two ranks both measured
+    per_step: "list[dict]" = []
+    slowest_counts: "dict[int, int]" = {}
+    excess: "dict[int, list[float]]" = {}
+    all_steps = sorted({s for per in steps_by_rank.values() for s in per})
+    for s in all_steps:
+        durs = {r: per[s] for r, per in steps_by_rank.items() if s in per}
+        if len(durs) < 2:
+            continue
+        slowest = max(durs, key=durs.get)
+        fastest_dur = min(durs.values())
+        slowest_counts[slowest] = slowest_counts.get(slowest, 0) + 1
+        for r, d in durs.items():
+            excess.setdefault(r, []).append(d - fastest_dur)
+        per_step.append(
+            {
+                "step": s,
+                "skew_s": round(durs[slowest] - fastest_dur, 6),
+                "slowest_rank": slowest,
+                "durs_s": {str(r): round(d, 6) for r, d in sorted(durs.items())},
+            }
+        )
+    straggler = None
+    if slowest_counts:
+        rank = max(slowest_counts, key=slowest_counts.get)
+        exc = excess.get(rank, [])
+        straggler = {
+            "rank": rank,
+            "slowest_steps": slowest_counts[rank],
+            "steps_compared": len(per_step),
+            "mean_excess_s": round(sum(exc) / len(exc), 6) if exc else 0.0,
+        }
+
+    heartbeat_gaps: dict = {}
+    for rank, ts in sorted(heartbeats.items()):
+        ts = sorted(ts)
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        heartbeat_gaps[str(rank)] = {
+            "beats": len(ts),
+            "max_gap_s": round(max(gaps), 6) if gaps else 0.0,
+            "p50_gap_s": round(percentile(sorted(gaps), 50), 6) if gaps else 0.0,
+        }
+
+    flights = []
+    for rec in load_flight_records(paths):
+        phases = rec.get("phases") or {}
+        flights.append(
+            {
+                "file": rec.get("_file"),
+                "rank": (rec.get("meta") or {}).get("process_index"),
+                "reason": rec.get("reason"),
+                "step": rec.get("step"),
+                "phases": {
+                    t: {"phase": p.get("phase"), "age_s": p.get("age_s")}
+                    for t, p in phases.items()
+                },
+            }
+        )
+
+    return {
+        "per_rank": {
+            str(r): dict(
+                info,
+                wall_s=_dist(list(steps_by_rank.get(r, {}).values())),
+            )
+            for r, info in sorted(ranks.items())
+        },
+        "steps_compared": len(per_step),
+        "skew_s": _dist([p["skew_s"] for p in per_step]),
+        "worst_steps": sorted(per_step, key=lambda p: -p["skew_s"])[:5],
+        "slowest_counts": {str(r): n for r, n in sorted(slowest_counts.items())},
+        "straggler": straggler,
+        "heartbeat_gaps": heartbeat_gaps,
+        "flight_records": flights,
+    }
+
+
+def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
     events = load_events(paths)
     metas = [e for e in events if e.get("kind") == "meta"]
     steps = [e for e in events if e.get("kind") == "step"]
@@ -92,6 +226,13 @@ def build_report(paths: Iterable[str]) -> dict:
     memory = [e for e in events if e.get("kind") == "memory"]
     comms = [e for e in events if e.get("kind") == "comm"]
     waits = [e for e in events if e.get("kind") == "data_wait"]
+
+    file_rank = {
+        m["_file"]: int(m["process_index"])
+        for m in metas
+        if m.get("_file") and m.get("process_index") is not None
+    }
+    per_rank_events = _per_rank_counts(events, file_rank)
 
     by_fn: dict = {}
     for m in misses:
@@ -140,6 +281,8 @@ def build_report(paths: Iterable[str]) -> dict:
         "runs": sorted({str(m.get("run_id")) for m in metas if m.get("run_id")}),
         "processes": len({m.get("process_index") for m in metas}) or None,
         "events": len(events),
+        "per_rank_events": per_rank_events,
+        "dropped_events": sum(r["dropped"] for r in per_rank_events.values()),
         "steps": {
             "count": len(steps),
             "wall_s": _dist([float(s.get("dur_s", 0.0)) for s in steps]),
@@ -171,6 +314,8 @@ def build_report(paths: Iterable[str]) -> dict:
         },
         "data_wait_events": len(waits),
     }
+    if by_rank:
+        report["ranks"] = _rank_section(events, file_rank, paths)
     return report
 
 
@@ -188,6 +333,21 @@ def format_report(report: dict) -> str:
     runs = ", ".join(report.get("runs") or []) or "<none>"
     lines.append(f"telemetry report — run(s): {runs}, "
                  f"{report.get('processes') or 0} process(es), {report['events']} events")
+    per_rank = report.get("per_rank_events") or {}
+    if per_rank:
+        lines.append(
+            "  events by rank: "
+            + ", ".join(f"rank{r}={c['events']}" for r, c in per_rank.items())
+        )
+    dropped = report.get("dropped_events", 0)
+    if dropped:
+        by_rank_drops = ", ".join(
+            f"rank{r}={c['dropped']}" for r, c in per_rank.items() if c["dropped"]
+        )
+        lines.append(
+            f"  WARNING: {dropped} event(s) DROPPED on flush failure ({by_rank_drops}) "
+            "— these streams are incomplete"
+        )
     s = report["steps"]
     lines.append(f"steps: {s['count']}")
     for key, label in (("wall_s", "step time"), ("data_wait_s", "data wait"), ("execute_s", "execute")):
@@ -237,7 +397,149 @@ def format_report(report: dict) -> str:
     lines.append(f"comms: {c['total_calls']} call(s), {_fmt_bytes(c['total_bytes'])} total")
     for op, rec in c["by_op"].items():
         lines.append(f"  {op}: {rec['calls']} call(s), {_fmt_bytes(rec['bytes'])}")
+    if report.get("ranks"):
+        lines.append(format_rank_section(report["ranks"]))
     return "\n".join(lines)
+
+
+def format_rank_section(ranks: dict) -> str:
+    """Human rendering of the ``--by-rank`` straggler forensics."""
+    lines = ["per-rank stragglers:"]
+    for rank, info in (ranks.get("per_rank") or {}).items():
+        wall = info.get("wall_s") or {}
+        wall_s = (
+            f", wall p50={wall['p50'] * 1e3:.2f}ms max={wall['max'] * 1e3:.2f}ms"
+            if wall.get("count")
+            else ""
+        )
+        dropped_s = f", {info['dropped']} dropped" if info.get("dropped") else ""
+        lines.append(
+            f"  rank {rank}: {info['events']} event(s), {info['steps']} step(s)"
+            f"{wall_s}{dropped_s}"
+        )
+    skew = ranks.get("skew_s") or {}
+    if skew.get("count"):
+        lines.append(
+            f"  step skew over {ranks['steps_compared']} shared step(s): "
+            f"p50={skew['p50'] * 1e3:.2f}ms  p90={skew['p90'] * 1e3:.2f}ms  "
+            f"max={skew['max'] * 1e3:.2f}ms"
+        )
+    straggler = ranks.get("straggler")
+    if straggler:
+        lines.append(
+            f"  straggler: rank {straggler['rank']} — slowest in "
+            f"{straggler['slowest_steps']}/{straggler['steps_compared']} step(s), "
+            f"mean excess {straggler['mean_excess_s'] * 1e3:.2f}ms over the fastest rank"
+        )
+    for step in ranks.get("worst_steps") or []:
+        durs = "  ".join(f"rank{r}={d * 1e3:.2f}ms" for r, d in step["durs_s"].items())
+        lines.append(
+            f"    step {step['step']}: skew {step['skew_s'] * 1e3:.2f}ms "
+            f"(slowest rank {step['slowest_rank']}: {durs})"
+        )
+    gaps = ranks.get("heartbeat_gaps") or {}
+    if gaps:
+        lines.append(
+            "  heartbeat gaps: "
+            + ", ".join(
+                f"rank{r} max={g['max_gap_s']:.2f}s over {g['beats']} beat(s)"
+                for r, g in gaps.items()
+            )
+        )
+    flights = ranks.get("flight_records") or []
+    if flights:
+        lines.append("  flight records:")
+        for rec in flights:
+            phases = ", ".join(
+                f"{t}:{p['phase']}@{p['age_s']}s" for t, p in (rec["phases"] or {}).items()
+            )
+            step_s = f" (step {rec['step']})" if rec.get("step") is not None else ""
+            lines.append(
+                f"    {rec['file']}: {rec['reason']}{step_s}"
+                + (f" — open phases: {phases}" if phases else "")
+            )
+    return "\n".join(lines)
+
+
+def run_doctor() -> int:
+    """Self-check the forensics pipeline: flight dump → watchdog stall
+    detection → straggler report. Exercises the real code paths against
+    synthetic inputs in a temp dir; prints one PASS/FAIL line per check."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from . import flight_recorder
+    from .flight_recorder import FlightRecorder
+    from .watchdog import Watchdog
+
+    failures = 0
+
+    def _check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        print(f"doctor: {name:<28} {'PASS' if ok else 'FAIL'}"
+              + (f" ({detail})" if detail and not ok else ""))
+        failures += 0 if ok else 1
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # 1. flight recorder: ring + dump with all-thread stacks
+        rec = FlightRecorder(capacity=32)
+        for i in range(40):
+            rec.record("doctor_tick", i=i)
+        path = rec.dump("doctor self-check", out_dir=tmp)
+        ok = False
+        detail = "dump returned None"
+        if path and os.path.exists(path):
+            data = json.load(open(path))
+            ok = (
+                len(data["events"]) == 32
+                and any("run_doctor" in "".join(t["stack"]) for t in data["threads"])
+                and data["reason"] == "doctor self-check"
+            )
+            detail = "dump missing ring/stacks/reason"
+        _check("flight recorder dump", ok, detail)
+
+        # 2. watchdog: a thread blocked in a phase must produce a named dump
+        wd = Watchdog(timeout=0.3, interval=0.1, out_dir=tmp).start()
+
+        def _stall():
+            with flight_recorder.phase("doctor:fake_stall"):
+                _time.sleep(1.2)
+
+        worker = threading.Thread(target=_stall, name="doctor-staller", daemon=True)
+        worker.start()
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not wd.dump_paths:
+            _time.sleep(0.05)
+        worker.join()
+        wd.stop()
+        ok = bool(wd.dump_paths)
+        detail = "no stall dump within 5s"
+        if ok:
+            data = json.load(open(wd.dump_paths[0]))
+            ok = "doctor:fake_stall" in data["reason"]
+            detail = "dump does not name the stalled phase"
+        _check("watchdog stall detection", ok, detail)
+
+        # 3. straggler report over synthetic two-rank streams (rank 1 3x slower)
+        for rank, scale in ((0, 1.0), (1, 3.0)):
+            with open(os.path.join(tmp, f"events-rank{rank}.jsonl"), "w") as f:
+                f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "doctor",
+                                    "process_index": rank, "num_processes": 2}) + "\n")
+                for s in range(8):
+                    f.write(json.dumps({"kind": "step", "step": s, "t": float(s),
+                                        "dur_s": 0.01 * scale}) + "\n")
+        rep = build_report([tmp], by_rank=True)
+        straggler = (rep.get("ranks") or {}).get("straggler") or {}
+        _check(
+            "straggler attribution",
+            straggler.get("rank") == 1 and rep["ranks"]["skew_s"]["count"] == 8,
+            f"straggler={straggler}",
+        )
+
+    print("doctor: all checks passed" if not failures
+          else f"doctor: {failures} check(s) FAILED")
+    return 1 if failures else 0
 
 
 def main(argv: Optional["list[str]"] = None) -> int:
@@ -249,11 +551,20 @@ def main(argv: Optional["list[str]"] = None) -> int:
     rep = sub.add_parser("report", help="aggregate one or more event dirs/files")
     rep.add_argument("paths", nargs="+", help="telemetry dir(s) or .jsonl file(s)")
     rep.add_argument("--json", action="store_true", help="print the raw report dict")
+    rep.add_argument(
+        "--by-rank",
+        action="store_true",
+        help="cross-rank straggler section: per-step rank skew, heartbeat gaps, "
+        "flight records",
+    )
+    sub.add_parser("doctor", help="self-check the watchdog/flight-recorder/report pipeline")
     args = parser.parse_args(argv)
+    if args.command == "doctor":
+        return run_doctor()
     if args.command != "report":
         parser.print_help()
         return 2
-    report = build_report(args.paths)
+    report = build_report(args.paths, by_rank=args.by_rank)
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:

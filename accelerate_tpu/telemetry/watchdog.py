@@ -1,0 +1,343 @@
+"""Hang/straggler watchdog: a heartbeat thread that turns a silent stall into
+a named, dumped, per-rank diagnosis.
+
+Pod-scale TPU systems live or die by hang attribution: one rank blocked in a
+collective blocks every rank, and the only symptom is "nothing is happening".
+The watchdog watches two complementary liveness signals:
+
+1. **Heartbeat sources** — components that should make regular progress
+   register (:meth:`Watchdog.register`) and :meth:`Watchdog.beat` on each unit
+   of work: the Accelerator's train step beats per step, the data-loader's
+   prefetch producer beats per produced batch. A source whose last beat is
+   older than the timeout is stalled — and because each source is named, a
+   stuck *producer* is distinguishable from a stuck *collective*.
+2. **Open phases** — blocking regions annotated via
+   :func:`flight_recorder.phase` (collectives in ``utils/operations.py``,
+   backend init in the bench probe, data fetch in the loader). A phase older
+   than the timeout means a thread is blocked *inside* it; the stall report
+   names it (``collective:gather``), which is the answer a hang report needs.
+
+On a stall the watchdog emits a ``watchdog_stall`` event, writes the flight
+record (ring buffer + all-thread stacks + open phases, see
+:mod:`.flight_recorder`), hard-flushes the EventLog, and — when
+``abort_on_stall`` — exits the process with code 101 so an orchestrator
+restarts the rank instead of wedging the pod.
+
+Each check interval also emits one ``heartbeat`` record (step, source ages,
+open phases) into the JSONL stream when telemetry is enabled; the report CLI's
+``--by-rank`` view merges these into per-rank heartbeat-gap timelines.
+
+Two GIL escape hatches for hangs a Python thread cannot observe: the loop
+re-arms ``faulthandler.dump_traceback_later`` as a dead-man switch (if the
+watchdog thread itself is starved — a C call holding the GIL — the C-level
+dumper still writes all-thread stacks to ``watchdog-rank<k>.stacks``), and
+``flight_recorder.install`` separately covers SIGSEGV/SIGABRT.
+
+Disabled-path contract: nothing here starts a thread or opens a file unless
+:func:`start` (or ``ACCELERATE_WATCHDOG_TIMEOUT`` > 0 via
+:func:`maybe_start_from_env`) asks; the hot-path helpers (:func:`beat`) are a
+single ``is None`` check while inactive.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+from . import events as tel
+from . import flight_recorder
+
+WATCHDOG_TIMEOUT_ENV_VAR = "ACCELERATE_WATCHDOG_TIMEOUT"
+WATCHDOG_INTERVAL_ENV_VAR = "ACCELERATE_WATCHDOG_INTERVAL"
+WATCHDOG_ABORT_ENV_VAR = "ACCELERATE_WATCHDOG_ABORT"
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+ABORT_EXIT_CODE = 101
+
+
+def env_timeout() -> float:
+    """``ACCELERATE_WATCHDOG_TIMEOUT`` in seconds; 0.0 (disabled) when unset
+    or malformed. Same parser as ``WatchdogConfig.timeout`` so the env-armed
+    and config-armed paths can never disagree on the same variable."""
+    # lazy import: utils/__init__ pulls in operations -> telemetry, so a
+    # module-level import here would re-enter a partially initialized package
+    from ..utils.environment import parse_seconds_from_env
+
+    return parse_seconds_from_env(WATCHDOG_TIMEOUT_ENV_VAR)
+
+
+class Watchdog:
+    """One heartbeat/stall-detection thread for this process."""
+
+    def __init__(
+        self,
+        timeout: float,
+        interval: Optional[float] = None,
+        abort_on_stall: bool = False,
+        out_dir: Optional[str] = None,
+    ):
+        if timeout <= 0:
+            raise ValueError(f"watchdog timeout must be > 0 seconds, got {timeout}")
+        self.timeout = float(timeout)
+        self.interval = (
+            float(interval) if interval else max(0.05, min(self.timeout / 4.0, 5.0))
+        )
+        self.abort_on_stall = bool(abort_on_stall)
+        self.out_dir = out_dir
+        self.stall_count = 0
+        self.dump_paths: "list[str]" = []
+        self._sources: "dict[str, list]" = {}  # name -> [last_beat_t, info, stalled]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stacks_file = None
+        self._dumped_phases: "set[tuple]" = set()
+
+    # ------------------------------------------------------------- liveness --
+    def register(self, name: str, **info: Any) -> None:
+        """Start watching a named progress source; its clock starts now."""
+        with self._lock:
+            self._sources[name] = [time.monotonic(), dict(info), False]
+
+    def unregister(self, name: str) -> None:
+        """Stop watching a source (clean shutdown is not a stall)."""
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def beat(self, name: str, **info: Any) -> None:
+        """Record progress for ``name`` (auto-registers on first beat)."""
+        with self._lock:
+            rec = self._sources.get(name)
+            if rec is None:
+                self._sources[name] = [time.monotonic(), dict(info), False]
+                return
+            rec[0] = time.monotonic()
+            if info:
+                rec[1].update(info)
+            rec[2] = False  # a beat ends any stall episode
+
+    def sources(self) -> "dict[str, dict]":
+        """``{name: {"age_s": ..., **info}}`` snapshot."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                name: {"age_s": round(now - rec[0], 3), **rec[1]}
+                for name, rec in self._sources.items()
+            }
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self.out_dir = self.out_dir or flight_recorder.get_recorder()._resolve_out_dir()
+        try:
+            from ..state import process_identity
+
+            rank = process_identity().get("process_index", 0)
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._stacks_file = open(
+                os.path.join(self.out_dir, f"watchdog-rank{rank}.stacks"), "a"
+            )
+        except OSError:
+            self._stacks_file = None
+        self._arm_deadman()
+        self._thread = threading.Thread(
+            target=self._run, name="accelerate-tpu-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4 + 1.0)
+            self._thread = None
+        try:
+            import faulthandler
+
+            faulthandler.cancel_dump_traceback_later()
+        except Exception:
+            pass
+        if self._stacks_file is not None:
+            try:
+                self._stacks_file.close()
+            except OSError:
+                pass
+            self._stacks_file = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ----------------------------------------------------------- internals --
+    def _arm_deadman(self) -> None:
+        # GIL-proof backstop: if THIS thread stops being scheduled (a C call
+        # holding the GIL), faulthandler's C-level timer still dumps stacks.
+        # Re-armed every tick, so it only fires when the loop is starved.
+        try:
+            import faulthandler
+
+            faulthandler.dump_traceback_later(
+                self.timeout + 4 * self.interval,
+                file=self._stacks_file or sys.stderr,
+            )
+        except Exception:
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._arm_deadman()
+            try:
+                self._tick()
+            except Exception:  # the watchdog must outlive anything it watches
+                pass
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        stalls: "list[dict]" = []
+        with self._lock:
+            for name, rec in self._sources.items():
+                age = now - rec[0]
+                if age > self.timeout and not rec[2]:
+                    rec[2] = True  # one dump per stall episode
+                    stalls.append(
+                        {"what": "source", "name": name, "age_s": round(age, 3), **rec[1]}
+                    )
+        phases = flight_recorder.current_phases()
+        for thread_name, ph in phases.items():
+            if ph.get("age_s", 0.0) <= self.timeout:
+                continue
+            key = (ph.get("thread_id"), ph.get("phase"), ph.get("enter_t"))
+            if key in self._dumped_phases:
+                continue
+            self._dumped_phases.add(key)
+            stalls.append(
+                {
+                    "what": "phase",
+                    "name": ph.get("phase"),
+                    "thread": thread_name,
+                    "age_s": ph.get("age_s"),
+                }
+            )
+        if len(self._dumped_phases) > 4096:  # bound memory across a long run
+            self._dumped_phases.clear()
+        tel.emit(
+            "heartbeat",
+            step=flight_recorder.get_recorder().step,
+            sources={n: s["age_s"] for n, s in self.sources().items()},
+            phases={t: {"phase": p["phase"], "age_s": p["age_s"]} for t, p in phases.items()},
+        )
+        if stalls:
+            self._handle_stalls(stalls)
+
+    def _handle_stalls(self, stalls: "list[dict]") -> None:
+        descs = "; ".join(
+            f"{s['what']} '{s['name']}' stalled for {s['age_s']:.1f}s"
+            + (f" in thread {s['thread']}" if s.get("thread") else "")
+            for s in stalls
+        )
+        reason = f"watchdog: {descs} (timeout {self.timeout:g}s)"
+        tel.emit("watchdog_stall", reason=reason, stalls=stalls)
+        flight_recorder.record("watchdog_stall", reason=reason)
+        path = flight_recorder.dump(
+            reason,
+            out_dir=self.out_dir,
+            extra={
+                "watchdog": {
+                    "timeout_s": self.timeout,
+                    "stalls": stalls,
+                    "sources": self.sources(),
+                }
+            },
+        )
+        self.stall_count += 1
+        if path:
+            self.dump_paths.append(path)
+        print(
+            f"[accelerate-tpu watchdog] {reason}"
+            + (f" — flight record: {path}" if path else ""),
+            file=sys.stderr,
+            flush=True,
+        )
+        if self.abort_on_stall:
+            tel.hard_flush()
+            os._exit(ABORT_EXIT_CODE)
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton + zero-overhead shims (same contract as events.py:
+# every helper is one ``is None`` check while no watchdog is active).
+
+_ACTIVE: Optional[Watchdog] = None
+
+
+def start(
+    timeout: Optional[float] = None,
+    interval: Optional[float] = None,
+    abort_on_stall: Optional[bool] = None,
+    out_dir: Optional[str] = None,
+) -> Watchdog:
+    """Start the process watchdog (idempotent: returns the active one).
+    ``timeout`` defaults from ``ACCELERATE_WATCHDOG_TIMEOUT``."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    if timeout is None:
+        timeout = env_timeout()
+    if interval is None:
+        raw = os.environ.get(WATCHDOG_INTERVAL_ENV_VAR, "").strip()
+        if raw:
+            try:
+                interval = float(raw)
+            except ValueError:
+                interval = None
+    if abort_on_stall is None:
+        abort_on_stall = os.environ.get(WATCHDOG_ABORT_ENV_VAR, "").strip().lower() in _TRUE
+    _ACTIVE = Watchdog(
+        timeout, interval=interval, abort_on_stall=abort_on_stall, out_dir=out_dir
+    ).start()
+    return _ACTIVE
+
+
+def stop() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.stop()
+        _ACTIVE = None
+
+
+def maybe_start_from_env(out_dir: Optional[str] = None) -> Optional[Watchdog]:
+    """Start iff ``ACCELERATE_WATCHDOG_TIMEOUT`` > 0 and none is active yet.
+    Returns None — no thread, no file — otherwise."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    timeout = env_timeout()
+    if timeout <= 0:
+        return None
+    return start(timeout=timeout, out_dir=out_dir)
+
+
+def get_watchdog() -> Optional[Watchdog]:
+    return _ACTIVE
+
+
+def is_active() -> bool:
+    return _ACTIVE is not None
+
+
+def beat(name: str, **info: Any) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.beat(name, **info)
+
+
+def register(name: str, **info: Any) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.register(name, **info)
+
+
+def unregister(name: str) -> None:
+    if _ACTIVE is not None:
+        _ACTIVE.unregister(name)

@@ -39,6 +39,7 @@ from .dataclasses import (
     TorchDynamoPlugin,
     TorchTensorParallelConfig,
     TorchTensorParallelPlugin,
+    WatchdogConfig,
     add_model_config_to_megatron_parser,
     deepspeed_required,
     disable_fsdp_ram_efficient_loading,

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from datetime import timedelta
 from typing import Any, Callable, Optional
 
-from .environment import parse_flag_from_env
+from .environment import parse_flag_from_env, parse_seconds_from_env
 
 
 class BaseEnum(str, enum.Enum):
@@ -256,6 +256,43 @@ class AutocastConfig(KwargsHandler):
 
     enabled: bool = True
     cache_enabled: bool = True
+
+
+@dataclass
+class WatchdogConfig(KwargsHandler):
+    """Hang/straggler forensics (no reference counterpart — pod-scale TPU runs
+    need hang *attribution*, see ``telemetry/watchdog.py`` and
+    ``docs/troubleshooting.md``).
+
+    ``timeout`` seconds without a heartbeat (train step, prefetch producer) or
+    with one blocking phase held open (a collective, backend init) before the
+    watchdog dumps ``flight-rank<k>.json`` — all-thread stacks, the event ring,
+    and the name of the phase the rank is blocked in. ``0`` (the default)
+    disables the watchdog entirely: no thread is started and no file is
+    opened. Defaults seed from ``ACCELERATE_WATCHDOG_TIMEOUT`` /
+    ``ACCELERATE_WATCHDOG_INTERVAL`` / ``ACCELERATE_WATCHDOG_ABORT`` /
+    ``ACCELERATE_FLIGHT_DIR`` so a launcher can arm forensics without code
+    changes. ``abort_on_stall`` exits the process (code 101) after dumping so
+    an orchestrator restarts the rank instead of wedging the pod. Size the
+    timeout above your longest legitimate gap between steps (checkpointing,
+    eval) — a stall dump is cheap but noisy.
+    """
+
+    timeout: float = field(
+        default_factory=lambda: parse_seconds_from_env("ACCELERATE_WATCHDOG_TIMEOUT")
+    )
+    interval: Optional[float] = None
+    abort_on_stall: bool = field(
+        default_factory=lambda: parse_flag_from_env("ACCELERATE_WATCHDOG_ABORT")
+    )
+    flight_dir: Optional[str] = field(
+        default_factory=lambda: os.environ.get("ACCELERATE_FLIGHT_DIR")
+    )
+
+    @property
+    def enabled(self) -> bool:
+        """True when a positive timeout arms the watchdog."""
+        return self.timeout > 0
 
 
 @dataclass

@@ -41,6 +41,18 @@ def parse_choice_from_env(key: str, default: str = "no") -> str:
     return os.environ.get(key, str(default))
 
 
+def parse_seconds_from_env(key: str, default: float = 0.0) -> float:
+    """A duration env var as non-negative seconds; ``default`` when unset,
+    blank, or malformed (forensics config must never crash on a bad env)."""
+    raw = os.environ.get(key, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return default
+
+
 def get_int_from_env(keys: list[str] | tuple[str, ...], default: int) -> int:
     """Return the first env var among ``keys`` that is set, as an int."""
     if isinstance(keys, str):

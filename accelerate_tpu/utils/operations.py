@@ -28,6 +28,7 @@ import numpy as np
 
 from ..state import PartialState
 from ..telemetry import events as _telemetry
+from ..telemetry import flight_recorder as _flight
 from .environment import parse_flag_from_env
 
 
@@ -221,7 +222,10 @@ def gather(tree):
             return process_allgather(x, tiled=True)
         return x
 
-    return recursively_apply(_gather, tree)
+    # flight-recorder annotation: a rank that hangs here is "blocked in
+    # collective:gather" in the watchdog's stall dump, not just "stuck"
+    with _flight.phase("collective:gather"):
+        return recursively_apply(_gather, tree)
 
 
 def gather_object(obj: Any) -> list[Any]:
@@ -237,11 +241,12 @@ def gather_object(obj: Any) -> list[Any]:
 
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     _record_comm("gather_object", nbytes=payload.size)
-    sizes = process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
-    max_size = int(sizes.max())
-    padded = np.zeros(max_size, dtype=np.uint8)
-    padded[: payload.size] = payload
-    gathered = process_allgather(padded, tiled=False)
+    with _flight.phase("collective:gather_object", nbytes=int(payload.size)):
+        sizes = process_allgather(np.array([payload.size]), tiled=False).reshape(-1)
+        max_size = int(sizes.max())
+        padded = np.zeros(max_size, dtype=np.uint8)
+        padded[: payload.size] = payload
+        gathered = process_allgather(padded, tiled=False)
     return [
         pickle.loads(gathered[i, : int(sizes[i])].tobytes()) for i in range(state.num_processes)
     ]
@@ -260,7 +265,8 @@ def broadcast(tree, from_process: int = 0):
     def _bcast(x):
         return broadcast_one_to_all(x, is_source=state.process_index == from_process)
 
-    return recursively_apply(_bcast, tree)
+    with _flight.phase("collective:broadcast", from_process=from_process):
+        return recursively_apply(_bcast, tree)
 
 
 def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
@@ -276,11 +282,12 @@ def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
     is_source = state.process_index == from_process
     payload = np.frombuffer(pickle.dumps(object_list), dtype=np.uint8)
     _record_comm("broadcast_object_list", nbytes=payload.size)
-    size = broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
-    buf = np.zeros(int(size[0]), dtype=np.uint8)
-    if is_source:
-        buf[:] = payload
-    buf = broadcast_one_to_all(buf, is_source=is_source)
+    with _flight.phase("collective:broadcast_object_list", from_process=from_process):
+        size = broadcast_one_to_all(np.array([payload.size]), is_source=is_source)
+        buf = np.zeros(int(size[0]), dtype=np.uint8)
+        if is_source:
+            buf[:] = payload
+        buf = broadcast_one_to_all(buf, is_source=is_source)
     result = pickle.loads(buf.tobytes())
     object_list[:] = result
     return object_list
@@ -335,7 +342,8 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
         raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
     tree = _normalize_foreign(tree)
     _record_comm("reduce", tree)
-    return recursively_apply(_reduce, tree)
+    with _flight.phase("collective:reduce", reduction=reduction):
+        return recursively_apply(_reduce, tree)
 
 
 def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool = False):

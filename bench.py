@@ -61,6 +61,7 @@ _PEAK_FLOPS = {
 
 
 import contextlib
+import itertools
 import signal
 from typing import Optional
 
@@ -113,26 +114,107 @@ def _deadline(seconds: int):
         signal.signal(signal.SIGALRM, old)
 
 
-def _probe_backend_subprocess(timeout: int) -> "tuple[bool, str]":
+# killed/hung probes leave their post-mortem here (flight-rank0.json with the
+# probe's ring buffer + all-thread stacks, see telemetry/flight_recorder.py).
+# Each probe attempt writes its own attempt-<pid>-<n> subdir so a retry (or a
+# concurrent tpu_watcher probe) never clobbers evidence already linked in
+# _FLIGHT_RECORDS.
+_PROBE_FLIGHT_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "telemetry", "probe"
+)
+_FLIGHT_RECORDS: list = []  # artifact paths, surfaced in the output JSON
+_PROBE_ATTEMPT = itertools.count()
+
+
+def _probe_forensics_code(flight_dir: str, watchdog_timeout: float,
+                          init_stmt: str = "import jax; jax.devices()") -> str:
+    """Probe program with the forensics layer armed BEFORE backend init: the
+    watchdog dumps a flight record (naming the ``backend_init`` phase, with
+    all-thread stacks) and aborts well before the parent's kill deadline — so
+    "hung past 150s (killed)" finally comes with evidence attached."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # interval pinned to timeout/8 so the faulthandler dead-man (fires at
+    # timeout + 4*interval = 1.5x) lands inside the parent's kill window even
+    # for short probes — the observed axon hang holds the GIL inside
+    # initialize_pjrt_plugin, so the C-level dumper is the artifact that lands
+    return (
+        "import sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from accelerate_tpu.telemetry import flight_recorder, watchdog\n"
+        f"flight_recorder.install(out_dir={flight_dir!r})\n"
+        f"watchdog.start(timeout={watchdog_timeout!r}, "
+        f"interval={watchdog_timeout / 8.0!r}, abort_on_stall=True, "
+        f"out_dir={flight_dir!r})\n"
+        "with flight_recorder.phase('backend_init', op='jax.devices'):\n"
+        f"    {init_stmt}\n"
+        "print('ok')\n"
+    )
+
+
+def _probe_flight_artifact(flight_dir: str) -> Optional[str]:
+    """Best evidence a killed probe left: the flight JSON when the watchdog
+    thread got to run, else the faulthandler dead-man stacks (a GIL-holding C
+    hang — the axon-tunnel case — starves every Python thread, and only the
+    C-level dumper fires)."""
+    path = os.path.join(flight_dir, "flight-rank0.json")
+    if os.path.exists(path):
+        return path
+    for name in ("watchdog-rank0.stacks", "crash-rank0.stacks"):
+        path = os.path.join(flight_dir, name)
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            return path
+    return None
+
+
+def _probe_backend_subprocess(timeout: int, init_stmt: Optional[str] = None) -> "tuple[bool, str]":
     """Probe backend init in a KILLABLE subprocess. A hung tunnel blocks inside
     a C call that never returns to the interpreter, so an in-process SIGALRM
     handler never runs (observed: bench hung >60 min past its 180 s deadline);
     a subprocess can always be killed from outside. Returns ``(ok, detail)``
     where detail carries the probe's stderr tail so a degraded round records
-    WHY (round-3 postmortem: the JSON said only "failed/hung")."""
+    WHY (round-3 postmortem: the JSON said only "failed/hung") — and, when the
+    probe hung, the path of the flight-record post-mortem its in-process
+    watchdog dumped before the kill."""
+    import shutil
     import subprocess
 
-    code = "import jax; jax.devices(); print('ok')"
+    # per-attempt dir: a stale artifact from a previous probe can't masquerade
+    # as this one's, and a retry can't destroy evidence a previous attempt
+    # already linked in _FLIGHT_RECORDS
+    flight_dir = os.path.join(
+        _PROBE_FLIGHT_DIR, f"attempt-{os.getpid()}-{next(_PROBE_ATTEMPT)}"
+    )
+    shutil.rmtree(flight_dir, ignore_errors=True)
+    code = _probe_forensics_code(
+        flight_dir,
+        # dump+abort comfortably inside the parent's kill window (observed
+        # inits answer in seconds or hang forever; 0.6x keeps slow-but-live
+        # inits alive while the dump still lands well before the kill)
+        watchdog_timeout=max(1.0, timeout * 0.6),
+        **({"init_stmt": init_stmt} if init_stmt else {}),
+    )
+
+    def _with_flight(detail: str) -> str:
+        artifact = _probe_flight_artifact(flight_dir)
+        if artifact:
+            _FLIGHT_RECORDS.append(artifact)
+            if "flight record:" not in detail:  # stderr tail may already name it
+                return f"{detail}; flight record: {artifact}"
+        return detail
+
     try:
         res = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout
         )
         if res.returncode == 0 and "ok" in res.stdout:
+            shutil.rmtree(flight_dir, ignore_errors=True)  # healthy probes leave no litter
             return True, "ok"
         tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
-        return False, f"rc={res.returncode}: " + " | ".join(t.strip() for t in tail)[-300:]
+        return False, _with_flight(
+            f"rc={res.returncode}: " + " | ".join(t.strip() for t in tail)[-300:]
+        )
     except subprocess.TimeoutExpired:
-        return False, f"hung past {timeout}s (killed)"
+        return False, _with_flight(f"hung past {timeout}s (killed)")
 
 
 _BACKEND_DEGRADED: Optional[str] = None  # set when TPU probe failed -> CPU run
@@ -1166,6 +1248,7 @@ def _headline_payload(result: dict, vs_baseline, configs: dict, partial: bool) -
         "note": "synthetic data (no hub access); loss comparable across rounds only",
         **({"degraded": _BACKEND_DEGRADED} if _BACKEND_DEGRADED else {}),
         **({"probe_history": _PROBE_HISTORY[-8:]} if _PROBE_HISTORY else {}),
+        **({"flight_records": sorted(set(_FLIGHT_RECORDS))} if _FLIGHT_RECORDS else {}),
         "configs": configs,  # _emit sanitizes the whole payload
     }
     if partial:
@@ -1210,6 +1293,7 @@ def main():
                     "error": f"{type(e).__name__}: {e}",
                     **({"degraded": _BACKEND_DEGRADED} if _BACKEND_DEGRADED else {}),
                     **({"probe_history": _PROBE_HISTORY[-8:]} if _PROBE_HISTORY else {}),
+                    **({"flight_records": sorted(set(_FLIGHT_RECORDS))} if _FLIGHT_RECORDS else {}),
                 }
             ),
             flush=True,

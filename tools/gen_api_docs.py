@@ -124,18 +124,28 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
     "telemetry": (
         "Telemetry",
         "Built-in observability (no reference counterpart): structured step "
-        "events, recompile/memory/comms metrics, and the "
+        "events, recompile/memory/comms metrics, hang/crash forensics "
+        "(flight recorder + watchdog), and the "
         "`python -m accelerate_tpu.telemetry report` CLI. See "
-        "`docs/telemetry.md` for the guide.",
+        "`docs/telemetry.md` and `docs/troubleshooting.md` for the guides.",
         [("accelerate_tpu.telemetry.events",
           ["EventLog", "enable", "disable", "maybe_enable_from_env", "is_enabled",
-           "get_event_log", "emit", "counter", "gauge", "span", "set_step"]),
+           "get_event_log", "emit", "counter", "gauge", "span", "set_step",
+           "hard_flush"]),
          ("accelerate_tpu.telemetry.step_profiler",
           ["StepTelemetry", "RecompileWatcher", "install_compile_listener",
            "compile_snapshot", "record_data_wait"]),
          ("accelerate_tpu.telemetry.memory", None),
+         ("accelerate_tpu.telemetry.flight_recorder",
+          ["FlightRecorder", "get_recorder", "record", "phase", "set_step",
+           "current_phases", "dump", "install", "uninstall", "enabled_from_env",
+           "load_flight_records"]),
+         ("accelerate_tpu.telemetry.watchdog",
+          ["Watchdog", "start", "stop", "maybe_start_from_env", "get_watchdog",
+           "beat", "register", "unregister", "env_timeout"]),
          ("accelerate_tpu.telemetry.report",
-          ["build_report", "format_report", "load_events", "percentile", "main"]),
+          ["build_report", "format_report", "format_rank_section", "load_events",
+           "percentile", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "tracking": (

@@ -47,8 +47,14 @@ def log(msg: str) -> None:
 
 
 def probe(timeout: int) -> bool:
-    """True iff `jax.devices()` answers with a real backend within timeout."""
-    ok, _detail = _probe_backend_subprocess(timeout)
+    """True iff `jax.devices()` answers with a real backend within timeout.
+    A hung probe is killed AND leaves a flight-record post-mortem (the probe
+    arms the telemetry watchdog before touching the backend, see
+    bench._probe_forensics_code); its path is logged here so a 5-hour outage
+    finally comes with stacks attached."""
+    ok, detail = _probe_backend_subprocess(timeout)
+    if not ok:
+        log(f"probe diagnosis: {detail}")
     return ok
 
 
