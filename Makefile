@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input doctor
+        bench-input bench-ckpt doctor
 
 PYTEST := python -m pytest -q
 
@@ -79,6 +79,10 @@ bench:
 # sync-vs-prefetch input pipeline microbench (benchmarks/input_pipeline)
 bench-input:
 	python benchmarks/input_pipeline/run.py
+
+# sync-vs-async checkpoint stall microbench (benchmarks/checkpoint)
+bench-ckpt:
+	python benchmarks/checkpoint/run.py
 
 # forensics self-check: flight-recorder dump, watchdog stall detection and
 # straggler report against synthetic inputs (telemetry/report.py run_doctor)

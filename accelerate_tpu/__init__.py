@@ -12,6 +12,7 @@ from .parallelism_config import ParallelismConfig
 from .state import AcceleratorState, GradientState, PartialState
 from .utils import (
     AutocastKwargs,
+    CheckpointConfig,
     DDPCommunicationHookType,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
@@ -30,6 +31,8 @@ from .utils import (
 __all__ = [
     "Accelerator",
     "AutocastKwargs",
+    "CheckpointConfig",
+    "CheckpointCorruptError",
     "DDPCommunicationHookType",
     "DeepSpeedPlugin",
     "DispatchedParams",
@@ -129,6 +132,10 @@ def __getattr__(name):
         from .checkpointing import load_checkpoint_in_model
 
         return load_checkpoint_in_model
+    if name == "CheckpointCorruptError":
+        from .checkpointing import CheckpointCorruptError
+
+        return CheckpointCorruptError
     if name == "synchronize_rng_states":
         from .utils.random import synchronize_rng_states
 

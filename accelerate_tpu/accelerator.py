@@ -34,6 +34,7 @@ from .parallel.sharding import ShardingRules, infer_param_specs, shard_params
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
+    CheckpointConfig,
     DataLoaderConfiguration,
     GradScalerConfig,
     GradientAccumulationPlugin,
@@ -206,6 +207,7 @@ class Accelerator:
         jit_config: Optional[JitConfig] = None,
         grad_scaler_config: Optional[GradScalerConfig] = None,
         watchdog_config: Optional[WatchdogConfig] = None,
+        checkpoint_config: Optional[CheckpointConfig] = None,
         shard_rules: Optional[ShardingRules] = None,
         rng_types: Optional[Sequence[str]] = None,
         rng_seed: Optional[int] = None,
@@ -363,6 +365,10 @@ class Accelerator:
                     if grad_scaler_config is not None:
                         raise ValueError("grad_scaler_config given both directly and as a handler")
                     grad_scaler_config = handler
+                elif isinstance(handler, CheckpointConfig):
+                    if checkpoint_config is not None:
+                        raise ValueError("checkpoint_config given both directly and as a handler")
+                    checkpoint_config = handler
                 elif isinstance(handler, AutocastConfig):
                     self.autocast_handler = handler
                 elif isinstance(handler, DistributedDataParallelKwargs):
@@ -394,6 +400,10 @@ class Accelerator:
         self.jit_config = jit_config or JitConfig()
         self.jit_config.apply()
         self.grad_scaler_config = grad_scaler_config or GradScalerConfig()
+        self.checkpoint_config = checkpoint_config or CheckpointConfig()
+        # background writer for save_state(blocking=False); built lazily so a
+        # run that never saves async never starts a thread
+        self._checkpoint_manager = None
         self.shard_rules = shard_rules
         # host-RNG streams synchronized across processes at each epoch start
         # (reference Accelerator rng_types, accelerator.py:278; default numpy —
@@ -1467,18 +1477,79 @@ class Accelerator:
         self._load_state_pre_hooks[handle.id] = hook
         return handle
 
-    def save_state(self, output_dir: Optional[str] = None, params=None, opt_state=None, **kwargs) -> str:
-        from .checkpointing import save_accelerator_state
+    def _ensure_checkpoint_manager(self):
+        if self._checkpoint_manager is None:
+            from .checkpoint_async import CheckpointManager
 
-        # pre-hooks fire inside save_accelerator_state, AFTER automatic
-        # checkpoint naming resolves the real directory
-        return save_accelerator_state(
-            self, output_dir=output_dir, params=params, opt_state=opt_state, **kwargs
-        )
+            self._checkpoint_manager = CheckpointManager(
+                max_in_flight=self.checkpoint_config.max_in_flight
+            )
+        return self._checkpoint_manager
+
+    def save_state(
+        self,
+        output_dir: Optional[str] = None,
+        params=None,
+        opt_state=None,
+        blocking: Optional[bool] = None,
+        **kwargs,
+    ) -> str:
+        """Save a resumable checkpoint (reference ``save_state:3529``).
+
+        ``blocking=False`` (or ``CheckpointConfig(async_save=True)``) returns
+        after the device→host **snapshot** — milliseconds — and a background
+        writer serializes, fsyncs and atomically commits; the returned
+        directory is guaranteed on disk only after :meth:`wait_for_checkpoint`
+        (or the next back-pressured save / ``end_training``). Either way the
+        save is crash-consistent: a kill at any point leaves the previous
+        committed checkpoint loadable (see docs/checkpointing.md).
+        """
+        from .checkpointing import save_accelerator_state, snapshot_accelerator_state
+
+        if blocking is None:
+            blocking = not self.checkpoint_config.async_save
+        kwargs.setdefault("save_on_each_node", self.checkpoint_config.save_on_each_node)
+        if blocking:
+            if self._checkpoint_manager is not None:
+                # earlier async saves commit first: saves land in call order
+                self._checkpoint_manager.drain()
+            # pre-hooks fire inside save_accelerator_state, AFTER automatic
+            # checkpoint naming resolves the real directory
+            return save_accelerator_state(
+                self, output_dir=output_dir, params=params, opt_state=opt_state, **kwargs
+            )
+        manager = self._ensure_checkpoint_manager()
+        manager.check_error()  # surface a parked writer failure before blocking
+        manager.reserve_slot()  # back-pressure: bounds extra host copies
+        try:
+            snap = snapshot_accelerator_state(
+                self,
+                output_dir=output_dir,
+                params=params,
+                opt_state=opt_state,
+                blocking=False,
+                active_staging=manager.active_staging(),
+                **kwargs,
+            )
+            # submit inside the try: it re-raises parked writer errors BEFORE
+            # enqueuing, and a leaked slot here would deadlock every later save
+            return manager.submit(snap)
+        except BaseException:
+            manager.release_slot()
+            raise
+
+    def wait_for_checkpoint(self, timeout: Optional[float] = None) -> None:
+        """Block until every in-flight async ``save_state`` has committed;
+        re-raises the first background writer error. No-op when nothing is
+        in flight."""
+        if self._checkpoint_manager is not None:
+            self._checkpoint_manager.drain(timeout=timeout)
 
     def load_state(self, input_dir: Optional[str] = None, params=None, opt_state=None, **kwargs):
         from .checkpointing import load_accelerator_state
 
+        # an in-flight async save may be writing the very dir being loaded
+        self.wait_for_checkpoint()
         return load_accelerator_state(
             self, input_dir=input_dir, params=params, opt_state=opt_state, **kwargs
         )
@@ -1635,6 +1706,12 @@ class Accelerator:
         from .telemetry import events as _tel
         from .telemetry import watchdog as _watchdog
 
+        # drain the async checkpoint writer BEFORE forensics teardown: a save
+        # still committing must finish (and may beat the watchdog doing so),
+        # and its errors must surface here rather than vanish with the daemon
+        if self._checkpoint_manager is not None:
+            self._checkpoint_manager.shutdown(drain=True)
+            self._checkpoint_manager = None
         if _tel.is_enabled() and self.trackers:
             self.log_telemetry_summary()
         # forensics teardown: training no longer beats, so the train-step
@@ -1648,3 +1725,15 @@ class Accelerator:
             for tracker in self.trackers:
                 tracker.finish()
         self.wait_for_everyone()
+
+    def __del__(self):
+        # last-resort drain barrier: an interpreter exiting with an async save
+        # still in flight must not tear the write mid-commit (daemon threads
+        # die abruptly). end_training is the explicit spelling; this covers
+        # scripts that never call it. Defensive: __del__ may run half-torn.
+        try:
+            manager = getattr(self, "_checkpoint_manager", None)
+            if manager is not None:
+                manager.shutdown(drain=True)
+        except Exception:
+            pass

@@ -1,0 +1,205 @@
+"""Background checkpoint writer: the output-side mirror of the input
+prefetch pipeline (``data_loader.py``'s producer thread — PR 3 proved the
+overlap pattern on the input side; this applies it to ``save_state``).
+
+``Accelerator.save_state(blocking=False)`` splits a save into the fast
+**snapshot** (``checkpointing.snapshot_accelerator_state`` — device→host
+copies of exactly the replica-0 chunks this process owns, returning control
+to the train loop in milliseconds) and the **write+commit** pipeline
+(``checkpointing.write_and_commit`` — serialize into ``<dir>.tmp``, fsync,
+``_COMMITTED`` manifest last, atomic ``os.replace``), which this module runs
+on a single daemon thread so checkpoint cadence stops taxing step time.
+
+Back-pressure: at most ``CheckpointConfig.max_in_flight`` snapshots may be
+queued or writing (default 1 — one extra host copy of the state, the same
+bound the reference's blocking save has). An additional ``save_state`` blocks
+in :meth:`CheckpointManager.submit` until a slot frees; that wait is exposed
+stall and is reported as such (telemetry ``checkpoint``/``backpressure``).
+
+Forensics: while writing, the worker is a registered watchdog heartbeat
+source (``checkpoint_writer``) that beats once per file, and every write/
+commit runs inside a flight-recorder phase — a hung filesystem write is
+named in stall dumps instead of reading as a silent training hang
+(see ``telemetry/watchdog.py``, PR 4).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+from .logging import get_logger
+from .telemetry import events as _tel
+from .telemetry import flight_recorder as _flight
+from .telemetry import watchdog as _watchdog
+
+logger = get_logger(__name__)
+
+_WD_SOURCE = "checkpoint_writer"
+
+
+class _Job:
+    __slots__ = ("snapshot", "done", "result", "error")
+
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self.done = threading.Event()
+        self.result: Optional[str] = None
+        self.error: Optional[BaseException] = None
+
+
+class CheckpointManager:
+    """Owns the writer thread and the in-flight accounting for one
+    :class:`~accelerate_tpu.accelerator.Accelerator`.
+
+    Lifecycle: lazily started on the first ``submit``; ``drain()`` blocks
+    until every queued save has committed (surfacing the first writer error);
+    ``shutdown()`` drains and stops the thread. The thread is a daemon, but
+    ``Accelerator.end_training``/``__del__`` drain explicitly — relying on
+    daemon teardown would tear a write mid-commit on clean exits.
+    """
+
+    def __init__(self, max_in_flight: int = 1):
+        self.max_in_flight = max(1, int(max_in_flight))
+        self._queue: "collections.deque[_Job]" = collections.deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._slots = threading.BoundedSemaphore(self.max_in_flight)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._jobs: "list[_Job]" = []  # completed, pending error harvest
+        self._active_staging: "set[str]" = set()
+
+    # ------------------------------------------------------------- interface --
+    def active_staging(self) -> "set[str]":
+        """Staging dirs owned by queued/writing saves — stale-staging cleanup
+        must never touch these."""
+        with self._lock:
+            return set(self._active_staging)
+
+    def reserve_slot(self) -> float:
+        """Back-pressure gate, taken BEFORE the snapshot is built (bounding
+        host RAM at ``max_in_flight`` extra state copies). Returns seconds
+        blocked, which is exposed stall by definition."""
+        t0 = time.monotonic()
+        if not self._slots.acquire(blocking=False):
+            with _flight.phase("checkpoint_backpressure"):
+                self._slots.acquire()
+            waited = time.monotonic() - t0
+            _tel.emit(
+                "checkpoint", phase="backpressure", dur_s=round(waited, 6), hidden=False
+            )
+            return waited
+        return 0.0
+
+    def release_slot(self) -> None:
+        """Give back a slot reserved with :meth:`reserve_slot` when the save
+        it was for never got submitted (snapshot raised)."""
+        self._slots.release()
+
+    def submit(self, snapshot) -> str:
+        """Enqueue a snapshot for background write+commit; returns the final
+        directory the save will land in. The caller must hold a slot from
+        :meth:`reserve_slot`."""
+        self.check_error()
+        job = _Job(snapshot)
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._run, name="checkpoint-writer", daemon=True
+                )
+                self._thread.start()
+            self._queue.append(job)
+            self._jobs.append(job)
+            self._active_staging.add(snapshot.staging_dir)
+            self._wake.notify_all()
+        return snapshot.final_dir
+
+    def pending(self) -> int:
+        """Jobs not yet committed (queued or writing)."""
+        with self._lock:
+            return sum(1 for j in self._jobs if not j.done.is_set())
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted save has committed; re-raise the first
+        writer error. ``timeout`` (seconds) raises TimeoutError on expiry."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                jobs = list(self._jobs)
+            if not jobs:
+                break
+            for job in jobs:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                if not job.done.wait(remaining):
+                    raise TimeoutError(
+                        f"checkpoint writer did not finish within {timeout}s "
+                        f"(writing {job.snapshot.final_dir})"
+                    )
+            with self._lock:
+                # only harvest jobs everyone waited on; new submits stay
+                self._jobs = [j for j in self._jobs if j not in jobs]
+            for job in jobs:
+                if job.error is not None:
+                    raise RuntimeError(
+                        f"background checkpoint save to {job.snapshot.final_dir} failed"
+                    ) from job.error
+        self.check_error()
+
+    def check_error(self) -> None:
+        """Raise the first unharvested writer error (without waiting)."""
+        with self._lock:
+            failed = next((j for j in self._jobs if j.done.is_set() and j.error), None)
+            if failed is not None:
+                self._jobs.remove(failed)
+        if failed is not None:
+            raise RuntimeError(
+                f"background checkpoint save to {failed.snapshot.final_dir} failed"
+            ) from failed.error
+
+    def shutdown(self, drain: bool = True) -> None:
+        thread = self._thread
+        if thread is None:
+            return
+        try:
+            if drain:
+                self.drain()
+        finally:
+            with self._lock:
+                self._stop = True
+                self._wake.notify_all()
+            thread.join(timeout=30.0)
+            self._thread = None
+
+    # ---------------------------------------------------------------- worker --
+    def _run(self) -> None:
+        from . import checkpointing  # late: tests monkeypatch write_and_commit
+
+        while True:
+            with self._lock:
+                while not self._queue and not self._stop:
+                    self._wake.wait()
+                if self._stop and not self._queue:
+                    return
+                job = self._queue.popleft()
+            snap = job.snapshot
+            try:
+                _watchdog.register(_WD_SOURCE, dir=snap.final_dir)
+
+                def heartbeat(**info: Any) -> None:
+                    _watchdog.beat(_WD_SOURCE, **info)
+
+                with _flight.phase("checkpoint_write", dir=snap.final_dir):
+                    job.result = checkpointing.write_and_commit(snap, heartbeat=heartbeat)
+            except BaseException as e:  # surfaced on drain/next submit
+                job.error = e
+                logger.error(f"background checkpoint save to {snap.final_dir} failed: {e}")
+            finally:
+                _watchdog.unregister(_WD_SOURCE)
+                with self._lock:
+                    self._active_staging.discard(snap.staging_dir)
+                self._slots.release()
+                job.done.set()

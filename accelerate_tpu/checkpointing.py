@@ -20,11 +20,16 @@ import json
 import os
 import re
 import shutil
-from typing import Any, Optional
+import signal
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
 
 import numpy as np
 
 from .logging import get_logger
+from .sharded_checkpoint import CheckpointCorruptError  # noqa: F401  (public re-export)
 
 logger = get_logger(__name__)
 
@@ -48,12 +53,60 @@ RNG_STATE_NAME = RNG_NAME
 SCALER_NAME = "scaler"  # fp16 scale state lives inside the optimizer state
 PROFILE_PATTERN_NAME = "profile_{suffix}.json"
 
+# crash-consistent commit protocol (see docs/checkpointing.md "Async saves and
+# crash consistency"): every save serializes into `<dir>.tmp`, fsyncs, writes
+# the COMMITTED_MARKER manifest last, then atomically `os.replace`s onto the
+# final name. A directory without the marker was torn mid-write and is never
+# loaded; a `.tmp` directory WITH the marker crashed between marker and rename
+# and is repaired (the rename is finished) on the next load/save.
+COMMITTED_MARKER = "_COMMITTED"
+STAGING_SUFFIX = ".tmp"
+_TRASH_SUFFIX = ".trash"
+_DONE_RE = re.compile(r"_DONE\.rank(\d{5})\.json")
+_AUTO_DIR_RE = re.compile(r"checkpoint_(\d+)")
+
+
+def _maybe_crash(point: str) -> None:
+    """Deterministic fault injection for crash-consistency tests: SIGKILL this
+    process when ``ACCELERATE_CKPT_CRASH_POINT`` names the current point. A
+    no-op (one env lookup) outside tests."""
+    if os.environ.get("ACCELERATE_CKPT_CRASH_POINT") == point:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory by path (Linux allows fsync on O_RDONLY fds —
+    directory fsync is how a rename/create is made durable)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def is_committed_checkpoint(directory: str) -> bool:
+    """True iff ``directory`` finished its save protocol (marker present)."""
+    return os.path.isfile(os.path.join(directory, COMMITTED_MARKER))
+
 
 # ---------------------------------------------------------------------------
 # pytree <-> flat dict
 
 
-def flatten_pytree(tree) -> dict[str, np.ndarray]:
+def flatten_pytree(tree, copy: bool = False) -> dict[str, np.ndarray]:
+    """Flatten to '/'-joined paths → numpy. ``copy=True`` forces owned host
+    buffers (on the CPU backend ``np.asarray`` can alias the device buffer,
+    which a donating train step will mutate under an async writer)."""
     import jax
 
     flat = {}
@@ -67,7 +120,8 @@ def flatten_pytree(tree) -> dict[str, np.ndarray]:
             from .utils.operations import _replicate_global_array
 
             leaf = _replicate_global_array(leaf)
-        flat[key or "_root"] = np.asarray(leaf)
+        arr = np.asarray(leaf)
+        flat[key or "_root"] = np.array(arr, copy=True) if copy else arr
     return flat
 
 
@@ -96,8 +150,17 @@ def save_pytree(tree, path: str) -> None:
 
 
 def load_flat(path: str) -> dict[str, np.ndarray]:
-    with np.load(path, allow_pickle=False) as data:
-        return {k: data[k] for k in data.files}
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            return {k: data[k] for k in data.files}
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # torn zip container, truncated header, ...
+        raise CheckpointCorruptError(
+            f"corrupt checkpoint file {path}: {e} (torn write? resume from an "
+            "older committed checkpoint)",
+            path=path,
+        ) from e
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +168,10 @@ def load_flat(path: str) -> dict[str, np.ndarray]:
 
 
 def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
+    """Resolve the final checkpoint directory. Rotation does NOT happen here:
+    deleting old checkpoints before the new save commits would leave zero
+    usable checkpoints after a mid-save crash — rotation runs post-commit
+    (:func:`rotate_checkpoints`)."""
     pc = accelerator.project_configuration
     if output_dir is None:
         if pc.automatic_checkpoint_naming:
@@ -119,18 +186,75 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str]) -> str:
             raise FileExistsError(
                 f"Checkpoint {folder} already exists — iteration was not advanced"
             )
-        if accelerator.is_main_process:
-            # rotation (reference accelerator.py:3567-3593)
-            if pc.total_limit is not None and os.path.isdir(output_dir):
-                existing = sorted(
-                    (d for d in os.listdir(output_dir) if re.fullmatch(r"checkpoint_\d+", d)),
-                    key=lambda d: int(d.split("_")[1]),
-                )
-                while len(existing) + 1 > pc.total_limit:
-                    victim = existing.pop(0)
-                    shutil.rmtree(os.path.join(output_dir, victim), ignore_errors=True)
         output_dir = folder
     return output_dir
+
+
+def repair_interrupted_commit(final_dir: str) -> bool:
+    """Finish a commit that crashed between marker write and rename: a
+    ``<final>.tmp`` holding the COMMITTED_MARKER is fully durable — complete
+    the swap. Returns True when a repair happened."""
+    tmp = final_dir + STAGING_SUFFIX
+    if not (os.path.isdir(tmp) and is_committed_checkpoint(tmp)):
+        return False
+    trash = final_dir + _TRASH_SUFFIX
+    shutil.rmtree(trash, ignore_errors=True)
+    if os.path.isdir(final_dir):
+        os.replace(final_dir, trash)
+    os.replace(tmp, final_dir)
+    shutil.rmtree(trash, ignore_errors=True)
+    parent = os.path.dirname(os.path.abspath(final_dir))
+    if os.path.isdir(parent):
+        _fsync_path(parent)
+    logger.warning(f"repaired interrupted checkpoint commit: {tmp} -> {final_dir}")
+    return True
+
+
+def clean_stale_staging(final_dir: str, active: Optional["set[str]"] = None) -> None:
+    """Remove partial ``.tmp``/``.trash`` staging left by a crashed save
+    (repairing committed-but-unrenamed ones first). Sweeps the sibling
+    ``checkpoint_*`` staging dirs too under automatic naming. ``active`` names
+    staging dirs owned by in-flight async saves — never touched."""
+    active = active or set()
+    candidates = {final_dir}
+    parent = os.path.dirname(os.path.abspath(final_dir))
+    if _AUTO_DIR_RE.fullmatch(os.path.basename(final_dir)) and os.path.isdir(parent):
+        for name in os.listdir(parent):
+            if _AUTO_DIR_RE.fullmatch(name.removesuffix(STAGING_SUFFIX)):
+                candidates.add(os.path.join(parent, name.removesuffix(STAGING_SUFFIX)))
+    for final in sorted(candidates):
+        tmp = final + STAGING_SUFFIX
+        if tmp in active:
+            continue
+        if repair_interrupted_commit(final):
+            continue
+        if os.path.isdir(tmp):
+            logger.warning(f"removing partial checkpoint staging dir {tmp}")
+            shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(final + _TRASH_SUFFIX, ignore_errors=True)
+
+
+def rotate_checkpoints(root: str, total_limit: int, just_committed: str) -> None:
+    """Post-commit rotation (reference accelerator.py:3567-3593 deletes BEFORE
+    saving — here deletion only ever happens after the replacement landed).
+    Keeps the ``total_limit`` newest ``checkpoint_<i>`` dirs; staging/trash
+    dirs never match the pattern; the just-committed dir and the newest
+    committed dir are never victims even if the limit says otherwise."""
+    if total_limit is None or not os.path.isdir(root):
+        return
+    existing = sorted(
+        (d for d in os.listdir(root) if _AUTO_DIR_RE.fullmatch(d)),
+        key=lambda d: int(d.split("_")[1]),
+    )
+    committed = [d for d in existing if is_committed_checkpoint(os.path.join(root, d))]
+    protect = {os.path.basename(os.path.normpath(just_committed))}
+    if committed:
+        protect.add(committed[-1])
+    victims = existing[: max(0, len(existing) - max(1, int(total_limit)))]
+    for victim in victims:
+        if victim in protect:
+            continue
+        shutil.rmtree(os.path.join(root, victim), ignore_errors=True)
 
 
 def _should_shard(trees) -> bool:
@@ -148,47 +272,125 @@ def _should_shard(trees) -> bool:
     return False
 
 
-def _remove_stale_model_files(output_dir: str) -> None:
-    """Remove previous model/optimizer artifacts (both formats) from a reused
-    checkpoint dir so a fresh save never mixes with leftovers."""
-    pattern = re.compile(
-        rf"({MODEL_NAME}|{OPTIMIZER_NAME})(_\d+)?"
-        r"(\.npz|-shard-\d{5}\.(npz|bin|index\.json))"
-    )
-    for name in os.listdir(output_dir):
-        if pattern.fullmatch(name):
+@dataclass
+class _Artifact:
+    """One file-to-be of a checkpoint: ``kind`` selects the serializer.
+
+    ``npz``: payload is a flat ``{key: np.ndarray}`` dict; ``sharded``:
+    payload is a ``ShardedTreeSnapshot`` and ``name`` is the shard prefix;
+    ``text``/``bytes``: pre-encoded small state (json/pickle)."""
+
+    kind: str
+    name: str
+    payload: Any
+
+
+@dataclass
+class CheckpointSnapshot:
+    """Everything a checkpoint save needs, detached from live training state.
+
+    Produced by :func:`snapshot_accelerator_state` in the **fast** phase (the
+    only part the train loop waits for): device→host copies of the replica-0
+    array regions plus encoded small states. Consumed by
+    :func:`write_and_commit` — on the caller thread (blocking save) or a
+    background writer (``save_state(blocking=False)``)."""
+
+    final_dir: str
+    artifacts: "list[_Artifact]"
+    process_index: int
+    num_processes: int
+    is_main: bool
+    sharded: bool
+    save_on_each_node: bool = False
+    is_local_main: bool = False
+    rotation: Optional["tuple[str, int]"] = None  # (root, total_limit), post-commit
+    iteration: Optional[int] = None
+    nbytes: int = 0
+    blocking: bool = True  # telemetry: writer time is hidden when False
+    snapshot_s: float = 0.0
+
+    @property
+    def staging_dir(self) -> str:
+        return self.final_dir + STAGING_SUFFIX
+
+    @property
+    def is_committer(self) -> bool:
+        """Who runs the marker rendezvous + atomic rename. Under
+        ``save_on_each_node`` every node's dir needs its own commit, so each
+        local main commits (peer committers racing on a shared fs are handled
+        at the ``os.replace``)."""
+        return self.is_main or (self.save_on_each_node and self.is_local_main)
+
+
+def _encode_small_states(accelerator) -> "list[_Artifact]":
+    """Scheduler/dataloader/custom-object/RNG states: small, host-resident,
+    encoded at snapshot time so the writer never touches live objects."""
+    import pickle
+
+    from .utils.random import capture_rng_states
+
+    artifacts: "list[_Artifact]" = []
+    for i, sched in enumerate(accelerator._schedulers):
+        suffix = "" if i == 0 else f"_{i}"
+        artifacts.append(
+            _Artifact("text", f"{SCHEDULER_NAME}{suffix}.json", json.dumps(sched.state_dict()))
+        )
+    for i, dl in enumerate(accelerator._dataloaders):
+        suffix = "" if i == 0 else f"_{i}"
+        base = f"{SAMPLER_NAME}{suffix}"
+        state = dl.state_dict()
+        # a stateful INNER loader's (torchdata) state is OPAQUE: always
+        # pickle it — json "succeeding" can still be lossy (int dict keys
+        # coerce to strings, mangling worker-state maps), and tensors/bytes
+        # fail outright. Native wrapper states are plain and stay json.
+        payload = None
+        if not getattr(dl, "_stateful_inner", False):
             try:
-                os.remove(os.path.join(output_dir, name))
-            except OSError:  # pragma: no cover - concurrent cleanup
-                pass
+                payload = json.dumps(state)
+                if json.loads(payload) != state:
+                    # dumps can "succeed" lossily (int dict keys coerce to
+                    # strings, tuples to lists) — only a clean round-trip
+                    # may use the json spelling
+                    payload = None
+            except (TypeError, ValueError):
+                payload = None  # e.g. a custom sampler with tensor state
+        if payload is None:
+            artifacts.append(_Artifact("bytes", base + ".pkl", pickle.dumps(state)))
+        else:
+            artifacts.append(_Artifact("text", base + ".json", payload))
+    for i, obj in enumerate(accelerator._custom_objects):
+        flat = flatten_pytree(obj.state_dict(), copy=True)
+        name = f"{CUSTOM_NAME}_{i}.npz"
+        artifacts.append(_Artifact("npz", name, flat))
+        artifacts.append(
+            _Artifact("text", name + ".meta.json", json.dumps({"keys": sorted(flat)}))
+        )
+    return artifacts
 
 
-def save_accelerator_state(
+def snapshot_accelerator_state(
     accelerator,
     output_dir: Optional[str] = None,
     params=None,
     opt_state=None,
     save_on_each_node: bool = False,
     sharded: Optional[bool] = None,
-) -> str:
-    """Save everything needed to resume (reference ``save_accelerator_state:62``
-    driven by ``accelerator.save_state:3529``).
+    blocking: bool = True,
+    active_staging: Optional["set[str]"] = None,
+) -> CheckpointSnapshot:
+    """The fast phase of a save: resolve the directory, copy this process's
+    replica-0 array regions device→host, encode small states, advance the
+    iteration counter — and return in milliseconds-to-subseconds, never
+    touching the filesystem beyond stale-staging cleanup. The returned
+    snapshot owns every byte it references; live params/opt-state may be
+    donated/mutated immediately after."""
+    from .sharded_checkpoint import snapshot_sharded_pytree
+    from .telemetry import events as _tel
 
-    ``params``/``opt_state`` let functional training loops pass their live
-    threaded values explicitly; without them the values written back by the
-    prepared train step (``Accelerator.prepare_train_step``) are used.
-
-    ``sharded=True`` (auto-on when any leaf spans hosts) writes model/optimizer
-    state as per-process shard files — no host ever materializes the full
-    state (reference ``save_fsdp_model utils/fsdp_utils.py:103`` via
-    ``torch.distributed.checkpoint`` sharded writers).
-    """
-    from .utils.random import capture_rng_states
-
+    t0 = time.monotonic()
     output_dir = _checkpoint_dir(accelerator, output_dir)
+    pc = accelerator.project_configuration
     is_writer = accelerator.is_main_process or save_on_each_node
-    if is_writer:
-        os.makedirs(output_dir, exist_ok=True)
 
     models = [params] if params is not None else accelerator._models
     opt_states = (
@@ -200,89 +402,433 @@ def save_accelerator_state(
         hook(models, output_dir)
     if sharded is None:
         sharded = _should_shard(list(models) + list(opt_states))
-    # a reused output_dir may hold the OTHER format (or shard files from a
-    # different process count) — load prefers npz and merges every index file,
-    # so stale leftovers would silently restore old state; scrub first. Every
-    # writer scrubs: with save_on_each_node on a node-local FS the main
-    # process cannot reach the other nodes' dirs
-    if is_writer and os.path.isdir(output_dir):
-        _remove_stale_model_files(output_dir)
-    # barrier taken by EVERY process (a branch-local one would deadlock when
-    # only rank 0 writes): no process starts writing until every writer's
-    # stale-file scrub is done — with save_on_each_node on a shared fs all
-    # processes write into the same dir
-    accelerator.wait_for_everyone()
-    if sharded:
-        from .sharded_checkpoint import save_sharded_pytree
 
-        os.makedirs(output_dir, exist_ok=True)  # every proc makes its own
+    # a previous crashed save may have left partial staging next to (or at)
+    # this save's target — repair committed ones, drop torn ones. Main only
+    # (plus each node's local main under save_on_each_node, whose dir may be
+    # node-local): racing rmtrees across writers on a shared fs helps nobody.
+    if accelerator.is_main_process or (save_on_each_node and accelerator.is_local_main_process):
+        clean_stale_staging(output_dir, active=active_staging)
+
+    artifacts: "list[_Artifact]" = []
+    if sharded:
+        # every process snapshots exactly the chunks it will write (the same
+        # replica-0 selection save_sharded_pytree always computed)
         for i, model in enumerate(models):
             suffix = "" if i == 0 else f"_{i}"
-            save_sharded_pytree(model, output_dir, prefix=f"{MODEL_NAME}{suffix}")
+            artifacts.append(
+                _Artifact("sharded", f"{MODEL_NAME}{suffix}", snapshot_sharded_pytree(model))
+            )
         for i, state in enumerate(opt_states):
             if state is not None:
                 suffix = "" if i == 0 else f"_{i}"
-                save_sharded_pytree(state, output_dir, prefix=f"{OPTIMIZER_NAME}{suffix}")
+                artifacts.append(
+                    _Artifact(
+                        "sharded", f"{OPTIMIZER_NAME}{suffix}", snapshot_sharded_pytree(state)
+                    )
+                )
     elif is_writer:
         for i, model in enumerate(models):
             suffix = "" if i == 0 else f"_{i}"
-            save_pytree(model, os.path.join(output_dir, f"{MODEL_NAME}{suffix}.npz"))
+            artifacts.append(
+                _Artifact("npz", f"{MODEL_NAME}{suffix}.npz", flatten_pytree(model, copy=True))
+            )
         for i, state in enumerate(opt_states):
             if state is not None:
                 suffix = "" if i == 0 else f"_{i}"
-                save_pytree(state, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.npz"))
+                artifacts.append(
+                    _Artifact(
+                        "npz", f"{OPTIMIZER_NAME}{suffix}.npz", flatten_pytree(state, copy=True)
+                    )
+                )
     if is_writer:
-        for i, sched in enumerate(accelerator._schedulers):
-            suffix = "" if i == 0 else f"_{i}"
-            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{suffix}.json"), "w") as f:
-                json.dump(sched.state_dict(), f)
-        for i, dl in enumerate(accelerator._dataloaders):
-            suffix = "" if i == 0 else f"_{i}"
-            base = os.path.join(output_dir, f"{SAMPLER_NAME}{suffix}")
-            state = dl.state_dict()
-            # a stateful INNER loader's (torchdata) state is OPAQUE: always
-            # pickle it — json "succeeding" can still be lossy (int dict keys
-            # coerce to strings, mangling worker-state maps), and tensors/bytes
-            # fail outright. Native wrapper states are plain and stay json.
-            payload = None
-            if not getattr(dl, "_stateful_inner", False):
-                try:
-                    payload = json.dumps(state)
-                    if json.loads(payload) != state:
-                        # dumps can "succeed" lossily (int dict keys coerce to
-                        # strings, tuples to lists) — only a clean round-trip
-                        # may use the json spelling
-                        payload = None
-                except (TypeError, ValueError):
-                    payload = None  # e.g. a custom sampler with tensor state
-            if payload is None:
-                import pickle as _pickle
-
-                with open(base + ".pkl", "wb") as f:
-                    _pickle.dump(state, f)
-                if os.path.exists(base + ".json"):  # overwritten checkpoint dir
-                    os.remove(base + ".json")
-            else:
-                with open(base + ".json", "w") as f:
-                    f.write(payload)
-                if os.path.exists(base + ".pkl"):
-                    os.remove(base + ".pkl")
-        for i, obj in enumerate(accelerator._custom_objects):
-            _save_custom(obj, os.path.join(output_dir, f"{CUSTOM_NAME}_{i}.npz"))
+        artifacts.extend(_encode_small_states(accelerator))
 
     # RNG is per-process (reference :153-176)
-    rng_states = capture_rng_states()
-    rng_file = os.path.join(output_dir, f"{RNG_NAME}_{accelerator.process_index}.pkl")
-    accelerator.wait_for_everyone()
     import pickle
 
-    os.makedirs(output_dir, exist_ok=True)
-    with open(rng_file, "wb") as f:
-        pickle.dump(rng_states, f)
+    from .utils.random import capture_rng_states
 
-    accelerator.project_configuration.iteration += 1
-    logger.info(f"saved state to {output_dir}")
-    return output_dir
+    artifacts.append(
+        _Artifact(
+            "bytes",
+            f"{RNG_NAME}_{accelerator.process_index}.pkl",
+            pickle.dumps(capture_rng_states()),
+        )
+    )
+
+    # barrier taken by EVERY process: after it, every rank's device→host
+    # copies are done, so callers may mutate/donate live state — and the
+    # process-consistent iteration counter can advance
+    accelerator.wait_for_everyone()
+    iteration = pc.iteration if pc.automatic_checkpoint_naming else None
+    rotation = None
+    if pc.automatic_checkpoint_naming:
+        if pc.total_limit is not None:
+            rotation = (os.path.dirname(output_dir), int(pc.total_limit))
+        pc.iteration += 1
+
+    nbytes = 0
+    for art in artifacts:
+        if art.kind == "sharded":
+            nbytes += art.payload.nbytes
+        elif art.kind == "npz":
+            nbytes += sum(a.nbytes for a in art.payload.values())
+        else:
+            nbytes += len(art.payload)
+    snap = CheckpointSnapshot(
+        final_dir=output_dir,
+        artifacts=artifacts,
+        process_index=accelerator.process_index,
+        num_processes=accelerator.num_processes,
+        is_main=accelerator.is_main_process,
+        sharded=bool(sharded),
+        save_on_each_node=save_on_each_node,
+        is_local_main=accelerator.is_local_main_process,
+        rotation=rotation,
+        iteration=iteration,
+        nbytes=nbytes,
+        blocking=blocking,
+        snapshot_s=time.monotonic() - t0,
+    )
+    _tel.emit(
+        "checkpoint",
+        phase="snapshot",
+        dur_s=round(snap.snapshot_s, 6),
+        bytes=nbytes,
+        dir=output_dir,
+        hidden=False,
+        blocking=blocking,
+        sharded=snap.sharded,
+    )
+    return snap
+
+
+def write_snapshot(
+    snap: CheckpointSnapshot,
+    directory: Optional[str] = None,
+    heartbeat: Optional[Callable[..., None]] = None,
+) -> "tuple[dict[str, dict], dict[str, float]]":
+    """Serialize every artifact of ``snap`` into ``directory`` (default: the
+    snapshot's staging dir), fsync each file and the directory. Pure IO —
+    safe on a background thread. Returns ``(files, timings)``: per-file
+    bytes/CRC32 for the commit manifest and serialize/write second splits."""
+    from .sharded_checkpoint import write_sharded_snapshot
+
+    directory = directory or snap.staging_dir
+    os.makedirs(directory, exist_ok=True)
+    files: "dict[str, dict]" = {}
+    serialize_s = 0.0
+    write_s = 0.0
+    first_written = False
+    for art in snap.artifacts:
+        if heartbeat is not None:
+            heartbeat(file=art.name)
+        if art.kind == "sharded":
+            t0 = time.monotonic()
+            files.update(
+                write_sharded_snapshot(art.payload, directory, prefix=art.name, heartbeat=heartbeat)
+            )
+            write_s += time.monotonic() - t0
+        else:
+            path = os.path.join(directory, art.name)
+            if art.kind == "npz":
+                # savez streams straight into the open file: no BytesIO
+                # doubling of the (model-sized) host copy the snapshot holds
+                t0 = time.monotonic()
+                with open(path, "wb") as f:
+                    np.savez(f, **art.payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                write_s += time.monotonic() - t0
+                files[art.name] = {
+                    "bytes": os.path.getsize(path),
+                    "crc32": _file_crc32(path),
+                }
+            else:
+                t0 = time.monotonic()
+                data = art.payload.encode("utf-8") if art.kind == "text" else art.payload
+                serialize_s += time.monotonic() - t0
+                t0 = time.monotonic()
+                with open(path, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                write_s += time.monotonic() - t0
+                files[art.name] = {
+                    "bytes": len(data),
+                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                }
+        if not first_written:
+            first_written = True
+            _maybe_crash("mid_write")
+    _fsync_path(directory)
+    return files, {"serialize_s": serialize_s, "write_s": write_s}
+
+
+def _commit_timeout() -> float:
+    try:
+        return float(os.environ.get("ACCELERATE_CKPT_COMMIT_TIMEOUT", "600"))
+    except ValueError:
+        return 600.0
+
+
+def commit_snapshot(
+    snap: CheckpointSnapshot,
+    files: "dict[str, dict]",
+    heartbeat: Optional[Callable[..., None]] = None,
+) -> str:
+    """Make the staged save durable and visible, atomically.
+
+    Every process drops a fsynced ``_DONE.rank<k>.json`` (its file manifest)
+    into staging. The main process waits for all ranks' markers (shared-fs
+    rendezvous — the same assumption the sharded loader already makes), merges
+    them into the ``_COMMITTED`` manifest written last, fsyncs, and
+    ``os.replace``s staging onto the final name. A crash at ANY point leaves
+    either the old committed checkpoint untouched or a repairable
+    marker-carrying staging dir — never a half-written directory under the
+    final name."""
+    staging = snap.staging_dir
+    done_name = f"_DONE.rank{snap.process_index:05d}.json"
+    done_payload = {
+        "process_index": snap.process_index,
+        "files": files,
+        "bytes": snap.nbytes,
+    }
+    done_path = os.path.join(staging, done_name)
+    # write-then-rename: the committer's poll matches done_name the instant it
+    # appears in listdir, so the marker must never be visible half-written
+    with open(done_path + ".tmp", "w") as f:
+        json.dump(done_payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(done_path + ".tmp", done_path)
+    _fsync_path(staging)
+    if not snap.is_committer:
+        return snap.final_dir
+
+    merged_files = dict(files)
+    if snap.num_processes > 1:
+        deadline = time.monotonic() + _commit_timeout()
+        want = snap.num_processes
+        if snap.save_on_each_node:
+            # per-node dirs: only this node's ranks drop markers here. The
+            # launcher declares the node size; without a declaration assume a
+            # shared fs (every rank's marker lands in this staging dir).
+            local = os.environ.get("LOCAL_WORLD_SIZE", "")
+            if local.strip().isdigit():
+                want = max(1, min(want, int(local)))
+        while True:
+            done = [n for n in os.listdir(staging) if _DONE_RE.fullmatch(n)]
+            if heartbeat is not None:
+                heartbeat(waiting_ranks=want - len(done))
+            if len(done) >= want:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"checkpoint commit timed out waiting for rank done-markers in "
+                    f"{staging} ({len(done)}/{want} present). On a node-local "
+                    "filesystem use save_on_each_node (and declare LOCAL_WORLD_SIZE "
+                    "so each node's commit waits only for its own ranks); raise "
+                    "ACCELERATE_CKPT_COMMIT_TIMEOUT for slow filesystems."
+                )
+            time.sleep(0.05)
+        for name in done:
+            with open(os.path.join(staging, name)) as f:
+                merged_files.update(json.load(f).get("files", {}))
+
+    manifest = {
+        "schema": 1,
+        "iteration": snap.iteration,
+        "num_processes": snap.num_processes,
+        "sharded": snap.sharded,
+        "total_bytes": snap.nbytes,
+        "committed_at_unix": round(time.time(), 3),
+        "files": merged_files,
+    }
+    marker = os.path.join(staging, COMMITTED_MARKER)
+    with open(marker, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(staging)
+    _maybe_crash("before_replace")
+    final = snap.final_dir
+    trash = final + _TRASH_SUFFIX
+    try:
+        if os.path.isdir(final):
+            shutil.rmtree(trash, ignore_errors=True)
+            os.replace(final, trash)
+        os.replace(staging, final)
+    except FileNotFoundError:
+        # a peer committer (save_on_each_node on a shared fs) won the race;
+        # the checkpoint is in place either way
+        if not os.path.isdir(final):
+            raise
+    shutil.rmtree(trash, ignore_errors=True)
+    parent = os.path.dirname(os.path.abspath(final))
+    if os.path.isdir(parent):
+        _fsync_path(parent)
+    return final
+
+
+def write_and_commit(
+    snap: CheckpointSnapshot, heartbeat: Optional[Callable[..., None]] = None
+) -> str:
+    """Writer-side pipeline: serialize → fsync → commit → rotate. Runs on the
+    caller thread for blocking saves and on the background writer for async
+    ones; telemetry marks the time hidden when async."""
+    from .telemetry import events as _tel
+
+    hidden = not snap.blocking
+    files, timings = write_snapshot(snap, heartbeat=heartbeat)
+    _tel.emit(
+        "checkpoint",
+        phase="serialize",
+        dur_s=round(timings["serialize_s"], 6),
+        dir=snap.final_dir,
+        hidden=hidden,
+    )
+    _tel.emit(
+        "checkpoint",
+        phase="write",
+        dur_s=round(timings["write_s"], 6),
+        bytes=sum(int(rec.get("bytes", 0)) for rec in files.values()),
+        dir=snap.final_dir,
+        hidden=hidden,
+    )
+    t0 = time.monotonic()
+    final = commit_snapshot(snap, files, heartbeat=heartbeat)
+    _tel.emit(
+        "checkpoint",
+        phase="commit",
+        dur_s=round(time.monotonic() - t0, 6),
+        dir=final,
+        hidden=hidden,
+        committed=snap.is_committer,
+    )
+    if snap.is_committer and snap.rotation is not None:
+        rotate_checkpoints(snap.rotation[0], snap.rotation[1], final)
+    logger.info(f"saved state to {final}")
+    return final
+
+
+def save_accelerator_state(
+    accelerator,
+    output_dir: Optional[str] = None,
+    params=None,
+    opt_state=None,
+    save_on_each_node: bool = False,
+    sharded: Optional[bool] = None,
+) -> str:
+    """Save everything needed to resume (reference ``save_accelerator_state:62``
+    driven by ``accelerator.save_state:3529``) — the blocking path:
+    snapshot + write + commit back-to-back on the caller thread, with the same
+    staging/fsync/marker crash-consistency the async writer uses.
+
+    ``params``/``opt_state`` let functional training loops pass their live
+    threaded values explicitly; without them the values written back by the
+    prepared train step (``Accelerator.prepare_train_step``) are used.
+
+    ``sharded=True`` (auto-on when any leaf spans hosts) writes model/optimizer
+    state as per-process shard files — no host ever materializes the full
+    state (reference ``save_fsdp_model utils/fsdp_utils.py:103`` via
+    ``torch.distributed.checkpoint`` sharded writers).
+    """
+    snap = snapshot_accelerator_state(
+        accelerator,
+        output_dir=output_dir,
+        params=params,
+        opt_state=opt_state,
+        save_on_each_node=save_on_each_node,
+        sharded=sharded,
+        blocking=True,
+    )
+    final = write_and_commit(snap)
+    # no process reads a checkpoint its peers have not finished committing
+    accelerator.wait_for_everyone()
+    return final
+
+
+def find_latest_checkpoint(base: str) -> str:
+    """Newest *committed* ``checkpoint_<i>`` under ``base``: staging dirs are
+    invisible, interrupted commits are repaired first, and an uncommitted
+    (torn) newer dir is skipped in favor of the newest committed one — a
+    kill -9 mid-save can therefore never leave resume pointing at garbage.
+    Dirs predating the commit protocol (no marker at all) remain loadable as
+    a fallback when no committed dir exists."""
+    if not os.path.isdir(base):
+        raise FileNotFoundError(f"no checkpoints under {base}")
+    for name in sorted(os.listdir(base)):
+        stem = name.removesuffix(STAGING_SUFFIX)
+        if name.endswith(STAGING_SUFFIX) and _AUTO_DIR_RE.fullmatch(stem):
+            repair_interrupted_commit(os.path.join(base, stem))
+    candidates = sorted(
+        (d for d in os.listdir(base) if _AUTO_DIR_RE.fullmatch(d)),
+        key=lambda d: int(d.split("_")[1]),
+    )
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints under {base}")
+    committed = [d for d in candidates if is_committed_checkpoint(os.path.join(base, d))]
+    if committed:
+        skipped = [d for d in candidates if int(d.split("_")[1]) > int(committed[-1].split("_")[1])]
+        if skipped:
+            logger.warning(
+                f"ignoring uncommitted checkpoint dir(s) {skipped} (torn save?); "
+                f"resuming from {committed[-1]}"
+            )
+        return os.path.join(base, committed[-1])
+    logger.warning(
+        f"no committed checkpoints under {base}; falling back to newest dir "
+        f"{candidates[-1]} (pre-async-checkpoint layout)"
+    )
+    return os.path.join(base, candidates[-1])
+
+
+def _validate_manifest(input_dir: str) -> None:
+    """Check the committed manifest against the directory: every listed file
+    must be present with the recorded size (and, with
+    ``ACCELERATE_CKPT_VERIFY=crc``, the recorded whole-file CRC32). Catches
+    post-commit tampering/truncation before any bytes are deserialized; chunk
+    CRCs in the sharded format are additionally verified on every read."""
+    marker = os.path.join(input_dir, COMMITTED_MARKER)
+    if not os.path.isfile(marker):
+        return
+    try:
+        with open(marker) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"unparseable commit manifest {marker}: {e}", path=marker
+        ) from e
+    check_crc = os.environ.get("ACCELERATE_CKPT_VERIFY", "size").strip().lower() == "crc"
+    for name, rec in (manifest.get("files") or {}).items():
+        path = os.path.join(input_dir, name)
+        if not os.path.isfile(path):
+            # per-process files (RNG) legitimately live only on their node
+            # under save_on_each_node; missing SHARED artifacts are corruption
+            if name.startswith(RNG_NAME):
+                continue
+            raise CheckpointCorruptError(
+                f"checkpoint {input_dir} is missing {name} listed in its commit "
+                "manifest",
+                path=path,
+            )
+        size = os.path.getsize(path)
+        if rec.get("bytes") is not None and size != int(rec["bytes"]):
+            raise CheckpointCorruptError(
+                f"checkpoint file {path} has {size} bytes, manifest says "
+                f"{rec['bytes']} (torn/tampered write)",
+                path=path,
+            )
+        if check_crc and rec.get("crc32") is not None:
+            crc = _file_crc32(path)
+            if crc != int(rec["crc32"]):
+                raise CheckpointCorruptError(
+                    f"checkpoint file {path} fails manifest CRC32 "
+                    f"({crc:#010x} != {int(rec['crc32']):#010x})",
+                    path=path,
+                )
 
 
 def load_accelerator_state(
@@ -300,13 +846,18 @@ def load_accelerator_state(
 
     if input_dir is None:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
-        candidates = sorted(
-            (d for d in os.listdir(base) if re.fullmatch(r"checkpoint_\d+", d)),
-            key=lambda d: int(d.split("_")[1]),
-        )
-        if not candidates:
-            raise FileNotFoundError(f"no checkpoints under {base}")
-        input_dir = os.path.join(base, candidates[-1])
+        input_dir = find_latest_checkpoint(base)
+    else:
+        # a crash between marker and rename leaves the checkpoint under
+        # `<dir>.tmp` with the marker inside — finish the rename and load it
+        if not os.path.isdir(input_dir):
+            repair_interrupted_commit(input_dir)
+        if os.path.isdir(input_dir) and not is_committed_checkpoint(input_dir):
+            logger.warning(
+                f"loading {input_dir} without a {COMMITTED_MARKER} manifest "
+                "(pre-async-checkpoint save, or a save torn mid-write)"
+            )
+    _validate_manifest(input_dir)
 
     # user pre-hooks see the RESOLVED directory (after latest-checkpoint
     # discovery), reference register_load_state_pre_hook contract (:3664)
@@ -386,14 +937,6 @@ def load_accelerator_state(
         return (restored[0], restored_opt_state) if opt_state is not None else restored[0]
     accelerator._models = restored
     return (restored, restored_opt_state) if opt_state is not None else restored
-
-
-def _save_custom(obj, path: str) -> None:
-    state = obj.state_dict()
-    flat = flatten_pytree(state)
-    np.savez(path, **flat)
-    with open(path + ".meta.json", "w") as f:
-        json.dump({"keys": sorted(flat)}, f)
 
 
 def _load_custom(obj, path: str) -> None:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import re
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
 import numpy as np
@@ -46,6 +47,17 @@ from .logging import get_logger
 logger = get_logger(__name__)
 
 _SHARD_RE = re.compile(r"(?P<prefix>.+)-shard-(?P<proc>\d{5})\.index\.json")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity validation (CRC mismatch, short
+    read, torn container, unparseable index). Carries ``path`` naming the
+    offending file so operators know exactly what to delete/restore instead
+    of silently assembling garbage from a torn write."""
+
+    def __init__(self, message: str, path: Optional[str] = None):
+        super().__init__(message)
+        self.path = path
 
 
 def _ckpt_format() -> str:
@@ -88,20 +100,43 @@ def _spec_to_json(sharding) -> Optional[list]:
     return [_axis(a) for a in spec]
 
 
-def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
-    """Write this process's chunks of ``tree`` (called on EVERY process).
+@dataclass
+class ShardedTreeSnapshot:
+    """Host-side capture of one process's replica-0 chunks of a pytree.
+
+    The **snapshot** half of a sharded save: every array region this process
+    must write is already copied to host numpy (``chunks``), with the
+    coordinate/layout metadata (``leaves_meta``) the index file needs. After
+    construction nothing references device memory — serialization can happen
+    on another thread, arbitrarily later, against mutated live arrays.
+    """
+
+    process_index: int
+    num_processes: int
+    chunks: "dict[str, np.ndarray]" = field(default_factory=dict)
+    leaves_meta: "dict[str, dict]" = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.chunks.values())
+
+
+def snapshot_sharded_pytree(tree) -> ShardedTreeSnapshot:
+    """Device→host capture of this process's replica-0 chunks (called on EVERY
+    process). The fast phase of a sharded save: only the addressable shards
+    this host already owns are copied — no collectives, no file IO.
 
     Non-``jax.Array`` leaves (host numpy/scalars, replicated by construction)
-    are written by process 0 only, as a single full chunk.
+    are captured by process 0 only, as a single full chunk.
     """
     import jax
 
-    os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     nproc = jax.process_count()
 
-    chunks: dict[str, np.ndarray] = {}
-    leaves_meta: dict[str, dict] = {}
+    snap = ShardedTreeSnapshot(process_index=proc, num_processes=nproc)
+    chunks = snap.chunks
+    leaves_meta = snap.leaves_meta
     counter = 0
 
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
@@ -136,7 +171,10 @@ def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
                 written_regions.add(region)
                 ckey = f"c{counter:07d}"
                 counter += 1
-                data = np.asarray(shard.data)
+                # explicit copy: on the CPU backend np.asarray can alias the
+                # device buffer, and a donated buffer mutates under an async
+                # writer — the snapshot must own its bytes
+                data = np.array(shard.data, copy=True)
                 if data.dtype.kind not in "fiub" or str(data.dtype) == "bfloat16":
                     data = data.astype(np.float32)
                 chunks[ckey] = data
@@ -147,7 +185,7 @@ def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
             # their indices will carry this leaf
         else:
             if proc == 0:
-                arr = np.asarray(leaf)
+                arr = np.array(leaf, copy=True)
                 ckey = f"c{counter:07d}"
                 counter += 1
                 if arr.dtype.kind not in "fiub" or str(arr.dtype) == "bfloat16":
@@ -159,6 +197,25 @@ def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
                     "spec": None,
                     "chunks": [{"key": ckey, "start": [0] * arr.ndim, "stop": list(arr.shape)}],
                 }
+    return snap
+
+
+def write_sharded_snapshot(
+    snap: ShardedTreeSnapshot,
+    directory: str,
+    prefix: str = "model",
+    heartbeat=None,
+) -> "dict[str, dict]":
+    """Serialize a :class:`ShardedTreeSnapshot` — the **write** half of a
+    sharded save; pure file IO, safe on a background thread. Returns
+    ``{filename: {"bytes": n, "crc32": c | None}}`` for the commit manifest.
+    ``heartbeat`` (if given) is called once per file written so a watchdog can
+    tell a hung filesystem from a large save.
+    """
+    os.makedirs(directory, exist_ok=True)
+    proc = snap.process_index
+    chunks = snap.chunks
+    leaves_meta = snap.leaves_meta
 
     fmt = _ckpt_format()
     index_file = os.path.join(directory, f"{prefix}-shard-{proc:05d}.index.json")
@@ -182,13 +239,30 @@ def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
         for meta in leaves_meta.values():
             for chunk in meta["chunks"]:
                 chunk.update(layout[chunk["key"]])
+    if heartbeat is not None:
+        heartbeat(os.path.basename(shard_file))
     with open(index_file, "w") as f:
         json.dump(
-            {"process_index": proc, "num_processes": nproc, "leaves": leaves_meta},
+            {"process_index": proc, "num_processes": snap.num_processes, "leaves": leaves_meta},
             f,
         )
+    if heartbeat is not None:
+        heartbeat(os.path.basename(index_file))
     logger.info(f"wrote {len(chunks)} chunks to {shard_file}")
-    return shard_file
+    return {
+        os.path.basename(shard_file): {"bytes": os.path.getsize(shard_file)},
+        os.path.basename(index_file): {"bytes": os.path.getsize(index_file)},
+    }
+
+
+def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
+    """Write this process's chunks of ``tree`` (called on EVERY process):
+    :func:`snapshot_sharded_pytree` + :func:`write_sharded_snapshot` run
+    back-to-back on the caller thread."""
+    snap = snapshot_sharded_pytree(tree)
+    written = write_sharded_snapshot(snap, directory, prefix=prefix)
+    shard = next(n for n in written if not n.endswith(".index.json"))
+    return os.path.join(directory, shard)
 
 
 def is_sharded_checkpoint(directory: str, prefix: str = "model") -> bool:
@@ -207,8 +281,15 @@ def _read_indices(directory: str, prefix: str) -> dict[str, dict]:
         if not m or m.group("prefix") != prefix:
             continue
         found = True
-        with open(os.path.join(directory, name)) as f:
-            index = json.load(f)
+        try:
+            with open(os.path.join(directory, name)) as f:
+                index = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"unparseable shard index {os.path.join(directory, name)}: {e} "
+                "(torn write? delete this checkpoint and resume from an older one)",
+                path=os.path.join(directory, name),
+            ) from e
         stem = os.path.join(directory, name[: -len(".index.json")])
         for key, meta in index["leaves"].items():
             entry = merged.setdefault(
@@ -258,12 +339,19 @@ class _ChunkReader:
         for file, want in by_file.items():
             seen: set[int] = set()
             want = [c for c in want if not (c["offset"] in seen or seen.add(c["offset"]))]
-            bufs = native_io.read_chunks(
-                file,
-                [c["offset"] for c in want],
-                [c["nbytes"] for c in want],
-                [c["crc32"] for c in want] if all("crc32" in c for c in want) else None,
-            )
+            try:
+                bufs = native_io.read_chunks(
+                    file,
+                    [c["offset"] for c in want],
+                    [c["nbytes"] for c in want],
+                    [c["crc32"] for c in want] if all("crc32" in c for c in want) else None,
+                )
+            except (ValueError, IOError) as e:
+                # CRC mismatch or short read: a torn/corrupt chunk container.
+                # Name the file so the operator knows what to discard.
+                raise CheckpointCorruptError(
+                    f"corrupt checkpoint chunk file {file}: {e}", path=file
+                ) from e
             for c, buf in zip(want, bufs):
                 self._bin_cache[(file, c["offset"])] = np.frombuffer(
                     buf, dtype=np.dtype(c["dtype"])
@@ -277,7 +365,12 @@ class _ChunkReader:
                 self.read_many([chunk])
             return self._bin_cache[key]
         if file not in self._open:
-            self._open[file] = np.load(file, allow_pickle=False)
+            try:
+                self._open[file] = np.load(file, allow_pickle=False)
+            except Exception as e:  # torn zip container
+                raise CheckpointCorruptError(
+                    f"corrupt checkpoint shard file {file}: {e}", path=file
+                ) from e
         return self._open[file][chunk["key"]]
 
     def close(self):

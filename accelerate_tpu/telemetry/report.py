@@ -9,6 +9,10 @@
 - a data-pipeline section: per-phase input wait (fetch / transfer / stall),
   prefetch queue occupancy and the overlap ratio — how much of the input
   pipeline was hidden behind device compute,
+- a checkpoints section: saves, bytes written, per-phase time
+  (snapshot / serialize / write / commit / backpressure) and the
+  exposed-vs-hidden split — how many checkpoint seconds the train loop
+  actually paid vs how many the async writer overlapped,
 - device/host memory peaks,
 - comms traffic per collective op (calls + payload bytes),
 - per-rank event counts and the dropped-event total in the header — silent
@@ -276,6 +280,31 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
             max(0.0, min(1.0, 1.0 - prefetch["stall_s"] / busy)), 6
         )
 
+    # -- checkpoints: per-phase time, exposed (train loop blocked) vs hidden --
+    ckpts = [e for e in events if e.get("kind") == "checkpoint"]
+    ck_phases: dict = {}
+    ck_exposed = 0.0
+    ck_hidden = 0.0
+    for c in ckpts:
+        phase = str(c.get("phase", "?"))
+        dur = float(c.get("dur_s", 0.0))
+        ck_phases.setdefault(phase, []).append(dur)
+        # records predating the async writer carry no flag: they were
+        # synchronous, i.e. exposed stall on the train loop
+        if c.get("hidden", False):
+            ck_hidden += dur
+        else:
+            ck_exposed += dur
+    checkpoints = {
+        "saves": sum(1 for c in ckpts if c.get("phase") == "commit" and c.get("committed", True)),
+        "bytes": sum(int(c.get("bytes", 0)) for c in ckpts if c.get("phase") == "write"),
+        "exposed_s": round(ck_exposed, 6),
+        "hidden_s": round(ck_hidden, 6),
+        "phases": {
+            p: dict(_dist(v), total=round(sum(v), 6)) for p, v in sorted(ck_phases.items())
+        },
+    }
+
     report = {
         "schema": max((int(m.get("schema", 0)) for m in metas), default=0),
         "runs": sorted({str(m.get("run_id")) for m in metas if m.get("run_id")}),
@@ -313,6 +342,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
             "prefetch": prefetch,
         },
         "data_wait_events": len(waits),
+        "checkpoints": checkpoints,
     }
     if by_rank:
         report["ranks"] = _rank_section(events, file_rank, paths)
@@ -384,6 +414,19 @@ def format_report(report: dict) -> str:
                 f"  prefetch: {pf['batches']} batch(es) over {pf['epochs']} epoch(s), "
                 f"overlap {ratio_s}{occ_s}"
             )
+    ck = report.get("checkpoints") or {}
+    if ck.get("saves") or (ck.get("phases") or {}):
+        lines.append(
+            f"checkpoints: {ck.get('saves', 0)} save(s), {_fmt_bytes(ck.get('bytes', 0))} "
+            f"written — exposed stall {ck.get('exposed_s', 0.0) * 1e3:.2f}ms, "
+            f"hidden (overlapped) {ck.get('hidden_s', 0.0) * 1e3:.2f}ms"
+        )
+        for phase, d in (ck.get("phases") or {}).items():
+            if d.get("count"):
+                lines.append(
+                    f"  {phase:<12} n={d['count']}  total={d['total'] * 1e3:.2f}ms  "
+                    f"p50={d['p50'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
+                )
     m = report["memory"]
     lines.append(
         "memory peaks: device "

@@ -3,6 +3,7 @@ from .dataclasses import (
     AORecipeKwargs,
     AutocastConfig,
     AutocastKwargs,
+    CheckpointConfig,
     ComputeEnvironment,
     CustomDtype,
     DDPCommunicationHookType,

@@ -296,6 +296,37 @@ class WatchdogConfig(KwargsHandler):
 
 
 @dataclass
+class CheckpointConfig(KwargsHandler):
+    """Asynchronous zero-stall checkpointing (no reference counterpart — the
+    reference's ``save_state`` blocks for the full serialize+write; see
+    ``docs/checkpointing.md`` "Async saves and crash consistency").
+
+    ``async_save``: default for ``Accelerator.save_state`` — when True, saves
+    run ``blocking=False``: the train loop only pays the device→host snapshot
+    (milliseconds) and a single daemon writer serializes, fsyncs and commits
+    in the background. Per-call ``save_state(..., blocking=...)`` overrides.
+    ``max_in_flight``: how many snapshots may be queued/writing at once;
+    an additional ``save_state`` blocks (back-pressure) until a slot frees —
+    the default of 1 bounds host RAM to one extra state copy.
+    ``save_on_each_node``: default for the same-named ``save_state`` kwarg
+    (reference ``save_state:3529``): every node writes a full copy to its
+    node-local dir instead of only the main process writing one.
+    Seeds from ``ACCELERATE_ASYNC_CHECKPOINT`` so a launcher can flip saves
+    async without code changes.
+    """
+
+    async_save: bool = field(
+        default_factory=lambda: parse_flag_from_env("ACCELERATE_ASYNC_CHECKPOINT", False)
+    )
+    max_in_flight: int = 1
+    save_on_each_node: bool = False
+
+    def __post_init__(self):
+        if self.max_in_flight < 1:
+            raise ValueError(f"max_in_flight must be >= 1, got {self.max_in_flight}")
+
+
+@dataclass
 class GradScalerConfig(KwargsHandler):
     """fp16 loss-scaling settings (reference ``GradScalerKwargs:241``). Only used for
     ``mixed_precision="fp16"``; bf16 on TPU needs no scaler. Implemented with a

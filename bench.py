@@ -791,6 +791,37 @@ def run_bench():
     }
 
 
+def run_bench_checkpoint_stall(on_tpu: bool) -> dict:
+    """Checkpoint-stall config (ISSUE 5 acceptance): exposed-stall ratio of
+    async vs sync ``save_state`` around a fixed-cadence step loop — how much
+    of the blocking save's step-time tax the background writer still exposes
+    (< 0.20 is the bar), plus async p95 step time vs the no-checkpoint
+    baseline. Delegates to ``benchmarks/checkpoint/run.py``."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "checkpoint", "run.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_checkpoint_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the benchmark's defaults: enough compute per save window (every*compute_ms)
+    # to hide a 16 MiB fsync'd write — smaller windows make the ratio noisy
+    # (sync's total stall shrinks toward the async path's constant snapshot cost)
+    out = mod.run_bench_checkpoint(on_tpu, steps=75, compute_ms=30.0, every=25, mb=16.0)
+    return {
+        "metric": "checkpoint exposed-stall ratio (async/sync)",
+        "value": out["value"],
+        "unit": out["unit"],
+        "p95_async_over_baseline": out["p95_async_over_baseline"],
+        "baseline": out["baseline"],
+        "sync": out["sync"],
+        "async": out["async"],
+        "state_mb": out["state_mb"],
+        "save_every": out["save_every"],
+    }
+
+
 def run_bench_longcontext(on_tpu: bool) -> dict:
     """Long-context config (reference claims: CP "1M+ seq" / ALST "15M tokens",
     ``docs/source/concept_guides/{context,sequence}_parallelism.md``; here the
@@ -1321,6 +1352,7 @@ def main():
         # reference's Llama-1B scale (24L x 2048) — the old 12-layer anchor is
         # not like-for-like; a fresh anchor is seeded on the next TPU run
         ("compile_time_llama1b", run_bench_compile_time),
+        ("checkpoint_stall", run_bench_checkpoint_stall),
     ):
         if _remaining() < 120:
             configs[name] = {

@@ -1,0 +1,144 @@
+"""Checkpoint-stall microbench: step-time tax of periodic saves, sync vs async.
+
+Runs a fixed-cadence "train" loop (per-step compute stand-in) over a params/
+opt-state pytree of ``--mb`` megabytes and measures the p95 step time for
+three variants:
+
+- ``baseline``: no checkpointing at all,
+- ``sync``:  ``save_state`` (blocking) every ``--every`` steps,
+- ``async``: ``save_state(blocking=False)`` every ``--every`` steps.
+
+The async writer hides the serialize+fsync+commit behind subsequent steps, so
+its p95 should sit near the baseline while sync pays the full write on every
+saving step. ``value`` is the exposed-stall ratio: how much of the sync
+save's extra step time the async path still exposes (lower is better; the
+acceptance bar in ISSUE 5 is < 0.20). Emits one JSON line per the bench.py
+conventions.
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit
+
+
+def _percentile(values, p):
+    values = sorted(values)
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, max(0, int(round(p / 100 * (len(values) - 1)))))
+    return values[idx]
+
+
+def _params(mb: float):
+    import numpy as np
+
+    n = max(1, int(mb * (1 << 20) / 4 / 2))  # two leaves
+    return {
+        "w": np.random.default_rng(0).standard_normal(n).astype(np.float32),
+        "m": np.zeros(n, dtype=np.float32),
+    }
+
+
+def _measure(steps, compute_s, every, mode, mb):
+    """One loop; returns per-step wall times and total save-call time."""
+    from accelerate_tpu import Accelerator, CheckpointConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    workdir = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+    try:
+        acc = Accelerator(
+            project_config=ProjectConfiguration(
+                project_dir=workdir, automatic_checkpoint_naming=True, total_limit=2
+            ),
+            checkpoint_config=CheckpointConfig(async_save=(mode == "async")),
+        )
+        params = _params(mb)
+        acc.save_state(params=params, blocking=True)  # warmup: backend + first dirs
+        step_times = []
+        save_call_s = 0.0
+        for step in range(steps):
+            t0 = time.monotonic()
+            time.sleep(compute_s)  # the jitted step the writer must hide under
+            if mode != "baseline" and (step + 1) % every == 0:
+                s0 = time.monotonic()
+                acc.save_state(params=params, blocking=(mode == "sync"))
+                save_call_s += time.monotonic() - s0
+            step_times.append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        acc.wait_for_checkpoint()
+        drain_s = time.monotonic() - t0
+        acc.end_training()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "p50_step_ms": round(_percentile(step_times, 50) * 1e3, 3),
+        "p95_step_ms": round(_percentile(step_times, 95) * 1e3, 3),
+        "max_step_ms": round(max(step_times) * 1e3, 3),
+        "wall_s": round(sum(step_times), 4),
+        "save_call_s": round(save_call_s, 4),
+        "drain_s": round(drain_s, 4),
+        "saves": (steps // every) if mode != "baseline" else 0,
+    }
+
+
+def run_bench_checkpoint(
+    on_tpu: bool,
+    steps: int = 75,
+    compute_ms: float = 30.0,
+    every: int = 25,
+    mb: float = 16.0,
+) -> dict:
+    # note: hiding a write takes compute to hide under — the defaults keep
+    # every*compute_ms above this box's fsync'd write time for `mb` MiB; a
+    # cadence faster than disk throughput shows up as back-pressure stall in
+    # BOTH the sync and async variants (and in the telemetry report)
+    baseline = _measure(steps, compute_ms / 1e3, every, "baseline", mb)
+    sync = _measure(steps, compute_ms / 1e3, every, "sync", mb)
+    async_ = _measure(steps, compute_ms / 1e3, every, "async", mb)
+    # exposed stall = extra whole-loop wall over baseline, charged to saving
+    sync_stall = max(1e-9, sync["wall_s"] - baseline["wall_s"])
+    async_stall = max(0.0, async_["wall_s"] - baseline["wall_s"])
+    return {
+        "bench": "checkpoint",
+        "unit": "exposed_stall_ratio(async/sync)",
+        "value": round(async_stall / sync_stall, 4),
+        "baseline": baseline,
+        "sync": sync,
+        "async": async_,
+        "p95_async_over_baseline": round(
+            async_["p95_step_ms"] / max(baseline["p95_step_ms"], 1e-9), 3
+        ),
+        "steps": steps,
+        "compute_ms": compute_ms,
+        "save_every": every,
+        "state_mb": mb,
+        "on_tpu": on_tpu,
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=75)
+    ap.add_argument("--compute-ms", type=float, default=30.0,
+                    help="per-step compute the async writer hides under")
+    ap.add_argument("--every", type=int, default=25, help="save_state cadence in steps")
+    ap.add_argument("--mb", type=float, default=16.0, help="params+opt-state size in MiB")
+    args = ap.parse_args()
+    emit(
+        run_bench_checkpoint(
+            on_tpu=detect_backend(),
+            steps=args.steps,
+            compute_ms=args.compute_ms,
+            every=args.every,
+            mb=args.mb,
+        )
+    )

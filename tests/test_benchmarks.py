@@ -52,6 +52,24 @@ def test_input_pipeline_benchmark_smoke():
     assert out["prefetch_depth"] == 2
 
 
+def test_checkpoint_benchmark_smoke():
+    """Fast tier-1 smoke: the sync-vs-async checkpoint microbench runs and
+    emits the contract keys (the zero-stall margin itself is asserted by
+    test_async_checkpoint's timing tests; wall-clock ratio assertions here
+    would be flaky on a loaded CI box)."""
+    out = run_script(
+        "benchmarks/checkpoint/run.py",
+        "--steps", "9", "--compute-ms", "10", "--every", "3", "--mb", "2",
+    )
+    assert out["bench"] == "checkpoint"
+    assert out["unit"] == "exposed_stall_ratio(async/sync)"
+    assert out["value"] >= 0
+    for variant in ("baseline", "sync", "async"):
+        assert out[variant]["p95_step_ms"] > 0
+    assert out["sync"]["saves"] == out["async"]["saves"] == 3
+    assert out["baseline"]["saves"] == 0
+
+
 def test_benchmark_dirs_are_documented():
     dirs = [p for p in (REPO / "benchmarks").iterdir() if p.is_dir() and p.name != "__pycache__"]
     assert len(dirs) >= 5

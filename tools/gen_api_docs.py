@@ -88,6 +88,21 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         [("accelerate_tpu.generation", None),
          ("accelerate_tpu.parallel.pipeline", None)],
     ),
+    "checkpointing": (
+        "Checkpointing",
+        "Crash-consistent (staging + fsync + `_COMMITTED` marker + atomic "
+        "rename) save/load with an async zero-stall path: "
+        "`save_state(blocking=False)` pays only the device→host snapshot; a "
+        "background writer serializes and commits (see `docs/checkpointing.md`).",
+        [("accelerate_tpu.checkpointing",
+          ["CheckpointCorruptError", "CheckpointSnapshot", "snapshot_accelerator_state",
+           "write_snapshot", "commit_snapshot", "write_and_commit",
+           "save_accelerator_state", "load_accelerator_state", "find_latest_checkpoint",
+           "is_committed_checkpoint", "rotate_checkpoints", "repair_interrupted_commit",
+           "save_model", "load_checkpoint_in_model"]),
+         ("accelerate_tpu.checkpoint_async", ["CheckpointManager"]),
+         ("accelerate_tpu.utils.dataclasses", ["CheckpointConfig"])],
+    ),
     "kwargs": (
         "Kwargs handlers and plugins",
         "Configuration dataclasses (reference `utils/dataclasses.py`).",
