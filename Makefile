@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt doctor
+        bench-input bench-ckpt doctor lint
 
 PYTEST := python -m pytest -q
 
@@ -73,6 +73,12 @@ test_checkpoint:
 quality:
 	python -m compileall -q accelerate_tpu
 
+# jaxlint: traced-code static analysis (host syncs, recompile hazards,
+# donation bugs, rank-divergent collectives, trace-time nondeterminism).
+# Exit 0 iff no findings beyond jaxlint-baseline.json and inline disables.
+lint:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.analysis lint accelerate_tpu/
+
 bench:
 	python bench.py
 
@@ -84,7 +90,8 @@ bench-input:
 bench-ckpt:
 	python benchmarks/checkpoint/run.py
 
-# forensics self-check: flight-recorder dump, watchdog stall detection and
-# straggler report against synthetic inputs (telemetry/report.py run_doctor)
+# self-check: flight-recorder dump, watchdog stall detection, straggler
+# report, collective-divergence detection and the jaxlint engine against
+# synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor

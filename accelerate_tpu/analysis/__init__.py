@@ -1,0 +1,71 @@
+"""jaxlint — static analysis for jit-traced JAX code.
+
+An AST-based rule engine that discovers the jit/pjit/shard_map-decorated
+functions in a package and the call graph reachable from them, then runs
+JAX-aware rules over that **traced region**: host syncs (R1), recompile
+hazards (R2), buffer-donation bugs (R3), rank-divergent collectives (R4),
+and trace-time nondeterminism (R5). Every rule descends from a bug this
+repo shipped or autopsied at runtime; the linter turns those runtime
+detectors (telemetry PR 2, forensics PR 4) into preventions.
+
+Entry points::
+
+    python -m accelerate_tpu.analysis lint accelerate_tpu/   # the CLI
+    make lint                                                # same, CI-wired
+
+    from accelerate_tpu.analysis import run_lint
+    result = run_lint(["accelerate_tpu/"])
+    result.ok, result.new_findings
+
+Pure stdlib ``ast`` — linting never imports the analyzed code and never
+touches a jax backend. See ``docs/static_analysis.md`` for the rule
+catalog, and ``jaxlint-baseline.json`` for the ratcheting baseline.
+"""
+
+from .baseline import (
+    BASELINE_FILENAME,
+    apply_baseline,
+    discover_baseline,
+    load_baseline,
+    write_baseline,
+)
+from .callgraph import (
+    FunctionInfo,
+    JitSpec,
+    ModuleIndex,
+    PackageIndex,
+    TracedRegion,
+    build_package_index,
+    discover_traced,
+)
+from .engine import LintResult, run_lint
+from .findings import Finding, Severity, summarize
+from .reporters import JSON_SCHEMA_VERSION, render_human, render_json
+from .rules import RULES, Rule, RuleContext, load_all_rules
+
+__all__ = [
+    "BASELINE_FILENAME",
+    "Finding",
+    "FunctionInfo",
+    "JitSpec",
+    "JSON_SCHEMA_VERSION",
+    "LintResult",
+    "ModuleIndex",
+    "PackageIndex",
+    "RULES",
+    "Rule",
+    "RuleContext",
+    "Severity",
+    "TracedRegion",
+    "apply_baseline",
+    "build_package_index",
+    "discover_baseline",
+    "discover_traced",
+    "load_all_rules",
+    "load_baseline",
+    "render_human",
+    "render_json",
+    "run_lint",
+    "summarize",
+    "write_baseline",
+]

@@ -1,0 +1,105 @@
+"""``python -m accelerate_tpu.analysis`` — the jaxlint CLI.
+
+Subcommands:
+
+- ``lint PATH... [--json] [--rules R1,R4] [--baseline FILE] [--no-baseline]
+  [--write-baseline] [--verbose]`` — lint files/dirs; exit 0 iff no *new*
+  (unsuppressed, unbaselined) findings and no parse errors.
+- ``rules`` — print the rule catalog.
+
+``make lint`` wires ``lint accelerate_tpu/`` into CI; the baseline at the
+repo root is discovered automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from . import baseline as baseline_mod
+from .engine import run_lint
+from .reporters import render_human, render_json
+from .rules import load_all_rules
+
+
+def main(argv: Optional["list[str]"] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m accelerate_tpu.analysis",
+        description="jaxlint: static analysis for jit-traced JAX code "
+        "(host syncs, recompile hazards, donation bugs, rank-divergent "
+        "collectives, trace-time nondeterminism).",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    lint = sub.add_parser("lint", help="lint files or directories")
+    lint.add_argument("paths", nargs="+", help="python files or package dirs")
+    lint.add_argument("--json", action="store_true", help="machine-readable output")
+    lint.add_argument(
+        "--rules",
+        help="comma-separated subset (e.g. R1,R4); default: all rules",
+    )
+    lint.add_argument(
+        "--baseline",
+        help=f"baseline file (default: nearest {baseline_mod.BASELINE_FILENAME} "
+        "above the first path)",
+    )
+    lint.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline: report every finding as new",
+    )
+    lint.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    lint.add_argument(
+        "--verbose",
+        action="store_true",
+        help="also print suppressed/baselined findings",
+    )
+
+    sub.add_parser("rules", help="print the rule catalog")
+
+    args = parser.parse_args(argv)
+    if args.command == "rules":
+        for rule in load_all_rules().values():
+            print(f"{rule.id}  {rule.name}  [{rule.severity}]")
+            print(f"    {rule.description}")
+        return 0
+    if args.command != "lint":
+        parser.print_help()
+        return 2
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        result = run_lint(
+            args.paths,
+            rules=rules,
+            baseline_path=args.baseline,
+            use_baseline=not args.no_baseline,
+        )
+    except ValueError as exc:  # e.g. a typo in --rules must not pass vacuously
+        print(f"jaxlint: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = (
+            args.baseline
+            or result.baseline_path
+            or baseline_mod.BASELINE_FILENAME
+        )
+        n = baseline_mod.write_baseline(result.findings, path)
+        print(f"jaxlint: wrote {n} baseline entr{'y' if n == 1 else 'ies'} to {path}")
+        return 0
+
+    if args.json:
+        print(render_json(result.findings, result.stats))
+    else:
+        print(render_human(result.findings, result.stats, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Checked-in baseline: pre-existing findings ratchet down, never block.
+
+The shipped ``jaxlint-baseline.json`` lists every finding that existed when
+the linter landed and was judged not-worth-fixing-yet. A finding matching a
+baseline entry is reported but doesn't fail the run; a finding NOT in the
+baseline fails it. Entries are matched by line-number-free fingerprint
+(rule, path, enclosing symbol, stripped source line) so edits elsewhere in
+a file don't invalidate them — and matching *consumes* entries, so two new
+copies of one baselined bug still fail.
+
+``tests/test_repo_hygiene.py`` guards that the file only ever shrinks:
+fixing debt removes entries; adding debt means adding an entry, which the
+guard rejects. ``--write-baseline`` regenerates the file from the current
+findings (sorted, stable) for the shrinking case.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from .findings import Finding
+
+BASELINE_FILENAME = "jaxlint-baseline.json"
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> "list[dict]":
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a jaxlint baseline (missing 'findings')")
+    return list(data["findings"])
+
+
+def apply_baseline(findings: Iterable[Finding], entries: "list[dict]") -> None:
+    """Mark findings covered by baseline entries (in place). Each entry
+    covers at most one finding."""
+    pool: "dict[tuple, int]" = {}
+    for e in entries:
+        key = (
+            e.get("rule", ""),
+            e.get("path", ""),
+            e.get("symbol", ""),
+            e.get("line_content", ""),
+        )
+        pool[key] = pool.get(key, 0) + 1
+    for f in findings:
+        if f.suppressed:
+            continue
+        left = pool.get(f.fingerprint, 0)
+        if left > 0:
+            pool[f.fingerprint] = left - 1
+            f.baselined = True
+
+
+def write_baseline(findings: Iterable[Finding], path: str) -> int:
+    """Serialize the *unsuppressed* findings as the new baseline."""
+    # per-fingerprint multiplicity: duplicate findings on distinct lines
+    # with identical text need one entry each to all be covered
+    counts: "dict[tuple, int]" = {}
+    for f in findings:
+        if not f.suppressed:
+            counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    out = []
+    for fp in sorted(counts):
+        rule, fpath, symbol, line_content = fp
+        for _ in range(counts[fp]):
+            out.append(
+                {
+                    "rule": rule,
+                    "path": fpath,
+                    "symbol": symbol,
+                    "line_content": line_content,
+                }
+            )
+    payload = {"version": BASELINE_VERSION, "findings": out}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(out)
+
+
+def discover_baseline(paths: "list[str]") -> Optional[str]:
+    """Walk up from the first linted path looking for the baseline file —
+    so ``python -m accelerate_tpu.analysis lint accelerate_tpu/`` run from
+    the repo root finds ``./jaxlint-baseline.json`` without a flag."""
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    if os.path.isfile(start):
+        start = os.path.dirname(start)
+    current = start
+    for _ in range(12):
+        candidate = os.path.join(current, BASELINE_FILENAME)
+        if os.path.exists(candidate):
+            return candidate
+        parent = os.path.dirname(current)
+        if parent == current:
+            break
+        current = parent
+    return None

@@ -1,0 +1,662 @@
+"""Module indexing and traced-region discovery for jaxlint.
+
+jaxlint's unit of analysis is not "the file" but **the traced region**: the
+set of functions reachable from a ``jax.jit`` / ``pjit`` / ``shard_map``
+wrap point. Rules R1/R2/R5 only fire inside that region (a ``float()`` on a
+host-side numpy batch is fine; the same call on a tracer inside the jitted
+step is a device→host sync). This module builds everything the rules need:
+
+- :class:`ModuleIndex` — one parsed file: imports, every ``def`` (however
+  nested) as a :class:`FunctionInfo`, raw source lines.
+- :class:`PackageIndex` — all scanned modules plus name resolution: local
+  defs, module globals, ``from x import y``, ``self.method`` — best-effort
+  and static, the same trade every import-light linter makes.
+- :func:`discover_traced` — finds jit wrap points (decorator form, call
+  form, ``functools.partial`` form, and one level of builder indirection:
+  ``step = build(); jit(step)`` follows ``build``'s ``return`` of a nested
+  def), then BFSes the call graph to mark every reachable function traced.
+
+Pure stdlib ``ast`` — importing this module must never import jax or any
+scanned code (linting runs on machines with no TPU and in CI sandboxes).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: call targets that open a traced region, matched on the dotted tail.
+#: Bare names match when the module imports them (from jax / jax_compat);
+#: attribute forms must be rooted in a jax-ish base (``jax.jit``,
+#: ``jax.experimental.pjit.pjit``) so ``scheduler.jit`` can't false-positive.
+JIT_TAILS = {"jit", "pjit", "shard_map"}
+_JIT_BASES = {"jax", "jax.experimental.pjit", "jax.experimental.shard_map", "pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class JitSpec:
+    """One jit/pjit/shard_map wrap point and the argnums that matter."""
+
+    kind: str  # "jit" | "pjit" | "shard_map"
+    node: ast.Call  # the wrap call itself (or decorator call)
+    donate_argnums: Optional[tuple] = None
+    donate_argnames: Optional[tuple] = None
+    static_argnums: Optional[tuple] = None
+    static_argnames: Optional[tuple] = None
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums) or bool(self.donate_argnames)
+
+
+@dataclass
+class FunctionInfo:
+    """One ``def``/``lambda`` anywhere in a module."""
+
+    qualname: str  # "Class.method" / "outer.<locals>.inner"
+    module: str  # dotted module name
+    path: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str] = None
+    parent: Optional[str] = None  # enclosing function qualname
+    local_defs: "dict[str, str]" = field(default_factory=dict)  # name -> child qualname
+    jit_specs: "list[JitSpec]" = field(default_factory=list)  # wraps applied to THIS fn
+    returned_local_defs: "list[str]" = field(default_factory=list)  # builder pattern
+    _own_nodes: Optional[list] = field(default=None, repr=False, compare=False)
+
+    @property
+    def key(self) -> tuple:
+        return (self.module, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+    def param_names(self) -> "list[str]":
+        a = self.node.args
+        names = [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        names += [p.arg for p in a.kwonlyargs]
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def positional_params(self) -> "list[str]":
+        a = self.node.args
+        return [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+
+
+class _ModuleVisitor(ast.NodeVisitor):
+    """Single pass that records imports, functions (at any depth), module
+    globals, and ``global``-reassigned names."""
+
+    def __init__(self, index: "ModuleIndex"):
+        self.index = index
+        self._stack: "list[FunctionInfo]" = []
+        self._class_stack: "list[str]" = []
+
+    # -- imports -------------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.index.imports[alias.asname or alias.name.split(".")[0]] = alias.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:  # relative: resolve against this module's dotted name
+            parts = self.index.modname.split(".")
+            # level 1 == current package: for a plain module that strips the
+            # module's own leaf name; a package __init__ (modname IS the
+            # package) keeps all its parts
+            drop = node.level - 1 if self.index.is_package else node.level
+            anchor = parts[: len(parts) - drop] if drop else parts
+            base = ".".join(anchor + ([base] if base else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.index.imports[alias.asname or alias.name] = (
+                f"{base}.{alias.name}" if base else alias.name
+            )
+        self.generic_visit(node)
+
+    # -- defs ----------------------------------------------------------------
+    def _enter_function(self, node, name: str) -> FunctionInfo:
+        if self._stack:
+            parent = self._stack[-1]
+            qual = f"{parent.qualname}.<locals>.{name}"
+        elif self._class_stack:
+            qual = ".".join(self._class_stack + [name])
+            parent = None
+        else:
+            qual, parent = name, None
+        info = FunctionInfo(
+            qualname=qual,
+            module=self.index.modname,
+            path=self.index.path,
+            node=node,
+            class_name=self._class_stack[-1] if self._class_stack else None,
+            parent=parent.qualname if parent else None,
+        )
+        if parent is not None:
+            parent.local_defs[name] = qual
+        elif not self._class_stack:
+            self.index.top_defs[name] = qual
+        self.index.functions[qual] = info
+        return info
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._function(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._function(node, node.name)
+
+    def _function(self, node, name: str) -> None:
+        info = self._enter_function(node, name)
+        for deco in node.decorator_list:
+            spec = parse_jit_expr(deco, self.index)
+            if spec is not None:
+                info.jit_specs.append(spec)
+        self._stack.append(info)
+        for child in node.body:
+            self.visit(child)
+        self._stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        name = f"<lambda:{node.lineno}>"
+        info = self._enter_function(node, name)
+        self.index.lambdas[id(node)] = info
+        self._stack.append(info)
+        self.visit(node.body)
+        self._stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        for child in node.body:
+            self.visit(child)
+        self._class_stack.pop()
+
+    # -- module globals ------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._stack and not self._class_stack:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.index.module_globals[tgt.id] = node.value
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self._stack:
+            self.index.global_writes.update(node.names)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        # builder pattern: ``def build(): def step(..): ...; return step``
+        if self._stack and node.value is not None:
+            fn = self._stack[-1]
+            for name in _returned_names(node.value):
+                if name in fn.local_defs:
+                    fn.returned_local_defs.append(fn.local_defs[name])
+        self.generic_visit(node)
+
+
+def _returned_names(value: ast.AST) -> "list[str]":
+    """Names a ``return`` statement may hand back (bare name, tuple, or a
+    jit-wrap of a name)."""
+    out: list[str] = []
+    if isinstance(value, ast.Name):
+        out.append(value.id)
+    elif isinstance(value, ast.Tuple):
+        for elt in value.elts:
+            out.extend(_returned_names(elt))
+    elif isinstance(value, ast.Call) and value.args:
+        # return jax.jit(step) / return shard_map(step, ...)
+        if isinstance(value.args[0], ast.Name):
+            out.append(value.args[0].id)
+    return out
+
+
+@dataclass
+class ModuleIndex:
+    """Everything jaxlint knows about one parsed file."""
+
+    path: str
+    modname: str
+    tree: ast.Module
+    source_lines: "list[str]"
+    is_package: bool = False  # an __init__.py: modname names the package itself
+    imports: "dict[str, str]" = field(default_factory=dict)
+    functions: "dict[str, FunctionInfo]" = field(default_factory=dict)
+    top_defs: "dict[str, str]" = field(default_factory=dict)
+    lambdas: "dict[int, FunctionInfo]" = field(default_factory=dict)
+    module_globals: "dict[str, ast.AST]" = field(default_factory=dict)
+    global_writes: "set[str]" = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, path: str, modname: str, source: str) -> "ModuleIndex":
+        tree = ast.parse(source, filename=path)
+        index = cls(
+            path=path,
+            modname=modname,
+            tree=tree,
+            source_lines=source.splitlines(),
+            is_package=os.path.basename(path) == "__init__.py",
+        )
+        _ModuleVisitor(index).visit(tree)
+        return index
+
+    def line(self, lineno: int) -> str:
+        try:
+            return self.source_lines[lineno - 1].strip()
+        except IndexError:
+            return ""
+
+
+def _tuple_int_kwarg(call: ast.Call, name: str) -> Optional[tuple]:
+    for kw in call.keywords:
+        if kw.arg != name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            vals = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant):
+                    vals.append(elt.value)
+            # non-constant elements (donate_argnums=(A, B)) must still read
+            # as configured — pad with the "?" sentinel per unreadable slot
+            return tuple(vals) + ("?",) * (len(v.elts) - len(vals))
+        if isinstance(v, ast.IfExp):  # donate_argnums=(0, 1) if donate else ()
+            for arm in (v.body, v.orelse):
+                got = None
+                if isinstance(arm, (ast.Tuple, ast.List)) and arm.elts:
+                    got = tuple(
+                        e.value for e in arm.elts if isinstance(e, ast.Constant)
+                    )
+                elif isinstance(arm, ast.Constant) and arm.value != ():
+                    got = (arm.value,)
+                if got:
+                    return got  # conservatively: "donation is configured"
+        # present but not statically readable (a variable, a computed
+        # tuple): the "?" sentinel keeps the kwarg truthy — JitSpec.donates
+        # must not read configured donation as absent — while every
+        # per-argnum check skips it (they only accept ints)
+        return ("?",)
+    return None
+
+
+def _is_jit_name(name: str, index: ModuleIndex) -> bool:
+    """Does ``name`` (dotted) denote jit/pjit/shard_map here?"""
+    tail = name.rsplit(".", 1)[-1]
+    if tail not in JIT_TAILS:
+        return False
+    if "." in name:
+        base = name.rsplit(".", 1)[0]
+        resolved = index.imports.get(base.split(".")[0], base.split(".")[0])
+        full_base = base.replace(base.split(".")[0], resolved, 1)
+        return full_base in _JIT_BASES or full_base.startswith("jax.")
+    # bare name: accept when imported from a jax-ish or compat module
+    target = index.imports.get(name, "")
+    return (
+        target.startswith("jax")
+        or target.endswith(f"jax_compat.{tail}")
+        or target.endswith(f".{tail}")  # from ..utils.jax_compat import shard_map
+        and ("jax" in target or "compat" in target)
+    )
+
+
+def parse_jit_expr(node: ast.AST, index: ModuleIndex) -> Optional[JitSpec]:
+    """Recognize a jit wrap expression: ``jax.jit``, ``jax.jit(...)``,
+    ``partial(jax.jit, ...)``, ``functools.partial(jax.jit, ...)`` — used
+    both for decorators and for call-form wraps."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        name = dotted(node)
+        if name and _is_jit_name(name, index):
+            fake = ast.Call(func=node, args=[], keywords=[])
+            ast.copy_location(fake, node)
+            return JitSpec(kind=name.rsplit(".", 1)[-1], node=fake)
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    fname = dotted(node.func)
+    if fname in _PARTIAL_NAMES and node.args:
+        inner = dotted(node.args[0])
+        if inner and _is_jit_name(inner, index):
+            return JitSpec(
+                kind=inner.rsplit(".", 1)[-1],
+                node=node,
+                donate_argnums=_tuple_int_kwarg(node, "donate_argnums"),
+                donate_argnames=_tuple_int_kwarg(node, "donate_argnames"),
+                static_argnums=_tuple_int_kwarg(node, "static_argnums"),
+                static_argnames=_tuple_int_kwarg(node, "static_argnames"),
+            )
+        return None
+    if fname and _is_jit_name(fname, index):
+        return JitSpec(
+            kind=fname.rsplit(".", 1)[-1],
+            node=node,
+            donate_argnums=_tuple_int_kwarg(node, "donate_argnums"),
+            donate_argnames=_tuple_int_kwarg(node, "donate_argnames"),
+            static_argnums=_tuple_int_kwarg(node, "static_argnums"),
+            static_argnames=_tuple_int_kwarg(node, "static_argnames"),
+        )
+    return None
+
+
+@dataclass
+class JitSite:
+    """A call-form wrap point: ``jax.jit(fn, ...)`` / ``shard_map(fn, ..)``
+    with the wrapped function resolved when possible. R3 analyzes these."""
+
+    spec: JitSpec
+    module: ModuleIndex
+    enclosing: Optional[FunctionInfo]  # function containing the wrap call
+    target: Optional[FunctionInfo]  # the wrapped function, if resolved
+    bound_names: "list[str]" = field(default_factory=list)  # x = jax.jit(f)
+
+
+class PackageIndex:
+    """All scanned modules + cross-module name resolution."""
+
+    def __init__(self) -> None:
+        self.modules: "dict[str, ModuleIndex]" = {}
+        self.errors: "list[tuple[str, str]]" = []  # (path, message)
+
+    def add_file(self, path: str, modname: str) -> Optional[ModuleIndex]:
+        # same-named files outside packages (scripts/, fixtures/) must not
+        # shadow each other — every scanned file gets its own index entry
+        base, n = modname, 2
+        while modname in self.modules:
+            modname = f"{base}#{n}"
+            n += 1
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            index = ModuleIndex.parse(path, modname, source)
+        except (OSError, SyntaxError, ValueError) as exc:
+            self.errors.append((path, f"{type(exc).__name__}: {exc}"))
+            return None
+        self.modules[modname] = index
+        return index
+
+    # -- resolution ----------------------------------------------------------
+    def resolve_call(
+        self, name: str, module: ModuleIndex, scope: Optional[FunctionInfo]
+    ) -> Optional[FunctionInfo]:
+        """Resolve a (possibly dotted) called name to a FunctionInfo."""
+        if name.startswith("self.") or name.startswith("cls."):
+            method = name.split(".", 1)[1]
+            if scope is not None and scope.class_name and "." not in method:
+                return module.functions.get(f"{scope.class_name}.{method}")
+            return None
+        if "." not in name:
+            # enclosing local defs, innermost first
+            fn = scope
+            while fn is not None:
+                if name in fn.local_defs:
+                    return module.functions.get(fn.local_defs[name])
+                fn = module.functions.get(fn.parent) if fn.parent else None
+            if name in module.top_defs:
+                return module.functions.get(module.top_defs[name])
+            target = module.imports.get(name)
+            if target and "." in target:
+                mod, leaf = target.rsplit(".", 1)
+                other = self.modules.get(mod)
+                if other and leaf in other.top_defs:
+                    return other.functions.get(other.top_defs[leaf])
+            return None
+        base, leaf = name.rsplit(".", 1)
+        if "." in base:
+            return None  # a.b.c(): too deep to chase statically
+        target_mod = module.imports.get(base)
+        other = self.modules.get(target_mod) if target_mod else None
+        if other and leaf in other.top_defs:
+            return other.functions.get(other.top_defs[leaf])
+        return None
+
+    def all_functions(self):
+        for module in self.modules.values():
+            yield from module.functions.values()
+
+
+# ---------------------------------------------------------------------------
+# traced-region discovery
+
+
+@dataclass
+class TracedRegion:
+    """Output of :func:`discover_traced`."""
+
+    traced: "dict[tuple, FunctionInfo]"  # key -> fn reachable from a wrap point
+    roots: "dict[tuple, JitSpec]"  # directly-wrapped functions
+    sites: "list[JitSite]"  # call-form wrap points (R3's input)
+
+    def is_traced(self, fn: FunctionInfo) -> bool:
+        return fn.key in self.traced
+
+    def spec_for(self, fn: FunctionInfo) -> Optional[JitSpec]:
+        return self.roots.get(fn.key)
+
+
+def _calls_in(fn: FunctionInfo):
+    """Call nodes lexically inside ``fn``, not descending into nested defs
+    (those are their own FunctionInfos)."""
+    return (n for n in iter_own_nodes(fn) if isinstance(n, ast.Call))
+
+
+def iter_own_nodes(fn: FunctionInfo):
+    """Every AST node lexically owned by ``fn`` (nested defs excluded), in
+    pre-order — the traversal surface rules use. Cached per function: every
+    rule walks every traced function, and recomputing the nested-def set
+    per walk dominated the engine's runtime."""
+    if fn._own_nodes is not None:
+        return fn._own_nodes
+    out: list = []
+    stack = [fn.node]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        first = False
+        out.append(node)
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+    fn._own_nodes = out
+    return out
+
+
+def _resolve_wrapped(
+    arg: ast.AST,
+    pkg: PackageIndex,
+    module: ModuleIndex,
+    scope: Optional[FunctionInfo],
+    local_values: "dict[str, ast.AST]",
+) -> Optional[FunctionInfo]:
+    """What function does the first argument of ``jax.jit(<arg>)`` denote?"""
+    if isinstance(arg, ast.Lambda):
+        return module.lambdas.get(id(arg))
+    if isinstance(arg, ast.Call):
+        # jax.jit(partial(f, ...)) → f
+        fname = dotted(arg.func)
+        if fname in _PARTIAL_NAMES and arg.args:
+            return _resolve_wrapped(arg.args[0], pkg, module, scope, local_values)
+        # jax.jit(build_step(...)) → the nested def build_step returns
+        if fname:
+            built = pkg.resolve_call(fname, module, scope)
+            if built is not None and built.returned_local_defs:
+                return module.functions.get(built.returned_local_defs[0])
+        return None
+    name = dotted(arg)
+    if name is None:
+        return None
+    direct = pkg.resolve_call(name, module, scope)
+    if direct is not None:
+        return direct
+    # one level of value-chasing: step = build(...); jax.jit(step)
+    if "." not in name and name in local_values:
+        return _resolve_wrapped(local_values[name], pkg, module, scope, local_values)
+    return None
+
+
+def discover_traced(pkg: PackageIndex) -> TracedRegion:
+    """Find every wrap point, resolve targets, BFS the call graph."""
+    roots: "dict[tuple, JitSpec]" = {}
+    sites: "list[JitSite]" = []
+
+    for module in pkg.modules.values():
+        # decorator-form roots were collected during parsing
+        for fn in module.functions.values():
+            for spec in fn.jit_specs:
+                roots.setdefault(fn.key, spec)
+        # call-form wrap points: jax.jit(f, ...) anywhere in the module
+        for scope_fn in [None] + list(module.functions.values()):
+            nodes = list(
+                iter_own_nodes(scope_fn)
+                if scope_fn is not None
+                else _module_level_nodes(module)
+            )
+            # pass 1 — first-assignment value map, so ``step = build(...);
+            # step = jax.jit(step)`` resolves ``step`` through the builder
+            # (the self-wrap assignment maps the name to the wrapped expr,
+            # not to the wrap itself), plus assign-targets per wrap call
+            local_values: "dict[str, ast.AST]" = {}
+            bound_by_call: "dict[int, list[str]]" = {}
+            for node in nodes:
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = node.value
+                spec = (
+                    parse_jit_expr(value, module)
+                    if isinstance(value, ast.Call)
+                    else None
+                )
+                targets = [dotted(t) for t in node.targets]
+                targets = [t for t in targets if t]
+                if spec is not None:
+                    bound_by_call[id(value)] = targets
+                    if getattr(value, "args", None):
+                        value = value.args[0]  # name denotes the wrapped fn
+                for t in targets:
+                    if "." not in t and t not in local_values:
+                        local_values[t] = value
+            # pass 2 — the wrap sites themselves
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                spec = parse_jit_expr(node, module)
+                if spec is None or not node.args:
+                    continue
+                target = _resolve_wrapped(
+                    node.args[0], pkg, module, scope_fn, local_values
+                )
+                site = JitSite(
+                    spec=spec,
+                    module=module,
+                    enclosing=scope_fn,
+                    target=target,
+                    bound_names=bound_by_call.get(id(node), []),
+                )
+                sites.append(site)
+                if target is not None:
+                    roots.setdefault(target.key, spec)
+
+    # BFS reachability over resolvable calls
+    traced: "dict[tuple, FunctionInfo]" = {}
+    frontier: "list[FunctionInfo]" = []
+    for module in pkg.modules.values():
+        for fn in module.functions.values():
+            if fn.key in roots:
+                frontier.append(fn)
+    while frontier:
+        fn = frontier.pop()
+        if fn.key in traced:
+            continue
+        traced[fn.key] = fn
+        module = pkg.modules[fn.module]
+        for call in _calls_in(fn):
+            name = dotted(call.func)
+            if name is None:
+                continue
+            callee = pkg.resolve_call(name, module, fn)
+            if callee is not None and callee.key not in traced:
+                frontier.append(callee)
+
+    return TracedRegion(traced=traced, roots=roots, sites=sites)
+
+
+def _module_level_nodes(module: ModuleIndex):
+    """Module-level statements only — every function body (top-level or
+    nested) belongs to its own FunctionInfo and is pruned, including the
+    def statement itself."""
+    fn_nodes = {id(f.node) for f in module.functions.values()}
+
+    def _walk(node):
+        if id(node) in fn_nodes:
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from _walk(child)
+
+    for stmt in module.tree.body:
+        yield from _walk(stmt)
+
+
+# ---------------------------------------------------------------------------
+# file discovery
+
+
+def modname_for(path: str) -> str:
+    """Dotted module name: walk up while __init__.py exists."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    parent = os.path.dirname(path)
+    while os.path.exists(os.path.join(parent, "__init__.py")):
+        parts.append(os.path.basename(parent))
+        parent = os.path.dirname(parent)
+    if parts[0] == "__init__":
+        parts = parts[1:] or parts
+    return ".".join(reversed(parts))
+
+
+def collect_py_files(paths: "list[str]") -> "list[str]":
+    files: "list[str]" = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d != "__pycache__" and not d.startswith(".")
+                )
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(root, name))
+    return files
+
+
+def build_package_index(paths: "list[str]") -> PackageIndex:
+    pkg = PackageIndex()
+    for path in collect_py_files(paths):
+        pkg.add_file(path, modname_for(path))
+    return pkg

@@ -1,0 +1,123 @@
+"""The jaxlint engine: parse → discover traced region → run rules →
+suppressions → baseline.
+
+``run_lint`` is the single entry point the CLI, the tests, and the
+telemetry doctor all call. It never imports the code it analyzes — pure
+``ast`` over source text — so linting is safe on machines with no jax
+backend and costs tens of milliseconds for this whole repo.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import baseline as baseline_mod
+from . import suppressions as suppress_mod
+from .callgraph import build_package_index, discover_traced
+from .findings import Finding, summarize
+from .rules import RuleContext, load_all_rules
+
+
+@dataclass
+class LintResult:
+    """Everything a caller needs: all findings (annotated), run stats, and
+    the pass/fail verdict."""
+
+    findings: "list[Finding]" = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+    baseline_path: Optional[str] = None
+
+    @property
+    def new_findings(self) -> "list[Finding]":
+        return [f for f in self.findings if f.is_new]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new_findings and not self.stats.get("parse_errors")
+
+    def summary(self) -> dict:
+        return summarize(self.findings)
+
+
+def _lint_root(paths: "list[str]") -> str:
+    """Findings carry paths relative to the common root of the linted
+    paths' parent — which for ``lint accelerate_tpu/`` from the repo root
+    means repo-relative paths, matching the baseline file."""
+    first = os.path.abspath(paths[0]) if paths else os.getcwd()
+    if os.path.isfile(first):
+        first = os.path.dirname(first)
+    return os.path.dirname(first) or first
+
+
+def run_lint(
+    paths: "list[str]",
+    rules: Optional["list[str]"] = None,
+    baseline_path: Optional[str] = None,
+    use_baseline: bool = True,
+    root: Optional[str] = None,
+) -> LintResult:
+    """Lint ``paths`` (files or directories).
+
+    ``rules`` restricts to a subset (e.g. ``["R1", "R4"]``); ``baseline_path``
+    overrides baseline discovery; ``use_baseline=False`` reports everything
+    as new (the fixture-corpus mode the tests use).
+    """
+    # resolve the baseline FIRST: when one is in play, finding paths must be
+    # relative to ITS directory so `lint accelerate_tpu/state.py` and
+    # `lint accelerate_tpu/` fingerprint the same file identically
+    resolved_baseline = baseline_path
+    if resolved_baseline is None and use_baseline:
+        resolved_baseline = baseline_mod.discover_baseline(paths)
+    if root is None and use_baseline and resolved_baseline:
+        root = os.path.dirname(os.path.abspath(resolved_baseline))
+    root = root or _lint_root(paths)
+    pkg = build_package_index(paths)
+    region = discover_traced(pkg)
+    ctx = RuleContext(pkg, region, root)
+
+    registry = load_all_rules()
+    if rules:
+        unknown = [r for r in rules if r.upper() not in registry]
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s) {unknown} — known: {sorted(registry)}"
+            )
+        selected = [registry[r.upper()] for r in rules]
+    else:
+        selected = list(registry.values())
+
+    findings: "list[Finding]" = []
+    for rule in selected:
+        findings.extend(rule.check(ctx))
+
+    # inline suppressions (path keys are lint-root-relative, like findings)
+    suppressions_by_path: "dict[str, dict[int, set]]" = {}
+    skipped_paths: "set[str]" = set()
+    for module in pkg.modules.values():
+        rel = os.path.relpath(module.path, root)
+        suppressions_by_path[rel] = suppress_mod.parse_line_suppressions(
+            module.source_lines
+        )
+        if suppress_mod.file_is_skipped(module.source_lines):
+            skipped_paths.add(rel)
+    suppress_mod.apply_suppressions(findings, suppressions_by_path, skipped_paths)
+
+    # baseline
+    if use_baseline and resolved_baseline and os.path.exists(resolved_baseline):
+        baseline_mod.apply_baseline(
+            findings, baseline_mod.load_baseline(resolved_baseline)
+        )
+
+    stats = {
+        "files": len(pkg.modules),
+        "traced_functions": len(region.traced),
+        "jit_roots": len(region.roots),
+        "jit_sites": len(region.sites),
+        "parse_errors": list(pkg.errors),
+        "rules": [r.id for r in selected],
+    }
+    return LintResult(
+        findings=findings, stats=stats, baseline_path=resolved_baseline
+    )

@@ -1,0 +1,95 @@
+"""Finding/severity model for jaxlint.
+
+A :class:`Finding` is one diagnostic at one source location. Findings are
+value objects: the engine produces them, suppressions and the baseline
+annotate them (``suppressed`` / ``baselined``), and the reporters render
+them — nothing downstream mutates the location or message.
+
+The *fingerprint* (rule, relative path, enclosing symbol, stripped source
+line) deliberately excludes the line number so a baseline entry survives
+unrelated edits above the finding; see :mod:`.baseline`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Severity(enum.IntEnum):
+    """Ordered so reporters can sort worst-first."""
+
+    NOTE = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error" / "warning" in human output
+        return self.name.lower()
+
+
+@dataclass
+class Finding:
+    """One diagnostic: ``path:line:col: <rule> <severity>: <message>``."""
+
+    rule: str  # "R1".."R5"
+    severity: Severity
+    path: str  # as scanned (engine relativizes against the lint root)
+    line: int
+    col: int
+    message: str
+    symbol: str = ""  # enclosing function qualname ("" at module level)
+    line_content: str = ""  # stripped source line, for baseline matching
+    suppressed: bool = False  # an inline ``# jaxlint: disable=Rn`` covers it
+    baselined: bool = False  # a checked-in baseline entry covers it
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> tuple:
+        """Line-number-free identity used for baseline matching."""
+        return (self.rule, self.path, self.symbol, self.line_content)
+
+    @property
+    def is_new(self) -> bool:
+        """True when neither a suppression nor the baseline covers it —
+        exactly the findings that fail the lint run."""
+        return not (self.suppressed or self.baselined)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        out = {
+            "rule": self.rule,
+            "severity": str(self.severity),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+            "line_content": self.line_content,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+        if self.extra:
+            out["extra"] = self.extra
+        return out
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule)
+
+
+def summarize(findings: "list[Finding]") -> dict:
+    """Counts the reporters and the CLI exit code are built from."""
+    new = [f for f in findings if f.is_new]
+    return {
+        "total": len(findings),
+        "new": len(new),
+        "errors": sum(1 for f in new if f.severity == Severity.ERROR),
+        "warnings": sum(1 for f in new if f.severity == Severity.WARNING),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "by_rule": {
+            rule: sum(1 for f in new if f.rule == rule)
+            for rule in sorted({f.rule for f in new})
+        },
+    }

@@ -1,0 +1,66 @@
+"""Human and JSON renderings of a lint run.
+
+The human reporter is one line per finding (``path:line:col: Rn severity:
+message [symbol]``) sorted by location, then a summary line — the format
+editors and CI log scrapers already parse for flake8-family tools. The JSON
+reporter is the machine surface ``tests/test_analysis.py`` pins a schema
+for; its top-level shape is versioned independently of the rule set.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .findings import Finding, summarize
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_human(findings: "list[Finding]", stats: dict, verbose: bool = False) -> str:
+    lines = []
+    for f in sorted(findings, key=Finding.sort_key):
+        if f.suppressed and not verbose:
+            continue
+        if f.baselined and not verbose:
+            continue
+        tag = ""
+        if f.suppressed:
+            tag = " (suppressed)"
+        elif f.baselined:
+            tag = " (baselined)"
+        sym = f" [{f.symbol}]" if f.symbol else ""
+        lines.append(
+            f"{f.location()}: {f.rule} {f.severity}: {f.message}{sym}{tag}"
+        )
+    s = summarize(findings)
+    lines.append(
+        f"jaxlint: {s['new']} new finding(s) "
+        f"({s['errors']} error(s), {s['warnings']} warning(s)), "
+        f"{s['baselined']} baselined, {s['suppressed']} suppressed — "
+        f"{stats.get('files', 0)} file(s), "
+        f"{stats.get('traced_functions', 0)} traced function(s), "
+        f"{stats.get('jit_roots', 0)} jit root(s)"
+    )
+    if s["new"] and s["by_rule"]:
+        per = ", ".join(f"{r}: {n}" for r, n in s["by_rule"].items())
+        lines.append(f"  by rule: {per}")
+    for path, err in stats.get("parse_errors", []):
+        lines.append(f"  parse error: {path}: {err}")
+    return "\n".join(lines)
+
+
+def render_json(findings: "list[Finding]", stats: dict) -> str:
+    payload = {
+        "schema": JSON_SCHEMA_VERSION,
+        "summary": summarize(findings),
+        "stats": {
+            "files": stats.get("files", 0),
+            "traced_functions": stats.get("traced_functions", 0),
+            "jit_roots": stats.get("jit_roots", 0),
+            "parse_errors": [
+                {"path": p, "error": e} for p, e in stats.get("parse_errors", [])
+            ],
+        },
+        "findings": [f.to_dict() for f in sorted(findings, key=Finding.sort_key)],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

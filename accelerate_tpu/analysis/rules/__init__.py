@@ -1,0 +1,244 @@
+"""jaxlint rule registry.
+
+A rule is a pure function ``check(ctx) -> list[Finding]`` plus metadata,
+registered at import time. Every rule descends from a bug this repo actually
+shipped or autopsied (see ``docs/static_analysis.md`` for the lineage):
+
+- **R1** host-sync in traced code — the retrace/stall class the telemetry
+  step profiler can only *report* after it burns device time.
+- **R2** recompile hazards — the jit-cache-miss storms of bench round 2.
+- **R3** donation bugs — the PR 3 schedule-free optimizer state aliasing a
+  donated param buffer.
+- **R4** rank-divergent collectives — the r04 evidence-free hang: a
+  collective reached by only some ranks deadlocks the fleet.
+- **R5** nondeterminism in traced code — trace-time values baked into the
+  compiled program that differ per run/rank.
+
+``RuleContext`` carries the package index and traced region, plus the
+cross-rule helpers (jit call sites, collective-containment fixpoint) that
+several rules need, computed once.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..callgraph import (
+    FunctionInfo,
+    JitSite,
+    ModuleIndex,
+    PackageIndex,
+    TracedRegion,
+    _module_level_nodes,
+    dotted,
+    iter_own_nodes,
+)
+from ..findings import Finding, Severity
+
+
+@dataclass
+class Rule:
+    id: str
+    name: str
+    severity: Severity
+    description: str
+    check: Callable  # (RuleContext) -> list[Finding]
+
+
+RULES: "dict[str, Rule]" = {}
+
+
+def register(rule: Rule) -> Rule:
+    RULES[rule.id] = rule
+    return rule
+
+
+class RuleContext:
+    """Shared state for one lint run."""
+
+    def __init__(self, pkg: PackageIndex, region: TracedRegion, root: str):
+        self.pkg = pkg
+        self.region = region
+        self.root = root
+        self._call_sites: Optional[list] = None
+        self._collective_fns: Optional[set] = None
+
+    # -- finding construction ------------------------------------------------
+    def finding(
+        self,
+        rule: str,
+        severity: Severity,
+        module: ModuleIndex,
+        node: ast.AST,
+        message: str,
+        fn: Optional[FunctionInfo] = None,
+        **extra,
+    ) -> Finding:
+        import os
+
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        path = os.path.relpath(module.path, self.root)
+        return Finding(
+            rule=rule,
+            severity=severity,
+            path=path,
+            line=line,
+            col=col,
+            message=message,
+            symbol=fn.qualname if fn is not None else "",
+            line_content=module.line(line),
+            extra=extra,
+        )
+
+    # -- shared analyses -----------------------------------------------------
+    def jit_call_sites(self) -> "list[tuple]":
+        """Call sites *of* jitted functions: ``(call, spec, module, scope)``.
+
+        Covers calls to decorator-jitted defs, to names a call-form wrap was
+        bound to (``step = jax.jit(f); ... step(...)``), and to attribute
+        bindings (``self._train_step = jax.jit(f); self._train_step(...)``).
+        R2 (varying/unhashable static args) and R3 (donation at the call
+        boundary) both consume this.
+        """
+        if self._call_sites is not None:
+            return self._call_sites
+        sites: "list[tuple]" = []
+        # name -> spec maps, per module (call-form bindings are module-local)
+        bound: "dict[str, dict[str, JitSite]]" = {}
+        for site in self.region.sites:
+            per = bound.setdefault(site.module.modname, {})
+            for name in site.bound_names:
+                per[name] = site
+        for module in self.pkg.modules.values():
+            per = bound.get(module.modname, {})
+            # scope None = module level: a top-level `step(x, [4, 8])` is as
+            # much a call site as one inside a function
+            for scope in [None] + list(module.functions.values()):
+                nodes = (
+                    iter_own_nodes(scope)
+                    if scope is not None
+                    else _module_level_nodes(module)
+                )
+                for node in nodes:
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    if name is None:
+                        continue
+                    if name in per:
+                        sites.append((node, per[name].spec, module, scope))
+                        continue
+                    callee = self.pkg.resolve_call(name, module, scope)
+                    if callee is not None:
+                        spec = self.region.roots.get(callee.key)
+                        # only decorator-form roots are jitted under their
+                        # own name; for call-form wraps (`step = jax.jit(f)`)
+                        # a direct `f(...)` is an EAGER call that donates
+                        # nothing — the jitted spelling is the bound name,
+                        # matched above
+                        if spec is not None and callee.jit_specs:
+                            sites.append((node, spec, module, scope))
+        self._call_sites = sites
+        return sites
+
+    def collective_functions(self) -> "set[tuple]":
+        """Keys of scanned functions that (transitively) issue a host-level
+        collective — the fixpoint R4 walks rank-conditionals against."""
+        if self._collective_fns is not None:
+            return self._collective_fns
+        contains: "set[tuple]" = set()
+        # one AST pass: per-function resolved callees + direct-collective seed
+        callees: "dict[tuple, set]" = {}
+        for module in self.pkg.modules.values():
+            for fn in module.functions.values():
+                keys = set()
+                for node in iter_own_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if call_is_collective(node):
+                        contains.add(fn.key)
+                        continue
+                    name = dotted(node.func)
+                    if name is not None:
+                        callee = self.pkg.resolve_call(name, module, fn)
+                        if callee is not None:
+                            keys.add(callee.key)
+                callees[fn.key] = keys
+        # propagate caller-ward to fixpoint over the precomputed edges
+        changed = True
+        while changed:
+            changed = False
+            for key, callee_keys in callees.items():
+                if key not in contains and callee_keys & contains:
+                    contains.add(key)
+                    changed = True
+        self._collective_fns = contains
+        return contains
+
+
+#: host-level collective entry points (``utils/operations.py`` and the
+#: jax_compat/multihost wrappers) — every one of these deadlocks when only a
+#: subset of ranks reaches it.
+COLLECTIVE_NAMES = {
+    "gather",
+    "gather_object",
+    "gather_for_metrics",
+    "broadcast",
+    "broadcast_object_list",
+    "broadcast_one_to_all",
+    "reduce",
+    "pad_across_processes",
+    "process_allgather",
+    "sync_global_devices",
+    "wait_for_everyone",
+    "barrier",
+    "all_gather",
+    "all_reduce",
+}
+
+
+def call_is_collective(node: ast.Call) -> Optional[str]:
+    name = dotted(node.func)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return tail if tail in COLLECTIVE_NAMES else None
+
+
+#: names whose truthiness differs across ranks — branching on one of these
+#: and then issuing a collective is the R4 deadlock shape.
+RANK_MARKERS = {
+    "is_main_process",
+    "is_local_main_process",
+    "is_last_process",
+    "process_index",
+    "local_process_index",
+    "rank",
+    "local_rank",
+    "node_rank",
+    "global_rank",
+}
+
+
+def test_is_rank_divergent(node: ast.AST) -> bool:
+    """Does this expression's value depend on the process identity?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in RANK_MARKERS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in RANK_MARKERS:
+            return True
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func) or ""
+            if name.rsplit(".", 1)[-1] in {"process_index", "process_count"}:
+                return True
+    return False
+
+
+def load_all_rules() -> "dict[str, Rule]":
+    """Import every rule module (registration is an import side effect)."""
+    from . import collectives, donation, host_sync, nondeterminism, recompile  # noqa: F401
+
+    return RULES

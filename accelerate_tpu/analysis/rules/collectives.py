@@ -1,0 +1,294 @@
+"""R4 — rank-divergent collectives.
+
+Descends from the r04 bench hang: a collective reached by only a subset of
+ranks blocks forever, with zero evidence of *which* call site diverged. The
+PR 4 watchdog can autopsy that hang (it names the collective each stalled
+rank is blocked in); this rule refuses to ship it.
+
+A call site is flagged when a collective — or a function that transitively
+issues one (:meth:`RuleContext.collective_functions`) — is reachable only
+under a rank-dependent condition:
+
+- ``if is_main_process: gather(...)`` (directly, or via a helper);
+- ``gather(x) if is_main_process else None`` / ``is_main and gather(x)``;
+- an early return guarded by rank identity (``if not is_main: return``)
+  followed by a collective later in the function — the subtlest shape, and
+  exactly how real checkpoint/logging code deadlocks.
+
+Symmetric branches are clean: when the ``if`` and ``else`` arms issue the
+same multiset of collective ops, every rank participates (a source-rank
+*argument* like ``broadcast_one_to_all(x, is_source=rank == 0)`` is the
+correct spelling and never matches this rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..callgraph import FunctionInfo, ModuleIndex, dotted
+from ..findings import Severity
+from . import (
+    Rule,
+    RuleContext,
+    call_is_collective,
+    register,
+    test_is_rank_divergent,
+)
+
+
+def _collective_calls(
+    ctx: RuleContext, module: ModuleIndex, scope: Optional[FunctionInfo], node: ast.AST
+) -> "list[tuple[ast.Call, str]]":
+    """Collective call sites lexically under ``node`` (not descending into
+    nested defs — a def under a conditional runs only when *called*)."""
+    out: "list[tuple[ast.Call, str]]" = []
+
+    def _visit(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(n, ast.Call):
+            op = call_is_collective(n)
+            if op is not None:
+                out.append((n, op))
+            else:
+                name = dotted(n.func)
+                if name is not None:
+                    callee = ctx.pkg.resolve_call(name, module, scope)
+                    if (
+                        callee is not None
+                        and callee.key in ctx.collective_functions()
+                    ):
+                        out.append((n, f"{name} -> collective"))
+        for child in ast.iter_child_nodes(n):
+            _visit(child)
+
+    _visit(node)
+    return out
+
+
+def _branch_ops(calls: "list[tuple[ast.Call, str]]") -> "tuple[str, ...]":
+    # ORDER-SENSITIVE: `if main: gather(); reduce() else: reduce(); gather()`
+    # has equal op multisets and still deadlocks (main's gather meets the
+    # other ranks' reduce) — only an identical sequence is symmetric
+    return tuple(op for _, op in calls)
+
+
+def _arm_op_signature(
+    ctx: RuleContext,
+    module: ModuleIndex,
+    scope: Optional[FunctionInfo],
+    stmts: "list[ast.stmt]",
+) -> "tuple[str, ...]":
+    """Op sequence of one arm for the symmetry comparison, with collectives
+    nested under FURTHER conditions inside the arm marked ``op?`` — a
+    sometimes-executed gather is not symmetric with an unconditional one
+    (``if main: (if step % 100 == 0: gather()) else: gather()`` deadlocks
+    on 99 of 100 steps)."""
+    ops: "list[str]" = []
+
+    def _visit(node: ast.AST, cond: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            for call, op in _collective_calls(ctx, module, scope, node):
+                if call is node:
+                    ops.append(f"{op}?" if cond else op)
+                    break
+        child_cond = cond or isinstance(
+            node, (ast.If, ast.While, ast.For, ast.AsyncFor, ast.IfExp, ast.BoolOp)
+        )
+        for child in ast.iter_child_nodes(node):
+            _visit(child, child_cond)
+
+    for stmt in stmts:
+        _visit(stmt, False)
+    return tuple(ops)
+
+
+def _flatten_arms(stmt: ast.If) -> "list[list[ast.stmt]]":
+    """``if/elif/elif/else`` as a flat list of arm bodies. A chain with no
+    final ``else`` contributes an empty arm — ranks matching no condition
+    execute nothing, which is exactly what the symmetry check must see.
+
+    Only RANK-DIVERGENT elif tests are flattened into arms: ``elif
+    process_index == 1`` partitions the ranks, but an ``elif step % 100``
+    (AST-identical to ``else: if step % 100:``) is ordinary control flow
+    every remaining rank evaluates alike — it stays inside its arm, where
+    :func:`_arm_op_signature`'s ``?`` marking compares it structurally."""
+    arms: "list[list[ast.stmt]]" = [stmt.body]
+    orelse = stmt.orelse
+    while (
+        len(orelse) == 1
+        and isinstance(orelse[0], ast.If)
+        and test_is_rank_divergent(orelse[0].test)
+    ):
+        arms.append(orelse[0].body)
+        orelse = orelse[0].orelse
+    arms.append(orelse)  # the final else (possibly empty)
+    return arms
+
+
+def _ends_in_exit(body: "list[ast.stmt]") -> bool:
+    if not body:
+        return False
+    last = body[-1]
+    return isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _check_scope(
+    ctx: RuleContext,
+    module: ModuleIndex,
+    scope: Optional[FunctionInfo],
+    body: "list[ast.stmt]",
+    findings: list,
+    guarded_since: Optional[int] = None,
+) -> None:
+    """Walk one statement list; ``guarded_since`` carries the line of an
+    earlier rank-guarded early-return that filters who executes the rest."""
+    for stmt in body:
+        # nested defs/classes are their own scopes (separate FunctionInfos);
+        # a def statement under a guard executes nothing by itself
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        # collectives after a rank-filtered early return
+        if guarded_since is not None:
+            for call, op in _collective_calls(ctx, module, scope, stmt):
+                findings.append(
+                    ctx.finding(
+                        "R4",
+                        Severity.ERROR,
+                        module,
+                        call,
+                        f"collective `{op}` is unreachable for ranks filtered "
+                        f"by the rank-guarded early return at line "
+                        f"{guarded_since} — the participating ranks deadlock "
+                        "waiting for the filtered ones",
+                        fn=scope,
+                    )
+                )
+            continue  # already flagged everything below the guard
+
+        if isinstance(stmt, ast.If) and test_is_rank_divergent(stmt.test):
+            arm_calls = []
+            sequences = set()
+            for arm in _flatten_arms(stmt):
+                calls = []
+                for s in arm:
+                    calls.extend(_collective_calls(ctx, module, scope, s))
+                arm_calls.append(calls)
+                sequences.add(_arm_op_signature(ctx, module, scope, arm))
+            if len(sequences) > 1:
+                for call, op in [c for calls in arm_calls for c in calls]:
+                    findings.append(
+                        ctx.finding(
+                            "R4",
+                            Severity.ERROR,
+                            module,
+                            call,
+                            f"collective `{op}` reached only under a "
+                            "rank-dependent condition — ranks that skip it "
+                            "deadlock the ones that don't; hoist the "
+                            "collective out of the conditional (gate the "
+                            "*payload*, not the op)",
+                            fn=scope,
+                        )
+                    )
+            if _ends_in_exit(stmt.body) and not stmt.orelse:
+                guarded_since = stmt.lineno
+            continue
+
+        # ternaries / short-circuits anywhere in this statement; nested
+        # defs are pruned (they run only when called — their bodies are
+        # walked as their own scopes), lambdas are scanned inline since a
+        # rank ternary inside one is almost always invoked in place
+        stack = [stmt]
+        subs = []
+        while stack:
+            n = stack.pop()
+            subs.append(n)
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+        for sub in subs:
+            if isinstance(sub, ast.IfExp) and test_is_rank_divergent(sub.test):
+                for arm in (sub.body, sub.orelse):
+                    for call, op in _collective_calls(ctx, module, scope, arm):
+                        findings.append(
+                            ctx.finding(
+                                "R4",
+                                Severity.ERROR,
+                                module,
+                                call,
+                                f"collective `{op}` in one arm of a "
+                                "rank-dependent conditional expression — "
+                                "only some ranks execute it",
+                                fn=scope,
+                            )
+                        )
+            elif isinstance(sub, ast.BoolOp):
+                values = sub.values
+                if any(test_is_rank_divergent(v) for v in values[:-1]):
+                    for v in values[1:]:
+                        for call, op in _collective_calls(ctx, module, scope, v):
+                            findings.append(
+                                ctx.finding(
+                                    "R4",
+                                    Severity.ERROR,
+                                    module,
+                                    call,
+                                    f"collective `{op}` short-circuited "
+                                    "behind a rank-dependent condition",
+                                    fn=scope,
+                                )
+                            )
+
+        # recurse into non-rank-divergent compound statements so nested
+        # rank conditionals (e.g. inside a try or a data loop) are seen
+        for attr in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, attr, None)
+            if isinstance(inner, list) and inner and isinstance(inner[0], ast.stmt):
+                _check_scope(ctx, module, scope, inner, findings, None)
+        for handler in getattr(stmt, "handlers", []) or []:
+            _check_scope(ctx, module, scope, handler.body, findings, None)
+
+
+def check(ctx: RuleContext) -> list:
+    findings: list = []
+    for module in ctx.pkg.modules.values():
+        for fn in module.functions.values():
+            node = fn.node
+            body = getattr(node, "body", None)
+            if isinstance(body, list):
+                _check_scope(ctx, module, fn, body, findings)
+        _check_scope(
+            ctx,
+            module,
+            None,
+            [s for s in module.tree.body],
+            findings,
+        )
+    # module-level walk above re-descends into function bodies via compound
+    # statements only when they are plain statements; defs are separate —
+    # dedupe anything flagged twice by (path, line, col, message)
+    unique: dict = {}
+    for f in findings:
+        unique.setdefault((f.path, f.line, f.col, f.message), f)
+    return list(unique.values())
+
+
+register(
+    Rule(
+        id="R4",
+        name="rank-divergent-collective",
+        severity=Severity.ERROR,
+        description=(
+            "Collectives reachable by only a subset of ranks: calls under "
+            "is_main_process/process_index conditionals, behind rank-guarded "
+            "early returns, or in one arm of rank ternaries — the r04 "
+            "deadlock class the watchdog can only autopsy."
+        ),
+        check=check,
+    )
+)

@@ -1,0 +1,313 @@
+"""R3 — buffer-donation hazards at jit boundaries.
+
+Descends directly from the PR 3 schedule-free optimizer bug: the optimizer
+init copied state leaves that *aliased* the param buffers (schedule-free's
+``z`` iterate), so a train step with ``donate_argnums=(0, 1)`` donated one
+physical buffer twice — ``INTERNAL: ... buffer donated twice`` on TPU, or
+silent corruption where the runtime doesn't check.
+
+Three shapes, all at the *call site* of a donated jit function (where the
+alias is visible), plus one at the wrap point:
+
+- **missing donation** (wrap point): a jitted step that returns updated
+  versions of its large-state params (``return params, opt_state, …``)
+  without ``donate_argnums`` holds two copies of the model live across the
+  update — 2× params of HBM wasted. Warning, not error: sometimes the caller
+  really does need the old state.
+- **aliased donation**: an argument at a donated position shares a buffer
+  (via plain-name assignment or container-literal membership) with another
+  argument of the same call.
+- **use-after-donate**: the donated name is read after the call without
+  being rebound by it.
+- **donate-in-loop**: the call sits in a loop and the donated name is not
+  rebound by the call's own assignment — the second iteration passes a
+  deleted buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import iter_own_nodes
+from ..findings import Severity
+from . import Rule, RuleContext, register
+
+#: param names that denote the large, update-in-place state of a train step
+LARGE_STATE_NAMES = {
+    "params",
+    "opt_state",
+    "state",
+    "grads",
+    "model",
+    "weights",
+    "variables",
+    "master_params",
+    "kv_cache",
+    "cache",
+}
+
+
+def _alias_roots(name: str, aliases: "dict[str, set]") -> "set[str]":
+    return aliases.get(name, set()) | {name}
+
+
+def _build_aliases(scope_node: ast.AST) -> "dict[str, set]":
+    """Name → set of names it may share buffers with, from plain-name
+    assignments (``z = params``) and container-literal membership
+    (``opt_state = {"z": z}``). One forward pass, lexical order."""
+    aliases: "dict[str, set]" = {}
+    for node in ast.walk(scope_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        sources: "set[str]" = set()
+        value = node.value
+        if isinstance(value, ast.Name):
+            sources |= _alias_roots(value.id, aliases)
+        elif isinstance(value, (ast.Tuple, ast.List, ast.Dict, ast.Set)):
+            elts = value.values if isinstance(value, ast.Dict) else value.elts
+            for elt in elts:
+                if isinstance(elt, ast.Name):
+                    sources |= _alias_roots(elt.id, aliases)
+        if not sources:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                aliases.setdefault(tgt.id, set()).update(sources)
+    return aliases
+
+
+def _arg_names(arg: ast.AST, aliases: "dict[str, set]") -> "set[str]":
+    """Buffer roots an argument expression may carry."""
+    if isinstance(arg, ast.Name):
+        return _alias_roots(arg.id, aliases)
+    if isinstance(arg, (ast.Tuple, ast.List, ast.Set)):
+        out: "set[str]" = set()
+        for elt in arg.elts:
+            out |= _arg_names(elt, aliases)
+        return out
+    if isinstance(arg, ast.Dict):
+        out = set()
+        for v in arg.values:
+            out |= _arg_names(v, aliases)
+        return out
+    return set()
+
+
+def _stores_after(scope_node: ast.AST, name: str, after_line: int) -> "list[int]":
+    return sorted(
+        n.lineno
+        for n in ast.walk(scope_node)
+        if isinstance(n, ast.Name)
+        and isinstance(n.ctx, (ast.Store,))
+        and n.id == name
+        and n.lineno >= after_line
+    )
+
+
+def _loads_between(scope_node, name, lo, hi) -> "list[int]":
+    return sorted(
+        n.lineno
+        for n in ast.walk(scope_node)
+        if isinstance(n, ast.Name)
+        and isinstance(n.ctx, ast.Load)
+        and n.id == name
+        and lo < n.lineno <= hi
+    )
+
+
+def _call_in_loop(scope_node: ast.AST, call: ast.Call) -> bool:
+    def _contains(node):
+        return any(n is call for n in ast.walk(node))
+
+    def _descend(node) -> bool:
+        for child in ast.iter_child_nodes(node):
+            if not _contains(child):
+                continue
+            if isinstance(child, (ast.For, ast.While)) and any(
+                _contains(s) for s in child.body + child.orelse
+            ):
+                return True
+            return _descend(child)
+        return False
+
+    return _descend(scope_node)
+
+
+def _assignment_rebinds(scope_node: ast.AST, call: ast.Call, name: str) -> bool:
+    """Is ``call`` the value of an assignment whose targets rebind ``name``?
+    (``params, opt_state, m = step(params, opt_state, batch)``)"""
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Assign) and (
+            node.value is call
+            or (
+                isinstance(node.value, (ast.Tuple,))
+                and any(e is call for e in node.value.elts)
+            )
+        ):
+            for tgt in node.targets:
+                if any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(tgt)
+                ):
+                    return True
+    return False
+
+
+def check(ctx: RuleContext) -> list:
+    findings = []
+
+    # -- wrap points: large-state step without donation ----------------------
+    seen_wraps = set()
+    for key, spec in ctx.region.roots.items():
+        if spec.kind not in ("jit", "pjit") or spec.donates:
+            continue
+        fn = ctx.region.traced.get(key)
+        if fn is None or key in seen_wraps:
+            continue
+        seen_wraps.add(key)
+        params = set(fn.positional_params())
+        large = params & LARGE_STATE_NAMES
+        if not large:
+            continue
+        # only a step that RETURNS updated versions of those params is an
+        # update-in-place candidate (eval/forward steps keep their inputs)
+        # top-level returned names only — a param used *inside* the returned
+        # expression (``return eval_fn(params, batch)``) is not an update
+        returned: "set[str]" = set()
+        for node in iter_own_nodes(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                elts = (
+                    node.value.elts
+                    if isinstance(node.value, ast.Tuple)
+                    else [node.value]
+                )
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        returned.add(e.id)
+                        # ``return new_params, ...`` is an update of ``params``
+                        for prefix in ("new_", "next_", "updated_"):
+                            if e.id.startswith(prefix):
+                                returned.add(e.id[len(prefix):])
+        updated = large & returned
+        if not updated:
+            continue
+        module = ctx.pkg.modules[fn.module]
+        names = ", ".join(sorted(updated))
+        findings.append(
+            ctx.finding(
+                "R3",
+                Severity.WARNING,
+                module,
+                spec.node if spec.node.lineno else fn.node,
+                f"jitted step returns updated `{names}` without "
+                "donate_argnums — the old and new state are both live across "
+                "the update (2x state HBM); donate the input buffers",
+                fn=fn,
+            )
+        )
+
+    # -- call sites of donated functions -------------------------------------
+    for call, spec, module, scope in ctx.jit_call_sites():
+        if not spec.donates:
+            continue
+        donated_idx = [
+            i for i in (spec.donate_argnums or ()) if isinstance(i, int)
+        ]
+        if not donated_idx:
+            continue
+        # module-level call sites (scope None) use the module tree as the
+        # alias/use-after-donate scope — a script-level donated call is the
+        # same bug as one inside a function
+        scope_node = scope.node if scope is not None else module.tree
+        aliases = _build_aliases(scope_node)
+        donated: "dict[int, set]" = {}
+        for i in donated_idx:
+            if i < len(call.args):
+                donated[i] = _arg_names(call.args[i], aliases)
+        for i, dnames in donated.items():
+            if not dnames:
+                continue
+            # (a) the same buffer appears in another argument of this call
+            for j, arg in enumerate(call.args):
+                if j == i:
+                    continue
+                other = _arg_names(arg, aliases)
+                shared = dnames & other
+                if shared:
+                    what = ", ".join(sorted(shared))
+                    also_donated = j in donated
+                    findings.append(
+                        ctx.finding(
+                            "R3",
+                            Severity.ERROR,
+                            module,
+                            call,
+                            f"donated argument {i} shares buffer(s) `{what}` "
+                            f"with argument {j}"
+                            + (
+                                " (also donated — double donation)"
+                                if also_donated
+                                else " — the donated buffer is still aliased "
+                                "by a live reference"
+                            )
+                            + "; copy the aliased leaves before the call",
+                            fn=scope,
+                        )
+                    )
+            # (b)/(c): use-after-donate and donate-in-loop, on the directly
+            # passed name (alias tracking would over-flag here)
+            if not isinstance(call.args[i], ast.Name):
+                continue
+            name = call.args[i].id
+            rebound = _assignment_rebinds(scope_node, call, name)
+            in_loop = _call_in_loop(scope_node, call)
+            # the load window opens after the call's LAST line — a wrapped
+            # call's own continuation-line arguments are not post-call reads
+            call_end = getattr(call, "end_lineno", None) or call.lineno
+            if in_loop and not rebound:
+                findings.append(
+                    ctx.finding(
+                        "R3",
+                        Severity.ERROR,
+                        module,
+                        call,
+                        f"`{name}` is donated inside a loop but never rebound "
+                        "from the call result — the next iteration passes a "
+                        "deleted buffer",
+                        fn=scope,
+                    )
+                )
+            elif not rebound:
+                stores = _stores_after(scope_node, name, call_end + 1)
+                horizon = stores[0] if stores else 10**9
+                loads = _loads_between(scope_node, name, call_end, horizon)
+                if loads:
+                    findings.append(
+                        ctx.finding(
+                            "R3",
+                            Severity.ERROR,
+                            module,
+                            call,
+                            f"`{name}` is read at line {loads[0]} after being "
+                            "donated here — donated buffers are deleted by "
+                            "the call",
+                            fn=scope,
+                        )
+                    )
+    return findings
+
+
+register(
+    Rule(
+        id="R3",
+        name="donation-hazard",
+        severity=Severity.ERROR,
+        description=(
+            "Buffer-donation bugs at jit boundaries: large-state steps "
+            "without donate_argnums, donated buffers aliased by other live "
+            "references (the PR 3 schedule-free bug), use-after-donate, "
+            "donation inside loops without rebinding."
+        ),
+        check=check,
+    )
+)

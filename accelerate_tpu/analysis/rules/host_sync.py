@@ -1,0 +1,169 @@
+"""R1 — host synchronization inside traced code.
+
+Every construct this rule flags forces the runtime to materialize a traced
+value on the host: ``.item()`` / ``.tolist()``, ``float()/int()/bool()`` on a
+tracer, ``np.asarray`` of a tracer, ``jax.device_get``, Python ``if`` /
+``while`` / ``assert`` on a traced value, ``.block_until_ready()``. Inside a
+``jax.jit`` region these either raise ``ConcretizationTypeError`` at trace
+time or — worse, when the function is *sometimes* run eagerly — silently
+serialize the device pipeline (the hidden-sync papercut class of the MLPerf
+TPU-pod postmortem, PAPERS.md 1909.09756).
+
+The step profiler sees these as inexplicable gaps between dispatch and
+execute *after* TPU time is burned; this rule sees them in the diff.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted, iter_own_nodes
+from ..findings import Severity
+from ..taint import Cls, Taint
+from . import Rule, RuleContext, register
+
+_CAST_SYNCS = {"float", "int", "bool", "complex"}
+_METHOD_SYNCS = {"item", "tolist"}
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def check(ctx: RuleContext) -> list:
+    findings = []
+    for fn in ctx.region.traced.values():
+        module = ctx.pkg.modules[fn.module]
+        taint = Taint(fn, ctx.region.spec_for(fn))
+        for node in iter_own_nodes(fn):
+            taint.visit_statement(node)
+
+            if isinstance(node, (ast.If, ast.While)):
+                if taint.classify(node.test) == Cls.TRACED:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    findings.append(
+                        ctx.finding(
+                            "R1",
+                            Severity.ERROR,
+                            module,
+                            node,
+                            f"python `{kw}` on a traced value — forces a "
+                            "device→host sync (ConcretizationTypeError under "
+                            "jit); use jnp.where / lax.cond",
+                            fn=fn,
+                        )
+                    )
+            elif isinstance(node, ast.IfExp):
+                if taint.classify(node.test) == Cls.TRACED:
+                    findings.append(
+                        ctx.finding(
+                            "R1",
+                            Severity.ERROR,
+                            module,
+                            node,
+                            "conditional expression on a traced value — use "
+                            "jnp.where / lax.select",
+                            fn=fn,
+                        )
+                    )
+            elif isinstance(node, ast.Assert):
+                if taint.classify(node.test) == Cls.TRACED:
+                    findings.append(
+                        ctx.finding(
+                            "R1",
+                            Severity.ERROR,
+                            module,
+                            node,
+                            "assert on a traced value syncs the host; use "
+                            "checkify or debug.check",
+                            fn=fn,
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                findings.extend(_check_call(ctx, module, fn, taint, node))
+    return findings
+
+
+def _check_call(ctx, module, fn, taint: Taint, node: ast.Call) -> list:
+    out = []
+    name = dotted(node.func) or ""
+    tail = name.rsplit(".", 1)[-1]
+
+    if name in _CAST_SYNCS and node.args:
+        if taint.classify(node.args[0]) == Cls.TRACED:
+            out.append(
+                ctx.finding(
+                    "R1",
+                    Severity.ERROR,
+                    module,
+                    node,
+                    f"`{name}()` on a traced value pulls it to the host — "
+                    "keep it on device (jnp.asarray / astype) or mark the "
+                    "argument static",
+                    fn=fn,
+                )
+            )
+    elif tail in _METHOD_SYNCS and isinstance(node.func, ast.Attribute):
+        if taint.classify(node.func.value) != Cls.STATIC:
+            out.append(
+                ctx.finding(
+                    "R1",
+                    Severity.ERROR,
+                    module,
+                    node,
+                    f"`.{tail}()` inside traced code is a device→host sync — "
+                    "return the array and materialize outside the jit "
+                    "boundary",
+                    fn=fn,
+                )
+            )
+    elif name in _NP_MATERIALIZE and node.args:
+        if taint.classify(node.args[0]) == Cls.TRACED:
+            out.append(
+                ctx.finding(
+                    "R1",
+                    Severity.ERROR,
+                    module,
+                    node,
+                    f"`{name}()` of a traced value materializes it on the "
+                    "host — use jnp equivalents inside traced code",
+                    fn=fn,
+                )
+            )
+    elif name in {"jax.device_get", "device_get"}:
+        out.append(
+            ctx.finding(
+                "R1",
+                Severity.ERROR,
+                module,
+                node,
+                "`jax.device_get` inside traced code is a host sync — move "
+                "it outside the jit boundary",
+                fn=fn,
+            )
+        )
+    elif tail == "block_until_ready":
+        out.append(
+            ctx.finding(
+                "R1",
+                Severity.ERROR,
+                module,
+                node,
+                "`.block_until_ready()` inside traced code stalls dispatch — "
+                "it belongs in benchmarks/tests outside the jit boundary",
+                fn=fn,
+            )
+        )
+    return out
+
+
+register(
+    Rule(
+        id="R1",
+        name="host-sync-in-traced-code",
+        severity=Severity.ERROR,
+        description=(
+            "Device→host synchronization inside a jit/pjit/shard_map region: "
+            ".item()/.tolist(), float()/int()/bool() on tracers, np.asarray, "
+            "jax.device_get, python control flow on traced values."
+        ),
+        check=check,
+    )
+)

@@ -1,0 +1,138 @@
+"""R5 — nondeterminism baked into traced code.
+
+Anything evaluated at *trace time* becomes a constant in the compiled
+program. ``time.time()`` inside a jitted step isn't a clock — it's the
+timestamp of the first trace, forever. ``random.random()`` is one draw,
+frozen. Worse on SPMD: each rank traces independently, so each rank bakes a
+*different* constant — silent cross-rank divergence that surfaces hundreds
+of steps later as a loss mismatch (or, when the value feeds a shape or a
+sharding spec, as the R4 deadlock class).
+
+Flags, inside the traced region:
+
+- ``time.*`` / ``datetime.now`` calls;
+- python ``random.*`` / ``np.random.*`` / ``os.urandom`` / ``uuid.*``
+  (``jax.random`` with explicit keys is the deterministic spelling and is
+  never flagged);
+- iteration over a ``set`` — order is unspecified and varies per process
+  (hash randomization), so any structure built from it diverges per rank.
+
+Set iteration is additionally flagged in *sharding-spec-shaped* functions
+(name mentions shard/spec/partition) even outside traced code: an
+unordered axis assignment diverging across ranks is how a mesh disagrees
+with itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted, iter_own_nodes
+from ..findings import Severity
+from . import Rule, RuleContext, register
+
+_TIME_CALLS = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+    "time.monotonic_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+}
+_ENTROPY_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_ENTROPY_CALLS = {"os.urandom", "uuid.uuid4", "uuid.uuid1", "secrets.token_bytes"}
+
+
+def _is_entropy_call(name: str) -> bool:
+    if name in _ENTROPY_CALLS or name in _TIME_CALLS:
+        return True
+    for prefix in _ENTROPY_PREFIXES:
+        if name.startswith(prefix):
+            return True
+    return False
+
+
+def _is_set_iter(node: ast.For) -> bool:
+    it = node.iter
+    if isinstance(it, ast.Set):
+        return True
+    if isinstance(it, ast.Call):
+        return (dotted(it.func) or "") == "set"
+    return False
+
+
+def check(ctx: RuleContext) -> list:
+    findings = []
+    for fn in ctx.region.traced.values():
+        module = ctx.pkg.modules[fn.module]
+        for node in iter_own_nodes(fn):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func) or ""
+                if _is_entropy_call(name):
+                    findings.append(
+                        ctx.finding(
+                            "R5",
+                            Severity.WARNING,
+                            module,
+                            node,
+                            f"`{name}()` in traced code is evaluated once at "
+                            "trace time and baked into the program — each "
+                            "rank bakes a different constant; use jax.random "
+                            "with an explicit key (or pass the value in as "
+                            "an argument)",
+                            fn=fn,
+                        )
+                    )
+            elif isinstance(node, ast.For) and _is_set_iter(node):
+                findings.append(
+                    ctx.finding(
+                        "R5",
+                        Severity.WARNING,
+                        module,
+                        node,
+                        "iteration over a set in traced code — order is "
+                        "unspecified and varies per process, so the traced "
+                        "program differs per rank; sort it",
+                        fn=fn,
+                    )
+                )
+    # sharding-spec builders: set-iteration order becomes the mesh layout
+    traced_keys = set(ctx.region.traced)
+    for module in ctx.pkg.modules.values():
+        for fn in module.functions.values():
+            if fn.key in traced_keys:
+                continue
+            lowered = fn.name.lower()
+            if not any(h in lowered for h in ("shard", "spec", "partition")):
+                continue
+            for node in iter_own_nodes(fn):
+                if isinstance(node, ast.For) and _is_set_iter(node):
+                    findings.append(
+                        ctx.finding(
+                            "R5",
+                            Severity.WARNING,
+                            module,
+                            node,
+                            "iteration over a set while building sharding "
+                            "specs — unordered axis assignment can differ "
+                            "across ranks; sort it",
+                            fn=fn,
+                        )
+                    )
+    return findings
+
+
+register(
+    Rule(
+        id="R5",
+        name="nondeterminism-in-traced-code",
+        severity=Severity.WARNING,
+        description=(
+            "time.*/random.*/np.random/set-iteration inside traced code — "
+            "values baked at trace time that differ per run and per rank."
+        ),
+        check=check,
+    )
+)

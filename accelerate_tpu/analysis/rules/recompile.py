@@ -1,0 +1,192 @@
+"""R2 — recompile hazards.
+
+The static counterpart of the step profiler's jit-cache-miss detector
+(telemetry PR 2): everything here compiles *fine* and then recompiles — or
+unrolls — in production, which on a TPU pod means minutes of XLA time per
+occurrence (the bench round 2 recompile storms).
+
+Flags:
+
+- **shape-derived Python branches** in traced code (``if x.shape[0] > 128:``)
+  — legal at trace time, silently specializes the program per shape;
+- **python loops over traced arrays** — unroll into the HLO and re-unroll
+  (recompile) for every new length;
+- **unhashable static args** at jit call boundaries (list/dict/set literal
+  passed at a ``static_argnums`` position raises at best, retraces at worst);
+- **per-iteration-varying static args** (the static arg is the loop
+  variable: one recompile per iteration);
+- **closures over mutable globals** — the traced function bakes the value at
+  trace time; later mutation is invisible (stale constant) or, when the
+  cache key sees it, a retrace per mutation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted, iter_own_nodes
+from ..findings import Severity
+from ..taint import Cls, Taint
+from . import Rule, RuleContext, register
+
+_MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict", "deque"}
+
+
+def _loop_targets(scope_node: ast.AST, call: ast.Call) -> "set[str]":
+    """Names bound by ``for`` loops lexically enclosing ``call``."""
+    targets: "set[str]" = set()
+
+    def _contains(node: ast.AST) -> bool:
+        return any(n is call for n in ast.walk(node))
+
+    def _descend(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if not _contains(child):
+                continue
+            if isinstance(child, ast.For) and any(
+                _contains(s) for s in child.body + child.orelse
+            ):
+                targets.update(
+                    n.id for n in ast.walk(child.target) if isinstance(n, ast.Name)
+                )
+            _descend(child)
+            return  # the call lives in exactly one child subtree
+
+    _descend(scope_node)
+    return targets
+
+
+def check(ctx: RuleContext) -> list:
+    findings = []
+
+    # -- traced-region hazards ------------------------------------------------
+    for fn in ctx.region.traced.values():
+        module = ctx.pkg.modules[fn.module]
+        taint = Taint(fn, ctx.region.spec_for(fn))
+        local_names = set(fn.param_names())
+        for node in iter_own_nodes(fn):
+            taint.visit_statement(node)
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in tgts:
+                    local_names.update(
+                        n.id for n in ast.walk(t) if isinstance(n, ast.Name)
+                    )
+            elif isinstance(node, (ast.For,)):
+                local_names.update(
+                    n.id for n in ast.walk(node.target) if isinstance(n, ast.Name)
+                )
+                if taint.classify(node.iter) == Cls.TRACED:
+                    findings.append(
+                        ctx.finding(
+                            "R2",
+                            Severity.WARNING,
+                            module,
+                            node,
+                            "python loop over a traced array unrolls into the "
+                            "program and recompiles per length — use lax.scan "
+                            "/ lax.fori_loop",
+                            fn=fn,
+                        )
+                    )
+            if isinstance(node, (ast.If, ast.While)):
+                if taint.classify(node.test) == Cls.SHAPE:
+                    findings.append(
+                        ctx.finding(
+                            "R2",
+                            Severity.WARNING,
+                            module,
+                            node,
+                            "branch on a shape-derived value specializes the "
+                            "compiled program per shape — pad/bucket shapes "
+                            "or lift the branch out of the traced region",
+                            fn=fn,
+                        )
+                    )
+        # closure over a mutable module global (ALL_CAPS constants exempt
+        # unless something rebinds them through ``global``)
+        for node in iter_own_nodes(fn):
+            if not isinstance(node, ast.Name) or not isinstance(node.ctx, ast.Load):
+                continue
+            name = node.id
+            if name in local_names or name in module.imports:
+                continue
+            if name.isupper() and name not in module.global_writes:
+                continue
+            value = module.module_globals.get(name)
+            mutable_literal = isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(value, ast.Call)
+                and (dotted(value.func) or "").rsplit(".", 1)[-1] in _MUTABLE_CALLS
+            )
+            if name in module.global_writes or (value is not None and mutable_literal):
+                findings.append(
+                    ctx.finding(
+                        "R2",
+                        Severity.WARNING,
+                        module,
+                        node,
+                        f"traced function closes over mutable module global "
+                        f"`{name}` — its value is baked at trace time (stale "
+                        "after mutation) or forces a retrace; pass it as an "
+                        "argument",
+                        fn=fn,
+                    )
+                )
+                local_names.add(name)  # one finding per name per function
+
+    # -- jit call-boundary hazards -------------------------------------------
+    for call, spec, module, scope in ctx.jit_call_sites():
+        static_idx = spec.static_argnums or ()
+        if not static_idx:
+            continue
+        for i in static_idx:
+            if not isinstance(i, int) or i >= len(call.args):
+                continue
+            arg = call.args[i]
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+                findings.append(
+                    ctx.finding(
+                        "R2",
+                        Severity.ERROR,
+                        module,
+                        arg,
+                        f"unhashable static argument (argnum {i}) at a jit "
+                        "call site — static args key the compile cache and "
+                        "must be hashable (use a tuple / frozen dataclass)",
+                        fn=scope,
+                    )
+                )
+            elif isinstance(arg, ast.Name):
+                # module-level call sites use the module tree as the loop
+                # ancestry (a top-level benchmark loop recompiles the same)
+                scope_node = scope.node if scope is not None else module.tree
+                if arg.id in _loop_targets(scope_node, call):
+                    findings.append(
+                        ctx.finding(
+                            "R2",
+                            Severity.WARNING,
+                            module,
+                            arg,
+                            f"static argument (argnum {i}) is the enclosing "
+                            "loop variable — one recompile per iteration; "
+                            "trace it or hoist the loop inside the jit",
+                            fn=scope,
+                        )
+                    )
+    return findings
+
+
+register(
+    Rule(
+        id="R2",
+        name="recompile-hazard",
+        severity=Severity.WARNING,
+        description=(
+            "Code that compiles once in the demo and recompiles per shape/"
+            "iteration in production: shape-derived branches, unrolling loops "
+            "over tracers, unhashable or loop-varying static args, closures "
+            "over mutable globals."
+        ),
+        check=check,
+    )
+)

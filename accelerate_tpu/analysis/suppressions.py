@@ -1,0 +1,65 @@
+"""Inline suppression comments.
+
+Two spellings, matching the linter convention the repo already follows for
+noqa-style tools:
+
+- ``# jaxlint: disable=R1`` (or ``disable=R1,R3``) at the end of the
+  flagged line suppresses those rules **on that line only**;
+- ``# jaxlint: disable`` with no rule list suppresses every rule on the
+  line;
+- ``# jaxlint: skip-file`` within the first ten lines of a file suppresses
+  the whole file (generated code, vendored fixtures).
+
+A suppression is an *audited* exception: the finding still appears in the
+report (counted under "suppressed"), it just doesn't fail the run. This is
+deliberately different from the baseline (:mod:`.baseline`), which exists
+to ratchet down pre-existing debt without an in-source annotation.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from .findings import Finding
+
+_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable(?:=(?P<rules>[A-Za-z0-9,\s]+))?")
+_SKIP_FILE_RE = re.compile(r"#\s*jaxlint:\s*skip-file")
+_SKIP_FILE_WINDOW = 10
+
+
+def parse_line_suppressions(source_lines: "list[str]") -> "dict[int, set]":
+    """1-based line -> set of suppressed rule ids ({"*"} = all rules)."""
+    out: "dict[int, set]" = {}
+    for i, line in enumerate(source_lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = {"*"}
+        else:
+            out[i] = {r.strip().upper() for r in rules.split(",") if r.strip()}
+    return out
+
+
+def file_is_skipped(source_lines: "list[str]") -> bool:
+    return any(
+        _SKIP_FILE_RE.search(line)
+        for line in source_lines[:_SKIP_FILE_WINDOW]
+    )
+
+
+def apply_suppressions(
+    findings: Iterable[Finding],
+    suppressions_by_path: "dict[str, dict[int, set]]",
+    skipped_paths: "set[str]",
+) -> None:
+    """Mark findings covered by an inline comment (in place)."""
+    for f in findings:
+        if f.path in skipped_paths:
+            f.suppressed = True
+            continue
+        rules = suppressions_by_path.get(f.path, {}).get(f.line)
+        if rules and ("*" in rules or f.rule in rules):
+            f.suppressed = True

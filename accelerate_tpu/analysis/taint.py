@@ -1,0 +1,308 @@
+"""Three-valued intra-function taint for traced code.
+
+Inside a jitted function a value is one of:
+
+- ``TRACED`` — a tracer. Python control flow on it (``if``/``while``/
+  ``bool()``/``float()``) forces a device→host sync at best and a
+  ``ConcretizationTypeError`` at worst → R1's input.
+- ``SHAPE`` — trace-time static but *shape-derived* (``x.shape``, ``len(x)``,
+  ``x.ndim``). Branching on it is legal and silent — and recompiles the whole
+  program for every new shape → R2's input.
+- ``STATIC`` — ordinary Python (config flags, mesh names, constants).
+
+The lattice join is ``TRACED > SHAPE > STATIC``; any mixed expression takes
+the worst class of its parts. The walk is a single forward pass over the
+statement list (no fixpoint): assignments propagate classes to names, and
+loop-carried reassignment to a *weaker* class is rare enough in real step
+functions that the precision trade is worth the simplicity — this is a
+linter, not a verifier.
+
+Heuristics for the seed class of each parameter live in
+:func:`initial_params`: positional args of a jitted function are tracers
+unless named in the jit spec's ``static_argnums``/``static_argnames`` or
+shaped like configuration (``self``, ``config``, ``*_fn``, or any constant
+default — str/bool/None and also numbers, so ``group_size=2048``-style
+knobs read as static). Helpers *reachable from* a root get the same
+treatment — their array-ish params (``params``, ``batch``, ``x``…) stay
+TRACED, their config-ish params don't fire false R1s.
+"""
+
+from __future__ import annotations
+
+import ast
+import enum
+from typing import Optional
+
+from .callgraph import FunctionInfo, JitSpec, dotted
+
+
+class Cls(enum.IntEnum):
+    STATIC = 0
+    SHAPE = 1
+    TRACED = 2
+
+
+def join(*classes: Cls) -> Cls:
+    return max(classes, default=Cls.STATIC)
+
+
+#: parameter names that denote configuration, not arrays, in helpers the
+#: call graph reaches (for jit *roots* the spec's static_argnums wins).
+STATIC_PARAM_NAMES = {
+    "self",
+    "cls",
+    "config",
+    "cfg",
+    "mesh",
+    "axis",
+    "axis_name",
+    "axis_names",
+    "spec",
+    "specs",
+    "sharding",
+    "shardings",
+    "policy",
+    "mode",
+    "name",
+    "dtype",
+    "shape",
+    "num_heads",
+    "block_size",
+    "eps",
+    "optimizer",
+    "tx",
+}
+
+#: dotted-call prefixes whose results are tracers when called in traced code
+_TRACED_CALL_PREFIXES = (
+    "jnp.",
+    "jax.numpy.",
+    "lax.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.random.",
+    "jax.tree.",
+    "jax.tree_util.",
+    "optax.",
+)
+
+#: calls that always produce trace-time-static values
+_STATIC_CALLS = {
+    "len",
+    "isinstance",
+    "hasattr",
+    "getattr",
+    "type",
+    "id",
+    "range",
+    "enumerate",
+    "zip",
+    "str",
+    "repr",
+    "format",
+}
+
+#: attribute tails on a traced value that yield shape-derived statics.
+#: ``dtype`` is deliberately absent: jit keys its cache on dtype anyway, so
+#: a dtype branch specializes without *adding* compiles (unlike shape
+#: branches, which defeat padding/bucketing).
+_SHAPE_ATTRS = {"shape", "ndim", "size", "nbytes"}
+
+#: attributes of a traced value that are plain trace-time objects (not
+#: tracers, not shape-derived): branching on them is benign specialization
+_STATIC_ATTRS = {"dtype", "sharding", "device", "weak_type", "aval"}
+
+
+def _param_default_is_configy(fn: FunctionInfo, name: str) -> bool:
+    """A constant default (str/bool/None/int/float) marks a param as
+    configuration, not an array. Numeric defaults are a judged trade: they
+    make ``group_size=2048``-style knobs static (correct in every case this
+    repo has) at the price of missing a host sync on a scalar passed as a
+    traced array through a numeric-default param — spell those as arrays
+    with no default to keep them traced."""
+    a = fn.node.args
+    pos = [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+    defaults = list(a.defaults)
+    # defaults align with the tail of positional params
+    for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+        if p == name and isinstance(d, ast.Constant):
+            if d.value is None or isinstance(d.value, (bool, str, int, float)):
+                return True
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == name and isinstance(d, ast.Constant):
+            if d.value is None or isinstance(d.value, (bool, str, int, float)):
+                return True
+    return False
+
+
+def initial_params(fn: FunctionInfo, spec: Optional[JitSpec]) -> "dict[str, Cls]":
+    """Seed classes for a function's parameters."""
+    out: "dict[str, Cls]" = {}
+    static_idx = set(spec.static_argnums or ()) if spec else set()
+    static_names = set(spec.static_argnames or ()) if spec else set()
+    positional = fn.positional_params()
+    for i, name in enumerate(positional):
+        if (
+            i in static_idx
+            or name in static_names
+            or name in STATIC_PARAM_NAMES
+            or name.endswith("_fn")
+            or name.endswith("_fns")
+            or _param_default_is_configy(fn, name)
+        ):
+            out[name] = Cls.STATIC
+        else:
+            out[name] = Cls.TRACED
+    for name in fn.param_names():
+        if name not in out:
+            out[name] = (
+                Cls.STATIC
+                if (
+                    name in static_names
+                    or name in STATIC_PARAM_NAMES
+                    or name.endswith("_fn")
+                    or name.endswith("_fns")
+                    or _param_default_is_configy(fn, name)
+                )
+                else Cls.TRACED
+            )
+    return out
+
+
+class Taint:
+    """Forward-pass classifier for one function body."""
+
+    def __init__(self, fn: FunctionInfo, spec: Optional[JitSpec] = None):
+        self.fn = fn
+        self.names: "dict[str, Cls]" = initial_params(fn, spec)
+
+    # -- expression classification -------------------------------------------
+    def classify(self, node: Optional[ast.AST]) -> Cls:
+        if node is None:
+            return Cls.STATIC
+        if isinstance(node, ast.Constant):
+            return Cls.STATIC
+        if isinstance(node, ast.Name):
+            return self.names.get(node.id, Cls.STATIC)
+        if isinstance(node, ast.Attribute):
+            base = self.classify(node.value)
+            if node.attr in _SHAPE_ATTRS:
+                return Cls.SHAPE if base == Cls.TRACED else base
+            if node.attr in _STATIC_ATTRS:
+                return Cls.STATIC
+            # attribute on a traced pytree (batch["x"] spelled batch.x) stays
+            # traced; attributes on statics stay static
+            return base
+        if isinstance(node, ast.Subscript):
+            base = self.classify(node.value)
+            if base == Cls.SHAPE:
+                return Cls.SHAPE  # x.shape[0]
+            return base
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return join(*(self.classify(e) for e in node.elts))
+        if isinstance(node, ast.Dict):
+            return join(
+                *(self.classify(v) for v in node.values),
+                *(self.classify(k) for k in node.keys if k is not None),
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return join(self.classify(node.left), self.classify(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return join(*(self.classify(v) for v in node.values))
+        if isinstance(node, ast.Compare):
+            # identity checks (`aux is not None`) resolve at trace time —
+            # the *object* is known even when its value is a tracer
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return Cls.STATIC
+            return join(
+                self.classify(node.left), *(self.classify(c) for c in node.comparators)
+            )
+        if isinstance(node, ast.IfExp):
+            return join(self.classify(node.body), self.classify(node.orelse))
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        if isinstance(node, ast.Starred):
+            return self.classify(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self.classify(node.elt)
+        if isinstance(node, ast.DictComp):
+            return join(self.classify(node.key), self.classify(node.value))
+        if isinstance(node, ast.JoinedStr):
+            return Cls.STATIC
+        if isinstance(node, ast.Lambda):
+            return Cls.STATIC
+        # unknown expression kinds: assume static (under-flagging beats noise)
+        return Cls.STATIC
+
+    def _classify_call(self, node: ast.Call) -> Cls:
+        name = dotted(node.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        # pytree/dict structure is trace-time static: iterating params.items()
+        # (or .keys()/.values()) is ordinary python over static structure
+        if isinstance(node.func, ast.Attribute) and tail in {
+            "items",
+            "keys",
+            "values",
+        }:
+            return Cls.STATIC
+        if name in _STATIC_CALLS:
+            if name == "len" and self.classify(node.args[0] if node.args else None) == Cls.TRACED:
+                return Cls.SHAPE  # len(traced) is static but shape-derived
+            return Cls.STATIC
+        if name in {"int", "float", "bool", "complex"}:
+            arg = self.classify(node.args[0]) if node.args else Cls.STATIC
+            # int(x.shape[0]) → shape-derived static; int(tracer) is R1's
+            # job to flag, but the *value* it would produce is host-side
+            return Cls.SHAPE if arg in (Cls.SHAPE, Cls.TRACED) else Cls.STATIC
+        for prefix in _TRACED_CALL_PREFIXES:
+            if name.startswith(prefix):
+                return Cls.TRACED
+        if name.endswith(".astype") or name.endswith(".reshape") or name.endswith(
+            ".sum"
+        ) or name.endswith(".mean") or name.endswith(".max") or name.endswith(".min"):
+            return self.classify(node.func.value) if isinstance(
+                node.func, ast.Attribute
+            ) else Cls.TRACED
+        # method on a traced receiver keeps the receiver's class
+        if isinstance(node.func, ast.Attribute):
+            return self.classify(node.func.value)
+        # unknown free function: propagate the worst argument class — a helper
+        # fed a tracer almost always returns one
+        return join(
+            *(self.classify(a) for a in node.args),
+            *(self.classify(k.value) for k in node.keywords),
+        )
+
+    # -- statement effects ---------------------------------------------------
+    def assign(self, target: ast.AST, cls: Cls) -> None:
+        if isinstance(target, ast.Name):
+            self.names[target.id] = cls
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign(elt, cls)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, cls)
+        # attribute/subscript targets don't bind names
+
+    def visit_statement(self, node: ast.AST) -> None:
+        """Update name classes for one statement (callers walk in source
+        order via :func:`callgraph.iter_own_nodes`)."""
+        if isinstance(node, ast.Assign):
+            cls = self.classify(node.value)
+            for tgt in node.targets:
+                self.assign(tgt, cls)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self.assign(node.target, self.classify(node.value))
+        elif isinstance(node, ast.AugAssign):
+            cls = join(self.classify(node.target), self.classify(node.value))
+            self.assign(node.target, cls)
+        elif isinstance(node, ast.For):
+            self.assign(node.target, self.classify(node.iter))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, self.classify(item.context_expr))
+        elif isinstance(node, ast.comprehension):
+            self.assign(node.target, self.classify(node.iter))

@@ -715,7 +715,11 @@ class BridgedOptimizer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_state
 
-        self._apply = jax.jit(apply)
+        # no donate_argnums on purpose: torch-interop _TensorViews hold raw
+        # references to the param arrays across steps, so donating params
+        # would delete buffers under live views; 2x-state HBM is the price
+        # of the interop path
+        self._apply = jax.jit(apply)  # jaxlint: disable=R3
 
     def step(self, closure=None):
         import jax.numpy as jnp

@@ -107,7 +107,9 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
     B, S, H, D = q.shape
     max_len = k_cache.shape[1]
     hkv = k_cache.shape[2]
-    if hkv != H:
+    # GQA head-repeat: the H/Hkv ratio is fixed per model config, so this
+    # shape branch specializes exactly once — not a per-step recompile
+    if hkv != H:  # jaxlint: disable=R2
         rep = H // hkv
         k_cache = jnp.repeat(k_cache, rep, axis=2)
         v_cache = jnp.repeat(v_cache, rep, axis=2)
@@ -148,7 +150,9 @@ def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config, 
     from .models.transformer import llama_ffn
 
     capacity_factor = None
-    if config.moe_experts > 0 and S == 1:
+    # S == 1 is the decode-vs-prefill split: exactly the two-program shape
+    # bucketing the decode path is built around, not an accidental retrace
+    if config.moe_experts > 0 and S == 1:  # jaxlint: disable=R2
         capacity_factor = max(config.moe_capacity_factor, config.moe_experts / config.moe_top_k)
     y, _ = llama_ffn(layer_params, x, config, mesh=mesh, capacity_factor=capacity_factor)
     h = h + y

@@ -88,7 +88,9 @@ def moe_ffn(
     E = params["router"]["kernel"].shape[-1]
     N = B * S
     g = min(group_size, N)
-    while N % g != 0:  # shrink to a divisor; worst case g=1 never happens for 2^k shapes
+    # shape-specialization is intended here: the divisor search runs at trace
+    # time and the program is compiled per (B, S) bucket anyway
+    while N % g != 0:  # shrink to a divisor; worst case g=1 never happens for 2^k shapes  # jaxlint: disable=R2
         g -= 1
     G = N // g
     capacity = max(int(np.ceil(top_k * capacity_factor * g / E)), 1)

@@ -204,18 +204,24 @@ class PartialState:
         if self.num_processes > 1:
             from jax.experimental import multihost_utils
 
+            from .telemetry import flight_recorder as _flight
+
+            _flight.record_collective("barrier", tag)
             multihost_utils.sync_global_devices(tag)
 
     @contextmanager
     def main_process_first(self):
         """Main process runs the body first, others wait (reference ``state.py:515``)."""
+        # sequenced-barrier idiom: every rank enters the "enter" barrier
+        # exactly once (non-main before the body, main after), so the
+        # schedules match even though each call site is rank-conditional
         if not self.is_main_process:
-            self.wait_for_everyone("main_process_first.enter")
+            self.wait_for_everyone("main_process_first.enter")  # jaxlint: disable=R4
         try:
             yield
         finally:
             if self.is_main_process:
-                self.wait_for_everyone("main_process_first.enter")
+                self.wait_for_everyone("main_process_first.enter")  # jaxlint: disable=R4
             self.wait_for_everyone("main_process_first.exit")
 
     @contextmanager

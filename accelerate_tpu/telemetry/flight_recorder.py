@@ -109,6 +109,13 @@ class FlightRecorder:
         self.dump_count = 0
         self.last_dump_path: Optional[str] = None
         self._phases: "dict[int, list[_Phase]]" = {}
+        # rolling fingerprint of the (collective, shapes, dtypes) sequence —
+        # the runtime cross-check for jaxlint R4: ranks whose fingerprints
+        # diverge took different collective schedules (deadlock imminent)
+        self.collective_count = 0
+        self.collective_hash = 0
+        self.collective_recent: deque = deque(maxlen=64)
+        self._collective_lock = threading.Lock()
         self._installed = False
         self._prev_sigterm = None
         self._prev_excepthook = None
@@ -128,6 +135,58 @@ class FlightRecorder:
 
     def set_step(self, step: Optional[int]) -> None:
         self.step = step
+
+    def record_collective(self, op: str, signature: str) -> None:
+        """Fold one collective call into the rank's schedule fingerprint.
+
+        ``signature`` describes the payload (shapes/dtypes). The hash rolls
+        over the ordered ``op|signature`` sequence, so two ranks have
+        equal hashes iff they issued the same collectives with the same
+        payload shapes in the same order — exactly the property a divergent
+        ``if is_main_process: gather(...)`` breaks. A bounded window of
+        recent entries rides along so a ``--by-rank`` report can name the
+        first differing call, not just that they differ.
+
+        Locked: callers are single-threaded in the multihost configurations
+        that matter (the dispatcher downgrades prefetch to sync under
+        num_processes > 1 exactly so collectives stay ordered on one
+        thread), but a lost read-modify-write from an unconventional caller
+        must corrupt nothing. The rolling hash is ``zlib.crc32`` with the
+        previous hash as the seed — C speed (a params-sized signature costs
+        microseconds, not a per-byte Python loop under the lock) and
+        deterministic across processes, which the cross-rank comparison
+        requires."""
+        import zlib
+
+        payload = f"{op}|{signature}".encode()
+        with self._collective_lock:
+            h = zlib.crc32(payload, self.collective_hash) & 0xFFFFFFFF
+            self.collective_count += 1
+            self.collective_hash = h
+            self.collective_recent.append(
+                {
+                    "seq": self.collective_count,
+                    "op": op,
+                    "sig": signature,
+                    "hash": f"{h:08x}",
+                }
+            )
+
+    def collective_schedule(self) -> dict:
+        # the dump path must NEVER deadlock: a SIGTERM handler runs on the
+        # main thread, which may already hold the lock inside
+        # record_collective — timeout and fall back to a best-effort read
+        # rather than hang the crash handler
+        acquired = self._collective_lock.acquire(timeout=0.5)
+        try:
+            return {
+                "count": self.collective_count,
+                "hash": f"{self.collective_hash:08x}",
+                "recent": list(self.collective_recent),
+            }
+        finally:
+            if acquired:
+                self._collective_lock.release()
 
     def phase(self, name: str, **attrs: Any) -> _Phase:
         """``with recorder.phase("collective:gather", op="gather"): ...`` —
@@ -261,6 +320,7 @@ class FlightRecorder:
                 "events": _part(self.snapshot, []),
                 "threads": _part(self._thread_stacks, []),
                 "memory": _part(self._memory_snapshot, None),
+                "collective_schedule": _part(self.collective_schedule, None),
             }
             if extra:
                 payload.update(extra)
@@ -398,6 +458,10 @@ def set_step(step: Optional[int]) -> None:
 
 def phase(name: str, **attrs: Any) -> _Phase:
     return _RECORDER.phase(name, **attrs)
+
+
+def record_collective(op: str, signature: str) -> None:
+    _RECORDER.record_collective(op, signature)
 
 
 def current_phases() -> "dict[str, dict]":

@@ -119,6 +119,117 @@ def _per_rank_counts(events: "list[dict]", file_rank: "dict[str, int]") -> "dict
     return dict(sorted(per_rank.items()))
 
 
+def _collective_divergence(schedules: "dict[int, dict]") -> Optional[dict]:
+    """Cross-rank comparison of the flight recorder's collective-schedule
+    fingerprints — the runtime confirmation of a jaxlint R4 finding.
+
+    Equal (count, hash) across ranks means every rank issued the same
+    collectives with the same payload shapes in the same order. On mismatch,
+    the overlapping portions of the per-rank ``recent`` windows name the
+    first differing call when the divergence is recent enough to still be
+    in the window."""
+    if len(schedules) < 2:
+        return None
+    per_rank = {
+        str(r): {"count": s.get("count", 0), "hash": s.get("hash")}
+        for r, s in sorted(schedules.items())
+    }
+    out: dict = {"per_rank": per_rank, "diverged": False}
+
+    # a rank dumped before its first collective has an (empty) schedule that
+    # is trivially a prefix of every other — exclude it from the comparison
+    # (but DON'T let it mask divergence among the remaining ranks)
+    compared = {r: s for r, s in schedules.items() if s.get("count", 0) > 0}
+    zero_ranks = sorted(set(schedules) - set(compared))
+    if zero_ranks:
+        out["prefix_skew"] = {
+            str(r): s.get("count", 0) for r, s in sorted(schedules.items())
+        }
+    if len(compared) < 2:
+        return out
+
+    hashes = {(s.get("count", 0), s.get("hash")) for s in compared.values()}
+    if len(hashes) <= 1:
+        return out
+
+    # count skew alone is not divergence: dumps are taken at slightly
+    # different moments, so a healthy run shows one rank a call or two
+    # ahead with an IDENTICAL common prefix. The per-seq cumulative hashes
+    # in the recent windows let us check: if every compared rank agrees on
+    # the hash at the minimum common count, the shorter schedules are
+    # prefixes of the longer ones.
+    counts = [s.get("count", 0) for s in compared.values()]
+    min_count = min(counts)
+    hash_at_min: "dict[int, str]" = {}
+    for rank, sched in compared.items():
+        if sched.get("count", 0) == min_count and sched.get("hash"):
+            hash_at_min[rank] = sched["hash"]
+        else:
+            for entry in sched.get("recent") or []:
+                if entry.get("seq") == min_count:
+                    hash_at_min[rank] = entry.get("hash")
+                    break
+    prefix_provable = len(hash_at_min) == len(compared)
+    if (
+        prefix_provable
+        and len(set(hash_at_min.values())) == 1
+        and len(set(counts)) > 1
+    ):
+        skew = {
+            str(r): s.get("count", 0) - min_count for r, s in sorted(compared.items())
+        }
+        out["prefix_skew"] = {**out.get("prefix_skew", {}), **skew}
+        return out
+
+    # align recent windows by seq and find the first disagreement visible
+    by_seq: "dict[int, dict]" = {}
+    for rank, sched in compared.items():
+        for entry in sched.get("recent") or []:
+            seq = entry.get("seq")
+            if seq is None:
+                continue
+            by_seq.setdefault(int(seq), {})[rank] = (
+                entry.get("op"),
+                entry.get("sig"),
+            )
+    first = None
+    for seq in sorted(by_seq):
+        calls = by_seq[seq]
+        if len(calls) >= 2 and len(set(calls.values())) > 1:
+            first = {
+                "seq": seq,
+                "calls": {
+                    str(r): {"op": op, "sig": sig}
+                    for r, (op, sig) in sorted(calls.items())
+                },
+            }
+            break
+    if len(set(counts)) > 1:
+        out["count_skew"] = {
+            str(r): s.get("count", 0) for r, s in sorted(compared.items())
+        }
+    if first is not None:
+        out["diverged"] = True  # a same-seq call provably differs
+        out["first_divergence"] = first
+    elif prefix_provable and len(set(hash_at_min.values())) > 1:
+        # the cumulative hashes at the minimum common count disagree:
+        # provably divergent at or before that call, even though the
+        # differing entry itself rotated out of every window
+        out["diverged"] = True
+        out["first_divergence"] = None
+    elif len(set(counts)) == 1:
+        # equal lengths, unequal hashes: provably divergent even though the
+        # differing call has rotated out of every window
+        out["diverged"] = True
+        out["first_divergence"] = None
+    else:
+        # counts differ and the skew outran the recent windows: cannot
+        # distinguish dump-timing skew from divergence — report as
+        # indeterminate rather than crying deadlock on a healthy run
+        out["indeterminate"] = True
+    return out
+
+
 def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> dict:
     """Cross-rank straggler forensics: per-step skew + slowest-rank
     attribution, heartbeat-gap timelines, and merged flight records."""
@@ -189,12 +300,14 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
         }
 
     flights = []
+    schedules: "dict[int, dict]" = {}
     for rec in load_flight_records(paths):
         phases = rec.get("phases") or {}
+        rank = (rec.get("meta") or {}).get("process_index")
         flights.append(
             {
                 "file": rec.get("_file"),
-                "rank": (rec.get("meta") or {}).get("process_index"),
+                "rank": rank,
                 "reason": rec.get("reason"),
                 "step": rec.get("step"),
                 "phases": {
@@ -203,6 +316,9 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
                 },
             }
         )
+        sched = rec.get("collective_schedule")
+        if rank is not None and isinstance(sched, dict):
+            schedules[int(rank)] = sched
 
     return {
         "per_rank": {
@@ -219,6 +335,7 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
         "straggler": straggler,
         "heartbeat_gaps": heartbeat_gaps,
         "flight_records": flights,
+        "collective_divergence": _collective_divergence(schedules),
     }
 
 
@@ -489,6 +606,43 @@ def format_rank_section(ranks: dict) -> str:
                 for r, g in gaps.items()
             )
         )
+    div = ranks.get("collective_divergence")
+    if div:
+        if div.get("diverged"):
+            lines.append(
+                "  COLLECTIVE SCHEDULE DIVERGENCE: ranks issued different "
+                "collective sequences (deadlock risk — see jaxlint R4)"
+            )
+            for r, s in (div.get("per_rank") or {}).items():
+                lines.append(f"    rank {r}: {s['count']} collective(s), hash {s['hash']}")
+            first = div.get("first_divergence")
+            if first:
+                calls = ", ".join(
+                    f"rank{r}={c['op']}({c['sig']})"
+                    for r, c in first["calls"].items()
+                )
+                lines.append(f"    first visible divergence at call #{first['seq']}: {calls}")
+        elif div.get("indeterminate"):
+            lines.append(
+                "  collective schedules: INDETERMINATE — counts differ and "
+                "the skew outran the recent-call windows; re-dump closer "
+                "together (or raise the window) to distinguish timing skew "
+                "from divergence"
+            )
+        elif div.get("prefix_skew"):
+            ahead = ", ".join(
+                f"rank{r}+{n}" for r, n in div["prefix_skew"].items() if n
+            )
+            lines.append(
+                "  collective schedules: identical common prefix, dump-timing "
+                f"skew only ({ahead} call(s) ahead) — not divergence"
+            )
+        else:
+            sample = next(iter((div.get("per_rank") or {}).values()), {})
+            lines.append(
+                f"  collective schedules: consistent across ranks "
+                f"({sample.get('count', 0)} call(s), hash {sample.get('hash')})"
+            )
     flights = ranks.get("flight_records") or []
     if flights:
         lines.append("  flight records:")
@@ -579,6 +733,65 @@ def run_doctor() -> int:
             straggler.get("rank") == 1 and rep["ranks"]["skew_s"]["count"] == 8,
             f"straggler={straggler}",
         )
+
+        # 4. collective-schedule divergence: rank 0 took an extra gather
+        # (the `if is_main_process: gather()` shape) while rank 1 moved on
+        # to the barrier — their call #3 disagrees
+        for rank, ops in ((0, ["gather", "reduce:mean", "gather", "barrier"]),
+                          (1, ["gather", "reduce:mean", "barrier"])):
+            fr = FlightRecorder(capacity=16)
+            for op in ops:
+                fr.record_collective(op, "(8, 4)/float32")
+            with open(os.path.join(tmp, f"flight-rank{rank}.json"), "w") as f:
+                json.dump(
+                    {
+                        "kind": "flight_record",
+                        "reason": "doctor divergence",
+                        "meta": {"process_index": rank},
+                        "collective_schedule": fr.collective_schedule(),
+                    },
+                    f,
+                )
+        rep = build_report([tmp], by_rank=True)
+        div = (rep.get("ranks") or {}).get("collective_divergence") or {}
+        _check(
+            "collective divergence",
+            bool(div.get("diverged"))
+            and (div.get("first_divergence") or {}).get("seq") == 3,
+            f"divergence={div}",
+        )
+
+        # 5. static analyzer: a seeded host-sync + rank-divergent collective
+        # must both be caught by the lint engine (make lint's substrate)
+        snippet = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from accelerate_tpu.utils.operations import gather\n\n"
+            "@jax.jit\n"
+            "def step(params, batch):\n"
+            "    loss = jnp.mean(batch['x'] @ params['w'])\n"
+            "    return float(loss)\n\n"
+            "def log_metrics(state, metrics):\n"
+            "    if state.is_main_process:\n"
+            "        return gather(metrics)\n"
+            "    return None\n"
+        )
+        lint_dir = os.path.join(tmp, "lint")
+        os.makedirs(lint_dir, exist_ok=True)
+        with open(os.path.join(lint_dir, "doctor_lint_case.py"), "w") as f:
+            f.write(snippet)
+        try:
+            from ..analysis import run_lint
+
+            result = run_lint([lint_dir], use_baseline=False)
+            rules_hit = {f.rule for f in result.new_findings}
+            _check(
+                "static analyzer (jaxlint)",
+                {"R1", "R4"} <= rules_hit,
+                f"rules_hit={sorted(rules_hit)}",
+            )
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("static analyzer (jaxlint)", False, f"{type(exc).__name__}: {exc}")
 
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")

@@ -75,6 +75,40 @@ def _record_comm(op: str, tree=None, nbytes: Optional[int] = None) -> None:
     _telemetry.emit("comm", op=op, bytes=n, wire=PartialState().num_processes > 1)
 
 
+def _collective_signature(tree) -> str:
+    """Compact (shape, dtype) description of a collective payload, folded
+    into the flight recorder's per-rank schedule fingerprint — the runtime
+    cross-check for jaxlint R4: two ranks whose fingerprints diverge took
+    different collective schedules, and a ``--by-rank`` report can name the
+    first differing call post-mortem. Single-process runs record the op with
+    a ``local`` placeholder instead — divergence needs two ranks to exist,
+    so the payload walk would be pure hot-path overhead there."""
+    if PartialState().num_processes == 1:
+        return "local"
+    parts: "list[str]" = []
+
+    def _walk(x):
+        # read-only traversal — this runs on every collective call, so it
+        # must not pay recursively_apply's container reconstruction
+        if isinstance(x, (list, tuple)):
+            for item in x:
+                _walk(item)
+        elif isinstance(x, dict):
+            for value in x.values():
+                _walk(value)
+        elif _is_tensorlike(x) or _is_foreign_tensor(x):
+            shape = getattr(x, "shape", None)
+            parts.append(
+                f"{tuple(shape) if shape is not None else ()}/{getattr(x, 'dtype', '?')}"
+            )
+
+    try:
+        _walk(tree)
+    except Exception:
+        return "?"
+    return ",".join(parts) if parts else "-"
+
+
 def get_comm_counters() -> "dict[str, dict]":
     """Live per-op traffic counters: ``{op: {"calls": n, "bytes": b}}``."""
     return {op: {"calls": rec[0], "bytes": rec[1]} for op, rec in _COMM_COUNTS.items()}
@@ -224,6 +258,7 @@ def gather(tree):
 
     # flight-recorder annotation: a rank that hangs here is "blocked in
     # collective:gather" in the watchdog's stall dump, not just "stuck"
+    _flight.record_collective("gather", _collective_signature(tree))
     with _flight.phase("collective:gather"):
         return recursively_apply(_gather, tree)
 
@@ -232,6 +267,9 @@ def gather_object(obj: Any) -> list[Any]:
     """Gather arbitrary picklable objects from all processes into a list
     (reference ``gather_object:445``)."""
     state = PartialState()
+    # object payloads legitimately differ per rank (each contributes its
+    # own), so the fingerprint carries the op only — never the size
+    _flight.record_collective("gather_object", "obj")
     if state.num_processes == 1:
         if _telemetry.is_enabled():
             _record_comm("gather_object", nbytes=len(pickle.dumps(obj)))
@@ -256,6 +294,7 @@ def broadcast(tree, from_process: int = 0):
     """Broadcast array leaves from ``from_process`` to all processes
     (reference ``broadcast:539``). Single-process: identity."""
     _record_comm("broadcast", tree)
+    _flight.record_collective("broadcast", _collective_signature(tree))
     state = PartialState()
     if state.num_processes == 1:
         return tree
@@ -272,6 +311,7 @@ def broadcast(tree, from_process: int = 0):
 def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
     """Broadcast a list of picklable objects (reference ``broadcast_object_list:560``)."""
     state = PartialState()
+    _flight.record_collective("broadcast_object_list", "obj")
     if state.num_processes == 1:
         if _telemetry.is_enabled():
             _record_comm("broadcast_object_list", nbytes=len(pickle.dumps(object_list)))
@@ -342,6 +382,7 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
         raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
     tree = _normalize_foreign(tree)
     _record_comm("reduce", tree)
+    _flight.record_collective(f"reduce:{reduction}", _collective_signature(tree))
     with _flight.phase("collective:reduce", reduction=reduction):
         return recursively_apply(_reduce, tree)
 
@@ -352,6 +393,10 @@ def pad_across_processes(tree, dim: int = 0, pad_index: int = 0, pad_first: bool
     per-process batch sizes differ."""
     tree = _normalize_foreign(tree)
     state = PartialState()
+    # op-only signature: padding exists precisely because per-rank shapes
+    # DIFFER here — folding them in would poison the fingerprint on every
+    # healthy ragged batch (same contract as the object collectives)
+    _flight.record_collective("pad_across_processes", "ragged")
 
     def _pad(x):
         arr = np.asarray(x)

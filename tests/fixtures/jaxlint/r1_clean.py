@@ -1,0 +1,46 @@
+"""jaxlint fixture: R1 clean twins — near-misses that must produce ZERO
+findings. Each mirrors a violation in r1_host_sync.py with the legal
+spelling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step_identity_check(params, batch, aux=None):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    if aux is not None:  # identity check resolves at trace time
+        loss = loss + aux_weight(aux)
+    return loss
+
+
+def aux_weight(aux):
+    return jnp.sum(aux)
+
+
+@jax.jit
+def step_config_branch(params, batch, use_bias=False, scale=1.0):
+    out = batch["x"] @ params["w"]
+    if use_bias:  # bool-default param: trace-time static
+        out = out + params["b"]
+    return out * float(scale)  # float() of a config value, not a tracer
+
+
+@jax.jit
+def step_dict_items(params, batch):
+    total = jnp.zeros(())
+    for name, leaf in params.items():  # dict .items(), not array .item()
+        total = total + jnp.sum(leaf)
+    return total
+
+
+def host_side_metrics(arrays):
+    """NOT reachable from any jit root: host-side syncs are fine here."""
+    return [float(np.asarray(a).mean()) for a in arrays]
+
+
+@jax.jit
+def step_where(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    return jnp.where(loss > 0, loss * 2, loss)  # on-device select

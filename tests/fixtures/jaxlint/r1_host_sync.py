@@ -1,0 +1,56 @@
+"""jaxlint fixture: R1 seeded violations — host syncs inside traced code.
+
+Parsed by tests/test_analysis.py, never imported. Every construct here is a
+device→host sync inside a jit region; the twin (r1_clean.py) holds the
+near-miss spellings that must NOT fire.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step_with_item(params, batch):
+    loss = jnp.mean((batch["x"] @ params["w"]) ** 2)
+    scalar = loss.item()  # R1: .item() inside traced code
+    return scalar
+
+
+@jax.jit
+def step_with_float(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    lr_scale = float(loss)  # R1: float() on a tracer
+    return lr_scale
+
+
+@jax.jit
+def step_with_branch(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    if loss > 0:  # R1: python `if` on a traced value
+        loss = loss * 2
+    return loss
+
+
+@jax.jit
+def step_with_asarray(params, batch):
+    grads = jnp.ones_like(params["w"])
+    host = np.asarray(grads)  # R1: np.asarray of a tracer
+    return host
+
+
+@jax.jit
+def step_with_device_get(params, batch):
+    out = jnp.sum(params["w"])
+    return jax.device_get(out)  # R1: device_get inside traced code
+
+
+def traced_helper(logits):
+    """Reached from a jit root below — still traced code."""
+    return logits.tolist()  # R1: .tolist() in a traced helper
+
+
+@jax.jit
+def step_calling_helper(params, batch):
+    logits = batch["x"] @ params["w"]
+    return traced_helper(logits)

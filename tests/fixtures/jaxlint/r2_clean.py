@@ -1,0 +1,41 @@
+"""jaxlint fixture: R2 clean twins — zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BLOCK_SIZES = (128, 256)  # immutable ALL_CAPS constant: fine to close over
+
+
+@jax.jit
+def step_scan(params, batch):
+    def body(carry, row):
+        return carry + jnp.sum(row @ params["w"]), None
+
+    total, _ = lax.scan(body, jnp.zeros(()), batch["x"])  # scan, not unroll
+    return total
+
+
+@jax.jit
+def step_constant_closure(params, batch):
+    pad = _BLOCK_SIZES[0]  # reads an immutable module constant
+    return jnp.pad(batch["x"], ((0, 0), (0, pad))) @ params["w"]
+
+
+@jax.jit
+def step_static_range(params, batch, depth=4):
+    x = batch["x"]
+    for _ in range(depth):  # range() over a config int: static, no unroll hazard
+        x = jax.nn.relu(x @ params["w"])
+    return x
+
+
+def _inner_step(x, config):
+    return x * 2
+
+
+compiled_static = jax.jit(_inner_step, static_argnums=(1,))
+
+
+def call_with_hashable(x):
+    return compiled_static(x, (4, 8))  # tuple static arg: hashable, cached once

@@ -1,0 +1,46 @@
+"""jaxlint fixture: R2 seeded violations — recompile hazards."""
+
+import jax
+import jax.numpy as jnp
+
+_runtime_flags = {}  # mutable module global
+
+
+@jax.jit
+def step_shape_branch(params, batch):
+    x = batch["x"]
+    if x.shape[0] > 128:  # R2: shape-derived python branch
+        x = x[:128]
+    return x @ params["w"]
+
+
+@jax.jit
+def step_unrolled_loop(params, batch):
+    total = jnp.zeros(())
+    for row in batch["x"]:  # R2: python loop over a traced array unrolls
+        total = total + jnp.sum(row @ params["w"])
+    return total
+
+
+@jax.jit
+def step_mutable_global(params, batch):
+    scale = _runtime_flags["loss_scale"]  # R2: closure over mutable global
+    return jnp.mean(batch["x"] @ params["w"]) * scale
+
+
+def _inner_step(x, config):
+    return x * 2
+
+
+compiled_static = jax.jit(_inner_step, static_argnums=(1,))
+
+
+def call_with_unhashable(x):
+    return compiled_static(x, [4, 8])  # R2: unhashable static arg (list)
+
+
+def call_with_varying_static(x):
+    outs = []
+    for width in (8, 16, 32, 64):
+        outs.append(compiled_static(x, width))  # R2: loop-varying static arg
+    return outs

@@ -1,0 +1,52 @@
+"""jaxlint fixture: R3 clean twins — zero findings expected."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _update(params, opt_state, batch):
+    grads = jax.grad(lambda p: jnp.mean((batch["x"] @ p["w"]) ** 2))(params)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    return new_params, opt_state
+
+
+donated_step = jax.jit(_update, donate_argnums=(0,))
+
+
+def train_with_copied_state(params, batches):
+    # the PR 3 fix shape: copy the leaves instead of aliasing the buffer
+    opt_state = {"z": jax.tree_util.tree_map(jnp.copy, params), "count": 0}
+    for batch in batches:
+        params, opt_state = donated_step(params, opt_state, batch)
+    return params
+
+
+def train_rebinds(params, batches):
+    for batch in batches:
+        params, _ = donated_step(params, {"count": 0}, batch)  # rebound: fine
+    return params
+
+
+def wrapped_call_rebinds(params, opt_state, batch):
+    # black-style wrapped call: the continuation-line argument names are the
+    # call's own inputs, not post-donation reads
+    new_params, new_opt = donated_step(
+        params,
+        opt_state,
+        batch,
+    )
+    return new_params, new_opt
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def sgd_step_donated(params, opt_state, grads):
+    params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    return params, opt_state
+
+
+@jax.jit
+def forward_only(params, batch):
+    # returns a fresh value, not an updated param pytree: donation optional
+    return jnp.mean(batch["x"] @ params["w"])

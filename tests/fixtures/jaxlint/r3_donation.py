@@ -1,0 +1,45 @@
+"""jaxlint fixture: R3 seeded violations — donation bugs.
+
+``train_with_aliased_state`` is a faithful reconstruction of the PR 3
+schedule-free bug: the optimizer state holds ``z``, a plain alias of the
+param buffer, and the step donates params — one physical buffer donated
+while a live reference rides in another argument.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _update(params, opt_state, batch):
+    grads = jax.grad(lambda p: jnp.mean((batch["x"] @ p["w"]) ** 2))(params)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    return new_params, opt_state
+
+
+donated_step = jax.jit(_update, donate_argnums=(0,))
+
+
+def train_with_aliased_state(params, batches):
+    z = params  # schedule-free z iterate: aliases the param buffer
+    opt_state = {"z": z, "count": 0}
+    for batch in batches:
+        # R3: donated arg 0 (params) is aliased inside arg 1 (opt_state)
+        params, opt_state = donated_step(params, opt_state, batch)
+    return params
+
+
+def eval_after_donate(params, batch):
+    new_params, _ = donated_step(params, {"count": 0}, batch)
+    return jnp.sum(params["w"])  # R3: read after donation deleted the buffer
+
+
+def train_loop_no_rebind(params, batches):
+    for batch in batches:
+        donated_step(params, {"count": 0}, batch)  # R3: donated, never rebound
+    return params
+
+
+@jax.jit
+def sgd_step_no_donate(params, grads):
+    params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    return params, grads  # R3 (warning): updates params, no donate_argnums

@@ -1,0 +1,36 @@
+"""jaxlint fixture: R4 clean twins — zero findings expected."""
+
+from accelerate_tpu.utils.jax_compat import broadcast_one_to_all
+from accelerate_tpu.utils.operations import gather
+
+
+def gather_then_gate(state, metrics):
+    all_metrics = gather(metrics)  # every rank participates...
+    if state.is_main_process:
+        _write(all_metrics)  # ...only the payload handling is gated
+    return all_metrics
+
+
+def source_as_argument(state, x):
+    # the correct spelling of "main sends": rank identity is an ARGUMENT,
+    # every rank enters the collective
+    return broadcast_one_to_all(x, is_source=state.process_index == 0)
+
+
+def symmetric_branches(state, x, big):
+    if state.is_main_process:
+        y = gather(x)
+    else:
+        y = gather(x)  # same op both arms: schedules match
+    return y
+
+
+def rank_gated_io_only(state, payload):
+    if not state.is_main_process:
+        return None
+    _write(payload)  # file IO under a rank guard, no collective
+    return payload
+
+
+def _write(obj):
+    pass

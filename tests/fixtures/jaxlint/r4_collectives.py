@@ -1,0 +1,47 @@
+"""jaxlint fixture: R4 seeded violations — rank-divergent collectives.
+
+``save_metrics_deadlock`` is the canonical ``if is_main_process:
+gather(...)`` shape from the issue; ``checkpoint_guarded`` is the subtler
+early-return variant that real checkpoint code grows.
+"""
+
+from accelerate_tpu.utils.operations import broadcast, gather
+
+
+def save_metrics_deadlock(state, metrics):
+    if state.is_main_process:
+        all_metrics = gather(metrics)  # R4: only rank 0 reaches the gather
+        return all_metrics
+    return None
+
+
+def checkpoint_guarded(state, payload):
+    if not state.is_main_process:
+        return None  # rank filter...
+    return gather(payload)  # R4: ...then a collective only main reaches
+
+
+def _collect(tree):
+    return gather(tree)  # collective via helper
+
+
+def log_through_helper(state, metrics):
+    if state.process_index == 0:
+        return _collect(metrics)  # R4: collective-containing helper under rank guard
+    return None
+
+
+def ternary_gather(state, x):
+    return gather(x) if state.is_main_process else None  # R4: one-arm collective
+
+
+def shortcircuit_broadcast(state, x):
+    return state.is_main_process and broadcast(x)  # R4: short-circuited
+
+
+def asymmetric_branches(state, x):
+    if state.is_main_process:
+        y = gather(x)  # R4: branches disagree (gather vs broadcast)
+    else:
+        y = broadcast(x)  # R4: flagged with its sibling
+    return y

@@ -1,0 +1,33 @@
+"""jaxlint fixture: R5 clean twins — zero findings expected."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step_with_jax_random(params, batch, key=None):
+    noise = jax.random.normal(key, ())  # explicit key: deterministic
+    return jnp.mean(batch["x"] @ params["w"]) + noise
+
+
+@jax.jit
+def step_sorted_iteration(params, batch):
+    total = jnp.zeros(())
+    for name in sorted({"w", "b"}):  # sorted: stable order
+        total = total + jnp.sum(params[name])
+    return total
+
+
+def build_sharding_specs(axis_names):
+    specs = {}
+    for axis in sorted(set(axis_names)):  # sorted before building specs
+        specs[axis] = ("data", axis)
+    return specs
+
+
+def host_side_timing(fn, *args):
+    t0 = time.monotonic()  # not traced: host-side timing is fine
+    out = fn(*args)
+    return out, time.monotonic() - t0

@@ -1,0 +1,36 @@
+"""jaxlint fixture: R5 seeded violations — nondeterminism in traced code."""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step_with_clock(params, batch):
+    seed = time.time()  # R5: baked at trace time, differs per rank
+    return jnp.mean(batch["x"] @ params["w"]) + seed
+
+
+@jax.jit
+def step_with_python_random(params, batch):
+    jitter = random.random()  # R5: one frozen draw per trace
+    noise = np.random.normal(size=())  # R5: numpy entropy at trace time
+    return jnp.mean(batch["x"] @ params["w"]) * jitter + noise
+
+
+@jax.jit
+def step_with_set_iteration(params, batch):
+    total = jnp.zeros(())
+    for name in {"w", "b"}:  # R5: set order is unspecified per process
+        total = total + jnp.sum(params[name])
+    return total
+
+
+def build_sharding_specs(axis_names):
+    specs = {}
+    for axis in set(axis_names):  # R5: unordered axes feeding sharding specs
+        specs[axis] = ("data", axis)
+    return specs

@@ -1,0 +1,13 @@
+# jaxlint: skip-file — vendored-fixture stand-in: whole file exempt
+"""jaxlint fixture: file-level suppression."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def anything_goes(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    if loss > 0:  # would be R1 without the skip-file marker
+        return float(loss)
+    return 0.0

@@ -1,0 +1,30 @@
+"""jaxlint fixture: inline-suppression semantics.
+
+Each violation here is covered by a ``# jaxlint: disable`` comment; the
+engine must report them as suppressed (not new). The final function carries
+a real violation with a MISMATCHED rule id in the disable list — that one
+must still fail.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def tolerated_sync(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    debug = float(loss)  # jaxlint: disable=R1
+    return debug
+
+
+@jax.jit
+def tolerated_all(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    host = loss.item()  # jaxlint: disable
+    return host
+
+
+@jax.jit
+def wrong_rule_listed(params, batch):
+    loss = jnp.mean(batch["x"] @ params["w"])
+    return loss.tolist()  # jaxlint: disable=R4

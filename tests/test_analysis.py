@@ -1,0 +1,783 @@
+"""Tests for ``accelerate_tpu.analysis`` (jaxlint).
+
+The fixture corpus under ``tests/fixtures/jaxlint/`` seeds violations per
+rule (plus clean near-miss twins); the acceptance bar is **zero false
+negatives on the seeded set and zero findings on the twins** — including a
+reconstruction of the PR 3 donation-aliasing bug (r3_donation.py) and an
+``if is_main_process: gather(...)`` deadlock (r4_collectives.py).
+
+Also covers suppression/baseline semantics, the JSON output schema, the
+flight-recorder collective-fingerprint cross-check for R4, and (smoke) that
+``make lint`` passes on the repo itself.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.analysis import (
+    Severity,
+    build_package_index,
+    discover_traced,
+    run_lint,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _lint(*names, rules=None):
+    return run_lint([_fixture(n) for n in names], rules=rules, use_baseline=False)
+
+
+def _symbols(result, rule):
+    """Top-level function names carrying new findings of ``rule``."""
+    return {
+        f.symbol.split(".")[0]
+        for f in result.new_findings
+        if f.rule == rule and f.symbol
+    }
+
+
+# --------------------------------------------------------------- discovery --
+
+
+def test_traced_region_discovery():
+    pkg = build_package_index([FIXTURES])
+    region = discover_traced(pkg)
+    root_names = {q for (_m, q) in region.roots}
+    # decorator form, call form, and partial form are all wrap points
+    assert "step_with_item" in root_names  # @jax.jit
+    assert "_update" in root_names  # jax.jit(_update, donate_argnums=...)
+    assert "sgd_step_donated" in root_names  # @functools.partial(jax.jit, ...)
+    # a helper only *called* from a root is traced but not a root
+    traced_names = {q for (_m, q) in region.traced}
+    assert "traced_helper" in traced_names
+    assert ("r1_host_sync", "traced_helper") not in region.roots
+
+
+def test_donation_spec_parsed():
+    pkg = build_package_index([_fixture("r3_donation.py")])
+    region = discover_traced(pkg)
+    spec = region.roots[("r3_donation", "_update")]
+    assert spec.donate_argnums == (0,)
+
+
+def test_eager_call_to_raw_function_is_not_a_donated_site(tmp_path):
+    """`f(...)` where `step = jax.jit(f, donate_argnums=...)` exists is an
+    EAGER call — it donates nothing and must not trip use-after-donate."""
+    (tmp_path / "m.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "def train_step(params, batch):\n"
+        "    return params\n\n"
+        "step = jax.jit(train_step, donate_argnums=(0,))\n\n"
+        "def eager_debug(params, batch):\n"
+        "    out = train_step(params, batch)\n"
+        "    norm = jnp.sum(params['w'])\n"
+        "    return out, norm\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert [f for f in result.new_findings if f.rule == "R3"] == [], [
+        f.message for f in result.new_findings
+    ]
+
+
+def test_tuple_of_names_donate_argnums_counts_as_donating(tmp_path):
+    """`donate_argnums=(A, B)` with module constants still reads as
+    configured donation."""
+    (tmp_path / "m.py").write_text(
+        "import jax\n\n"
+        "A, B = 0, 1\n\n"
+        "def train_step(params, opt_state, batch):\n"
+        "    return params, opt_state\n\n"
+        "step = jax.jit(train_step, donate_argnums=(A, B))\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert [f for f in result.new_findings if f.rule == "R3"] == []
+
+
+def test_non_literal_donate_argnums_counts_as_donating(tmp_path):
+    """`donate_argnums=DONATE` (a variable) must not read as 'no donation' —
+    R3's missing-donation warning would fail lint on correct code."""
+    (tmp_path / "m.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "DONATE = (0, 1)\n\n"
+        "def train_step(params, opt_state, batch):\n"
+        "    params = jax.tree_util.tree_map(lambda p: p - 0.1, params)\n"
+        "    return params, opt_state\n\n"
+        "step = jax.jit(train_step, donate_argnums=DONATE)\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert [f for f in result.new_findings if f.rule == "R3"] == []
+
+
+def test_r4_order_swapped_and_elif_schedules_flagged(tmp_path):
+    """Equal op *multisets* are not symmetry: order swaps and elif chains
+    with no final else both deadlock and must be flagged."""
+    (tmp_path / "m.py").write_text(
+        "from accelerate_tpu.utils.operations import gather, reduce\n\n"
+        "def order_swapped(state, x):\n"
+        "    if state.is_main_process:\n"
+        "        a = gather(x)\n"
+        "        b = reduce(x)\n"
+        "    else:\n"
+        "        b = reduce(x)\n"
+        "        a = gather(x)\n"
+        "    return a, b\n\n"
+        "def elif_no_else(state, x):\n"
+        "    if state.process_index == 0:\n"
+        "        return gather(x)\n"
+        "    elif state.process_index == 1:\n"
+        "        return gather(x)\n"
+        "    return None\n\n"
+        "def symmetric(state, x):\n"
+        "    if state.is_main_process:\n"
+        "        y = gather(x)\n"
+        "    else:\n"
+        "        y = gather(x)\n"
+        "    return y\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert {f.symbol for f in result.new_findings if f.rule == "R4"} == {
+        "order_swapped",
+        "elif_no_else",
+    }
+
+
+# ------------------------------------------------------- per-rule fixtures --
+
+
+def test_r1_zero_false_negatives():
+    result = _lint("r1_host_sync.py")
+    assert _symbols(result, "R1") == {
+        "step_with_item",
+        "step_with_float",
+        "step_with_branch",
+        "step_with_asarray",
+        "step_with_device_get",
+        "traced_helper",
+    }
+    assert all(
+        f.severity == Severity.ERROR for f in result.new_findings if f.rule == "R1"
+    )
+
+
+def test_r2_zero_false_negatives():
+    result = _lint("r2_recompile.py")
+    assert _symbols(result, "R2") == {
+        "step_shape_branch",
+        "step_unrolled_loop",
+        "step_mutable_global",
+        "call_with_unhashable",
+        "call_with_varying_static",
+    }
+
+
+def test_r3_zero_false_negatives_incl_pr3_reconstruction():
+    result = _lint("r3_donation.py")
+    assert _symbols(result, "R3") == {
+        "train_with_aliased_state",
+        "eval_after_donate",
+        "train_loop_no_rebind",
+        "sgd_step_no_donate",
+    }
+    # the PR 3 shape specifically: donated params aliased inside opt_state,
+    # reported as an ERROR naming the shared buffer
+    aliased = [
+        f
+        for f in result.new_findings
+        if f.rule == "R3" and f.symbol == "train_with_aliased_state"
+    ]
+    assert len(aliased) == 1
+    assert aliased[0].severity == Severity.ERROR
+    assert "params" in aliased[0].message and "alias" in aliased[0].message
+
+
+def test_r4_zero_false_negatives_incl_main_process_gather():
+    result = _lint("r4_collectives.py")
+    assert _symbols(result, "R4") == {
+        "save_metrics_deadlock",
+        "checkpoint_guarded",
+        "log_through_helper",
+        "ternary_gather",
+        "shortcircuit_broadcast",
+        "asymmetric_branches",
+    }
+    # the issue's canonical deadlock: `if is_main_process: gather(...)`
+    canonical = [
+        f
+        for f in result.new_findings
+        if f.rule == "R4" and f.symbol == "save_metrics_deadlock"
+    ]
+    assert canonical and canonical[0].severity == Severity.ERROR
+    assert "gather" in canonical[0].message
+    # the early-return variant names the guard line
+    guarded = [
+        f
+        for f in result.new_findings
+        if f.rule == "R4" and f.symbol == "checkpoint_guarded"
+    ]
+    assert guarded and "early return" in guarded[0].message
+
+
+def test_r5_zero_false_negatives():
+    result = _lint("r5_nondet.py")
+    assert _symbols(result, "R5") == {
+        "step_with_clock",
+        "step_with_python_random",
+        "step_with_set_iteration",
+        "build_sharding_specs",
+    }
+
+
+@pytest.mark.parametrize(
+    "twin",
+    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py", "r5_clean.py"],
+)
+def test_clean_twins_produce_zero_findings(twin):
+    result = _lint(twin)
+    assert result.new_findings == [], [
+        (f.rule, f.location(), f.message) for f in result.new_findings
+    ]
+
+
+def test_rule_subset_selection():
+    result = _lint("r1_host_sync.py", "r4_collectives.py", rules=["R4"])
+    assert {f.rule for f in result.new_findings} == {"R4"}
+
+
+def test_unknown_rule_id_is_an_error():
+    """A --rules typo must not turn the lint into a vacuous pass."""
+    with pytest.raises(ValueError, match="R9"):
+        _lint("r1_host_sync.py", rules=["R9"])
+    res = _run_cli("lint", _fixture("r1_host_sync.py"), "--no-baseline", "--rules", "R9")
+    assert res.returncode == 2
+    assert "unknown rule" in res.stderr
+
+
+def test_module_level_jit_call_sites_checked(tmp_path):
+    """An unhashable static arg at a MODULE-LEVEL call site is the same
+    runtime TypeError as one inside a function — both must be flagged."""
+    (tmp_path / "m.py").write_text(
+        "import jax\n\n"
+        "def _inner(x, config):\n"
+        "    return x * 2\n\n"
+        "step = jax.jit(_inner, static_argnums=(1,))\n\n"
+        "def in_function(x):\n"
+        "    return step(x, [4, 8])\n\n"
+        "warmup = step(0, [4, 8])\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    unhashable = [
+        f for f in result.new_findings if f.rule == "R2" and "unhashable" in f.message
+    ]
+    assert len(unhashable) == 2, [(f.line, f.symbol) for f in unhashable]
+    assert {f.symbol for f in unhashable} == {"in_function", ""}
+
+
+def test_module_level_donated_call_site_checked(tmp_path):
+    """The PR 3 aliasing shape at script level (scope None) must be caught."""
+    (tmp_path / "m.py").write_text(
+        "import jax\n\n"
+        "def f(params, opt_state):\n"
+        "    return params, opt_state\n\n"
+        "step = jax.jit(f, donate_argnums=(0,))\n"
+        "params = {'w': 1}\n"
+        "out = step(params, {'z': params})\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    aliased = [
+        f for f in result.new_findings if f.rule == "R3" and "alias" in f.message
+    ]
+    assert len(aliased) == 1 and aliased[0].symbol == ""
+
+
+def test_init_py_relative_imports_resolve(tmp_path):
+    """`from .mod import helper` inside a package __init__ must resolve one
+    level INTO the package, not above it — traced-region BFS depends on it."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def helper(logits):\n"
+        "    return logits.tolist()\n"
+    )
+    (pkg / "__init__.py").write_text(
+        "import jax\nfrom .mod import helper\n\n"
+        "@jax.jit\ndef step(params, batch):\n"
+        "    return helper(batch['x'] @ params['w'])\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert {f.symbol for f in result.new_findings if f.rule == "R1"} == {"helper"}
+
+
+def test_r4_conditional_inside_arm_is_not_symmetric(tmp_path):
+    """A sometimes-executed collective in one arm vs an unconditional one in
+    the other deadlocks on the steps where the condition is false."""
+    (tmp_path / "m.py").write_text(
+        "from accelerate_tpu.utils.operations import gather\n\n"
+        "def sometimes(state, step, metrics):\n"
+        "    if state.is_main_process:\n"
+        "        if step % 100 == 0:\n"
+        "            gather(metrics)\n"
+        "    else:\n"
+        "        gather(metrics)\n\n"
+        "def both_conditional(state, step, metrics):\n"
+        "    if state.is_main_process:\n"
+        "        if step % 100 == 0:\n"
+        "            gather(metrics)\n"
+        "    else:\n"
+        "        if step % 100 == 0:\n"
+        "            gather(metrics)\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    assert {f.symbol for f in result.new_findings if f.rule == "R4"} == {"sometimes"}
+
+
+def test_r2_loop_varying_static_arg_at_module_level(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import jax\n\n"
+        "def step(x, width):\n"
+        "    return x * 2\n\n"
+        "jstep = jax.jit(step, static_argnums=(1,))\n"
+        "for n in range(100):\n"
+        "    jstep(1.0, n)\n"
+    )
+    result = run_lint([str(tmp_path)], use_baseline=False)
+    varying = [
+        f
+        for f in result.new_findings
+        if f.rule == "R2" and "loop variable" in f.message
+    ]
+    assert len(varying) == 1 and varying[0].symbol == ""
+
+
+def test_same_named_files_all_scanned(tmp_path):
+    """util.py in two non-package dirs must not shadow each other."""
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "util.py").write_text(
+            "import jax\nimport jax.numpy as jnp\n\n"
+            f"@jax.jit\ndef f_{sub}(params, batch):\n"
+            "    return float(jnp.mean(params['w']))\n"
+        )
+    result = run_lint([str(tmp_path / "a"), str(tmp_path / "b")], use_baseline=False)
+    assert result.stats["files"] == 2
+    assert {f.symbol for f in result.new_findings} == {"f_a", "f_b"}
+
+
+# ------------------------------------------------- suppressions + baseline --
+
+
+def test_inline_suppressions():
+    result = _lint("suppressed.py")
+    suppressed = [f for f in result.findings if f.suppressed]
+    assert {f.symbol.split(".")[0] for f in suppressed} == {
+        "tolerated_sync",
+        "tolerated_all",
+    }
+    # a disable listing the WRONG rule does not cover the finding
+    assert _symbols(result, "R1") == {"wrong_rule_listed"}
+
+
+def test_skip_file_suppresses_everything():
+    result = _lint("skipped_file.py")
+    assert result.new_findings == []
+    assert any(f.suppressed for f in result.findings)
+
+
+def test_baseline_roundtrip_and_ratchet(tmp_path):
+    work = tmp_path / "pkg"
+    work.mkdir()
+    shutil.copy(_fixture("r1_host_sync.py"), work / "legacy.py")
+    baseline = tmp_path / "jaxlint-baseline.json"
+
+    first = run_lint([str(work)], use_baseline=False)
+    n = len(first.new_findings)
+    assert n > 0
+    write_baseline(first.findings, str(baseline))
+
+    # baselined run: everything covered, nothing new
+    second = run_lint([str(work)], baseline_path=str(baseline))
+    assert second.new_findings == []
+    assert second.summary()["baselined"] == n
+
+    # line moves don't invalidate the baseline (fingerprints are line-free)
+    src = (work / "legacy.py").read_text()
+    (work / "legacy.py").write_text("# moved\n# down\n\n" + src)
+    third = run_lint([str(work)], baseline_path=str(baseline))
+    assert third.new_findings == []
+
+    # a NEW violation is not covered: the ratchet only goes down
+    (work / "legacy.py").write_text(
+        src
+        + "\n\n@jax.jit\ndef fresh_bug(params, batch):\n"
+        "    return float(jnp.mean(params['w']))\n"
+    )
+    fourth = run_lint([str(work)], baseline_path=str(baseline))
+    assert len(fourth.new_findings) == 1
+    assert fourth.new_findings[0].rule == "R1"
+    assert fourth.new_findings[0].symbol == "fresh_bug"
+
+
+def test_baseline_consumes_entries_per_duplicate(tmp_path):
+    """Two identical new copies of one baselined bug: one entry covers one."""
+    work = tmp_path / "pkg"
+    work.mkdir()
+    (work / "m.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\ndef f(params, batch):\n"
+        "    return float(jnp.mean(params['w']))\n"
+    )
+    baseline = tmp_path / "jaxlint-baseline.json"
+    write_baseline(run_lint([str(work)], use_baseline=False).findings, str(baseline))
+    (work / "m.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\ndef f(params, batch):\n"
+        "    return float(jnp.mean(params['w']))\n\n"
+        "@jax.jit\ndef g(params, batch):\n"
+        "    return float(jnp.mean(params['w']))\n"
+    )
+    res = run_lint([str(work)], baseline_path=str(baseline))
+    assert len(res.new_findings) == 1  # g's copy is new; f's stays covered
+
+
+# ------------------------------------------------------------ CLI surface --
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_cli_json_schema():
+    res = _run_cli("lint", _fixture("r1_host_sync.py"), "--no-baseline", "--json")
+    assert res.returncode == 1  # violations present
+    payload = json.loads(res.stdout)
+    assert payload["schema"] == 1
+    assert set(payload) == {"schema", "summary", "stats", "findings"}
+    assert {"total", "new", "errors", "warnings", "suppressed", "baselined", "by_rule"} <= set(
+        payload["summary"]
+    )
+    assert payload["summary"]["errors"] >= 6
+    for f in payload["findings"]:
+        assert {
+            "rule",
+            "severity",
+            "path",
+            "line",
+            "col",
+            "message",
+            "symbol",
+            "line_content",
+            "suppressed",
+            "baselined",
+        } <= set(f)
+        assert f["rule"] in {"R1", "R2", "R3", "R4", "R5"}
+        assert f["severity"] in {"error", "warning", "note"}
+
+
+def test_cli_exit_codes():
+    assert _run_cli("lint", _fixture("r1_clean.py"), "--no-baseline").returncode == 0
+    assert _run_cli("lint", _fixture("r1_host_sync.py"), "--no-baseline").returncode == 1
+
+
+def test_cli_rules_catalog():
+    res = _run_cli("rules")
+    assert res.returncode == 0
+    for rule_id in ("R1", "R2", "R3", "R4", "R5"):
+        assert rule_id in res.stdout
+
+
+def test_cli_write_baseline(tmp_path):
+    work = tmp_path / "pkg"
+    work.mkdir()
+    shutil.copy(_fixture("r5_nondet.py"), work / "m.py")
+    baseline = tmp_path / "bl.json"
+    res = _run_cli("lint", str(work), "--baseline", str(baseline), "--write-baseline")
+    assert res.returncode == 0, res.stdout + res.stderr
+    data = json.loads(baseline.read_text())
+    assert data["version"] == 1 and len(data["findings"]) >= 4
+    res = _run_cli("lint", str(work), "--baseline", str(baseline))
+    assert res.returncode == 0
+
+
+@pytest.mark.smoke
+def test_repo_lints_clean():
+    """The acceptance gate: `make lint` (the CLI over accelerate_tpu/ with
+    the shipped baseline) exits 0 at HEAD."""
+    res = _run_cli("lint", "accelerate_tpu/")
+    assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-2000:]
+
+
+# ------------------------------------- R4 runtime cross-check (satellite) --
+
+
+def test_collective_fingerprint_rolls_and_matches():
+    from accelerate_tpu.telemetry.flight_recorder import FlightRecorder
+
+    a, b = FlightRecorder(capacity=8), FlightRecorder(capacity=8)
+    for rec in (a, b):
+        rec.record_collective("gather", "(8, 4)/float32")
+        rec.record_collective("reduce:mean", "(8,)/float32")
+    assert a.collective_hash == b.collective_hash and a.collective_count == 2
+    b.record_collective("gather", "(8, 4)/float32")
+    assert a.collective_hash != b.collective_hash
+
+
+def test_gather_feeds_fingerprint():
+    import numpy as np
+
+    from accelerate_tpu.telemetry import flight_recorder
+    from accelerate_tpu.utils.operations import gather
+
+    rec = flight_recorder.get_recorder()
+    before = rec.collective_count
+    gather({"x": np.ones((4, 2), np.float32)})
+    assert rec.collective_count == before + 1
+    assert rec.collective_recent[-1]["op"] == "gather"
+    # single-process: op recorded, payload walk skipped (no peer to diverge
+    # from); multiprocess signatures are covered by _collective_signature's
+    # own test below
+    assert rec.collective_recent[-1]["sig"] == "local"
+
+
+def test_collective_signature_multiprocess_shapes(monkeypatch):
+    import numpy as np
+
+    from accelerate_tpu.utils import operations
+
+    class _FakeState:
+        num_processes = 2
+
+    monkeypatch.setattr(operations, "PartialState", lambda: _FakeState())
+    sig = operations._collective_signature(
+        {"a": np.ones((8, 2), np.float32), "b": [np.zeros((3,), np.int32)]}
+    )
+    assert "(8, 2)/float32" in sig and "(3,)/int32" in sig
+
+
+def test_pad_across_processes_feeds_fingerprint():
+    import numpy as np
+
+    from accelerate_tpu.telemetry import flight_recorder
+    from accelerate_tpu.utils.operations import pad_across_processes
+
+    rec = flight_recorder.get_recorder()
+    before = rec.collective_count
+    pad_across_processes({"x": np.ones((3, 2), np.float32)})
+    assert rec.collective_count == before + 1
+    # op-only signature: pad's whole job is rank-VARYING shapes, which must
+    # not poison the cross-rank fingerprint on healthy ragged batches
+    assert rec.collective_recent[-1]["op"] == "pad_across_processes"
+    assert rec.collective_recent[-1]["sig"] == "ragged"
+
+
+def test_by_rank_report_rank_with_no_collectives_is_prefix_skew(tmp_path):
+    """A rank dumped before its first collective has an (empty) prefix of
+    every schedule — skew, not a divergence banner."""
+    from accelerate_tpu.telemetry.flight_recorder import FlightRecorder
+    from accelerate_tpu.telemetry.report import build_report
+
+    for rank, ops in ((0, ["gather", "reduce:mean"]), (1, [])):
+        rec = FlightRecorder(capacity=16)
+        for op in ops:
+            rec.record_collective(op, "(8,)/float32")
+        (tmp_path / f"flight-rank{rank}.json").write_text(
+            json.dumps(
+                {
+                    "kind": "flight_record",
+                    "reason": "test",
+                    "meta": {"process_index": rank},
+                    "collective_schedule": rec.collective_schedule(),
+                }
+            )
+        )
+    div = build_report([str(tmp_path)], by_rank=True)["ranks"]["collective_divergence"]
+    assert div["diverged"] is False
+    assert div["prefix_skew"] == {"0": 2, "1": 0}
+
+
+def test_by_rank_report_confirms_divergent_schedule(tmp_path):
+    """Statically-flagged divergence (R4) confirmed at runtime: rank 1 skips
+    one gather; the --by-rank report names the first differing call."""
+    from accelerate_tpu.telemetry.flight_recorder import FlightRecorder
+    from accelerate_tpu.telemetry.report import build_report, format_rank_section
+
+    plans = {
+        # rank 0 took the rank-conditional extra gather; rank 1 moved on to
+        # the barrier — the dumps disagree at call #3, not just in length
+        0: ["gather", "reduce:mean", "gather", "barrier"],
+        1: ["gather", "reduce:mean", "barrier"],
+    }
+    for rank, ops in plans.items():
+        rec = FlightRecorder(capacity=16)
+        for op in ops:
+            rec.record_collective(op, "(8, 4)/float32")
+        (tmp_path / f"flight-rank{rank}.json").write_text(
+            json.dumps(
+                {
+                    "kind": "flight_record",
+                    "reason": "test",
+                    "meta": {"process_index": rank},
+                    "collective_schedule": rec.collective_schedule(),
+                }
+            )
+        )
+    report = build_report([str(tmp_path)], by_rank=True)
+    div = report["ranks"]["collective_divergence"]
+    assert div["diverged"] is True
+    assert div["count_skew"] == {"0": 4, "1": 3}
+    assert div["first_divergence"]["seq"] == 3
+    assert div["first_divergence"]["calls"]["0"]["op"] == "gather"
+    assert div["first_divergence"]["calls"]["1"]["op"] == "barrier"
+    text = format_rank_section(report["ranks"])
+    assert "COLLECTIVE SCHEDULE DIVERGENCE" in text
+    assert "call #3" in text
+
+
+def _write_sched(tmp_path, rank, sched):
+    (tmp_path / f"flight-rank{rank}.json").write_text(
+        json.dumps(
+            {
+                "kind": "flight_record",
+                "reason": "test",
+                "meta": {"process_index": rank},
+                "collective_schedule": sched,
+            }
+        )
+    )
+
+
+def test_by_rank_divergence_proven_at_min_count_despite_window_rotation(tmp_path):
+    """The differing call rotated out of every window, but the cumulative
+    hashes at the minimum common count disagree — that is proof, not
+    'indeterminate'."""
+    from accelerate_tpu.telemetry.report import build_report
+
+    _write_sched(
+        tmp_path,
+        0,
+        {
+            "count": 100,
+            "hash": "cccccccc",
+            "recent": [
+                {"seq": s, "op": "gather", "sig": "x", "hash": "aaaaaaaa"}
+                for s in range(90, 101)
+            ],
+        },
+    )
+    _write_sched(
+        tmp_path,
+        1,
+        {
+            "count": 90,
+            "hash": "bbbbbbbb",
+            "recent": [{"seq": 90, "op": "gather", "sig": "x", "hash": "bbbbbbbb"}],
+        },
+    )
+    div = build_report([str(tmp_path)], by_rank=True)["ranks"]["collective_divergence"]
+    assert div["diverged"] is True and div["first_divergence"] is None
+
+
+def test_by_rank_window_outrun_count_skew_is_indeterminate(tmp_path):
+    """Counts differ and no window reaches the minimum common count: timing
+    skew and divergence are indistinguishable — no deadlock banner."""
+    from accelerate_tpu.telemetry.report import build_report, format_rank_section
+
+    _write_sched(
+        tmp_path,
+        0,
+        {
+            "count": 200,
+            "hash": "cccccccc",
+            "recent": [
+                {"seq": s, "op": "gather", "sig": "x", "hash": "aaaaaaaa"}
+                for s in range(190, 201)
+            ],
+        },
+    )
+    _write_sched(
+        tmp_path,
+        1,
+        {
+            "count": 100,
+            "hash": "bbbbbbbb",
+            "recent": [
+                {"seq": s, "op": "gather", "sig": "x", "hash": "bbbbbbbb"}
+                for s in range(95, 101)
+            ],
+        },
+    )
+    report = build_report([str(tmp_path)], by_rank=True)
+    div = report["ranks"]["collective_divergence"]
+    assert div["diverged"] is False and div.get("indeterminate") is True
+    assert "INDETERMINATE" in format_rank_section(report["ranks"])
+
+
+def test_by_rank_report_consistent_schedule(tmp_path):
+    from accelerate_tpu.telemetry.flight_recorder import FlightRecorder
+    from accelerate_tpu.telemetry.report import build_report, format_rank_section
+
+    for rank in (0, 1):
+        rec = FlightRecorder(capacity=16)
+        for op in ("gather", "reduce:mean"):
+            rec.record_collective(op, "(8, 4)/float32")
+        (tmp_path / f"flight-rank{rank}.json").write_text(
+            json.dumps(
+                {
+                    "kind": "flight_record",
+                    "reason": "test",
+                    "meta": {"process_index": rank},
+                    "collective_schedule": rec.collective_schedule(),
+                }
+            )
+        )
+    report = build_report([str(tmp_path)], by_rank=True)
+    div = report["ranks"]["collective_divergence"]
+    assert div["diverged"] is False
+    assert "consistent across ranks" in format_rank_section(report["ranks"])
+
+
+def test_by_rank_report_prefix_skew_is_not_divergence(tmp_path):
+    """A healthy run dumped mid-step: rank 0 is one call ahead with an
+    identical common prefix — dump-timing skew, not a deadlock banner."""
+    from accelerate_tpu.telemetry.flight_recorder import FlightRecorder
+    from accelerate_tpu.telemetry.report import build_report, format_rank_section
+
+    plans = {0: ["gather", "reduce:mean", "gather"], 1: ["gather", "reduce:mean"]}
+    for rank, ops in plans.items():
+        rec = FlightRecorder(capacity=16)
+        for op in ops:
+            rec.record_collective(op, "(8, 4)/float32")
+        (tmp_path / f"flight-rank{rank}.json").write_text(
+            json.dumps(
+                {
+                    "kind": "flight_record",
+                    "reason": "test",
+                    "meta": {"process_index": rank},
+                    "collective_schedule": rec.collective_schedule(),
+                }
+            )
+        )
+    report = build_report([str(tmp_path)], by_rank=True)
+    div = report["ranks"]["collective_divergence"]
+    assert div["diverged"] is False
+    assert div["prefix_skew"] == {"0": 1, "1": 0}
+    text = format_rank_section(report["ranks"])
+    assert "dump-timing skew" in text and "DIVERGENCE" not in text

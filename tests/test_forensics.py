@@ -393,7 +393,9 @@ def test_doctor_self_checks(capsys):
 
     assert run_doctor() == 0
     out = capsys.readouterr().out
-    assert out.count("PASS") == 3 and "FAIL" not in out
+    # dump + stall + straggler + collective divergence + jaxlint
+    assert out.count("PASS") == 5 and "FAIL" not in out
+    assert "static analyzer (jaxlint)" in out and "collective divergence" in out
 
 
 # ------------------------------------------------------- integration hookups
@@ -405,7 +407,11 @@ def test_prefetch_producer_registers_and_unregisters(tmp_path):
 
     wd = watchdog.start(timeout=60, interval=0.05, out_dir=str(tmp_path))
     acc = Accelerator()
-    data = [{"x": np.ones((4,), np.float32)} for _ in range(24)]
+    # enough batches that the bounded queue (depth 2) keeps the producer
+    # alive — and registered — while the consumer holds the first batch; a
+    # 3-batch epoch let the producer finish and unregister (from its own
+    # exit path, by design) before the assertion below could observe it
+    data = [{"x": np.ones((4,), np.float32)} for _ in range(240)]
     dl = acc.prepare(DataLoader(data, batch_size=8))
     it = iter(dl)
     next(it)

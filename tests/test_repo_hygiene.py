@@ -41,3 +41,35 @@ def test_pycache_is_gitignored():
     assert os.path.exists(gitignore)
     patterns = [line.strip() for line in open(gitignore)]
     assert "__pycache__/" in patterns and "*.pyc" in patterns
+
+
+# --------------------------------------------------------------- jaxlint --
+# The static-analysis baseline (jaxlint-baseline.json) is a ratchet: entries
+# exist only to grandfather findings that predate the linter, and the count
+# may only ever go DOWN. Fixing debt removes entries; new findings must be
+# fixed or inline-suppressed with a justification comment, never baselined.
+# PR 6 shipped with zero entries — keep it that way (or lower, if a future
+# PR ever has to add one and then pays it off).
+
+MAX_JAXLINT_BASELINE_ENTRIES = 0
+
+
+def test_jaxlint_baseline_only_shrinks():
+    import json
+
+    path = os.path.join(REPO, "jaxlint-baseline.json")
+    assert os.path.exists(path), "jaxlint-baseline.json missing from repo root"
+    with open(path) as f:
+        data = json.load(f)
+    assert data.get("version") == 1
+    entries = data.get("findings")
+    assert isinstance(entries, list)
+    assert len(entries) <= MAX_JAXLINT_BASELINE_ENTRIES, (
+        f"jaxlint baseline grew to {len(entries)} entr(ies) — the baseline "
+        "only ratchets down. Fix the new finding or add an inline "
+        "`# jaxlint: disable=Rn` with a justification comment, then (only "
+        "if unavoidable) raise MAX_JAXLINT_BASELINE_ENTRIES in the same "
+        "review that approves the debt."
+    )
+    for entry in entries:
+        assert {"rule", "path", "symbol", "line_content"} <= set(entry)

@@ -88,6 +88,24 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         [("accelerate_tpu.generation", None),
          ("accelerate_tpu.parallel.pipeline", None)],
     ),
+    "analysis": (
+        "Static analysis (jaxlint)",
+        "AST-based analyzer for jit-traced code (no reference counterpart): "
+        "discovers the jit/pjit/shard_map call graph and flags host syncs "
+        "(R1), recompile hazards (R2), donation bugs (R3), rank-divergent "
+        "collectives (R4) and trace-time nondeterminism (R5). CLI: "
+        "`python -m accelerate_tpu.analysis lint` / `make lint`. See "
+        "`docs/static_analysis.md` for the rule catalog.",
+        [("accelerate_tpu.analysis.engine", ["run_lint", "LintResult"]),
+         ("accelerate_tpu.analysis.findings", ["Finding", "Severity", "summarize"]),
+         ("accelerate_tpu.analysis.callgraph",
+          ["build_package_index", "discover_traced", "PackageIndex", "ModuleIndex",
+           "FunctionInfo", "JitSpec", "TracedRegion"]),
+         ("accelerate_tpu.analysis.rules", ["Rule", "RuleContext", "load_all_rules"]),
+         ("accelerate_tpu.analysis.baseline",
+          ["load_baseline", "apply_baseline", "write_baseline", "discover_baseline"]),
+         ("accelerate_tpu.analysis.reporters", ["render_human", "render_json"])],
+    ),
     "checkpointing": (
         "Checkpointing",
         "Crash-consistent (staging + fsync + `_COMMITTED` marker + atomic "
