@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt doctor lint
+        bench-input bench-ckpt doctor lint profile
 
 PYTEST := python -m pytest -q
 
@@ -91,7 +91,13 @@ bench-ckpt:
 	python benchmarks/checkpoint/run.py
 
 # self-check: flight-recorder dump, watchdog stall detection, straggler
-# report, collective-divergence detection and the jaxlint engine against
+# report, collective-divergence detection, the jaxlint engine, perf cost
+# capture, xplane trace parsing and the performance report section against
 # synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor
+
+# performance observatory: a few traced bench train steps on the CPU backend
+# -> printed "performance" report section (MFU, roofline, top ops, overlap)
+profile:
+	JAX_PLATFORMS=cpu python benchmarks/perf/run.py

@@ -443,6 +443,21 @@ class Accelerator:
         )
         self._step_telemetry = _telemetry.StepTelemetry()
         self._compiled_counts: dict[str, int] = {}
+        # Automatic profiler windows on the tracked step (telemetry/xplane.py):
+        # armed by a ProfileConfig kwargs handler or the ACCELERATE_TRACE_*
+        # env knobs; each closed window is parsed into a `trace` event
+        # (top-k ops, compute/collective/idle split, comms-overlap ratio).
+        self._trace_windows = None
+        trace_cfg = self.profile_handler or ProfileConfig()
+        if trace_cfg.windows_enabled:
+            from .telemetry.xplane import TraceWindows
+
+            trace_out = trace_cfg.output_trace_dir or os.path.join(
+                self.project_dir or ".", "profile", "auto"
+            )
+            self._trace_windows = TraceWindows(
+                trace_cfg, os.path.join(trace_out, f"rank{self.process_index}")
+            )
         # Hang/crash forensics (telemetry/flight_recorder.py, telemetry/
         # watchdog.py): the ring buffer records regardless (pure memory); crash
         # handlers and the heartbeat thread arm only when asked — a default run
@@ -1011,7 +1026,7 @@ class Accelerator:
 
         return train_step
 
-    def _track_step(self, step_fn, optimizer):
+    def _track_step(self, step_fn, optimizer, kind: str = "train_step"):
         # The functional loop threads (params, opt_state) locally while
         # ``save_state`` reads ``optimizer.opt_state`` / ``self._models`` — and
         # donation deletes the stale buffers those references point at. Write the
@@ -1024,23 +1039,43 @@ class Accelerator:
         model_slot = 0 if len(self._models) == 1 else None
         from .telemetry import events as _tel
         from .telemetry import flight_recorder as _flight
+        from .telemetry import perf as _perf
         from .telemetry import watchdog as _watchdog
 
         step_telemetry = self._step_telemetry
         flight = _flight.get_recorder()
+        trace_windows = self._trace_windows
+        # XLA-reported cost of THIS wrapper's step fn (captured once, before
+        # the first call — args are never donated-away yet at that point);
+        # re-attached before every step so records from interleaved step fns
+        # (train + a second loop) never carry each other's roofline numbers
+        perf_cost: list = [None, False]  # [cost, capture_attempted]
 
         def step_and_track(params, opt_state, batch):
             # forensics: the flight ring always knows the current step, and an
             # active watchdog hears one beat per step (a rank whose beats stop
             # is stalled; its open phases name what it is blocked in)
-            flight.step = step_telemetry.step_index
-            _watchdog.beat("train_step", step=step_telemetry.step_index)
-            if _tel.is_enabled():
-                with step_telemetry.step():
+            step_index = step_telemetry.step_index
+            flight.step = step_index
+            _watchdog.beat("train_step", step=step_index)
+            if trace_windows is not None:
+                trace_windows.on_step_start(step_index)
+            try:
+                if _tel.is_enabled():
+                    if not perf_cost[1] and _perf.capture_enabled():
+                        perf_cost[1] = True
+                        perf_cost[0] = _perf.capture_compiled(
+                            kind, step_fn, (params, opt_state, batch)
+                        )
+                    step_telemetry.set_step_cost(perf_cost[0])
+                    with step_telemetry.step():
+                        new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+                else:
                     new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
-            else:
-                new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
-                step_telemetry.step_index += 1
+                    step_telemetry.step_index += 1
+            finally:
+                if trace_windows is not None:
+                    trace_windows.on_step_end(step_index)
             optimizer.opt_state = new_opt_state
             if model_slot is not None:
                 self._models[model_slot] = new_params
@@ -1118,14 +1153,14 @@ class Accelerator:
                 )
                 optimizer.opt_state = host_state
                 self._register_compiled("train_step_offload", step)
-                return self._track_step(step, optimizer)
+                return self._track_step(step, optimizer, kind="train_step_offload")
 
         if not self.jit_config.disable_jit:
             donate = self.jit_config.donate_params if donate is None else donate
             train_step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
             self._register_compiled("train_step", train_step)
 
-        return self._track_step(train_step, optimizer)
+        return self._track_step(train_step, optimizer, kind="train_step")
 
     def prepare_train_loop(
         self,
@@ -1179,7 +1214,7 @@ class Accelerator:
             train_loop = jax.jit(train_loop, donate_argnums=(0, 1) if donate else ())
             self._register_compiled("train_loop", train_loop)
 
-        return self._track_step(train_loop, optimizer)
+        return self._track_step(train_loop, optimizer, kind="train_loop")
 
     def prepare_eval_step(self, eval_fn: Callable) -> Callable:
         """Compile an eval/forward step with the compute-dtype policy applied."""
@@ -1712,6 +1747,10 @@ class Accelerator:
         if self._checkpoint_manager is not None:
             self._checkpoint_manager.shutdown(drain=True)
             self._checkpoint_manager = None
+        # a trace window open mid-run must be stopped (and parsed) before the
+        # process exits, or the profiler session leaks into the next run
+        if self._trace_windows is not None:
+            self._trace_windows.close()
         if _tel.is_enabled() and self.trackers:
             self.log_telemetry_summary()
         # forensics teardown: training no longer beats, so the train-step

@@ -11,7 +11,17 @@ to a loss-curve tracker. Layout:
   output directory). Zero overhead when disabled.
 - :mod:`.step_profiler` — per-step wall/data-wait/compile/execute split plus
   recompile detection (per-function jit cache-miss counting).
-- :mod:`.memory` — device/host memory watermarks sampled at step boundaries.
+- :mod:`.memory` — device/host memory watermarks sampled at step boundaries,
+  plus compile-time ``memory_analysis()`` projections checked against device
+  capacity (the OOM caught before it happens).
+- :mod:`.perf` — performance attribution: hardware peak registry (bf16
+  FLOP/s + HBM bandwidth per chip generation), compile-time
+  ``cost_analysis()`` capture for every tracked step function, and the
+  per-step MFU / arithmetic-intensity / roofline folding.
+- :mod:`.xplane` — programmatic ``jax.profiler`` trace windows
+  (every-Nth-step / one-shot via ``ProfileConfig`` or ``ACCELERATE_TRACE_*``)
+  and a dependency-free ``*.xplane.pb`` parser producing top-k op durations,
+  the compute/collective/idle device-time split, and the comms-overlap ratio.
 - :mod:`.flight_recorder` — always-on in-memory ring of recent events plus
   crash handlers (SIGTERM / unhandled exception / faulthandler) that dump
   ``flight-rank<k>.json`` post-mortems: ring, all-thread stacks, open phases,
@@ -30,7 +40,7 @@ Comms counters live in :mod:`accelerate_tpu.utils.operations` (the ops being
 counted) and write through :mod:`.events`.
 """
 
-from . import flight_recorder, watchdog
+from . import flight_recorder, perf, watchdog, xplane
 from .events import (
     TELEMETRY_DIR_ENV_VAR,
     TELEMETRY_ENV_VAR,
@@ -51,20 +61,26 @@ from .events import (
 )
 from .flight_recorder import FlightRecorder
 from .memory import MemoryMonitor, device_memory_stats, host_memory_bytes, live_array_bytes
+from .perf import CompiledCost, HardwarePeaks, capture_compiled, lm_train_mfu, peaks_for_device
 from .step_profiler import RecompileWatcher, StepTelemetry, record_data_wait
 from .tracker_bridge import mirror_to_trackers, summary_metrics
 from .watchdog import Watchdog
+from .xplane import TraceWindows, summarize_trace
 
 __all__ = [
     "TELEMETRY_DIR_ENV_VAR",
     "TELEMETRY_ENV_VAR",
     "TELEMETRY_SCHEMA_VERSION",
+    "CompiledCost",
     "EventLog",
     "FlightRecorder",
+    "HardwarePeaks",
     "MemoryMonitor",
     "RecompileWatcher",
     "StepTelemetry",
+    "TraceWindows",
     "Watchdog",
+    "capture_compiled",
     "counter",
     "device_memory_stats",
     "disable",
@@ -78,11 +94,16 @@ __all__ = [
     "host_memory_bytes",
     "is_enabled",
     "live_array_bytes",
+    "lm_train_mfu",
     "maybe_enable_from_env",
     "mirror_to_trackers",
+    "peaks_for_device",
+    "perf",
     "record_data_wait",
     "set_step",
     "span",
+    "summarize_trace",
     "summary_metrics",
     "watchdog",
+    "xplane",
 ]

@@ -112,3 +112,77 @@ class MemoryMonitor:
 def log_memory_watermarks() -> dict:
     """One-shot convenience: sample now, return the record."""
     return MemoryMonitor().sample()
+
+
+# ------------------------------------------------- compile-time projection --
+def compiled_memory_analysis(compiled) -> Optional[dict]:
+    """XLA's ``memory_analysis()`` of a compiled executable as a plain dict:
+    ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` / ``alias_bytes``
+    (and ``generated_code_bytes``), or ``None`` when the backend reports
+    nothing. Unlike the runtime watermarks above this is a *pre-execution*
+    fact — the projection that catches an OOM before it happens."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for src, dst in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        value = getattr(ma, src, None)
+        if value is not None:
+            out[dst] = int(value)
+    return out or None
+
+
+def projected_peak_bytes(analysis: dict) -> int:
+    """Device bytes the executable needs live at once: arguments + outputs +
+    temporaries, minus aliased (donated) buffers counted on both sides."""
+    return max(
+        0,
+        int(analysis.get("argument_bytes", 0))
+        + int(analysis.get("output_bytes", 0))
+        + int(analysis.get("temp_bytes", 0))
+        - int(analysis.get("alias_bytes", 0)),
+    )
+
+
+def check_memory_fit(name: str, analysis: Optional[dict], emit: bool = True) -> Optional[dict]:
+    """Compare a compiled function's projected peak against the device's
+    reported capacity (``bytes_limit``); emits one ``memory_projection``
+    record and a ``UserWarning`` when the projection exceeds capacity —
+    the OOM-three-hours-in, caught at compile time. Returns the projection
+    record (``None`` when there is nothing to project)."""
+    if not analysis:
+        return None
+    projected = projected_peak_bytes(analysis)
+    limit = 0
+    for dev in device_memory_stats():
+        # the step runs per device: the BINDING capacity is one device's
+        limit = max(limit, int(dev.get("bytes_limit", 0)))
+    record = {
+        "fn": name,
+        "projected_peak_bytes": projected,
+        "device_bytes_limit": limit or None,
+        "fits": (projected <= limit) if limit else None,
+        **analysis,
+    }
+    if emit:
+        tel.emit("memory_projection", **record)
+    if limit and projected > limit:
+        import warnings
+
+        warnings.warn(
+            f"compiled function {name!r} projects {projected / 1e9:.2f} GB of "
+            f"device memory (args+outputs+temps) but the device reports only "
+            f"{limit / 1e9:.2f} GB — expect an OOM; shrink the batch, enable "
+            "remat, or donate/offload state",
+            stacklevel=2,
+        )
+    return record

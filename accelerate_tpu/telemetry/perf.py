@@ -1,0 +1,310 @@
+"""Per-step performance attribution: hardware peaks, MFU, and roofline buckets.
+
+ROADMAP item 2 ("raw-speed push to MFU >= 0.7") needs per-step *evidence*:
+where device time goes, which functions are compute-bound vs HBM-bound, and
+how the measured step compares to the chip's roofline. This module is the
+shared substrate:
+
+- **Hardware peak registry** — bf16 peak FLOP/s and HBM bandwidth per chip
+  generation (public TPU specs), with a *nominal* CPU fallback so dev-box runs
+  still produce relative MFU numbers (env-overridable). ``bench.py`` and the
+  telemetry layer both read THIS table, so they can never disagree on peaks.
+- **Compile-time cost capture** — :func:`capture_compiled` lowers a jitted
+  step function once (AOT), records XLA's own ``cost_analysis()`` (FLOPs,
+  bytes accessed — remat recompute *included*: hardware utilization, not
+  model-MFU) and ``memory_analysis()`` (argument/output/temp bytes, checked
+  against device capacity by :mod:`.memory`), and emits one ``perf`` record.
+  The :class:`~accelerate_tpu.accelerator.Accelerator` runs it automatically
+  on the first call of every tracked step function while telemetry is on.
+- **Per-step folding** — the captured cost is handed to the step profiler, so
+  every ``step`` record carries ``mfu``, ``arithmetic_intensity`` and its
+  ``roofline`` bucket (``compute-bound`` vs ``hbm-bound``), and the report
+  CLI's "performance" section can plot the MFU trend per function.
+
+The capture costs one extra XLA compile per step function (the AOT executable
+is not shared with the jit call cache). It only runs while telemetry is
+enabled and can be killed independently with ``ACCELERATE_PERF_CAPTURE=0``;
+the compile it triggers is *excluded* from step compile/execute accounting
+(see :func:`~accelerate_tpu.telemetry.step_profiler.exclude_compiles`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from . import events as tel
+
+PERF_CAPTURE_ENV_VAR = "ACCELERATE_PERF_CAPTURE"
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs; fall back to
+# v5e for unknown TPU generations). THE peak table — bench.py imports it.
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+# HBM bandwidth per chip in bytes/s (public specs), for roofline ridge points
+HBM_BYTES_PER_S = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+# Nominal CPU stand-ins: dev boxes have no published "peak"; these make MFU a
+# *relative* signal (comparable run-over-run on the same box), never an
+# absolute utilization claim. Override per box via the env knobs.
+CPU_PEAK_FLOPS_ENV_VAR = "ACCELERATE_CPU_PEAK_FLOPS"
+CPU_HBM_GBPS_ENV_VAR = "ACCELERATE_CPU_HBM_GBPS"
+_CPU_NOMINAL_FLOPS = 1e11
+_CPU_NOMINAL_HBM_GBPS = 25.0
+
+
+@dataclass(frozen=True)
+class HardwarePeaks:
+    """Peak throughput of one chip: ``flops`` (bf16 FLOP/s) and
+    ``hbm_bytes_per_s``. ``nominal=True`` marks the CPU/dev-box stand-in whose
+    MFU numbers are relative, not absolute (``source`` says where the numbers
+    came from: ``table`` / ``env`` / ``cpu-nominal``)."""
+
+    device_kind: str
+    flops: float
+    hbm_bytes_per_s: Optional[float]
+    nominal: bool = False
+    source: str = "table"
+
+    @property
+    def ridge_intensity(self) -> Optional[float]:
+        """FLOP/byte at the roofline ridge: below it a kernel is HBM-bound."""
+        if not self.hbm_bytes_per_s or not self.flops:
+            return None
+        return self.flops / self.hbm_bytes_per_s
+
+
+def peaks_for_device(device: Optional[Any] = None) -> HardwarePeaks:
+    """Peak registry lookup for ``device`` (default: ``jax.devices()[0]``).
+
+    TPUs match on ``device_kind`` prefix, unknown TPU kinds fall back to v5e;
+    anything else gets the *nominal* CPU peaks (env-overridable via
+    ``ACCELERATE_CPU_PEAK_FLOPS`` FLOP/s / ``ACCELERATE_CPU_HBM_GBPS`` GB/s)
+    so MFU stays a usable relative signal on dev boxes."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "") or "")
+    for name, flops in PEAK_FLOPS.items():
+        if kind.startswith(name):
+            return HardwarePeaks(kind, flops, HBM_BYTES_PER_S.get(name))
+    if "TPU" in kind.upper():
+        return HardwarePeaks(
+            kind, PEAK_FLOPS["TPU v5e"], HBM_BYTES_PER_S["TPU v5e"], source="table"
+        )
+    from ..utils.environment import parse_optional_float_from_env
+
+    env_flops = parse_optional_float_from_env(CPU_PEAK_FLOPS_ENV_VAR)
+    env_bw = parse_optional_float_from_env(CPU_HBM_GBPS_ENV_VAR)
+    return HardwarePeaks(
+        kind or "cpu",
+        env_flops if env_flops else _CPU_NOMINAL_FLOPS,
+        (env_bw if env_bw else _CPU_NOMINAL_HBM_GBPS) * 1e9,
+        nominal=True,
+        source="env" if (env_flops or env_bw) else "cpu-nominal",
+    )
+
+
+def device_peak_flops(device: Optional[Any] = None, include_nominal: bool = False) -> float:
+    """Peak bf16 FLOP/s, or ``0.0`` for non-TPU devices unless
+    ``include_nominal`` (bench payloads omit MFU on dev boxes; telemetry
+    reports relative MFU there instead)."""
+    peaks = peaks_for_device(device)
+    if peaks.nominal and not include_nominal:
+        return 0.0
+    return peaks.flops
+
+
+def device_hbm_bandwidth(device: Optional[Any] = None, include_nominal: bool = False) -> Optional[float]:
+    """Peak HBM bytes/s, or ``None`` for non-TPU devices unless ``include_nominal``."""
+    peaks = peaks_for_device(device)
+    if peaks.nominal and not include_nominal:
+        return None
+    return peaks.hbm_bytes_per_s
+
+
+# ------------------------------------------------------------- MFU math ----
+def train_flops_per_sample(config: Any, seq_len: int, n_params: int) -> float:
+    """Model FLOPs per trained sample: 6*N per token (fwd 2N + bwd 4N) plus
+    the attention score/context matmuls 12 * L * d_model * T per token.
+    ``config`` needs ``n_layers`` and ``dim`` (any transformer config here)."""
+    per_token = 6.0 * n_params + 12.0 * config.n_layers * config.dim * seq_len
+    return per_token * seq_len
+
+
+def lm_train_mfu(
+    tokens_per_sec: float, n_params: int, config: Any, seq_len: int
+) -> Optional[float]:
+    """Model-FLOPs utilization for an LM train config, ``None`` off-TPU —
+    the one MFU methodology bench.py and telemetry share (remat recompute is
+    NOT counted: model-MFU, comparable across remat policies)."""
+    import jax
+
+    peak = device_peak_flops(jax.devices()[0])
+    if not peak:
+        return None
+    per_token = train_flops_per_sample(config, seq_len, n_params) / seq_len
+    return round(tokens_per_sec * per_token / peak, 4)
+
+
+def mfu(flops_per_step: float, step_seconds: float, peak_flops: float) -> Optional[float]:
+    """Utilization of one step: achieved FLOP/s over peak (``None`` when
+    either side is unknown/zero)."""
+    if not flops_per_step or not step_seconds or not peak_flops:
+        return None
+    return flops_per_step / step_seconds / peak_flops
+
+
+def arithmetic_intensity(flops: float, bytes_accessed: float) -> Optional[float]:
+    """FLOPs per byte of memory traffic — the roofline x-axis."""
+    if not flops or not bytes_accessed:
+        return None
+    return flops / bytes_accessed
+
+
+def roofline_bucket(intensity: Optional[float], peaks: HardwarePeaks) -> Optional[str]:
+    """``"compute-bound"`` when the kernel's arithmetic intensity clears the
+    chip's ridge point (peak FLOPs / peak HBM bytes), else ``"hbm-bound"``."""
+    ridge = peaks.ridge_intensity
+    if intensity is None or ridge is None:
+        return None
+    return "compute-bound" if intensity >= ridge else "hbm-bound"
+
+
+# -------------------------------------------------------- cost capture ----
+@dataclass
+class CompiledCost:
+    """One step function's XLA-reported cost: what `cost_analysis()` /
+    `memory_analysis()` said at compile time, plus the derived roofline
+    placement against the chip's peaks."""
+
+    name: str
+    flops: float
+    bytes_accessed: float
+    peaks: HardwarePeaks
+    memory: Optional[dict] = None
+
+    @property
+    def intensity(self) -> Optional[float]:
+        return arithmetic_intensity(self.flops, self.bytes_accessed)
+
+    @property
+    def roofline(self) -> Optional[str]:
+        return roofline_bucket(self.intensity, self.peaks)
+
+    def mfu(self, step_seconds: float) -> Optional[float]:
+        return mfu(self.flops, step_seconds, self.peaks.flops)
+
+    def record(self) -> dict:
+        """The ``perf`` event payload (stable field names — schema in
+        docs/telemetry.md)."""
+        out = {
+            "fn": self.name,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arithmetic_intensity": _round(self.intensity),
+            "roofline": self.roofline,
+            "peak_flops": self.peaks.flops,
+            "peak_hbm_bytes_per_s": self.peaks.hbm_bytes_per_s,
+            "peak_source": self.peaks.source,
+            "device_kind": self.peaks.device_kind,
+        }
+        if self.memory:
+            out.update({f"memory_{k}": v for k, v in self.memory.items()})
+        return out
+
+
+def _round(x: Optional[float], digits: int = 6) -> Optional[float]:
+    return None if x is None else round(float(x), digits)
+
+
+def capture_enabled() -> bool:
+    """Cost capture runs iff telemetry is on and ``ACCELERATE_PERF_CAPTURE``
+    is not explicitly falsy (it costs one extra XLA compile per step fn)."""
+    if not tel.is_enabled():
+        return False
+    return os.environ.get(PERF_CAPTURE_ENV_VAR, "").strip().lower() not in (
+        "0",
+        "false",
+        "no",
+        "off",
+    )
+
+
+def cost_from_compiled(name: str, compiled: Any) -> Optional[CompiledCost]:
+    """Extract a :class:`CompiledCost` from an already-compiled executable
+    (``jitted.lower(...).compile()``). Returns ``None`` when the backend
+    reports no cost data."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and bytes_accessed <= 0.0:
+        return None
+    from .memory import compiled_memory_analysis
+
+    return CompiledCost(
+        name=name,
+        flops=flops,
+        bytes_accessed=bytes_accessed,
+        peaks=peaks_for_device(),
+        memory=compiled_memory_analysis(compiled),
+    )
+
+
+def capture_compiled(name: str, fn: Any, args: tuple, kwargs: Optional[dict] = None) -> Optional[CompiledCost]:
+    """AOT-lower ``fn`` with ``args`` and record its XLA cost + memory
+    analysis; emits one ``perf`` event and a capacity check (see
+    :func:`~accelerate_tpu.telemetry.memory.check_memory_fit`).
+
+    The compile this triggers is excluded from the step profiler's
+    compile-second accounting, so step records keep meaning "compiles the
+    *training* path paid". Never raises: an uncapturable backend returns
+    ``None`` and training proceeds untouched."""
+    from . import step_profiler
+
+    if not hasattr(fn, "lower"):
+        return None  # eager (disable_jit) or already-AOT: nothing to lower
+    c0, s0 = step_profiler.raw_compile_snapshot()
+    try:
+        compiled = fn.lower(*args, **(kwargs or {})).compile()
+        cost = cost_from_compiled(name, compiled)
+    except Exception:
+        cost = None
+    finally:
+        c1, s1 = step_profiler.raw_compile_snapshot()
+        step_profiler.exclude_compiles(c1 - c0, s1 - s0)
+    if cost is None:
+        return None
+    tel.emit("perf", **cost.record())
+    if cost.memory:
+        from .memory import check_memory_fit
+
+        check_memory_fit(name, cost.memory)
+    return cost

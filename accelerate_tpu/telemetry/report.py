@@ -13,6 +13,12 @@
   (snapshot / serialize / write / commit / backpressure) and the
   exposed-vs-hidden split — how many checkpoint seconds the train loop
   actually paid vs how many the async writer overlapped,
+- a performance section (telemetry/perf.py + xplane.py): per-step MFU
+  distribution and first→last trend, a per-function roofline table (XLA
+  cost-analysis FLOPs, arithmetic intensity, compute-vs-HBM-bound bucket,
+  projected memory fit), and the trace-window accounting — top-k op/fusion
+  durations, the compute/collective/idle device-time split and the
+  comms-overlap ratio,
 - device/host memory peaks,
 - comms traffic per collective op (calls + payload bytes),
 - per-rank event counts and the dropped-event total in the header — silent
@@ -236,6 +242,7 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
     from .flight_recorder import load_flight_records
 
     steps_by_rank: "dict[int, dict[int, float]]" = {}
+    mfu_by_rank: "dict[int, list[float]]" = {}
     heartbeats: "dict[int, list[float]]" = {}
     ranks: "dict[int, dict]" = {}
     for e in events:
@@ -251,6 +258,8 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
                 steps_by_rank.setdefault(rank, {})[int(e["step"])] = float(
                     e.get("dur_s", 0.0)
                 )
+            if e.get("mfu") is not None:
+                mfu_by_rank.setdefault(rank, []).append(float(e["mfu"]))
         elif kind == "heartbeat":
             heartbeats.setdefault(rank, []).append(float(e.get("t", 0.0)))
         elif kind == "dropped":
@@ -325,6 +334,7 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
             str(r): dict(
                 info,
                 wall_s=_dist(list(steps_by_rank.get(r, {}).values())),
+                mfu=_dist(mfu_by_rank.get(r, [])),
             )
             for r, info in sorted(ranks.items())
         },
@@ -336,6 +346,92 @@ def _rank_section(events: "list[dict]", file_rank: "dict[str, int]", paths) -> d
         "heartbeat_gaps": heartbeat_gaps,
         "flight_records": flights,
         "collective_divergence": _collective_divergence(schedules),
+    }
+
+
+def _performance_section(events: "list[dict]", steps: "list[dict]") -> Optional[dict]:
+    """MFU/roofline/trace attribution (telemetry/perf.py + xplane.py):
+    ``None`` when the streams predate the performance layer (no ``perf`` /
+    ``trace`` records and no step carries ``mfu``)."""
+    perfs = [e for e in events if e.get("kind") == "perf"]
+    traces = [e for e in events if e.get("kind") == "trace" and not e.get("error")]
+    projections = [e for e in events if e.get("kind") == "memory_projection"]
+    mfu_steps = [s for s in steps if s.get("mfu") is not None]
+    if not perfs and not traces and not mfu_steps:
+        return None
+
+    proj_by_fn = {str(p.get("fn", "?")): p for p in projections}
+    by_fn: dict = {}
+    for p in perfs:
+        fn = str(p.get("fn", "?"))
+        rec = {
+            "flops": float(p.get("flops", 0.0)),
+            "bytes_accessed": float(p.get("bytes_accessed", 0.0)),
+            "arithmetic_intensity": p.get("arithmetic_intensity"),
+            "roofline": p.get("roofline"),
+            "peak_flops": p.get("peak_flops"),
+            "peak_hbm_bytes_per_s": p.get("peak_hbm_bytes_per_s"),
+            "peak_source": p.get("peak_source"),
+            "device_kind": p.get("device_kind"),
+        }
+        proj = proj_by_fn.get(fn)
+        if proj:
+            rec["projected_peak_bytes"] = proj.get("projected_peak_bytes")
+            rec["memory_fits"] = proj.get("fits")
+        by_fn[fn] = rec
+    for fn, rec in by_fn.items():
+        rec["mfu"] = _dist(
+            [float(s["mfu"]) for s in mfu_steps if s.get("perf_fn") == fn]
+        )
+
+    mfus = [float(s["mfu"]) for s in mfu_steps]
+    trend = None
+    if len(mfus) >= 2:
+        half = len(mfus) // 2
+        first = sum(mfus[:half]) / half
+        last = sum(mfus[half:]) / (len(mfus) - half)
+        trend = {
+            "first_half_mean": round(first, 6),
+            "second_half_mean": round(last, 6),
+            "delta": round(last - first, 6),
+        }
+
+    trace_section = None
+    if traces:
+        top: dict = {}
+        for t in traces:
+            for op in t.get("top_ops") or []:
+                rec = top.setdefault(
+                    str(op.get("op", "?")),
+                    {"op": str(op.get("op", "?")), "total_s": 0.0, "count": 0,
+                     "collective": bool(op.get("collective"))},
+                )
+                rec["total_s"] += float(op.get("total_s", 0.0))
+                rec["count"] += int(op.get("count", 0))
+        collective_s = sum(float(t.get("collective_s", 0.0)) for t in traces)
+        overlap_s = sum(float(t.get("collective_overlap_s", 0.0)) for t in traces)
+        op_total = sum(r["total_s"] for r in top.values())
+        top_ops = sorted(top.values(), key=lambda r: -r["total_s"])[:10]
+        for rec in top_ops:
+            rec["total_s"] = round(rec["total_s"], 6)
+            rec["share"] = round(rec["total_s"] / op_total, 4) if op_total else 0.0
+        trace_section = {
+            "windows": len(traces),
+            "events": sum(int(t.get("events", 0)) for t in traces),
+            "compute_s": round(sum(float(t.get("compute_s", 0.0)) for t in traces), 6),
+            "collective_s": round(collective_s, 6),
+            "idle_s": round(sum(float(t.get("idle_s", 0.0)) for t in traces), 6),
+            "collective_overlap_s": round(overlap_s, 6),
+            "comms_overlap_ratio": round(overlap_s / collective_s, 4) if collective_s else None,
+            "top_ops": top_ops,
+        }
+
+    return {
+        "mfu": _dist(mfus),
+        "mfu_trend": trend,
+        "by_fn": dict(sorted(by_fn.items())),
+        "trace": trace_section,
+        "trace_errors": sum(1 for e in events if e.get("kind") == "trace" and e.get("error")),
     }
 
 
@@ -460,6 +556,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         },
         "data_wait_events": len(waits),
         "checkpoints": checkpoints,
+        "performance": _performance_section(events, steps),
     }
     if by_rank:
         report["ranks"] = _rank_section(events, file_rank, paths)
@@ -544,6 +641,9 @@ def format_report(report: dict) -> str:
                     f"  {phase:<12} n={d['count']}  total={d['total'] * 1e3:.2f}ms  "
                     f"p50={d['p50'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
                 )
+    perf = report.get("performance")
+    if perf:
+        lines.append(format_performance_section(perf))
     m = report["memory"]
     lines.append(
         "memory peaks: device "
@@ -562,6 +662,85 @@ def format_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def _fmt_flops(n: float) -> str:
+    n = float(n)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000 or unit == "P":
+            return f"{n:.1f} {unit}FLOP" if unit else f"{n:.0f} FLOP"
+        n /= 1000.0
+    return f"{n:.1f} PFLOP"
+
+
+def format_performance_section(perf: dict) -> str:
+    """Human rendering of the MFU/roofline/trace attribution."""
+    lines = ["performance:"]
+    d = perf.get("mfu") or {}
+    if d.get("count"):
+        trend = perf.get("mfu_trend")
+        trend_s = ""
+        if trend:
+            arrow = "↑" if trend["delta"] >= 0 else "↓"
+            trend_s = (
+                f"  trend {trend['first_half_mean']:.4f}→"
+                f"{trend['second_half_mean']:.4f} {arrow}"
+            )
+        lines.append(
+            f"  MFU over {d['count']} step(s): p50={d['p50']:.4f}  "
+            f"mean={d['mean']:.4f}  max={d['max']:.4f}{trend_s}"
+        )
+    by_fn = perf.get("by_fn") or {}
+    if by_fn:
+        sample = next(iter(by_fn.values()))
+        peak_s = ""
+        if sample.get("peak_flops"):
+            bw = sample.get("peak_hbm_bytes_per_s")
+            peak_s = (
+                f" (peaks [{sample.get('peak_source')}]: "
+                f"{sample['peak_flops'] / 1e12:.1f} TFLOP/s"
+                + (f", {bw / 1e9:.0f} GB/s" if bw else "")
+                + ")"
+            )
+        lines.append(f"  roofline{peak_s}:")
+        for fn, rec in by_fn.items():
+            ai = rec.get("arithmetic_intensity")
+            mfu_d = rec.get("mfu") or {}
+            mfu_s = f"  mfu p50={mfu_d['p50']:.4f}" if mfu_d.get("count") else ""
+            fit = rec.get("memory_fits")
+            fit_s = "" if fit is None else ("" if fit else "  MEMORY OVER CAPACITY")
+            lines.append(
+                f"    {fn:<18} {_fmt_flops(rec.get('flops', 0.0))}/step  "
+                f"AI={ai:.1f} FLOP/B  {rec.get('roofline') or '?'}{mfu_s}{fit_s}"
+                if ai is not None
+                else f"    {fn:<18} {_fmt_flops(rec.get('flops', 0.0))}/step  "
+                f"{rec.get('roofline') or '?'}{mfu_s}{fit_s}"
+            )
+    tr = perf.get("trace")
+    if tr:
+        lines.append(
+            f"  trace windows: {tr['windows']} ({tr['events']} device event(s)) — "
+            f"compute {tr['compute_s'] * 1e3:.2f}ms, collective "
+            f"{tr['collective_s'] * 1e3:.2f}ms, idle {tr['idle_s'] * 1e3:.2f}ms"
+        )
+        ratio = tr.get("comms_overlap_ratio")
+        lines.append(
+            f"  comms overlap: {ratio * 100:.1f}% of collective time hidden under compute"
+            if ratio is not None
+            else "  comms overlap: n/a (no collective device time traced)"
+        )
+        for i, op in enumerate(tr.get("top_ops") or [], 1):
+            tag = "  [collective]" if op.get("collective") else ""
+            lines.append(
+                f"    top op {i}: {op['op']}  {op['total_s'] * 1e3:.2f}ms "
+                f"({op['share'] * 100:.1f}%, n={op['count']}){tag}"
+            )
+    if perf.get("trace_errors"):
+        lines.append(
+            f"  WARNING: {perf['trace_errors']} trace window(s) failed to start "
+            "(another profiler session was active)"
+        )
+    return "\n".join(lines)
+
+
 def format_rank_section(ranks: dict) -> str:
     """Human rendering of the ``--by-rank`` straggler forensics."""
     lines = ["per-rank stragglers:"]
@@ -572,10 +751,12 @@ def format_rank_section(ranks: dict) -> str:
             if wall.get("count")
             else ""
         )
+        rank_mfu = info.get("mfu") or {}
+        mfu_s = f", mfu p50={rank_mfu['p50']:.4f}" if rank_mfu.get("count") else ""
         dropped_s = f", {info['dropped']} dropped" if info.get("dropped") else ""
         lines.append(
             f"  rank {rank}: {info['events']} event(s), {info['steps']} step(s)"
-            f"{wall_s}{dropped_s}"
+            f"{wall_s}{mfu_s}{dropped_s}"
         )
     skew = ranks.get("skew_s") or {}
     if skew.get("count"):
@@ -793,9 +974,110 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("static analyzer (jaxlint)", False, f"{type(exc).__name__}: {exc}")
 
+        # 6. perf cost capture: XLA cost analysis of a real jitted fn must
+        # yield FLOPs and a roofline placement (telemetry/perf.py)
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from . import perf as _perf
+
+            @jax.jit
+            def _doctor_step(x, y):
+                return jnp.tanh(x @ y).sum()
+
+            ones = jnp.ones((64, 64), jnp.float32)
+            compiled = _doctor_step.lower(ones, ones).compile()
+            cost = _perf.cost_from_compiled("doctor_step", compiled)
+            ok = (
+                cost is not None
+                and cost.flops > 0
+                and (cost.mfu(1e-3) or 0) > 0
+                and cost.roofline in ("compute-bound", "hbm-bound")
+            )
+            _check("perf cost capture", ok, f"cost={cost}")
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("perf cost capture", False, f"{type(exc).__name__}: {exc}")
+
+        # 7. xplane trace parse: a real jax.profiler window must decode into
+        # op events with durations (telemetry/xplane.py, no-TF pb parser).
+        # Builds its own jitted fixture: a check-6 failure must not leak a
+        # NameError here and misdiagnose the trace parser.
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from . import xplane as _xplane
+
+            @jax.jit
+            def _trace_step(x, y):
+                return jnp.tanh(x @ y).sum()
+
+            ones = jnp.ones((64, 64), jnp.float32)
+            trace_dir = os.path.join(tmp, "trace")
+            jax.profiler.start_trace(trace_dir)
+            for _ in range(3):
+                _trace_step(ones, ones).block_until_ready()
+            jax.profiler.stop_trace()
+            summary = _xplane.summarize_trace(trace_dir)
+            ok = summary["events"] > 0 and bool(summary["top_ops"]) and summary["busy_s"] > 0
+            _check("xplane trace parse", ok,
+                   f"events={summary.get('events')} files={summary.get('files')}")
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("xplane trace parse", False, f"{type(exc).__name__}: {exc}")
+
+        # 8. performance report section: synthetic cost-analysis + trace
+        # fixture must render with non-zero MFU and an overlap ratio
+        try:
+            _doctor_performance_section(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("performance report section", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
+
+
+def _doctor_performance_section(tmp: str, _check) -> None:
+    """Doctor check 8 body: synthetic perf/step/trace records must aggregate
+    and render as a performance section with non-zero MFU."""
+    perf_dir = os.path.join(tmp, "perfrep")
+    os.makedirs(perf_dir, exist_ok=True)
+    with open(os.path.join(perf_dir, "events-rank0.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "doctor",
+                            "process_index": 0, "num_processes": 1}) + "\n")
+        f.write(json.dumps({
+            "kind": "perf", "t": 0.0, "fn": "train_step", "flops": 1e9,
+            "bytes_accessed": 1e7, "arithmetic_intensity": 100.0,
+            "roofline": "compute-bound", "peak_flops": 1e11,
+            "peak_hbm_bytes_per_s": 2.5e10, "peak_source": "cpu-nominal",
+            "device_kind": "cpu"}) + "\n")
+        for s in range(4):
+            f.write(json.dumps({
+                "kind": "step", "step": s, "t": float(s), "dur_s": 0.02,
+                "compile_s": 0.0, "execute_s": 0.02, "mfu": 0.5,
+                "arithmetic_intensity": 100.0, "roofline": "compute-bound",
+                "perf_fn": "train_step"}) + "\n")
+        f.write(json.dumps({
+            "kind": "trace", "t": 5.0, "events": 10, "ops": 3,
+            "span_s": 0.1, "busy_s": 0.09, "idle_s": 0.01,
+            "compute_s": 0.08, "collective_s": 0.02,
+            "collective_overlap_s": 0.015, "comms_overlap_ratio": 0.75,
+            "top_ops": [{"op": "fusion.1", "total_s": 0.05, "count": 4,
+                         "share": 0.6, "collective": False},
+                        {"op": "all-reduce.2", "total_s": 0.02, "count": 2,
+                         "share": 0.24, "collective": True}]}) + "\n")
+    rep = build_report([perf_dir])
+    perf_section = rep.get("performance") or {}
+    text = format_report(rep)
+    ok = (
+        (perf_section.get("mfu") or {}).get("p50", 0) > 0
+        and (perf_section.get("trace") or {}).get("comms_overlap_ratio") == 0.75
+        and "performance:" in text
+        and "compute-bound" in text
+        and "75.0% of collective time hidden" in text
+    )
+    _check("performance report section", ok, f"performance={perf_section}")
 
 
 def main(argv: Optional["list[str]"] = None) -> int:

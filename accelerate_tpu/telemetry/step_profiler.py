@@ -31,6 +31,10 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _compile_count = 0
 _compile_secs = 0.0
+# compiles triggered by telemetry itself (the perf cost-capture's AOT
+# compile) — subtracted so step records only count what TRAINING paid
+_excluded_count = 0
+_excluded_secs = 0.0
 _listener_installed = False
 
 # data-wait seconds accumulated by the dataloader since the last step boundary
@@ -57,8 +61,26 @@ def install_compile_listener() -> None:
 
 
 def compile_snapshot() -> "tuple[int, float]":
-    """(total backend compiles, total compile seconds) so far in this process."""
+    """(total backend compiles, total compile seconds) charged to training so
+    far in this process — compiles the telemetry layer itself triggered (perf
+    cost capture) are excluded."""
+    return _compile_count - _excluded_count, _compile_secs - _excluded_secs
+
+
+def raw_compile_snapshot() -> "tuple[int, float]":
+    """Unadjusted compile totals, for bracketing a telemetry-internal compile
+    (see :func:`exclude_compiles`)."""
     return _compile_count, _compile_secs
+
+
+def exclude_compiles(count: int, seconds: float) -> None:
+    """Mark ``count`` compiles / ``seconds`` as telemetry-internal: they will
+    not appear in step records' ``compile_s`` or the report's compile totals.
+    Called by :func:`~accelerate_tpu.telemetry.perf.capture_compiled` around
+    its AOT compile."""
+    global _excluded_count, _excluded_secs
+    _excluded_count += max(0, int(count))
+    _excluded_secs += max(0.0, float(seconds))
 
 
 def record_data_wait(seconds: float) -> None:
@@ -177,6 +199,21 @@ class _StepContext:
         compiles = c1 - self.c0
         compile_s = s1 - self.s0
         recompiles = sum(prof.watcher.poll().values())
+        fields: dict = {}
+        cost = prof.step_cost
+        if cost is not None:
+            # roofline attribution (telemetry/perf.py): MFU over the step's
+            # EXECUTE time (a compile-carrying step would otherwise read as a
+            # utilization collapse), intensity/bucket are compile-time facts
+            execute = max(wall - compile_s, 1e-9)
+            step_mfu = cost.mfu(execute)
+            if step_mfu is not None:
+                fields["mfu"] = round(step_mfu, 6)
+            if cost.intensity is not None:
+                fields["arithmetic_intensity"] = round(cost.intensity, 6)
+            if cost.roofline is not None:
+                fields["roofline"] = cost.roofline
+            fields["perf_fn"] = cost.name
         tel.emit(
             "step",
             name=prof.name,
@@ -186,6 +223,7 @@ class _StepContext:
             execute_s=round(max(0.0, wall - compile_s), 6),
             compiles=compiles,
             recompiles=max(0, recompiles),
+            **fields,
         )
         if prof.memory_every and prof.step_index % prof.memory_every == 0:
             from .memory import MemoryMonitor
@@ -214,12 +252,22 @@ class StepTelemetry:
         self.step_index = 0
         self.watcher = RecompileWatcher()
         self._memory = None
+        # the XLA-reported cost of the step function about to run (set by the
+        # Accelerator's perf capture); folded into each step record as
+        # mfu / arithmetic_intensity / roofline
+        self.step_cost = None
         if tel.is_enabled():
             install_compile_listener()
 
     def register_compiled(self, name: str, fn) -> None:
         """Track a jitted function's executable cache for recompile detection."""
         self.watcher.register(name, fn)
+
+    def set_step_cost(self, cost) -> None:
+        """Attach a :class:`~accelerate_tpu.telemetry.perf.CompiledCost` for
+        the step function the NEXT :meth:`step` context will run (``None``
+        clears it — records stop carrying MFU)."""
+        self.step_cost = cost
 
     def step(self) -> _StepContext:
         """``with step_telemetry.step(): compiled_step(...)`` — one record per step."""

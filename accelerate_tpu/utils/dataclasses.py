@@ -17,7 +17,12 @@ from dataclasses import dataclass, field
 from datetime import timedelta
 from typing import Any, Callable, Optional
 
-from .environment import parse_flag_from_env, parse_seconds_from_env
+from .environment import (
+    parse_flag_from_env,
+    parse_int_from_env,
+    parse_optional_int_from_env,
+    parse_seconds_from_env,
+)
 
 
 class BaseEnum(str, enum.Enum):
@@ -215,9 +220,24 @@ class ProfileConfig(KwargsHandler):
 
     ``output_trace_dir`` receives a TensorBoard/Perfetto-compatible trace; the
     reference exports per-rank Chrome traces (``accelerator.py:4148-4205``).
+
+    Two complementary mechanisms:
+
+    - the ``accelerator.profile(...)`` *context* (whole-block, or the
+      reference-style ``wait/warmup/active/repeat`` step schedule below);
+    - **automatic trace windows** on the tracked train step (no context
+      needed): every ``trace_every`` steps — or one-shot at step
+      ``trace_at`` — a window of ``trace_steps`` steps is traced, parsed
+      (top-k ops, compute/collective/idle split, comms-overlap ratio — see
+      ``telemetry/xplane.py``) and emitted as a ``trace`` telemetry record.
+      Env-seeded (``ACCELERATE_TRACE_EVERY`` / ``ACCELERATE_TRACE_STEPS`` /
+      ``ACCELERATE_TRACE_AT`` / ``ACCELERATE_TRACE_DIR``) so a launcher can
+      arm profiling with zero code changes.
     """
 
-    output_trace_dir: Optional[str] = None
+    output_trace_dir: Optional[str] = field(
+        default_factory=lambda: os.environ.get("ACCELERATE_TRACE_DIR") or None
+    )
     create_perfetto_link: bool = False
     create_perfetto_trace: bool = True
     host_tracer_level: int = 2
@@ -232,10 +252,26 @@ class ProfileConfig(KwargsHandler):
     warmup: int = 0
     active: int = 0
     repeat: int = 0
+    # automatic trace windows on the tracked step (telemetry/xplane.py):
+    # every Nth step / a one-shot step index, window length in steps
+    trace_every: int = field(
+        default_factory=lambda: parse_int_from_env("ACCELERATE_TRACE_EVERY", 0)
+    )
+    trace_steps: int = field(
+        default_factory=lambda: max(1, parse_int_from_env("ACCELERATE_TRACE_STEPS", 1))
+    )
+    trace_at: Optional[int] = field(
+        default_factory=lambda: parse_optional_int_from_env("ACCELERATE_TRACE_AT")
+    )
 
     @property
     def schedule_enabled(self) -> bool:
         return self.active > 0
+
+    @property
+    def windows_enabled(self) -> bool:
+        """True when automatic trace windows should drive the tracked step."""
+        return self.trace_every > 0 or self.trace_at is not None
 
     def build_options(self):
         import jax

@@ -53,6 +53,36 @@ def parse_seconds_from_env(key: str, default: float = 0.0) -> float:
         return default
 
 
+def parse_int_from_env(key: str, default: int = 0) -> int:
+    """An integer env var; ``default`` when unset, blank, or malformed
+    (telemetry/profiling config must never crash on a bad env)."""
+    return parse_optional_int_from_env(key, default)
+
+
+def parse_optional_int_from_env(key: str, default: "int | None" = None) -> "int | None":
+    """Like :func:`parse_int_from_env` but the default may be ``None``
+    ("feature not triggered") — unset/blank/malformed values yield it."""
+    raw = os.environ.get(key, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def parse_optional_float_from_env(key: str, default: "float | None" = None) -> "float | None":
+    """A float env var (scientific notation welcome, e.g. peak-FLOPs
+    overrides); unset/blank/malformed yields ``default``."""
+    raw = os.environ.get(key, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 def get_int_from_env(keys: list[str] | tuple[str, ...], default: int) -> int:
     """Return the first env var among ``keys`` that is set, as an int."""
     if isinstance(keys, str):

@@ -46,19 +46,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-# bf16 peak FLOPs/s per chip by device kind (public TPU specs; fall back to v5e)
-_PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
+# Hardware peaks + MFU methodology live in ONE place — the telemetry perf
+# registry — so bench and the telemetry layer can never disagree on what a
+# chip's peak FLOP/s is (ISSUE 7; the old private _PEAK_FLOPS table is gone).
+from accelerate_tpu.telemetry.perf import (
+    cost_from_compiled,
+    device_hbm_bandwidth,
+    device_peak_flops,
+    lm_train_mfu,
+    train_flops_per_sample,
+)
 
 import contextlib
 import itertools
@@ -296,58 +293,16 @@ def _init_backend(
         raise last_err
 
 
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for name, flops in _PEAK_FLOPS.items():
-        if kind.startswith(name):
-            return flops
-    return _PEAK_FLOPS["TPU v5e"] if "TPU" in kind.upper() else 0.0
-
-
-# HBM bandwidth per chip (public specs), for decode roofline fractions
-_HBM_BW = {"TPU v4": 1228e9, "TPU v5 lite": 819e9, "TPU v5e": 819e9,
-           "TPU v5p": 2765e9, "TPU v6 lite": 1640e9, "TPU v6e": 1640e9}
-
-
-def _hbm_bandwidth(device) -> Optional[float]:
-    kind = getattr(device, "device_kind", "")
-    for name, bw in _HBM_BW.items():
-        if kind.startswith(name):
-            return bw
-    return None
-
-
-def _train_flops_per_sample(config, seq_len: int, n_params: int) -> float:
-    """Model FLOPs per trained sample: 6*N per token (fwd 2N + bwd 4N) plus the
-    attention score/context matmuls 12 * L * d_model * T per token."""
-    per_token = 6.0 * n_params + 12.0 * config.n_layers * config.dim * seq_len
-    return per_token * seq_len
-
-
-def _lm_train_mfu(tokens_per_sec: float, n_params: int, config, seq_len: int):
-    """Model-FLOPs utilization for an LM train config (None off-TPU); same
-    methodology as the headline bench via the shared FLOPs formula."""
-    import jax
-
-    peak = _peak_flops(jax.devices()[0])
-    if not peak:
-        return None
-    per_token = _train_flops_per_sample(config, seq_len, n_params) / seq_len
-    return round(tokens_per_sec * per_token / peak, 4)
-
-
-def _compiled_step_flops(jitted_step, *args):
-    """``(flops_per_step, aot_executable)`` from XLA's cost analysis (counts
+def _compiled_step_cost(jitted_step, *args):
+    """``(CompiledCost, aot_executable)`` from XLA's cost analysis (counts
     what actually runs, remat recompute included — hardware utilization, not
-    model-MFU). The AOT executable is returned so the caller can run it
-    directly instead of paying a second trace/compile through the jit cache.
-    ``(None, None)`` when the backend doesn't report costs."""
+    model-MFU; see telemetry/perf.py). The AOT executable is returned so the
+    caller can run it directly instead of paying a second trace/compile
+    through the jit cache. ``(None, None)`` when the backend doesn't report
+    costs."""
     try:
         compiled = jitted_step.lower(*args).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        flops = float(ca.get("flops", 0.0))
-        return (flops or None), compiled
+        return cost_from_compiled("bench_step", compiled), compiled
     except Exception as e:
         print(f"cost_analysis unavailable: {type(e).__name__}: {e}", file=sys.stderr)
         return None, None
@@ -418,9 +373,9 @@ def run_bench_resnet(on_tpu: bool) -> dict:
     # needed) → hardware utilization for the per-config MFU table. The AOT
     # executable is reused as the hot-loop runner so the FLOP count costs no
     # second compilation; skipped entirely where no peak is known (CPU).
-    step_flops = None
-    if _peak_flops(jax.devices()[0]):
-        step_flops, aot = _compiled_step_flops(step, params, opt_state, batch)
+    step_cost = None
+    if device_peak_flops(jax.devices()[0]):
+        step_cost, aot = _compiled_step_cost(step, params, opt_state, batch)
         if aot is not None:
             step = aot
     params, opt_state, loss = step(params, opt_state, batch)
@@ -437,9 +392,13 @@ def run_bench_resnet(on_tpu: bool) -> dict:
         "image_side": side,
         "final_loss": round(final, 4),
     }
-    peak = _peak_flops(jax.devices()[0])
-    if peak and step_flops:
-        out["mfu"] = round(step_flops * steps / elapsed / peak, 4)
+    peak = device_peak_flops(jax.devices()[0])
+    if peak and step_cost:
+        out["mfu"] = round(step_cost.flops * steps / elapsed / peak, 4)
+        # XLA reports bytes too: place the conv-dominated step on the roofline
+        if step_cost.intensity is not None:
+            out["arithmetic_intensity"] = round(step_cost.intensity, 2)
+            out["roofline"] = step_cost.roofline
     return out
 
 
@@ -508,7 +467,7 @@ def run_bench_fsdp_lm(on_tpu: bool) -> dict:
         "final_loss": round(final, 4),
         "remat": str(remat_used),
     }
-    mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
+    mfu = lm_train_mfu(tokens_per_sec, n_params, config, seq)
     if mfu is not None:
         out["mfu"] = mfu  # model FLOPs only; remat recompute not counted
     return out
@@ -586,7 +545,7 @@ def run_bench_grad_accum(on_tpu: bool) -> dict:
         "final_loss": round(final, 4),
     }
     # same model-FLOPs methodology as the headline, via the shared helper
-    mfu = _lm_train_mfu(samples / elapsed / n_chips * seq_len, n_params, config, seq_len)
+    mfu = lm_train_mfu(samples / elapsed / n_chips * seq_len, n_params, config, seq_len)
     if mfu is not None:
         out["mfu"] = mfu
     return out
@@ -633,13 +592,13 @@ def run_bench_inference(on_tpu: bool) -> dict:
         "seconds_per_token": round(stats["seconds_per_token"], 4),
         "batch": bs,
     }
-    peak = _peak_flops(jax.devices()[0])
+    peak = device_peak_flops(jax.devices()[0])
     if peak:
         # decode is HBM-bandwidth-bound: 2N model FLOPs/token gives a LOW MFU
         # by design — the informative per-config number is how far from the
         # bandwidth roof the decode sits, so both are reported
         out["mfu"] = round(stats["decode_tokens_per_sec"] * 2 * n_params / peak, 4)
-        hbm_bw = _hbm_bandwidth(jax.devices()[0])
+        hbm_bw = device_hbm_bandwidth(jax.devices()[0])
         if hbm_bw:
             # weights (bf16, 2N bytes) are read once per decode STEP; all batch
             # rows share that read, so steps/sec = tokens_per_sec / batch
@@ -772,10 +731,26 @@ def run_bench():
         raise RuntimeError(f"no headline batch size ran (last: {last_msg})")
     per_chip = samples_per_sec / n_chips
 
-    peak = _peak_flops(jax.devices()[0])
+    peak = device_peak_flops(jax.devices()[0])
     mfu = (
-        per_chip * _train_flops_per_sample(config, seq_len, n_params) / peak if peak else None
+        per_chip * train_flops_per_sample(config, seq_len, n_params) / peak if peak else None
     )
+    trace_summary = None
+    if trace_dir:
+        # the captured trace is parsed, not just linked: top-k kernel/fusion
+        # durations, the compute/collective/idle split and the comms-overlap
+        # ratio ride the round's payload (telemetry/xplane.py parser)
+        try:
+            from accelerate_tpu.telemetry.xplane import summarize_trace
+
+            ts = summarize_trace(trace_dir, top_k=5)
+            trace_summary = {
+                key: ts[key]
+                for key in ("compute_s", "collective_s", "idle_s", "comms_overlap_ratio")
+            }
+            trace_summary["top_ops"] = ts["top_ops"]
+        except Exception as e:
+            print(f"trace summary unavailable: {type(e).__name__}: {e}", file=sys.stderr)
     return {
         "samples_per_sec": samples_per_sec,
         "per_chip": per_chip,
@@ -788,6 +763,7 @@ def run_bench():
         "n_params": n_params,
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
         **({"trace_dir": trace_dir} if trace_dir else {}),
+        **({"trace_summary": trace_summary} if trace_summary else {}),
     }
 
 
@@ -899,7 +875,7 @@ def run_bench_longcontext(on_tpu: bool) -> dict:
         "final_loss": round(final, 4),
         "remat": str(remat_used),
     }
-    mfu = _lm_train_mfu(tokens_per_sec, n_params, config, seq)
+    mfu = lm_train_mfu(tokens_per_sec, n_params, config, seq)
     if mfu is not None:
         out["mfu"] = mfu  # attention FLOPs dominate at this S; remat not counted
     # flash-vs-einsum EVIDENCE (VERDICT r04 item 4): when the winner was flash
@@ -1268,6 +1244,7 @@ def _headline_payload(result: dict, vs_baseline, configs: dict, partial: bool) -
         "batch_size": result.get("batch_size"),
         "final_loss": _num(result["final_loss"]),
         **({"trace_dir": result["trace_dir"]} if result.get("trace_dir") else {}),
+        **({"trace_summary": result["trace_summary"]} if result.get("trace_summary") else {}),
         **(
             {"vs_baseline_note": result["vs_baseline_note"]}
             if result.get("vs_baseline_note")

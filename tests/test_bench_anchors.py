@@ -213,30 +213,48 @@ class TestProbeRecovery:
 class TestPerConfigMfu:
     """VERDICT r04 item 2: every config must report utilization on TPU. The
     arithmetic is exercised here by faking the peak-FLOPs lookup (CPU reports
-    no peak, so the fields gate on it)."""
+    no peak, so the fields gate on it). Since ISSUE 7 the lookup lives in the
+    shared telemetry perf registry — bench-local call sites patch through
+    ``bench.device_peak_flops``, the LM configs go through
+    ``telemetry.perf.lm_train_mfu`` whose module global is patched instead."""
 
     def test_resnet_reports_mfu_when_peak_known(self, monkeypatch):
         import bench
 
-        monkeypatch.setattr(bench, "_peak_flops", lambda d: 1e12)
+        monkeypatch.setattr(bench, "device_peak_flops", lambda d: 1e12)
         out = bench.run_bench_resnet(on_tpu=False)
         assert out.get("mfu") is not None and out["mfu"] > 0
+        # XLA reports bytes too: the conv step gets a roofline placement
+        assert out.get("roofline") in ("compute-bound", "hbm-bound")
+        assert out.get("arithmetic_intensity", 0) > 0
 
     def test_grad_accum_reports_mfu_when_peak_known(self, monkeypatch):
         import bench
+        from accelerate_tpu.telemetry import perf
 
-        monkeypatch.setattr(bench, "_peak_flops", lambda d: 1e12)
+        monkeypatch.setattr(perf, "device_peak_flops", lambda d: 1e12)
         out = bench.run_bench_grad_accum(on_tpu=False)
         assert out.get("mfu") is not None and out["mfu"] > 0
 
     def test_inference_reports_mfu_and_roofline(self, monkeypatch):
         import bench
 
-        monkeypatch.setattr(bench, "_peak_flops", lambda d: 1e12)
-        monkeypatch.setattr(bench, "_hbm_bandwidth", lambda d: 819e9)
+        monkeypatch.setattr(bench, "device_peak_flops", lambda d: 1e12)
+        monkeypatch.setattr(bench, "device_hbm_bandwidth", lambda d: 819e9)
         out = bench.run_bench_inference(on_tpu=False)
         assert out.get("mfu") is not None and out["mfu"] > 0
         assert out.get("hbm_roofline_frac") is not None and out["hbm_roofline_frac"] > 0
+
+    def test_bench_has_no_private_peak_table(self):
+        """ISSUE 7 ratchet: bench.py must consume the shared telemetry/perf
+        registry — a reintroduced private table could silently diverge."""
+        import bench
+
+        assert not hasattr(bench, "_PEAK_FLOPS")
+        assert not hasattr(bench, "_HBM_BW")
+        assert not hasattr(bench, "_lm_train_mfu")
+        assert not hasattr(bench, "_peak_flops")
+        assert not hasattr(bench, "_train_flops_per_sample")
 
 
 class TestProbeLadderBudget:

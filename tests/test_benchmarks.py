@@ -70,6 +70,23 @@ def test_checkpoint_benchmark_smoke():
     assert out["baseline"]["saves"] == 0
 
 
+def test_perf_benchmark_smoke():
+    """Fast tier-1 smoke: the performance-observatory microbench (ISSUE 7)
+    runs the bench train step under telemetry + a trace window and emits the
+    contract keys with a non-zero MFU (absolute MFU margins on a loaded CI
+    box are asserted nowhere — CPU peaks are nominal by design)."""
+    out = run_script("benchmarks/perf/run.py", "--steps", "5", "--trace-every", "2")
+    assert out["bench"] == "perf"
+    assert out["unit"] == "mfu(p50)" and out["value"] > 0
+    assert out["roofline"] in ("compute-bound", "hbm-bound")
+    assert out["arithmetic_intensity"] > 0 and out["flops_per_step"] > 0
+    assert out["trace_windows"] >= 1
+    assert out["top_ops"] and all(op["total_s"] > 0 for op in out["top_ops"])
+    # single-process CPU run traces no collectives: the ratio must be an
+    # honest null, not a fake 1.0
+    assert out["overlap_ratio"] is None
+
+
 def test_benchmark_dirs_are_documented():
     dirs = [p for p in (REPO / "benchmarks").iterdir() if p.is_dir() and p.name != "__pycache__"]
     assert len(dirs) >= 5

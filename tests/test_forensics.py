@@ -394,8 +394,11 @@ def test_doctor_self_checks(capsys):
     assert run_doctor() == 0
     out = capsys.readouterr().out
     # dump + stall + straggler + collective divergence + jaxlint
-    assert out.count("PASS") == 5 and "FAIL" not in out
+    # + perf cost capture + xplane trace parse + performance report (ISSUE 7)
+    assert out.count("PASS") == 8 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
+    assert "perf cost capture" in out and "xplane trace parse" in out
+    assert "performance report section" in out
 
 
 # ------------------------------------------------------- integration hookups

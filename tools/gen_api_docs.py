@@ -157,10 +157,12 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
     "telemetry": (
         "Telemetry",
         "Built-in observability (no reference counterpart): structured step "
-        "events, recompile/memory/comms metrics, hang/crash forensics "
-        "(flight recorder + watchdog), and the "
+        "events, recompile/memory/comms metrics, performance attribution "
+        "(MFU/roofline cost capture + profiler trace windows), hang/crash "
+        "forensics (flight recorder + watchdog), and the "
         "`python -m accelerate_tpu.telemetry report` CLI. See "
-        "`docs/telemetry.md` and `docs/troubleshooting.md` for the guides.",
+        "`docs/telemetry.md`, `docs/performance.md` and "
+        "`docs/troubleshooting.md` for the guides.",
         [("accelerate_tpu.telemetry.events",
           ["EventLog", "enable", "disable", "maybe_enable_from_env", "is_enabled",
            "get_event_log", "emit", "counter", "gauge", "span", "set_step",
@@ -169,6 +171,14 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
           ["StepTelemetry", "RecompileWatcher", "install_compile_listener",
            "compile_snapshot", "record_data_wait"]),
          ("accelerate_tpu.telemetry.memory", None),
+         ("accelerate_tpu.telemetry.perf",
+          ["HardwarePeaks", "CompiledCost", "peaks_for_device", "device_peak_flops",
+           "device_hbm_bandwidth", "train_flops_per_sample", "lm_train_mfu", "mfu",
+           "arithmetic_intensity", "roofline_bucket", "capture_enabled",
+           "cost_from_compiled", "capture_compiled"]),
+         ("accelerate_tpu.telemetry.xplane",
+          ["TraceWindows", "parse_xspace", "parse_chrome_trace", "find_trace_files",
+           "summarize_planes", "summarize_trace", "is_collective_op", "is_infra_event"]),
          ("accelerate_tpu.telemetry.flight_recorder",
           ["FlightRecorder", "get_recorder", "record", "phase", "set_step",
            "current_phases", "dump", "install", "uninstall", "enabled_from_env",

@@ -24,7 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
-from bench import _peak_flops, _train_flops_per_sample  # noqa: E402
+from accelerate_tpu.telemetry.perf import (  # noqa: E402
+    device_peak_flops,
+    train_flops_per_sample,
+)
 
 
 def measure_cell(batch_size: int, unroll: bool, steps_per_call: int, smoke: bool):
@@ -75,8 +78,8 @@ def measure_cell(batch_size: int, unroll: bool, steps_per_call: int, smoke: bool
     float(np.asarray(m["loss"][-1]))
     elapsed = time.time() - t0
     per_chip = n_calls * steps_per_call * global_batch / elapsed / n_chips
-    peak = _peak_flops(jax.devices()[0])
-    mfu = per_chip * _train_flops_per_sample(config, seq_len, n_params) / peak if peak else None
+    peak = device_peak_flops(jax.devices()[0])
+    mfu = per_chip * train_flops_per_sample(config, seq_len, n_params) / peak if peak else None
     return {
         "batch_size": batch_size, "unroll_layers": unroll,
         "steps_per_call": steps_per_call,
