@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt doctor lint profile
+        bench-input bench-ckpt bench-zero1 doctor lint profile
 
 PYTEST := python -m pytest -q
 
@@ -89,6 +89,11 @@ bench-input:
 # sync-vs-async checkpoint stall microbench (benchmarks/checkpoint)
 bench-ckpt:
 	python benchmarks/checkpoint/run.py
+
+# fused-vs-annotation ZeRO-1 weight update (benchmarks/weight_update):
+# step time, opt-state bytes/replica, comms-overlap ratio
+bench-zero1:
+	python benchmarks/weight_update/run.py
 
 # self-check: flight-recorder dump, watchdog stall detection, straggler
 # report, collective-divergence detection, the jaxlint engine, perf cost

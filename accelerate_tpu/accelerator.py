@@ -30,7 +30,7 @@ import numpy as np
 from .data_loader import DataLoader, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .optimizer import AcceleratedOptimizer
 from .parallelism_config import ParallelismConfig
-from .parallel.sharding import ShardingRules, infer_param_specs, shard_params
+from .parallel.sharding import ShardingRules, make_sharding_plan, shard_params
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
@@ -429,6 +429,7 @@ class Accelerator:
         self._lomo_scale_growth = 0
         self._autocast_enabled = True
         self._param_specs = None
+        self._sharding_plan = None  # set by prepare_model (the single spec surface)
         self._accum_count = 0
         self.flag_tensor = None
         self.trackers: list = []
@@ -669,25 +670,34 @@ class Accelerator:
                 results[i] = self.prepare_scheduler(obj)
             else:
                 results[i] = obj
-        # late-bind optimizer state sharding to the prepared params
+        # late-bind optimizer state sharding to the prepared params — specs
+        # (incl. fused ZeRO-1 bucketing) come from the ONE sharding plan
         if params_seen is not None:
             for opt in self._optimizers:
                 if opt.opt_state is None:
-                    opt.init(
-                        params_seen, self.mesh, self._param_specs,
-                        zero1_axis=self._zero1_axis,
-                    )
+                    opt.init(params_seen, plan=self._sharding_plan)
         return results[0] if len(results) == 1 else tuple(results)
 
     def prepare_model(self, params, shard_rules: Optional[ShardingRules] = None, specs=None):
         """Assign shardings + place params (reference ``prepare_model:1735``
-        becomes a device_put; DDP/FSDP/TP wrapping collapses into the specs)."""
+        becomes a device_put; DDP/FSDP/TP wrapping collapses into the specs).
+
+        All spec decisions flow through ONE :func:`make_sharding_plan` call —
+        the plan is kept on the accelerator and later consumed by optimizer
+        state init (incl. fused ZeRO-1), host offload and checkpoint restore."""
         rules = shard_rules or self.shard_rules
-        if specs is None:
-            specs = infer_param_specs(params, self.mesh, self.parallelism_config, rules)
+        plan = make_sharding_plan(
+            params,
+            self.mesh,
+            self.parallelism_config,
+            rules=rules,
+            zero1_axis=self._zero1_axis,
+            param_specs=specs,
+        )
         if self.device_placement:
-            params, specs = shard_params(params, self.mesh, specs)
-        self._param_specs = specs
+            params = plan.place_params(params)
+        self._sharding_plan = plan
+        self._param_specs = plan.param_specs
         self._models.append(params)
         return params
 
@@ -700,15 +710,18 @@ class Accelerator:
 
         bridged = BridgedModule(module, accelerator=self)
         rules = shard_rules or self.shard_rules
-        specs = infer_param_specs(bridged.params, self.mesh, self.parallelism_config, rules)
+        plan = make_sharding_plan(
+            bridged.params, self.mesh, self.parallelism_config, rules=rules
+        )
         if self.device_placement:
             from jax.sharding import PartitionSpec
 
-            bridged.params, specs = shard_params(bridged.params, self.mesh, specs)
+            bridged.params = plan.place_params(bridged.params)
             bridged.buffers, _ = shard_params(  # buffers stay replicated
                 bridged.buffers, self.mesh, {k: PartitionSpec() for k in bridged.buffers}
             )
-        self._param_specs = specs
+        self._sharding_plan = plan
+        self._param_specs = plan.param_specs
         self._models.append(bridged)
         return bridged
 
@@ -792,6 +805,10 @@ class Accelerator:
                 accumulation_steps=self.gradient_accumulation_steps,
                 wrap_accumulation=wrap_accumulation,
             )
+            if not wrap_accumulation:
+                # fp8 partition routes updates by MODEL-tree labels; the fused
+                # ZeRO-1 bucketing would re-key the tree out from under it
+                optimizer._allow_fused_zero1 = False
         optimizer.accelerator_state = self.state
         self._optimizers.append(optimizer)
         return optimizer
@@ -980,8 +997,16 @@ class Accelerator:
                 metrics["grads_finite"] = finite
             if compute_grad_norm:
                 metrics["grad_norm"] = optax.global_norm(grads)
-            updates, new_opt_state = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            if optimizer._fused_update is not None:
+                # fused ZeRO-1 (parallel/weight_update.py): bucketed
+                # reduce-scatter → 1/N shard-local update → all-gather, all
+                # inside this traced step
+                new_params, new_opt_state = optimizer._fused_update(
+                    grads, opt_state, params
+                )
+            else:
+                updates, new_opt_state = optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
             if aux is not None:
                 metrics["aux"] = aux
             return new_params, new_opt_state, metrics, finite
@@ -1076,6 +1101,17 @@ class Accelerator:
             finally:
                 if trace_windows is not None:
                     trace_windows.on_step_end(step_index)
+            if _tel.is_enabled():
+                # fused ZeRO-1 collectives are compiled into the step — the
+                # host never sees them, so account their payload from the
+                # bucket plan (reduce-scatter + all-gather bytes per step)
+                plan = getattr(optimizer, "_plan", None)
+                compiled_comms = (
+                    plan.zero1_collective_bytes() if plan is not None else None
+                )
+                if compiled_comms:
+                    for op, nbytes in compiled_comms.items():
+                        ops.record_compiled_collective(op, nbytes)
             optimizer.opt_state = new_opt_state
             if model_slot is not None:
                 self._models[model_slot] = new_params
@@ -1149,7 +1185,8 @@ class Accelerator:
             else:
                 donate = self.jit_config.donate_params if donate is None else donate
                 step, host_state = make_host_offloaded_step(
-                    train_step, optimizer.opt_state, donate=donate, mesh=self.mesh
+                    train_step, optimizer.opt_state, donate=donate,
+                    mesh=self.mesh, plan=self._sharding_plan,
                 )
                 optimizer.opt_state = host_state
                 self._register_compiled("train_step_offload", step)

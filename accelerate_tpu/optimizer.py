@@ -77,19 +77,57 @@ class AcceleratedOptimizer:
         self.opt_state = None
         self._mesh = None
         self._param_specs = None
+        self._plan = None  # ShardingPlan consumed by init (the single spec surface)
+        self._fused_update = None  # fused ZeRO-1 update fn (parallel/weight_update.py)
+        self._allow_fused_zero1 = True  # cleared for label-routed transforms (fp8 meta)
         self._fp16_scaler_config = None  # set by Accelerator.prepare_train_step (fp16)
         self._accelerate_step_called = False  # set by patch_optimizer_step wrappers
         self.accelerator_state = None  # set by Accelerator.prepare
 
+    @property
+    def fused_zero1(self) -> bool:
+        """True when this optimizer's state is the bucketed, 1/N-per-replica
+        fused ZeRO-1 layout (``parallel/weight_update.py``)."""
+        return self._fused_update is not None
+
     # ------------------------------------------------------------- functional --
-    def init(self, params, mesh=None, param_specs=None, zero1_axis=None):
+    def init(self, params, mesh=None, param_specs=None, zero1_axis=None, plan=None):
         """Initialize (and shard) optimizer state for ``params``.
 
-        ``zero1_axis``: shard otherwise-replicated state leaves over that mesh
-        axis (ZeRO-1; see ``parallel.sharding.zero1_state_specs``)."""
+        All spec decisions come from a ``parallel.sharding.ShardingPlan`` —
+        passed by ``Accelerator.prepare`` or built here from the legacy
+        (mesh, param_specs, zero1_axis) arguments. Under fused ZeRO-1 the
+        state is BUCKETED (1/N per replica) and the compiled train step runs
+        the fused reduce-scatter/update/all-gather; otherwise the state
+        inherits param shardings (plus annotation-mode ZeRO-1 when asked)."""
         import jax
         import numpy as _np
 
+        if plan is None and mesh is not None:
+            from .parallel.sharding import make_sharding_plan
+
+            plan = make_sharding_plan(
+                params, mesh, param_specs=param_specs, zero1_axis=zero1_axis
+            )
+        self._plan = plan
+        self._fused_update = None
+        if plan is not None:
+            self._mesh = plan.mesh
+            self._param_specs = plan.param_specs
+            fused = None
+            if self._allow_fused_zero1:
+                fused = plan.init_fused_optimizer_state(self.optimizer, params)
+            elif plan.fused_zero1:
+                # label-routed transforms (fp8 meta partition) cannot be
+                # bucketed: demote the plan so annotation-mode ZeRO-1 still
+                # shards the state below AND the per-step compiled-collective
+                # accounting never reports the fused path's (absent) traffic
+                plan.zero1 = None
+            if fused is not None:
+                self.opt_state, self._fused_update = fused
+                if getattr(self, "_fp16_scaler_config", None) is not None:
+                    self._wrap_loss_scale_state()
+                return self.opt_state
         self.opt_state = self.optimizer.init(params)
         # some optimizers (optax.contrib.schedule_free_*: z iterate) seed state
         # leaves AS the param buffers; a donating train step would then donate
@@ -104,14 +142,8 @@ class AcceleratedOptimizer:
             return _device_copy(x)
 
         self.opt_state = jax.tree_util.tree_map(_unalias, self.opt_state)
-        if mesh is not None and param_specs is not None:
-            from .parallel.sharding import shard_like_params
-
-            self._mesh = mesh
-            self._param_specs = param_specs
-            self.opt_state = shard_like_params(
-                self.opt_state, mesh, params, param_specs, zero1_axis=zero1_axis
-            )
+        if plan is not None:
+            self.opt_state = plan.place_opt_state(self.opt_state, params)
         if getattr(self, "_fp16_scaler_config", None) is not None:
             self._wrap_loss_scale_state()
         return self.opt_state
@@ -147,6 +179,11 @@ class AcceleratedOptimizer:
 
         if self.opt_state is None:
             self.init(params)
+        if self._fused_update is not None:
+            # fused ZeRO-1 state is bucketed: route through the fused update
+            # (eager shard_map — same math the compiled step runs)
+            new_params, self.opt_state = self._fused_update(grads, self.opt_state, params)
+            return new_params
         updates, self.opt_state = self.optimizer.update(grads, self.opt_state, params)
         return optax.apply_updates(params, updates)
 

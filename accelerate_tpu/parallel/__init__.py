@@ -10,11 +10,16 @@ from .pipeline import (
 )
 from .sharding import (
     FSDP_AXES,
+    ShardingPlan,
     ShardingRules,
+    canonicalize_spec,
+    host_memory_kind,
     host_offload_supported,
     infer_param_specs,
     llama_tp_rules,
     make_host_offloaded_step,
+    make_sharding_plan,
+    offload_memory_kinds,
     offload_to_host,
     offload_tree_shardings,
     replicate,
@@ -22,4 +27,11 @@ from .sharding import (
     shard_params,
     tree_specs_like,
     zero1_state_specs,
+)
+from .weight_update import (
+    FusedZero1Incompatible,
+    Zero1BucketPlan,
+    build_bucket_plan,
+    init_bucketed_opt_state,
+    make_fused_zero1_update,
 )

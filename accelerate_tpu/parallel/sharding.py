@@ -1,24 +1,46 @@
-"""Parameter sharding rules: pytree → PartitionSpec tree over the named mesh.
+"""THE sharding decision surface: pytree → PartitionSpec/placement over the mesh.
 
 This replaces the reference's per-engine "prepare = wrap the module" flows with
-"prepare = assign shardings" (SURVEY.md §7):
+"prepare = assign shardings" (SURVEY.md §7), and — since ISSUE 9 — concentrates
+every spec decision behind ONE entry point, :func:`make_sharding_plan`
+(SimpleFSDP's trace-and-reshard architecture, arXiv:2411.00284: a single
+function of (mesh, parallelism_config) decides param/grad/opt-state/
+update-slice shardings; engines consume the plan instead of re-deriving specs):
 
-- FSDP/HSDP — reference ``_prepare_fsdp2`` (``accelerator.py:1643-1733``) +
-  ``fsdp2_prepare_model`` (``utils/fsdp_utils.py:607-722``): params sharded on dim 0
-  over the joint ``(dp_shard, cp)`` axes (the reference's ``dp_shard_cp`` flat mesh,
-  ``parallelism_config.py:211-239``); XLA all-gathers forward, reduce-scatters
-  backward — the GSPMD twin of FSDP2's DTensor flow.
-- TP — reference ``_prepare_tp`` (``accelerator.py:1572-1626``) + transformers
-  ``tp_plan`` tables: a module-pattern → PartitionSpec rule list.
-- The optimizer state inherits param shardings (reference FSDP2's optimizer
-  param-swap trick ``utils/fsdp_utils.py:543`` becomes: optax state is a pytree of
-  param-shaped leaves, shard it with the same specs).
+- ``Accelerator.prepare_model`` builds a :class:`ShardingPlan` and places params
+  through it;
+- ``AcceleratedOptimizer.init`` consumes ``plan.init_optimizer_state`` — which
+  routes ZeRO-1 through the fused bucketed weight update
+  (``parallel/weight_update.py``, arXiv:2004.13336) when the layout allows, and
+  through the GSPMD annotation path (:func:`zero1_state_specs`) otherwise;
+- host-offload staging shardings come from ``plan.offload_shardings``;
+- sharded checkpointing restores template-less leaves through
+  ``plan.sharding_from_saved_spec``.
+
+Specs are CANONICALIZED (trailing ``None`` dims trimmed) in exactly one place,
+:func:`canonicalize_spec`. This is load-bearing: a jitted step's outputs carry
+GSPMD-normalized (trimmed) NamedShardings, and any placed input whose sharding
+compares unequal to the matching output re-specializes the step's C++ fastpath
+cache at step 1 — the bert-tiny "1 recompile at step 1" signal PR 7 recorded.
+
+Sharding strategy per engine (unchanged semantics):
+
+- FSDP/HSDP — params sharded on dim 0 over the joint ``(dp_shard, cp)`` axes
+  (the reference's ``dp_shard_cp`` flat mesh); XLA all-gathers forward,
+  reduce-scatters backward.
+- TP — a module-pattern → PartitionSpec rule list (transformers ``tp_plan``).
+- Optimizer state inherits param shardings (reference FSDP2's param-swap trick
+  becomes: optax state is a pytree of param-shaped leaves, shard it alike).
+- ZeRO-1 — fused bucketed reduce-scatter/update/all-gather inside the jitted
+  step (see ``weight_update.py``); annotation-mode fallback for composite
+  meshes and non-elementwise transforms.
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -40,6 +62,40 @@ def _path_str(path) -> str:
         else:
             parts.append(str(p))
     return "/".join(parts)
+
+
+def canonicalize_spec(spec, axis_sizes: Optional[dict] = None):
+    """Normalize a PartitionSpec to the form GSPMD hands back on jitted-step
+    OUTPUTS: size-1 mesh axes dropped (sharding over them IS replication) and
+    trailing ``None`` dims trimmed — ``P(None, None, 'tp')`` on a tp=1 mesh
+    → ``P()``, ``P('dp_shard', None)`` → ``P('dp_shard')``.
+
+    This is load-bearing, not cosmetic: placing inputs in any equal-meaning
+    but unequal-COMPARING form makes the step's C++ dispatch cache
+    re-specialize on its second call (the input's sharding no longer matches
+    the previous step's output's).
+    """
+    from jax.sharding import PartitionSpec
+
+    dims = []
+    for d in (list(spec) if spec is not None else []):
+        if d is None:
+            dims.append(None)
+            continue
+        axes = tuple(d) if isinstance(d, (tuple, list)) else (d,)
+        if axis_sizes is not None:
+            # unknown axes default to "keep": device_put will then error
+            # loudly instead of this helper silently eating a typo
+            axes = tuple(a for a in axes if axis_sizes.get(a, 2) > 1)
+        if not axes:
+            dims.append(None)
+        elif len(axes) == 1:
+            dims.append(axes[0])
+        else:
+            dims.append(axes)
+    while dims and dims[-1] is None:
+        dims.pop()
+    return PartitionSpec(*dims)
 
 
 class ShardingRules:
@@ -73,8 +129,6 @@ def _merge_fsdp_into_spec(spec, shape, fsdp_axes: tuple, fsdp_size: int, axis_si
     stay as-is (replicated over the FSDP axes) — ``jax.device_put`` requires even
     shards outside jit.
     """
-    from jax.sharding import PartitionSpec
-
     dims = list(spec) if spec is not None else []
     while len(dims) < len(shape):
         dims.append(None)
@@ -88,10 +142,10 @@ def _merge_fsdp_into_spec(spec, shape, fsdp_axes: tuple, fsdp_size: int, axis_si
             existing_size = int(np.prod([axis_sizes.get(a, 1) for a in existing]))
             if shape[0] % (fsdp_size * existing_size) == 0:
                 dims[0] = tuple(fsdp_axes) + existing
-        return PartitionSpec(*dims)
+        return canonicalize_spec(dims, axis_sizes)
     target = 0 if 0 in candidates else max(candidates, key=lambda i: shape[i])
     dims[target] = tuple(fsdp_axes) if len(fsdp_axes) > 1 else fsdp_axes[0]
-    return PartitionSpec(*dims)
+    return canonicalize_spec(dims, axis_sizes)
 
 
 def infer_param_specs(
@@ -101,7 +155,7 @@ def infer_param_specs(
     rules: Optional[ShardingRules] = None,
     min_fsdp_size: int = 2**10,
 ):
-    """Compute a PartitionSpec pytree for ``params``.
+    """Compute a (canonical) PartitionSpec pytree for ``params``.
 
     1. explicit ``rules`` (TP tables etc.) claim dims first;
     2. if FSDP is enabled, shard the largest free dim over ``(dp_shard, cp)``
@@ -111,26 +165,21 @@ def infer_param_specs(
     3. everything else is replicated.
     """
     import jax
-    from jax.sharding import PartitionSpec
 
     pc = parallelism_config
     fsdp_on = pc is not None and pc.fsdp_enabled
     fsdp_axes = tuple(a for a in FSDP_AXES if mesh.shape.get(a, 1) > 1)
     fsdp_size = int(np.prod([mesh.shape[a] for a in fsdp_axes])) if fsdp_axes else 1
 
+    axis_sizes = dict(mesh.shape)
+
     def _spec(path, value):
         path_s = _path_str(path)
         shape = np.shape(value)
         base = rules.match(path_s) if rules is not None else None
-        if base is None:
-            base = PartitionSpec()
         if fsdp_on and fsdp_size > 1 and int(np.prod(shape or (1,))) >= min_fsdp_size:
-            return _merge_fsdp_into_spec(base, shape, fsdp_axes, fsdp_size, dict(mesh.shape))
-        # pad spec to rank
-        dims = list(base)
-        while len(dims) < len(shape):
-            dims.append(None)
-        return PartitionSpec(*dims)
+            return _merge_fsdp_into_spec(base, shape, fsdp_axes, fsdp_size, axis_sizes)
+        return canonicalize_spec(base, axis_sizes)
 
     return jax.tree_util.tree_map_with_path(_spec, params)
 
@@ -180,8 +229,8 @@ def tree_specs_like(tree, params, param_specs):
 def shard_like_params(tree, mesh, params, param_specs, zero1_axis: Optional[str] = None):
     """Device-put ``tree`` with shardings inherited from params where structures
     match (see :func:`tree_specs_like`). ``zero1_axis`` additionally applies
-    :func:`zero1_state_specs` — optimizer-state sharding over a replicate
-    axis."""
+    :func:`zero1_state_specs` — annotation-mode optimizer-state sharding over a
+    replicate axis (the fused bucketed path lives in ``plan.init_optimizer_state``)."""
     import jax
     from jax.sharding import NamedSharding
 
@@ -194,11 +243,14 @@ def shard_like_params(tree, mesh, params, param_specs, zero1_axis: Optional[str]
 
 
 def zero1_state_specs(state, specs, mesh, axis: str = "dp_replicate"):
-    """Shard otherwise-replicated optimizer-state leaves over the data-parallel
-    REPLICATE axis (ZeRO-1 as a GSPMD sharding — the technique of "Automatic
-    Cross-Replica Sharding of Weight Update in Data-Parallel Training", Xu et
-    al. 2020, arXiv:2004.13336: annotate the moment buffers sharded, let XLA
-    partition the elementwise optimizer math and insert the gathers).
+    """ANNOTATION-mode ZeRO-1: shard otherwise-replicated optimizer-state leaves
+    over the data-parallel replicate axis and let GSPMD partition the
+    elementwise update math (arXiv:2004.13336's original formulation).
+
+    This is the fallback for composite meshes (ZeRO-1 stacked on TP/FSDP-sharded
+    leaves) and non-elementwise transforms; pure-DP meshes take the fused
+    bucketed path in ``parallel/weight_update.py`` instead (deterministic, with
+    explicit reduce-scatter/all-gather and 1/N update math).
 
     Params and grads stay replicated (pure DP); only the optimizer state —
     2× params for Adam — splits across replicas, so each chip stores
@@ -233,6 +285,211 @@ def replicate(tree, mesh):
 
 
 # ---------------------------------------------------------------------------
+# The single spec-decision entry point (ISSUE 9 / SimpleFSDP arXiv:2411.00284)
+
+
+@dataclass
+class ShardingPlan:
+    """One resolved sharding decision for a prepared model.
+
+    Built by :func:`make_sharding_plan`; consumed by ``Accelerator`` (param
+    placement), ``AcceleratedOptimizer`` (state init/placement, fused ZeRO-1
+    update), host-offload staging, and sharded checkpointing. Holds the spec
+    set for params/grads (identical), optimizer state, and — when fused ZeRO-1
+    is active — the bucketed update-slice layout.
+    """
+
+    mesh: Any
+    parallelism_config: Optional[ParallelismConfig]
+    rules: Optional[ShardingRules]
+    param_specs: Any
+    zero1_axis: Optional[str] = None
+    zero1: Optional[Any] = None  # Zero1BucketPlan when the fused path is active
+
+    # ------------------------------------------------------------------ params --
+    @property
+    def grad_specs(self):
+        """Gradients of a mean loss share the param layout (GSPMD reduces them
+        in the backward pass)."""
+        return self.param_specs
+
+    @property
+    def fused_zero1(self) -> bool:
+        return self.zero1 is not None
+
+    def named_sharding(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def place_params(self, params):
+        """Device-put ``params`` per the plan's specs (the "prepare model"
+        moment)."""
+        placed, _ = shard_params(params, self.mesh, specs=self.param_specs)
+        return placed
+
+    # ------------------------------------------------------------- opt state --
+    def opt_state_specs(self, state, params):
+        """Spec tree for an optimizer state over the UNBUCKETED params:
+        param-shaped subtrees inherit param specs; annotation-mode ZeRO-1
+        applies only when the fused path is off (fused state is bucketed and
+        never sees this)."""
+        specs = tree_specs_like(state, params, self.param_specs)
+        if self.zero1_axis is not None and not self.fused_zero1:
+            specs = zero1_state_specs(state, specs, self.mesh, axis=self.zero1_axis)
+        return specs
+
+    def place_opt_state(self, state, params):
+        # one placement implementation: delegate to shard_like_params (fused
+        # ZeRO-1 never reaches here — its bucketed state is placed by
+        # init_fused_optimizer_state — so the annotation axis applies only
+        # when the fused path is off)
+        return shard_like_params(
+            state, self.mesh, params, self.param_specs,
+            zero1_axis=None if self.fused_zero1 else self.zero1_axis,
+        )
+
+    def init_fused_optimizer_state(self, tx, params):
+        """Initialize BUCKETED, 1/N-per-replica optimizer state for ``tx`` and
+        the matching fused update — or None when fused ZeRO-1 is off for this
+        plan or ``tx`` materializes state the bucket layout cannot shard (the
+        plan then demotes itself to the annotation path, and the caller
+        proceeds with ``tx.init`` + :meth:`place_opt_state`).
+
+        Returns ``(opt_state, update_fn)`` where
+        ``update_fn(grads, opt_state, params) -> (new_params, new_opt_state)``
+        replaces the plain ``tx.update`` + ``apply_updates`` pair inside the
+        jitted train step.
+        """
+        if not self.fused_zero1:
+            return None
+        from .weight_update import (
+            FusedZero1Incompatible,
+            init_bucketed_opt_state,
+            make_fused_zero1_update,
+        )
+
+        try:
+            state, state_specs = init_bucketed_opt_state(
+                tx, params, self.zero1, self.mesh
+            )
+            update_fn = make_fused_zero1_update(tx, self.zero1, self.mesh, state_specs)
+            return state, update_fn
+        except FusedZero1Incompatible as e:
+            import warnings
+
+            warnings.warn(str(e), stacklevel=2)
+            self.zero1 = None
+            return None
+
+    # ----------------------------------------------------------- host offload --
+    def offload_shardings(self, tree):
+        """``(host, device)`` sharding trees for staging ``tree`` between host
+        RAM and HBM inside a compiled step (ZeRO-Offload)."""
+        return offload_tree_shardings(tree, mesh=self.mesh)
+
+    # ------------------------------------------------------------ checkpoints --
+    def sharding_from_saved_spec(self, spec_json):
+        """NamedSharding for a spec recorded in a sharded-checkpoint index
+        (``sharded_checkpoint._spec_to_json`` format: a list of axis names,
+        axis-name lists, or None per dim; or None for replicated). Lets a
+        resume restore onto this plan's mesh without live template arrays."""
+        from jax.sharding import PartitionSpec
+
+        if spec_json is None:
+            return self.named_sharding(PartitionSpec())
+        dims = []
+        for axis in spec_json:
+            if axis is None:
+                dims.append(None)
+            elif isinstance(axis, (list, tuple)):
+                dims.append(tuple(axis))
+            else:
+                dims.append(str(axis))
+        return self.named_sharding(canonicalize_spec(dims, dict(self.mesh.shape)))
+
+    # -------------------------------------------------------------- telemetry --
+    def zero1_collective_bytes(self) -> "Optional[dict[str, int]]":
+        """Per-step compiled-collective payload of the fused weight update
+        (feeds the telemetry comms counters), or None when not fused."""
+        if not self.fused_zero1:
+            return None
+        n = self.zero1.collective_bytes
+        return {"reduce_scatter": n, "all_gather": n}
+
+
+def make_sharding_plan(
+    params,
+    mesh,
+    parallelism_config: Optional[ParallelismConfig] = None,
+    rules: Optional[ShardingRules] = None,
+    zero1_axis: Optional[str] = None,
+    zero1_fused: Optional[bool] = None,
+    zero1_bucket_bytes: Optional[int] = None,
+    min_fsdp_size: int = 2**10,
+    param_specs=None,
+) -> ShardingPlan:
+    """THE spec-decision entry point: given mesh + parallelism intent, resolve
+    the full sharding plan for params/grads/opt-state/update-slices.
+
+    Fused ZeRO-1 engages when ``zero1_axis`` names a >1-sized mesh axis, every
+    param is a floating array, and the params are fully replicated under the
+    resolved specs (pure data parallelism — ZeRO-1 composed with TP/FSDP keeps
+    the annotation path). ``zero1_fused=False`` (or env
+    ``ACCELERATE_ZERO1_FUSED=0``) forces the annotation path.
+    """
+    import jax
+
+    axis_sizes = dict(mesh.shape)
+    if param_specs is None:
+        param_specs = infer_param_specs(
+            params, mesh, parallelism_config, rules, min_fsdp_size=min_fsdp_size
+        )
+    else:
+        # user-supplied specs get the same canonical form as inferred ones —
+        # a padded/size-1-axis spec would re-specialize the jitted step at
+        # step 1 and could wrongly read as "not replicated" below
+        param_specs = jax.tree_util.tree_map(
+            lambda s: None if s is None else canonicalize_spec(s, axis_sizes),
+            param_specs,
+            is_leaf=lambda s: s is None,
+        )
+    plan = ShardingPlan(
+        mesh=mesh,
+        parallelism_config=parallelism_config,
+        rules=rules,
+        param_specs=param_specs,
+        zero1_axis=zero1_axis,
+    )
+    if zero1_axis is None:
+        return plan
+    axis_size = dict(mesh.shape).get(zero1_axis, 1)
+    if axis_size <= 1:
+        return plan
+    if zero1_fused is None:
+        from ..utils.environment import parse_flag_from_env
+
+        zero1_fused = parse_flag_from_env("ACCELERATE_ZERO1_FUSED", default=True)
+    if not zero1_fused:
+        return plan
+    spec_leaves = jax.tree_util.tree_leaves(param_specs)  # PartitionSpec is a leaf
+    all_replicated = all(
+        not any(ax is not None for ax in tuple(s)) for s in spec_leaves
+    )
+    if not all_replicated:
+        return plan  # composite mesh: ZeRO-1 annotations compose with FSDP/TP
+    from .weight_update import build_bucket_plan
+
+    try:
+        plan.zero1 = build_bucket_plan(
+            params, zero1_axis, axis_size, bucket_bytes=zero1_bucket_bytes
+        )
+    except ValueError:
+        plan.zero1 = None  # non-floating leaves: annotation path
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # Canonical TP rule builders (used by models/; mirrors transformers tp_plan)
 
 
@@ -262,29 +519,74 @@ def llama_tp_rules() -> ShardingRules:
 # optimizer partition to the DeepSpeed CPU Adam engine; torch-FSDP
 # CPUOffload(offload_params=True) pages flat-params to host. The TPU-native
 # mechanism is XLA memory kinds: optimizer-state arrays live in host RAM
-# (``pinned_host``) between steps, and the compiled step stages them into HBM
-# on entry and commits them back on exit — the transfers are inside ONE XLA
-# program, so they overlap with compute instead of round-tripping through
-# Python. Frees sizeof(opt_state) of HBM (2× params for Adam).
+# (``pinned_host`` on TPU) between steps, and the compiled step stages them
+# into HBM on entry and commits them back on exit — the transfers are inside
+# ONE XLA program, so they overlap with compute instead of round-tripping
+# through Python. Frees sizeof(opt_state) of HBM (2× params for Adam).
 
-_HOST_KIND = "pinned_host"
 _host_offload_support: Optional[bool] = None
+_offload_kinds: Optional[tuple] = None  # resolved (host_kind, device_kind); () = none
+
+
+def host_memory_kind() -> Optional[str]:
+    """The host-RAM memory kind this backend's devices expose: ``pinned_host``
+    on TPU; some CPU builds expose ``unpinned_host``. None when the device
+    reports no host tier at all."""
+    kinds = offload_memory_kinds()
+    return kinds[0] if kinds else None
+
+
+def offload_memory_kinds() -> Optional[tuple]:
+    """``(host_kind, device_kind)`` when this backend exposes BOTH a host-RAM
+    tier and a distinct device tier (the precondition for optimizer-state
+    offload), else None. The CPU emulation backend addresses only
+    ``unpinned_host`` — host RAM *is* its device memory, so there is nothing
+    to offload from and this returns None. Probed once per process."""
+    global _offload_kinds
+    if _offload_kinds is None:
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        resolved: tuple = ()
+        try:
+            dev = jax.devices()[0]
+            try:
+                kinds = [m.kind for m in dev.addressable_memories()]
+            except Exception:
+                # old jax without memory introspection: assume the TPU layout
+                kinds = ["device", "pinned_host"]
+            host = next((k for k in ("pinned_host", "unpinned_host") if k in kinds), None)
+            if host is not None and "device" in kinds:
+                # both tiers must be constructible as shardings
+                SingleDeviceSharding(dev, memory_kind=host)
+                SingleDeviceSharding(dev, memory_kind="device")
+                resolved = (host, "device")
+        except Exception:
+            resolved = ()
+        _offload_kinds = resolved
+    return _offload_kinds or None
 
 
 def host_offload_supported() -> bool:
     """True when this backend can compile memory-kind annotated programs (TPU
-    yes; the CPU emulation backend lacks the annotate_device_placement custom
-    call). Probed once with a tiny jit."""
+    yes; the CPU emulation backend exposes no separate device tier and most
+    CPU builds lack the annotate_device_placement custom call). Probed once
+    with a tiny jit."""
     global _host_offload_support
     if _host_offload_support is None:
         import jax
         import jax.numpy as jnp
         from jax.sharding import SingleDeviceSharding
 
+        kinds = offload_memory_kinds()
+        if kinds is None:
+            _host_offload_support = False
+            return False
+        host_kind, device_kind = kinds
         try:
             dev = jax.devices()[0]
-            host = SingleDeviceSharding(dev, memory_kind=_HOST_KIND)
-            devk = SingleDeviceSharding(dev, memory_kind="device")
+            host = SingleDeviceSharding(dev, memory_kind=host_kind)
+            devk = SingleDeviceSharding(dev, memory_kind=device_kind)
             x = jax.device_put(jnp.zeros((8,)), host)
             # the full offload round trip: H2D stage, compute, D2H commit —
             # the commit half is what unsupported backends fail to compile
@@ -292,9 +594,9 @@ def host_offload_supported() -> bool:
                 lambda a: jax.device_put(jax.device_put(a, devk) * 2, host)
             )(x)
             jax.block_until_ready(y)
-            # some backends (CPU emulation) compile but silently DROP the
-            # D2H placement — the round trip must actually land in host memory
-            _host_offload_support = getattr(y.sharding, "memory_kind", None) == _HOST_KIND
+            # some backends compile but silently DROP the D2H placement — the
+            # round trip must actually land in host memory
+            _host_offload_support = getattr(y.sharding, "memory_kind", None) == host_kind
         except Exception as e:
             # cache the verdict only for the known can't-compile signatures;
             # a transient runtime error must not pin False for the process
@@ -315,7 +617,8 @@ def _with_memory_kind(sharding, kind: str):
 
 def offload_tree_shardings(tree, mesh=None):
     """For a tree of live arrays return ``(host_shardings, device_shardings)``
-    trees derived from each leaf's current sharding.
+    trees derived from each leaf's current sharding (memory kinds resolved by
+    :func:`offload_memory_kinds` — ``pinned_host``/``device`` on TPU).
 
     With ``mesh`` given, leaves whose sharding does not span the mesh's device
     set (e.g. an optax ``count`` scalar committed to one device before
@@ -324,6 +627,13 @@ def offload_tree_shardings(tree, mesh=None):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    kinds = offload_memory_kinds()
+    if kinds is None:
+        raise RuntimeError(
+            "this backend exposes no separate host/device memory tiers "
+            f"(host offload needs both; see offload_memory_kinds)"
+        )
+    host_kind, device_kind = kinds
     mesh_devices = set(mesh.devices.flat) if mesh is not None else None
 
     def _base(x):
@@ -332,8 +642,8 @@ def offload_tree_shardings(tree, mesh=None):
             return NamedSharding(mesh, PartitionSpec())
         return s
 
-    host = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), _HOST_KIND), tree)
-    dev = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), "device"), tree)
+    host = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), host_kind), tree)
+    dev = jax.tree_util.tree_map(lambda x: _with_memory_kind(_base(x), device_kind), tree)
     return host, dev
 
 
@@ -346,20 +656,23 @@ def offload_to_host(tree, mesh=None):
     return jax.device_put(tree, host)
 
 
-def make_host_offloaded_step(base_step, opt_state, donate: bool = True, mesh=None):
+def make_host_offloaded_step(base_step, opt_state, donate: bool = True, mesh=None, plan=None):
     """Wrap ``base_step(params, opt_state, batch) -> (params, opt_state,
-    metrics)`` so the optimizer state lives in ``pinned_host`` between steps.
+    metrics)`` so the optimizer state lives in host memory between steps.
 
     ``opt_state`` must be the LIVE (device-resident) state; it is committed to
     host here and the matching host-resident state is returned alongside the
     compiled step: ``(step, host_opt_state)``. Inside the jitted step the
     state is staged HBM-ward (H2D), updated, and committed back (D2H) — both
-    transfers are part of the XLA program. Pass ``mesh`` so stray
-    single-device leaves are normalized onto it.
+    transfers are part of the XLA program. Pass ``plan`` (or ``mesh``) so
+    stray single-device leaves are normalized onto the mesh.
     """
     import jax
 
-    host_s, dev_s = offload_tree_shardings(opt_state, mesh=mesh)
+    if plan is not None:
+        host_s, dev_s = plan.offload_shardings(opt_state)
+    else:
+        host_s, dev_s = offload_tree_shardings(opt_state, mesh=mesh)
     host_state = jax.device_put(opt_state, host_s)
 
     def step(params, opt_state, batch):

@@ -1,0 +1,472 @@
+"""Fused cross-replica weight-update sharding: real ZeRO-1 inside the jitted step.
+
+The technique of "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (Xu et al. 2020, arXiv:2004.13336), made explicit
+instead of annotation-and-hope (the previous ``zero1_state_specs`` path merely
+sharded the moment buffers and let GSPMD partition the update — which also let
+the partitioner re-shard the forward/backward graph, reassociating reductions
+and making the "ZeRO-1 matches replicated DP" comparison ulp-unstable):
+
+1. **Bucket**: gradients are flattened and concatenated into size-bounded,
+   dtype-homogeneous buckets (:class:`Zero1BucketPlan`), padded so every bucket
+   splits evenly across the replicate axis.
+2. **Reduce-scatter**: each replica keeps only its ``1/N`` chunk of each grad
+   bucket. Gradients of a mean loss over a dp-sharded batch come out of
+   ``jax.grad`` already summed (a GSPMD all-reduce); the per-replica chunk is a
+   ``dynamic_slice`` keyed on the replica id, exactly the all-reduce +
+   partition-slice pattern XLA's reassociation pass rewrites into a
+   reduce-scatter (the CRS paper's transformation).
+3. **Shard-local update**: the optimizer transform runs on the ``1/N`` chunk —
+   optimizer math AND first/second-moment memory drop to ``1/N`` per replica.
+4. **All-gather**: the updated param chunks are reassembled. Buckets are
+   independent chains in the HLO, so XLA's latency-hiding scheduler can overlap
+   the all-gather of bucket *i* with the optimizer math of bucket *i+1*.
+
+The update region runs under ``shard_map`` (manual collectives), so no sharding
+constraint leaks into the forward/backward graph: the compiled loss/grad math
+is instruction-identical to the replicated-DP baseline, and the fused step's
+weights match it **bitwise** on a deterministic backend.
+
+Scope: the fused path assumes an *elementwise* optimizer transform chain
+(adam/adamw/sgd/lion/MultiSteps wrappers — anything whose per-element update
+depends only on that element's grad/param/state). Shape-dependent transforms
+(adafactor's factored moments, per-tensor trust ratios) are detected at init
+when they materialize non-bucket-shaped state and fall back to the annotation
+path; stateless shape-dependent transforms cannot be detected — disable with
+``ACCELERATE_ZERO1_FUSED=0`` for those.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+BUCKET_BYTES_ENV = "ACCELERATE_ZERO1_BUCKET_MB"
+
+
+class FusedZero1Incompatible(ValueError):
+    """The optimizer transform materialized state the fused ZeRO-1 path cannot
+    shard (non-bucket-shaped array leaves, e.g. adafactor's factored moments).
+    Callers catch this and fall back to the GSPMD annotation path."""
+
+
+def bucket_bytes_from_env(default: int = DEFAULT_BUCKET_BYTES) -> int:
+    raw = os.environ.get(BUCKET_BYTES_ENV, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(1, int(float(raw) * 1024 * 1024))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class _LeafSlot:
+    """Where one param/grad leaf lives inside the bucketed representation."""
+
+    leaf_index: int  # position in tree-flatten order
+    bucket: str
+    offset: int  # element offset into the bucket
+    size: int  # element count
+    shape: tuple
+    dtype: str
+
+
+@dataclass
+class Zero1BucketPlan:
+    """Static layout of the bucketed ZeRO-1 weight update for one param tree.
+
+    Built once (from shapes only) by :func:`build_bucket_plan`; used inside the
+    jitted step to flatten grads/params into buckets and re-assemble updated
+    params. Buckets are dtype-homogeneous and padded to a multiple of
+    ``axis_size`` so each replica owns an equal contiguous chunk.
+    """
+
+    axis: str
+    axis_size: int
+    treedef: Any
+    slots: "list[_LeafSlot]"
+    bucket_sizes: "dict[str, int]"  # padded element counts
+    bucket_dtypes: "dict[str, Any]"  # np.dtype per bucket
+    n_elements: int = 0  # total unpadded param elements
+
+    # ------------------------------------------------------------ properties --
+    @property
+    def bucket_names(self) -> "list[str]":
+        return list(self.bucket_sizes)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.bucket_sizes)
+
+    def chunk_size(self, name: str) -> int:
+        return self.bucket_sizes[name] // self.axis_size
+
+    @property
+    def bucket_nbytes(self) -> "dict[str, int]":
+        return {
+            name: size * np.dtype(self.bucket_dtypes[name]).itemsize
+            for name, size in self.bucket_sizes.items()
+        }
+
+    @property
+    def collective_bytes(self) -> int:
+        """Bytes moved per update in ONE direction (the reduce-scatter of grad
+        buckets; the all-gather of param buckets moves the same amount)."""
+        return sum(self.bucket_nbytes.values())
+
+    # ------------------------------------------------------------- transforms --
+    def bucket_tree(self, tree):
+        """Flatten a param-shaped pytree into ``{bucket_name: 1-D array}``.
+        Trace-safe (pure jnp ops); padding elements are zeros."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(leaves) != len(self.slots):
+            raise ValueError(
+                f"tree has {len(leaves)} leaves but the bucket plan was built "
+                f"for {len(self.slots)} — not the planned param structure"
+            )
+        parts: "dict[str, list]" = {name: [] for name in self.bucket_sizes}
+        filled: "dict[str, int]" = {name: 0 for name in self.bucket_sizes}
+        for slot in self.slots:
+            parts[slot.bucket].append(jnp.ravel(leaves[slot.leaf_index]))
+            filled[slot.bucket] += slot.size
+        out = {}
+        for name, pieces in parts.items():
+            pad = self.bucket_sizes[name] - filled[name]
+            if pad:
+                pieces.append(jnp.zeros((pad,), self.bucket_dtypes[name]))
+            out[name] = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        return out
+
+    def unbucket_tree(self, buckets):
+        """Rebuild the param-shaped pytree from ``{bucket_name: 1-D array}``."""
+        import jax
+
+        leaves: "list" = [None] * len(self.slots)
+        for slot in self.slots:
+            flat = buckets[slot.bucket]
+            piece = jax.lax.slice(flat, (slot.offset,), (slot.offset + slot.size,))
+            leaves[slot.leaf_index] = piece.reshape(slot.shape)
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    # ---------------------------------------------------------------- specs ----
+    def bucket_specs(self):
+        """``{bucket: P(axis)}`` — the update-slice shardings (each replica owns
+        a 1/N chunk of every bucket)."""
+        from jax.sharding import PartitionSpec
+
+        return {name: PartitionSpec(self.axis) for name in self.bucket_sizes}
+
+    def state_partition_specs(self, state):
+        """PartitionSpec tree for an optimizer state built over the bucketed
+        params: bucket-shaped subtrees get ``P(axis)``, scalars ``P()``.
+
+        Raises :class:`FusedZero1Incompatible` for array leaves that are
+        neither (the signature of a shape-dependent transform)."""
+        import jax
+        from jax.sharding import PartitionSpec
+
+        sizes = {}  # padded size -> seen (dict, not set: keep R5-clean iteration)
+        for s in self.bucket_sizes.values():
+            sizes[s] = True
+
+        def _spec(path, leaf):
+            ndim = getattr(leaf, "ndim", None)
+            if ndim is None or ndim == 0:
+                return PartitionSpec()
+            shape = tuple(leaf.shape)
+            if len(shape) == 1 and sizes.get(shape[0]):
+                return PartitionSpec(self.axis)
+            raise FusedZero1Incompatible(
+                f"optimizer state leaf {jax.tree_util.keystr(path)} has shape "
+                f"{shape}, which is not a ZeRO-1 bucket ({list(self.bucket_sizes.values())}) "
+                "or a scalar — this transform is not elementwise-bucketable "
+                "(e.g. adafactor's factored moments); falling back to the "
+                "GSPMD annotation path"
+            )
+
+        return jax.tree_util.tree_map_with_path(_spec, state)
+
+
+def build_bucket_plan(
+    params,
+    axis: str,
+    axis_size: int,
+    bucket_bytes: Optional[int] = None,
+) -> Zero1BucketPlan:
+    """Assign every param leaf to a dtype-homogeneous, size-bounded bucket.
+
+    Leaves are packed greedily in tree-flatten order (one open bucket per
+    dtype); a bucket closes when adding the next leaf would exceed
+    ``bucket_bytes``. Each bucket is padded to a multiple of ``axis_size``.
+    Raises ``ValueError`` for non-floating leaves (their ``jax.grad`` cotangent
+    is ``float0`` — callers should gate the fused path off instead).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if bucket_bytes is None:
+        bucket_bytes = bucket_bytes_from_env()
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    slots: "list[_LeafSlot]" = []
+    bucket_sizes: "dict[str, int]" = {}
+    bucket_dtypes: "dict[str, Any]" = {}
+    open_bucket: "dict[str, str]" = {}  # dtype str -> open bucket name
+    fill: "dict[str, int]" = {}  # bucket name -> unpadded elements
+    total = 0
+    for i, leaf in enumerate(leaves):
+        dtype = np.dtype(leaf.dtype)
+        # np's .kind can't see extension floats (bfloat16 reports 'V')
+        if not jnp.issubdtype(dtype, jnp.floating):
+            raise ValueError(
+                f"fused ZeRO-1 needs floating-point params; leaf {i} is {dtype}"
+            )
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        total += size
+        key = str(dtype)
+        name = open_bucket.get(key)
+        if name is not None and (fill[name] + size) * dtype.itemsize > bucket_bytes and fill[name] > 0:
+            name = None  # close the full bucket
+        if name is None:
+            name = f"b{len(bucket_sizes):03d}"
+            open_bucket[key] = name
+            bucket_sizes[name] = 0
+            bucket_dtypes[name] = dtype
+            fill[name] = 0
+        slots.append(
+            _LeafSlot(
+                leaf_index=i,
+                bucket=name,
+                offset=fill[name],
+                size=size,
+                shape=tuple(leaf.shape),
+                dtype=str(dtype),
+            )
+        )
+        fill[name] += size
+    for name, n in fill.items():
+        bucket_sizes[name] = -(-n // axis_size) * axis_size  # ceil to axis_size
+    return Zero1BucketPlan(
+        axis=axis,
+        axis_size=axis_size,
+        treedef=treedef,
+        slots=slots,
+        bucket_sizes=bucket_sizes,
+        bucket_dtypes=bucket_dtypes,
+        n_elements=total,
+    )
+
+
+def init_bucketed_opt_state(tx, params, plan: Zero1BucketPlan, mesh):
+    """Initialize ``tx`` over the BUCKETED param representation and place each
+    state leaf sharded ``1/N`` over the replicate axis.
+
+    Returns ``(opt_state, state_specs)``. Raises
+    :class:`FusedZero1Incompatible` when the transform materializes state the
+    bucket layout cannot shard (callers fall back to annotation-mode ZeRO-1).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    bucketed = jax.device_put(
+        plan.bucket_tree(params),
+        {n: NamedSharding(mesh, s) for n, s in plan.bucket_specs().items()},
+    )
+    state = tx.init(bucketed)
+    specs = plan.state_partition_specs(state)  # may raise FusedZero1Incompatible
+    state = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+    return state, specs
+
+
+def make_fused_zero1_update(tx, plan: Zero1BucketPlan, mesh, state_specs) -> Callable:
+    """Build ``update_fn(grads, opt_state, params) -> (new_params, new_opt_state)``.
+
+    Runs the bucketed reduce-scatter → shard-local ``tx.update`` → all-gather
+    pipeline under ``shard_map`` (manual collectives — nothing leaks into the
+    caller's forward/backward partitioning). Trace-safe: call it inside the
+    jitted train step. ``opt_state`` must come from
+    :func:`init_bucketed_opt_state`.
+    """
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
+
+    axis = plan.axis
+    names = plan.bucket_names
+    chunks = {n: plan.chunk_size(n) for n in names}
+    repl_specs = {n: P() for n in names}
+
+    def shard_update(gb, st, pb):
+        # per-replica region: gb/pb arrive replicated (full buckets), st leaves
+        # arrive as this replica's 1/N chunks (in_specs below)
+        idx = jax.lax.axis_index(axis)
+        g_sl, p_sl = {}, {}
+        for n in names:
+            start = idx * chunks[n]
+            g_sl[n] = jax.lax.dynamic_slice(gb[n], (start,), (chunks[n],))
+            p_sl[n] = jax.lax.dynamic_slice(pb[n], (start,), (chunks[n],))
+        updates, new_st = tx.update(g_sl, st, p_sl)
+        new_p = optax.apply_updates(p_sl, updates)
+        # per-bucket all-gathers are independent of each other and of the next
+        # bucket's optimizer math — XLA's latency-hiding scheduler overlaps them
+        new_pb = {
+            n: jax.lax.all_gather(new_p[n], axis, tiled=True) for n in names
+        }
+        return new_pb, new_st
+
+    sharded = shard_map(
+        shard_update,
+        mesh=mesh,
+        in_specs=(repl_specs, state_specs, repl_specs),
+        out_specs=(repl_specs, state_specs),
+        # scalar state (counts, mini_step) is replicated by construction; the
+        # checker cannot prove that through lax.cond (MultiSteps) — off
+        check_vma=False,
+    )
+
+    def update_fn(grads, opt_state, params):
+        gb = plan.bucket_tree(grads)
+        pb = plan.bucket_tree(params)
+        new_pb, new_state = sharded(gb, opt_state, pb)
+        return plan.unbucket_tree(new_pb), new_state
+
+    return update_fn
+
+
+# ---------------------------------------------------------------------------
+# Self-check (consumed by `make doctor`): build a fused step on a virtual
+# multi-device mesh, lint-critical invariants aside, and prove the compiled
+# program actually contains collectives moving the planned number of bytes.
+
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4, "u16": 2,
+    "u8": 1, "pred": 1,
+}
+
+
+def hlo_collective_bytes(hlo_text: str) -> "dict[str, int]":
+    """Sum output bytes of collective ops in an HLO module text dump —
+    the trace-derived cross-check that the fused step really communicates.
+    Handles both single results (``= f32[2048]{0} all-gather(...)``) and the
+    tuple results XLA's collective-combiner passes produce
+    (``= (f32[2048], f32[256]) all-gather(...)``)."""
+    import re
+
+    out: "dict[str, int]" = {}
+    shape = r"(\w+)\[([\d,]*)\]\S*"
+    single = re.compile(
+        rf"=\s*{shape}\s[^\n]*?\b(all-gather|reduce-scatter|all-reduce|collective-permute)\("
+    )
+    variadic = re.compile(
+        r"=\s*\(([^)]*)\)\s[^\n]*?\b(all-gather|reduce-scatter|all-reduce|collective-permute)\("
+    )
+    part = re.compile(rf"{shape}")
+
+    def _nbytes(dtype: str, dims: str) -> int:
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        return elems * _HLO_DTYPE_BYTES.get(dtype, 4)
+
+    for dtype, dims, op in single.findall(hlo_text):
+        out[op] = out.get(op, 0) + _nbytes(dtype, dims)
+    for inner, op in variadic.findall(hlo_text):
+        for dtype, dims in part.findall(inner):
+            out[op] = out.get(op, 0) + _nbytes(dtype, dims)
+    return out
+
+
+def self_check(n_devices: int = 8, bucket_bytes: int = 1 << 12) -> dict:
+    """Compile a fused ZeRO-1 step on ``n_devices`` virtual CPU devices and
+    report plan/HLO collective accounting plus a one-step parity probe vs the
+    replicated update. Run in a FRESH process (sets XLA_FLAGS before jax
+    loads); ``make doctor`` invokes it via a subprocess."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(
+        np.array(jax.devices()[:n_devices]).reshape(n_devices), ("dp_replicate",)
+    )
+    repl = NamedSharding(mesh, P())
+    params = {
+        "w1": jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1, repl
+        ),
+        "w2": jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(1), (32, 8)) * 0.1, repl
+        ),
+    }
+    plan = build_bucket_plan(params, "dp_replicate", n_devices, bucket_bytes)
+    tx = optax.adam(1e-3)
+    state, specs = init_bucketed_opt_state(tx, params, plan, mesh)
+    fused = make_fused_zero1_update(tx, plan, mesh, specs)
+
+    def loss_fn(p, b):
+        return jnp.mean((jnp.tanh(b @ p["w1"]) @ p["w2"]) ** 2)
+
+    def step(p, st, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        new_p, new_st = fused(grads, st, p)
+        return new_p, new_st, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+    batch = jax.device_put(jnp.ones((16, 64), jnp.float32), repl)
+    compiled = jitted.lower(params, state, batch).compile()
+    hlo_bytes = hlo_collective_bytes(compiled.as_text())
+
+    # one-step parity probe vs the plain replicated update
+    tx2 = optax.adam(1e-3)
+    base_state = jax.device_put(tx2.init(params), repl)
+
+    def base_step(p, st, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        u, st = tx2.update(grads, st, p)
+        return optax.apply_updates(p, u), st, loss
+
+    p_ref, _, _ = jax.jit(base_step)(params, base_state, batch)
+    p_fused, new_state, _ = jitted(params, state, batch)
+    max_delta = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_fused), jax.tree_util.tree_leaves(p_ref)
+        )
+    )
+    mu_leaf = new_state[0].mu[plan.bucket_names[0]]
+    shard = next(iter(mu_leaf.addressable_shards))
+    return {
+        "n_devices": n_devices,
+        "num_buckets": plan.num_buckets,
+        "plan_collective_bytes": plan.collective_bytes,
+        "hlo_collective_bytes": hlo_bytes,
+        "hlo_total_collective_bytes": sum(hlo_bytes.values()),
+        "opt_state_shard_fraction": shard.data.size / mu_leaf.size,
+        "parity_max_abs_delta": max_delta,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(self_check()))

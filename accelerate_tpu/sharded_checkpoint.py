@@ -420,13 +420,19 @@ def _assemble_region(meta: dict, start: list[int], stop: list[int], reader: _Chu
     return out
 
 
-def load_sharded_pytree(template, directory: str, prefix: str = "model"):
+def load_sharded_pytree(template, directory: str, prefix: str = "model", plan=None):
     """Restore a sharded checkpoint into the structure/shardings of ``template``.
 
     ``template`` leaves that are ``jax.Array`` are rebuilt with
     ``jax.make_array_from_callback`` against their live sharding — each device
     pulls only its own region, so resharding to a different mesh factorization
     is just different callback indices. Non-array leaves are read whole.
+
+    ``plan`` (a ``parallel.sharding.ShardingPlan``) lets ``jax.ShapeDtypeStruct``
+    template leaves restore WITHOUT live arrays: their target sharding is
+    rebuilt from the PartitionSpec recorded in the shard index via
+    ``plan.sharding_from_saved_spec`` — the resume-onto-a-fresh-mesh path,
+    where only shapes (not placed buffers) exist before the load.
     """
     import jax
 
@@ -438,7 +444,15 @@ def load_sharded_pytree(template, directory: str, prefix: str = "model"):
         if key not in merged:
             raise KeyError(f"sharded checkpoint missing leaf {key!r}")
         meta = merged[key]
-        if isinstance(leaf, jax.Array):
+        is_live = isinstance(leaf, jax.Array)
+        is_spec_leaf = (
+            not is_live
+            and plan is not None
+            and not isinstance(leaf, np.ndarray)
+            and hasattr(leaf, "shape")
+            and hasattr(leaf, "dtype")
+        )
+        if is_live or is_spec_leaf:
             if list(leaf.shape) != list(meta["shape"]):
                 raise ValueError(
                     f"shape mismatch for {key!r}: live {leaf.shape} vs saved {meta['shape']}"
@@ -449,9 +463,12 @@ def load_sharded_pytree(template, directory: str, prefix: str = "model"):
                 start, stop = _index_to_coords(index, _shape)
                 return _assemble_region(_meta, start, stop, reader, _dtype)
 
-            arr = jax.make_array_from_callback(tuple(leaf.shape), leaf.sharding, cb)
+            sharding = (
+                leaf.sharding if is_live else plan.sharding_from_saved_spec(meta.get("spec"))
+            )
+            arr = jax.make_array_from_callback(tuple(leaf.shape), sharding, cb)
             if arr.dtype != leaf.dtype:
-                arr = jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+                arr = jax.device_put(arr.astype(leaf.dtype), sharding)
             return arr
         start = [0] * len(meta["shape"])
         value = _assemble_region(meta, start, list(meta["shape"]), reader,

@@ -1033,9 +1033,73 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("performance report section", False, f"{type(exc).__name__}: {exc}")
 
+        # 9. fused ZeRO-1 weight update (ISSUE 9): the fused step's module must
+        # lint clean under the donation (R3) + collectives (R4) rules, and its
+        # COMPILED form on an 8-virtual-device mesh must contain collectives
+        # moving real bytes (run in a subprocess — the device count is fixed at
+        # backend init, which already happened in this process)
+        try:
+            _doctor_fused_zero1(_check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("fused zero1 weight update", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
+
+
+def _doctor_fused_zero1(_check) -> None:
+    """Doctor check 9 body: jaxlint R3/R4 over the fused-update module +
+    accelerator, then a subprocess self_check compiling the fused step and
+    summing collective bytes out of its HLO."""
+    import subprocess
+    import sys
+
+    from ..analysis import run_lint
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [
+        os.path.join(pkg_dir, "parallel", "weight_update.py"),
+        os.path.join(pkg_dir, "accelerator.py"),
+    ]
+    result = run_lint(targets, use_baseline=False)
+    bad = [f for f in result.new_findings if f.rule in ("R3", "R4")]
+    _check(
+        "fused zero1 lints clean (R3/R4)",
+        not bad,
+        "; ".join(f"{f.rule}:{os.path.basename(f.file)}:{f.line}" for f in bad),
+    )
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # self_check sets the virtual device count
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import json; from accelerate_tpu.parallel.weight_update import "
+            "self_check; print(json.dumps(self_check()))",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(pkg_dir),
+    )
+    ok = False
+    detail = f"exit {proc.returncode}: {proc.stderr[-300:]}"
+    if proc.returncode == 0:
+        try:
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            ok = (
+                payload["hlo_total_collective_bytes"] > 0
+                and payload["plan_collective_bytes"] > 0
+                and payload["opt_state_shard_fraction"] == 1.0 / payload["n_devices"]
+                and payload["parity_max_abs_delta"] < 1.5e-7
+            )
+            detail = f"payload={payload}"
+        except Exception as exc:
+            detail = f"unparseable self_check output: {exc}"
+    _check("fused zero1 compiled collectives", ok, detail)
 
 
 def _doctor_performance_section(tmp: str, _check) -> None:

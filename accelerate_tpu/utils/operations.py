@@ -75,6 +75,23 @@ def _record_comm(op: str, tree=None, nbytes: Optional[int] = None) -> None:
     _telemetry.emit("comm", op=op, bytes=n, wire=PartialState().num_processes > 1)
 
 
+def record_compiled_collective(op: str, nbytes: int) -> None:
+    """Count a collective COMPILED INTO a jitted step (fused ZeRO-1's
+    reduce-scatter/all-gather, ``parallel/weight_update.py``): the host never
+    dispatches it, so its payload is accounted from the static bucket plan,
+    once per step. Namespaced ``compiled:`` so the report's comms table
+    separates device-fabric traffic from host-level collectives. The disabled
+    path is one flag check."""
+    if not _telemetry.is_enabled():
+        return
+    rec = _COMM_COUNTS.setdefault(f"compiled:{op}", [0, 0])
+    rec[0] += 1
+    rec[1] += int(nbytes)
+    # wire=True: these bytes really cross the device fabric (ICI/DCN) even in
+    # a single-process multi-device run
+    _telemetry.emit("comm", op=f"compiled:{op}", bytes=int(nbytes), wire=True)
+
+
 def _collective_signature(tree) -> str:
     """Compact (shape, dtype) description of a collective payload, folded
     into the flight recorder's per-rank schedule fingerprint — the runtime

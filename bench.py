@@ -767,6 +767,51 @@ def run_bench():
     }
 
 
+def run_bench_weight_update(on_tpu: bool) -> dict:
+    """Fused ZeRO-1 weight-update config (ISSUE 9): fused-vs-annotation step
+    time, per-replica optimizer-state footprint, and the PR 7 comms-overlap
+    ratio over the fused step's armed trace windows. On TPU it runs in-process
+    on the real chips (a subprocess could not share the exclusive TPU); on CPU
+    it delegates to a subprocess so the 8-virtual-device mesh the fused path
+    needs can be requested before backend init — the parent's 1-device CPU
+    backend is already frozen."""
+    base = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "weight_update", "run.py"
+    )
+    if on_tpu:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("bench_weight_update_run", base)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = mod.run_bench_weight_update(
+            True, steps=20, dim=2048, layers=8, trace_every=8
+        )
+    else:
+        import subprocess
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, base, "--steps", "8", "--dim", "256",
+             "--layers", "2", "--trace-every", "4"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"weight_update bench failed: {proc.stderr[-500:]}")
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "metric": "zero1 fused/unfused step-time ratio",
+        "value": out["value"],
+        "unit": out["unit"],
+        "fused": out["fused"],
+        "unfused": out["unfused"],
+        "opt_state_fraction": out["fused"]["opt_state_fraction"],
+        "overlap_ratio": out["overlap_ratio"],
+        "collective_bytes_per_step": out["collective_bytes_per_step"],
+        "n_devices": out["n_devices"],
+    }
+
+
 def run_bench_checkpoint_stall(on_tpu: bool) -> dict:
     """Checkpoint-stall config (ISSUE 5 acceptance): exposed-stall ratio of
     async vs sync ``save_state`` around a fixed-cadence step loop — how much
@@ -1330,6 +1375,7 @@ def main():
         # not like-for-like; a fresh anchor is seeded on the next TPU run
         ("compile_time_llama1b", run_bench_compile_time),
         ("checkpoint_stall", run_bench_checkpoint_stall),
+        ("weight_update", run_bench_weight_update),
     ):
         if _remaining() < 120:
             configs[name] = {

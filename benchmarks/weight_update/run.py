@@ -1,0 +1,244 @@
+"""Fused ZeRO-1 weight-update microbench (ISSUE 9 acceptance path, also
+`make bench-zero1`).
+
+Trains the same pure-DP model under ZeRO-1 twice on the same mesh:
+
+- **unfused** — the annotation path (``ACCELERATE_ZERO1_FUSED=0``):
+  ``zero1_state_specs`` shards the moment buffers, GSPMD partitions the update;
+- **fused** — the bucketed path (``parallel/weight_update.py``): grads
+  reduce-scattered per bucket, 1/N shard-local optimizer math, all-gathered
+  params, all inside the jitted step.
+
+Emits one JSON line (bench.py conventions, last line on stdout) with the
+fused/unfused step-time ratio, optimizer-state bytes per replica for each leg,
+and — when a trace window is armed (``--trace-every``) — the
+``comms_overlap_ratio`` from the PR 7 trace summary: how much of the fused
+step's collective time the latency-hiding scheduler buried under compute.
+On the CPU backend the mesh is 8 virtual devices and the *ratio* fields are
+the meaningful signal; on a real TPU slice the step times are, too.
+"""
+
+import argparse
+import contextlib
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VIRTUAL_DEVICES = 8
+
+
+def _ensure_virtual_devices() -> None:
+    """8 virtual CPU devices — must land in XLA_FLAGS before jax's backend
+    initializes, so callers import this module before touching jax."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={VIRTUAL_DEVICES}"
+        ).strip()
+
+
+def _bytes_per_replica(tree) -> int:
+    import jax
+
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for shard in getattr(leaf, "addressable_shards", ()):
+            if shard.device == dev0:
+                total += shard.data.nbytes
+    return total
+
+
+def run_bench_weight_update(
+    on_tpu: bool,
+    steps: int = 20,
+    dim: int = 512,
+    layers: int = 4,
+    trace_every: int = 0,
+    keep_artifacts: bool = False,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import (
+        Accelerator,
+        DeepSpeedPlugin,
+        ParallelismConfig,
+        telemetry,
+    )
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import patch_environment
+    from accelerate_tpu.utils.dataclasses import ProfileConfig
+
+    n = len(jax.devices())
+
+    def make_params():
+        rng = np.random.default_rng(0)
+        return {
+            f"layer{i}": {
+                "w": jnp.asarray(rng.normal(size=(dim, dim)) * dim**-0.5, jnp.float32),
+                "b": jnp.zeros((dim,), jnp.float32),
+            }
+            for i in range(layers)
+        }
+
+    def loss_fn(p, batch):
+        x = batch["x"]
+        for i in range(layers):
+            x = jnp.tanh(x @ p[f"layer{i}"]["w"] + p[f"layer{i}"]["b"])
+        return jnp.mean(x**2)
+
+    batch = {
+        "x": jnp.asarray(
+            np.random.default_rng(1).normal(size=(max(16, 2 * n), dim)), jnp.float32
+        )
+    }
+
+    workdir = tempfile.mkdtemp(prefix="bench_zero1_")
+
+    def _null():
+        return contextlib.nullcontext()
+
+    def leg(fused: bool) -> dict:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        env = {} if fused else {"ACCELERATE_ZERO1_FUSED": "0"}
+        handlers = []
+        if fused and trace_every:
+            handlers.append(
+                ProfileConfig(
+                    trace_every=trace_every,
+                    trace_steps=2,  # CPU 1-step windows can close before TraceMe flush
+                    output_trace_dir=os.path.join(workdir, "trace"),
+                )
+            )
+        with patch_environment(**env) if env else _null():
+            acc = Accelerator(
+                deepspeed_plugin=DeepSpeedPlugin(zero_stage=1),
+                parallelism_config=ParallelismConfig(dp_replicate_size=n),
+                rng_seed=0,
+                kwargs_handlers=handlers or None,
+            )
+            params, opt = acc.prepare(make_params(), optax.adam(1e-3))
+        step = acc.prepare_train_step(loss_fn, opt)
+        state = opt.opt_state
+        opt_bytes = _bytes_per_replica(state)
+        opt_global = sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(state)
+        )
+        # warmup: compile + one steady-state dispatch
+        for _ in range(2):
+            params, state, m = step(params, state, batch)
+            float(np.asarray(m["loss"]))
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            params, state, m = step(params, state, batch)
+            # value fetch forces completion inside the timed window (and inside
+            # any open trace window)
+            loss = float(np.asarray(m["loss"]))
+            times.append(time.perf_counter() - t0)
+        acc.end_training()
+        return {
+            "fused": bool(opt.fused_zero1),
+            "step_ms": round(float(np.median(times)) * 1e3, 3),
+            "p95_step_ms": round(float(np.percentile(times, 95)) * 1e3, 3),
+            "opt_state_bytes_per_replica": opt_bytes,
+            # fraction of the full (replicated-equivalent) state one replica
+            # holds — the ZeRO-1 memory claim; ~1/n_devices plus scalar leaves
+            "opt_state_fraction": round(opt_bytes / max(opt_global, 1), 4),
+            "final_loss": round(loss, 6),
+        }
+
+    telemetry_dir = os.path.join(workdir, "telemetry")
+    overlap = None
+    collective_bytes_per_step = None
+    try:
+        unfused = leg(fused=False)
+        if trace_every:
+            telemetry.enable(telemetry_dir)
+        try:
+            fused = leg(fused=True)
+        finally:
+            if trace_every:
+                telemetry.disable()
+        if trace_every:
+            from accelerate_tpu.telemetry.report import build_report
+
+            rep = build_report([telemetry_dir])
+            trace = (rep.get("performance") or {}).get("trace") or {}
+            overlap = trace.get("comms_overlap_ratio")
+            comms = (rep.get("comms") or {}).get("by_op") or {}
+            rs = comms.get("compiled:reduce_scatter") or {}
+            if rs.get("calls"):
+                collective_bytes_per_step = rs.get("bytes", 0) // rs["calls"]
+    finally:
+        if not keep_artifacts:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    n_params = layers * (dim * dim + dim)
+    return {
+        "bench": "weight_update",
+        "unit": "step_time_ratio(fused/unfused)",
+        "value": round(fused["step_ms"] / max(unfused["step_ms"], 1e-9), 4),
+        "fused": fused,
+        "unfused": unfused,
+        "opt_state_ratio": round(
+            fused["opt_state_bytes_per_replica"]
+            / max(unfused["opt_state_bytes_per_replica"], 1),
+            4,
+        ),
+        "overlap_ratio": overlap,
+        "collective_bytes_per_step": collective_bytes_per_step,
+        "n_devices": n,
+        "n_params": n_params,
+        "steps": steps,
+        "on_tpu": on_tpu,
+        **({"artifacts": workdir} if keep_artifacts else {}),
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--trace-every", type=int, default=8,
+                    help="arm a two-step jax.profiler window every N fused steps "
+                         "(0 disables tracing and the overlap_ratio field)")
+    ap.add_argument("--keep-artifacts", action="store_true")
+    args = ap.parse_args()
+    # decide backend BEFORE jax initializes: virtual devices only help the CPU
+    # emulation; a real TPU slice brings its own chips
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        _ensure_virtual_devices()
+        from _common import detect_backend, emit
+
+        on_tpu = detect_backend()
+    else:
+        from _common import detect_backend, emit
+
+        on_tpu = detect_backend()
+        if not on_tpu:
+            print(
+                "warning: CPU fallback after backend init — virtual device "
+                "count could not be raised; mesh may be 1-wide",
+                file=sys.stderr,
+            )
+    emit(
+        run_bench_weight_update(
+            on_tpu=on_tpu,
+            steps=args.steps,
+            dim=args.dim,
+            layers=args.layers,
+            trace_every=args.trace_every,
+            keep_artifacts=args.keep_artifacts,
+        )
+    )

@@ -395,9 +395,11 @@ def test_doctor_self_checks(capsys):
     out = capsys.readouterr().out
     # dump + stall + straggler + collective divergence + jaxlint
     # + perf cost capture + xplane trace parse + performance report (ISSUE 7)
-    assert out.count("PASS") == 8 and "FAIL" not in out
+    # + fused zero1 lint/compiled-collectives (ISSUE 9)
+    assert out.count("PASS") == 10 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
+    assert "fused zero1 compiled collectives" in out
     assert "performance report section" in out
 
 

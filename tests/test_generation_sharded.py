@@ -194,7 +194,8 @@ class TestShardedDecodeParity:
     def test_tp_specs_applied(self, setup):
         _, _, _, _, sharded, specs = setup
         assert specs["layers"]["wq"]["kernel"] == P(None, None, "tp")
-        assert specs["layers"]["wo"]["kernel"] == P(None, "tp", None)
+        # canonical (trailing-None-trimmed) form — see sharding.canonicalize_spec
+        assert specs["layers"]["wo"]["kernel"] == P(None, "tp")
         shard_shape = sharded["layers"]["wq"]["kernel"].sharding.shard_shape(
             sharded["layers"]["wq"]["kernel"].shape
         )

@@ -25,18 +25,46 @@ def _is_tpu():
         return False
 
 
+# Capability probe (parallel/sharding.offload_memory_kinds): offload needs the
+# backend to expose BOTH a host-RAM tier (pinned_host on TPU, unpinned_host on
+# some CPU builds) and a distinct "device" tier. The CPU emulation backend
+# addresses ONLY unpinned_host — host RAM *is* its device memory, so there is
+# no second tier to stage from and the three placement tests below are
+# structurally impossible there, not merely failing.
+_KINDS = shlib.offload_memory_kinds()
+needs_memory_tiers = pytest.mark.skipif(
+    _KINDS is None,
+    reason=(
+        "backend exposes no separate host/device memory tiers "
+        "(CPU emulation addresses only unpinned_host — nothing to offload from)"
+    ),
+)
+
+
+@needs_memory_tiers
 def test_offload_tree_shardings_kinds():
+    host_kind, device_kind = _KINDS
     tree = {"m": jnp.ones((8,)), "v": jnp.ones((8,))}
     host, dev = shlib.offload_tree_shardings(tree)
-    assert all(s.memory_kind == "pinned_host" for s in jax.tree_util.tree_leaves(host))
-    assert all(s.memory_kind == "device" for s in jax.tree_util.tree_leaves(dev))
+    assert all(s.memory_kind == host_kind for s in jax.tree_util.tree_leaves(host))
+    assert all(s.memory_kind == device_kind for s in jax.tree_util.tree_leaves(dev))
 
 
+@needs_memory_tiers
 def test_offload_to_host_places_pinned():
     tree = {"m": jnp.arange(8.0)}
     out = shlib.offload_to_host(tree)
-    assert out["m"].sharding.memory_kind == "pinned_host"
+    assert out["m"].sharding.memory_kind == shlib.host_memory_kind()
     np.testing.assert_array_equal(np.asarray(out["m"]), np.arange(8.0))
+
+
+def test_offload_without_memory_tiers_raises_clearly():
+    """On a single-tier backend the offload helpers must say WHY instead of
+    surfacing jax's 'Could not find memory addressable' from deep inside."""
+    if _KINDS is not None:
+        pytest.skip("backend has real memory tiers; nothing to assert here")
+    with pytest.raises(RuntimeError, match="memory tiers"):
+        shlib.offload_tree_shardings({"m": jnp.ones((4,))})
 
 
 def test_plugin_sets_offload_intent():
@@ -98,16 +126,38 @@ def test_train_loop_warns_when_offload_configured(monkeypatch):
         acc.prepare_train_loop(lambda p, b: jnp.sum((p["w"] * b["x"]) ** 2), opt)
 
 
-def test_probe_does_not_cache_transient_failures(monkeypatch):
-    calls = []
-
-    def boom(*a, **k):
-        calls.append(1)
-        raise RuntimeError("RESOURCE_EXHAUSTED: transient")
-
+def test_single_tier_backend_is_definitively_unsupported(monkeypatch):
+    """No host/device tier split -> support is False and CACHED (the topology
+    cannot change mid-process; no point re-probing)."""
     monkeypatch.setattr(shlib, "_host_offload_support", None)
+    monkeypatch.setattr(shlib, "offload_memory_kinds", lambda: None)
+    assert shlib.host_offload_supported() is False
+    assert shlib._host_offload_support is False
+
+
+def _arm_fake_tiers(monkeypatch):
+    """Pretend the host/device tiers exist so host_offload_supported reaches
+    its COMPILE probe (on the CPU backend the kind probe short-circuits, and
+    even SingleDeviceSharding(pinned_host) construction raises)."""
     import jax as _jax
 
+    class FakeSharding:
+        def __init__(self, device, memory_kind=None):
+            self.memory_kind = memory_kind
+
+    monkeypatch.setattr(shlib, "offload_memory_kinds", lambda: ("pinned_host", "device"))
+    monkeypatch.setattr(_jax.sharding, "SingleDeviceSharding", FakeSharding)
+    monkeypatch.setattr(_jax, "device_put", lambda x, s=None: x)
+
+
+def test_probe_does_not_cache_transient_failures(monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: transient")
+
+    import jax as _jax
+
+    monkeypatch.setattr(shlib, "_host_offload_support", None)
+    _arm_fake_tiers(monkeypatch)
     monkeypatch.setattr(_jax, "jit", boom)
     assert shlib.host_offload_supported() is False
     assert shlib._host_offload_support is None  # transient -> not cached
@@ -118,6 +168,7 @@ def test_probe_does_not_cache_transient_failures(monkeypatch):
         raise RuntimeError("No registered implementation for untyped custom call to annotate_device_placement")
 
     monkeypatch.setattr(shlib, "_host_offload_support", None)
+    _arm_fake_tiers(monkeypatch)
     monkeypatch.setattr(_jax, "jit", boom2)
     assert shlib.host_offload_supported() is False
     assert shlib._host_offload_support is False

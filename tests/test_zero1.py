@@ -32,7 +32,9 @@ def test_zero1_shards_opt_state_not_params():
     # params replicated (all spec axes None)
     for x in jax.tree_util.tree_leaves(params):
         assert all(ax is None for ax in tuple(x.sharding.spec)), x.sharding
-    # adam moments sharded over dp_replicate on dim 0 (64 and 16 divide 8)
+    # the fused bucketed path engaged on this pure-DP mesh, with the adam
+    # moments (now 1-D buckets) sharded over dp_replicate
+    assert opt.fused_zero1
     specs = _kinds(opt.opt_state)
     assert any("dp_replicate" in s for s in specs), specs
 
@@ -40,10 +42,13 @@ def test_zero1_shards_opt_state_not_params():
 def test_zero1_state_memory_is_split():
     acc = Accelerator(cpu=True, deepspeed_plugin=DeepSpeedPlugin(zero_stage=1))
     params, opt = acc.prepare({"w": jnp.ones((64, 16))}, optax.adam(1e-2))
-    mu = opt.opt_state[0].mu["w"]
-    # each device holds 1/8 of the moment buffer
-    shard = next(iter(mu.addressable_shards))
-    assert shard.data.shape == (8, 16)
+    # fused ZeRO-1 stores adam moments as 1-D buckets; each device holds 1/8
+    mu = opt.opt_state[0].mu
+    assert set(mu) == {"b000"}  # one bucket for this tiny tree
+    bucket = mu["b000"]
+    assert bucket.shape == (64 * 16,)
+    shard = next(iter(bucket.addressable_shards))
+    assert shard.data.shape == (64 * 16 // 8,)
 
 
 def test_zero1_training_matches_unsharded_baseline():

@@ -76,9 +76,13 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
     "fsdp": (
         "FSDP",
         "FSDP is a NamedSharding assignment over the `dp_shard` mesh axis; "
-        "the FSDP1/FSDP2 split collapses under GSPMD.",
+        "the FSDP1/FSDP2 split collapses under GSPMD. Every spec decision "
+        "flows through ONE `make_sharding_plan` entry point (ISSUE 9); the "
+        "fused bucketed ZeRO-1 weight update lives in "
+        "`parallel.weight_update`.",
         [("accelerate_tpu.utils.dataclasses", ["FullyShardedDataParallelPlugin"]),
          ("accelerate_tpu.parallel.sharding", None),
+         ("accelerate_tpu.parallel.weight_update", None),
          ("accelerate_tpu.sharded_checkpoint", None)],
     ),
     "inference": (
