@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt bench-zero1 doctor lint profile
+        bench-input bench-ckpt bench-zero1 doctor lint profile chaos
 
 PYTEST := python -m pytest -q
 
@@ -106,3 +106,10 @@ doctor:
 # -> printed "performance" report section (MFU, roofline, top ops, overlap)
 profile:
 	JAX_PLATFORMS=cpu python benchmarks/perf/run.py
+
+# chaos e2e (resilience/chaos.py): fault-free reference run, then the same
+# toy training run supervised under a seeded SIGKILL schedule — the
+# supervisor must auto-resume from the last committed checkpoint and finish
+# with BITWISE-identical final params. CPU-only, tier-1-safe.
+chaos:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.resilience.chaos

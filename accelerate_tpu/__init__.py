@@ -33,6 +33,7 @@ __all__ = [
     "AutocastKwargs",
     "CheckpointConfig",
     "CheckpointCorruptError",
+    "CheckpointTopologyError",
     "DDPCommunicationHookType",
     "DeepSpeedPlugin",
     "DispatchedParams",
@@ -136,6 +137,10 @@ def __getattr__(name):
         from .checkpointing import CheckpointCorruptError
 
         return CheckpointCorruptError
+    if name == "CheckpointTopologyError":
+        from .checkpointing import CheckpointTopologyError
+
+        return CheckpointTopologyError
     if name == "synchronize_rng_states":
         from .utils.random import synchronize_rng_states
 

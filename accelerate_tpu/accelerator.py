@@ -489,6 +489,27 @@ class Accelerator:
                 out_dir=flight_dir,
             )
             self._watchdog_started = True
+        # Chaos harness (resilience/chaos.py): a seeded fault schedule in
+        # ACCELERATE_CHAOS_SCHEDULE arms deterministic SIGKILL/hang/straggler
+        # injection for chaos tests; unset, this is one env lookup ever and a
+        # None-check per injection site.
+        from .resilience import chaos as _chaos
+
+        _chaos.maybe_arm_from_env()
+        # Elastic cohort membership: under a supervised run (restart
+        # generation set, or a roster dir published) announce ourselves so the
+        # supervisor's roster reflects who actually came up.
+        if os.environ.get("ACCELERATE_RESTART_GENERATION", "").strip():
+            from .resilience import membership as _membership
+
+            roster = os.environ.get("ACCELERATE_COHORT_DIR", "").strip()
+            if not roster and flight_dir:
+                roster = os.path.join(flight_dir, "cohort")
+            if roster:
+                try:
+                    _membership.announce_membership(roster)
+                except OSError:
+                    pass  # announcement is advisory; training proceeds
         if rng_seed is not None:
             from .utils.random import set_seed
 
@@ -1062,6 +1083,7 @@ class Accelerator:
         # so only track when unambiguous (callers with multiple models pass
         # params/opt_state to save_state explicitly)
         model_slot = 0 if len(self._models) == 1 else None
+        from .resilience import chaos as _chaos
         from .telemetry import events as _tel
         from .telemetry import flight_recorder as _flight
         from .telemetry import perf as _perf
@@ -1083,6 +1105,7 @@ class Accelerator:
             step_index = step_telemetry.step_index
             flight.step = step_index
             _watchdog.beat("train_step", step=step_index)
+            _chaos.maybe_inject("train_step", step=step_index)
             if trace_windows is not None:
                 trace_windows.on_step_start(step_index)
             try:
@@ -1617,9 +1640,43 @@ class Accelerator:
         if self._checkpoint_manager is not None:
             self._checkpoint_manager.drain(timeout=timeout)
 
+    @property
+    def resume_from_checkpoint(self) -> Optional[str]:
+        """The checkpoint the launcher asked this incarnation to resume from:
+        ``ACCELERATE_RESUME_FROM_CHECKPOINT`` — ``"latest"`` (set by the
+        elastic supervisor and ``launch --max_restarts``) or an explicit
+        directory. None when no resume was requested. Training scripts gate
+        their ``load_state`` call on this::
+
+            if accelerator.resume_from_checkpoint:
+                params, opt_state = accelerator.load_state(
+                    accelerator.resume_from_checkpoint, params=params,
+                    opt_state=opt_state)
+        """
+        raw = os.environ.get("ACCELERATE_RESUME_FROM_CHECKPOINT", "").strip()
+        return raw or None
+
+    @property
+    def restart_generation(self) -> int:
+        """How many times the elastic supervisor has restarted this cohort
+        (0 = first incarnation; see ``resilience/membership.py``)."""
+        from .resilience.membership import current_generation
+
+        return current_generation()
+
     def load_state(self, input_dir: Optional[str] = None, params=None, opt_state=None, **kwargs):
+        """Restore a checkpoint (reference ``load_state:3617``).
+
+        ``input_dir=None`` or ``"latest"`` picks the newest *committed*
+        ``checkpoint_<i>`` under the project dir. Extra kwargs flow to
+        ``checkpointing.load_accelerator_state`` — notably ``elastic=True``
+        for a cross-topology resume (defaulted from
+        ``ACCELERATE_ELASTIC_RESUME`` under a supervised elastic relaunch).
+        """
         from .checkpointing import load_accelerator_state
 
+        if input_dir == "latest":
+            input_dir = None
         # an in-flight async save may be writing the very dir being loaded
         self.wait_for_checkpoint()
         return load_accelerator_state(

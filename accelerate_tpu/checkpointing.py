@@ -29,7 +29,11 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from .logging import get_logger
-from .sharded_checkpoint import CheckpointCorruptError  # noqa: F401  (public re-export)
+from .sharded_checkpoint import (  # noqa: F401  (public re-exports)
+    CheckpointCorruptError,
+    CheckpointTopologyError,
+    resize_padded_bucket,
+)
 
 logger = get_logger(__name__)
 
@@ -125,9 +129,14 @@ def flatten_pytree(tree, copy: bool = False) -> dict[str, np.ndarray]:
     return flat
 
 
-def unflatten_into(template, flat: dict[str, np.ndarray]):
+def unflatten_into(template, flat: dict[str, np.ndarray], elastic: bool = False):
     """Restore values from ``flat`` into the structure of ``template``, preserving
-    each live leaf's sharding/dtype placement."""
+    each live leaf's sharding/dtype placement.
+
+    ``elastic=True`` re-pads 1-D leaves whose saved length differs from the
+    template's — the fused-ZeRO-1 bucket case, whose padded length depends on
+    the replicate width (:func:`resize_padded_bucket`); any other mismatch
+    still fails in the placement below."""
     import jax
 
     def _restore(path, leaf):
@@ -138,6 +147,13 @@ def unflatten_into(template, flat: dict[str, np.ndarray]):
         if key not in flat:
             raise KeyError(f"checkpoint missing key {key!r}")
         value = flat[key]
+        if (
+            elastic
+            and getattr(value, "ndim", None) == 1
+            and getattr(leaf, "ndim", None) == 1
+            and value.shape[0] != leaf.shape[0]
+        ):
+            value = resize_padded_bucket(np.asarray(value), int(leaf.shape[0]), key)
         if isinstance(leaf, jax.Array):
             return jax.device_put(value.astype(leaf.dtype), leaf.sharding)
         return np.asarray(value, dtype=getattr(leaf, "dtype", None))
@@ -308,6 +324,7 @@ class CheckpointSnapshot:
     nbytes: int = 0
     blocking: bool = True  # telemetry: writer time is hidden when False
     snapshot_s: float = 0.0
+    mesh_shape: Optional[dict] = None  # writing mesh axis→size (topology guard)
 
     @property
     def staging_dir(self) -> str:
@@ -476,6 +493,13 @@ def snapshot_accelerator_state(
             nbytes += sum(a.nbytes for a in art.payload.values())
         else:
             nbytes += len(art.payload)
+    mesh_shape = None
+    try:
+        from .resilience.reshard import mesh_shape_dict
+
+        mesh_shape = mesh_shape_dict(getattr(accelerator, "mesh", None))
+    except Exception:
+        pass  # meshless accelerators (tests with bare state) still save
     snap = CheckpointSnapshot(
         final_dir=output_dir,
         artifacts=artifacts,
@@ -490,6 +514,7 @@ def snapshot_accelerator_state(
         nbytes=nbytes,
         blocking=blocking,
         snapshot_s=time.monotonic() - t0,
+        mesh_shape=mesh_shape,
     )
     _tel.emit(
         "checkpoint",
@@ -642,6 +667,7 @@ def commit_snapshot(
         "iteration": snap.iteration,
         "num_processes": snap.num_processes,
         "sharded": snap.sharded,
+        "mesh": snap.mesh_shape,
         "total_bytes": snap.nbytes,
         "committed_at_unix": round(time.time(), 3),
         "files": merged_files,
@@ -837,13 +863,26 @@ def load_accelerator_state(
     params=None,
     opt_state=None,
     load_kwargs: Optional[dict] = None,
+    elastic: Optional[bool] = None,
 ):
     """Mirror of :func:`save_accelerator_state` (reference
     ``load_accelerator_state:180``). Returns restored params (pytree or list);
     with ``opt_state`` given as a live template, returns
-    ``(params, opt_state)`` so functional loops can rethread both."""
+    ``(params, opt_state)`` so functional loops can rethread both.
+
+    Topology guard: the saved mesh shape (``_COMMITTED`` manifest / shard
+    indices) is compared against the live mesh. A mismatch raises
+    :class:`CheckpointTopologyError` naming both shapes — unless ``elastic``
+    is truthy (default: the ``ACCELERATE_ELASTIC_RESUME`` env flag, set by
+    the elastic supervisor), in which case the load re-shards: coordinates
+    re-chunk for free and fused-ZeRO-1 buckets are re-padded for the new
+    replicate width (see ``resilience/reshard.py``)."""
     from .utils.random import restore_rng_states
 
+    if elastic is None:
+        from .utils.environment import parse_flag_from_env
+
+        elastic = parse_flag_from_env("ACCELERATE_ELASTIC_RESUME")
     if input_dir is None:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
         input_dir = find_latest_checkpoint(base)
@@ -859,6 +898,24 @@ def load_accelerator_state(
             )
     _validate_manifest(input_dir)
 
+    # topology guard: a dp=N checkpoint loaded onto a dp=M mesh either
+    # re-shards (elastic) or fails HERE with both shapes named — not deep in
+    # jax with a bare shape error
+    from .resilience.reshard import check_topology, mesh_shape_dict, saved_topology
+
+    saved_mesh = saved_topology(input_dir)
+    current_mesh = mesh_shape_dict(getattr(accelerator, "mesh", None))
+    resharding = check_topology(saved_mesh, current_mesh, elastic=bool(elastic))
+    if resharding:
+        logger.warning(
+            f"elastic resume: re-sharding checkpoint {input_dir} "
+            f"({saved_mesh} -> {current_mesh})"
+        )
+        from .telemetry import events as _tel
+
+        _tel.emit("elastic", phase="reshard", dir=input_dir,
+                  saved_mesh=saved_mesh, current_mesh=current_mesh)
+
     # user pre-hooks see the RESOLVED directory (after latest-checkpoint
     # discovery), reference register_load_state_pre_hook contract (:3664)
     for hook in getattr(accelerator, "_load_state_pre_hooks", {}).values():
@@ -870,9 +927,9 @@ def load_accelerator_state(
         """Dispatch npz vs sharded format; returns None if neither exists."""
         npz_path = os.path.join(input_dir, f"{prefix}.npz")
         if os.path.exists(npz_path):
-            return unflatten_into(template, load_flat(npz_path))
+            return unflatten_into(template, load_flat(npz_path), elastic=resharding)
         if is_sharded_checkpoint(input_dir, prefix):
-            return load_sharded_pytree(template, input_dir, prefix)
+            return load_sharded_pytree(template, input_dir, prefix, elastic=resharding)
         return None
 
     models = [params] if params is not None else accelerator._models

@@ -43,7 +43,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     p.add_argument("--mixed_precision", default=None,
                    choices=("no", "bf16", "fp16", "fp8"))
     p.add_argument("--gradient_accumulation_steps", type=int, default=None)
-    p.add_argument("--max_restarts", type=int, default=0,
+    p.add_argument("--max_restarts", type=int, default=None,
                    help="Elastic supervision: relaunch the script up to N times on "
                         "nonzero exit (reference: torchrun --max_restarts passthrough, "
                         "commands/launch.py:998-1031). Restarted runs see "
@@ -51,6 +51,20 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         "so they can load_state() and continue.")
     p.add_argument("--monitor_interval", type=float, default=5.0,
                    help="Seconds to wait between a failure and the relaunch")
+    p.add_argument("--elastic", action="store_true",
+                   help="Full elastic supervision (resilience/supervisor.py): watch "
+                        "exit codes (101 = watchdog stall abort), heartbeat-file gaps "
+                        "and flight dumps; auto-resume the cohort from the last "
+                        "committed checkpoint with bounded exponential backoff under "
+                        "the --max_restarts budget (default 3 when --elastic); "
+                        "repeated crashes at the same step stop with a poison-step "
+                        "diagnosis. Arms the watchdog (ACCELERATE_WATCHDOG_ABORT) and "
+                        "sets ACCELERATE_ELASTIC_RESUME so a cross-topology resume "
+                        "re-shards instead of erroring.")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="With --elastic: restart the cohort when a rank's heartbeat "
+                        "file (touched by its watchdog every tick) goes stale for "
+                        "this many seconds. 0 disables the file watch.")
     p.add_argument("--debug", action="store_true",
                    help="ACCELERATE_DEBUG_MODE: verify collective shapes across processes")
     # DeepSpeed-style flags (reference utils/launch.py:557-577 env protocol;
@@ -219,7 +233,7 @@ def simple_launcher(args, cfg: ClusterConfig) -> int:
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (pkg_parent, env.get("PYTHONPATH")) if p
     )
-    max_restarts = max(0, getattr(args, "max_restarts", 0))
+    max_restarts = max(0, getattr(args, "max_restarts", None) or 0)
     monitor_interval = max(0.0, getattr(args, "monitor_interval", 5.0))
     rc = 1
     for attempt in range(max_restarts + 1):
@@ -305,7 +319,7 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     # incarnation to rendezvous)
     import time
 
-    max_restarts = max(0, getattr(args, "max_restarts", 0))
+    max_restarts = max(0, getattr(args, "max_restarts", None) or 0)
     monitor_interval = max(0.0, getattr(args, "monitor_interval", 5.0))
     rc = 1
     base_remote = cmd[-1] if cmd[-1].startswith("--command=") else None
@@ -331,10 +345,64 @@ def tpu_pod_launcher(args, cfg: ClusterConfig) -> int:
     return rc
 
 
+def elastic_launcher(args, cfg: ClusterConfig) -> int:
+    """``accelerate-tpu launch --elastic``: the per-host spawn wrapped in the
+    resilience supervisor (``resilience/supervisor.py``) — exit-code
+    classification, heartbeat-file gap watch, bounded-backoff auto-resume
+    from the last committed checkpoint, poison-step diagnosis, and restart
+    telemetry for the report CLI's "restarts" section."""
+    import time
+
+    from ..resilience.supervisor import RestartPolicy, supervise_command
+
+    env = {**os.environ, **build_launch_env(cfg), **deepspeed_env(args)}
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_parent, env.get("PYTHONPATH")) if p
+    )
+    # one run id across incarnations so telemetry streams merge into one story
+    env.setdefault("ACCELERATE_RUN_ID", f"elastic-{int(time.time())}-{os.getpid()}")
+    # a stalled rank must turn into a restartable exit: arm the watchdog with
+    # the abort path unless the operator configured it explicitly
+    env.setdefault("ACCELERATE_WATCHDOG_TIMEOUT", "300")
+    env.setdefault("ACCELERATE_WATCHDOG_ABORT", "1")
+    telemetry_dir = env.setdefault("ACCELERATE_TELEMETRY_DIR", "telemetry")
+    axis_sizes = {
+        axis: int(getattr(cfg, f"{axis}_size") or 1)
+        for axis in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp")
+    }
+    axis_sizes = {a: s for a, s in axis_sizes.items() if s > 1}
+    policy = RestartPolicy(
+        # None = unset -> elastic default 3; an EXPLICIT 0 means "supervise,
+        # classify, but never auto-restart" and must be honored
+        max_restarts=3 if args.max_restarts is None else max(0, args.max_restarts),
+        backoff_base_s=max(0.0, args.monitor_interval),
+        heartbeat_timeout_s=max(0.0, getattr(args, "heartbeat_timeout", 0.0)),
+    )
+    return supervise_command(
+        _script_cmd(args), env=env, policy=policy,
+        telemetry_dir=telemetry_dir, axis_sizes=axis_sizes or None,
+    )
+
+
 def launch_command(args) -> int:
     cfg = _merge_config(args)
     if args.tpu_pod:
+        if getattr(args, "elastic", False):
+            # pod fan-out keeps its own whole-pod restart loop; the full
+            # supervisor (exit classification, heartbeat watch, poison-step
+            # diagnosis) does not apply through gcloud ssh — say so instead
+            # of silently downgrading
+            print(
+                "[accelerate-tpu launch] --elastic is not supported with "
+                "--tpu_pod; using the pod-level re-fan-out loop "
+                "(--max_restarts) instead. Run --elastic per-host inside the "
+                "pod for full supervision.",
+                file=sys.stderr,
+            )
         return tpu_pod_launcher(args, cfg)
+    if getattr(args, "elastic", False):
+        return elastic_launcher(args, cfg)
     return simple_launcher(args, cfg)
 
 

@@ -38,6 +38,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from .parallelism_config import ParallelismConfig
+from .resilience.chaos import maybe_inject as _chaos_inject
 from .state import GradientState, PartialState
 from .telemetry import events as _tel
 from .telemetry import flight_recorder as _flight
@@ -876,6 +877,7 @@ class DataLoaderShard:
                 n = 0
                 while current is not _NO_BATCH and not stop.is_set():
                     _watchdog.beat(wd_source, batch=n)
+                    _chaos_inject("prefetch")
                     nxt = self._timed_fetch(base_iter, critical=False, totals=totals)
                     nxt_snap = _snap() if nxt is not _NO_BATCH else None
                     if n >= skip:

@@ -389,24 +389,37 @@ class ShardingPlan:
         return offload_tree_shardings(tree, mesh=self.mesh)
 
     # ------------------------------------------------------------ checkpoints --
-    def sharding_from_saved_spec(self, spec_json):
+    def sharding_from_saved_spec(self, spec_json, drop_unknown_axes: bool = False):
         """NamedSharding for a spec recorded in a sharded-checkpoint index
         (``sharded_checkpoint._spec_to_json`` format: a list of axis names,
         axis-name lists, or None per dim; or None for replicated). Lets a
-        resume restore onto this plan's mesh without live template arrays."""
+        resume restore onto this plan's mesh without live template arrays.
+
+        ``drop_unknown_axes=True`` (the elastic cross-topology path) treats
+        axis names this plan's mesh does not have as replication instead of
+        keeping them for a loud ``device_put`` failure — a checkpoint written
+        on a richer mesh factorization restores replicated over the missing
+        axes and re-chunks over the surviving ones."""
         from jax.sharding import PartitionSpec
 
         if spec_json is None:
             return self.named_sharding(PartitionSpec())
+        axis_sizes = dict(self.mesh.shape)
         dims = []
         for axis in spec_json:
             if axis is None:
                 dims.append(None)
             elif isinstance(axis, (list, tuple)):
-                dims.append(tuple(axis))
+                axes = tuple(str(a) for a in axis)
+                if drop_unknown_axes:
+                    axes = tuple(a for a in axes if a in axis_sizes)
+                dims.append(axes if axes else None)
             else:
-                dims.append(str(axis))
-        return self.named_sharding(canonicalize_spec(dims, dict(self.mesh.shape)))
+                name = str(axis)
+                if drop_unknown_axes and name not in axis_sizes:
+                    name = None
+                dims.append(name)
+        return self.named_sharding(canonicalize_spec(dims, axis_sizes))
 
     # -------------------------------------------------------------- telemetry --
     def zero1_collective_bytes(self) -> "Optional[dict[str, int]]":

@@ -1,0 +1,79 @@
+"""Elastic, preemption-tolerant training (ROADMAP item 5).
+
+PR 4 made failures diagnosable (watchdog, flight recorder, exit-101 abort)
+and PR 5 made them survivable (crash-consistent ``_COMMITTED`` checkpoints).
+This package makes them *routine*: a supervised cohort that detects a dead or
+stalled rank, tears the job down, and regrows it — possibly on fewer hosts —
+resuming from the last committed checkpoint without a human in the loop.
+
+- :mod:`.supervisor` — the ``accelerate-tpu launch --elastic`` loop: exit-code
+  classification (101 = stall abort, signals = preemption), heartbeat-file
+  gap detection, bounded-backoff restarts under a budget, poison-step
+  diagnosis, restart telemetry.
+- :mod:`.membership` — restart generations and the cohort roster handshake:
+  who survived, how the world renumbers, how ``dp_replicate`` rescales.
+- :mod:`.reshard` — cross-topology resume: mesh-shape guards
+  (``CheckpointTopologyError``) and fused-ZeRO-1 bucket re-padding so a dp=N
+  checkpoint restores onto dp=M.
+- :mod:`.chaos` — the deterministic fault-injection harness (``make chaos``)
+  that proves all of the above under seeded SIGKILL/hang/straggler schedules,
+  plus the straggler-mitigation replanner.
+
+See ``docs/resilience.md``.
+"""
+
+from .chaos import (
+    ChaosFaultError,
+    ChaosSchedule,
+    Fault,
+    maybe_arm_from_env,
+    maybe_inject,
+    replan_data_assignment,
+)
+from .membership import (
+    CohortSpec,
+    MembershipError,
+    announce_membership,
+    current_generation,
+    load_cohort_spec,
+    negotiate_membership,
+)
+from .reshard import (
+    CheckpointTopologyError,
+    check_topology,
+    is_elastic_compatible,
+    mesh_shape_dict,
+    saved_topology,
+    topology_matches,
+)
+from .supervisor import (
+    RestartPolicy,
+    Supervisor,
+    classify_exit,
+    supervise_command,
+)
+
+__all__ = [
+    "ChaosFaultError",
+    "ChaosSchedule",
+    "CheckpointTopologyError",
+    "CohortSpec",
+    "Fault",
+    "MembershipError",
+    "RestartPolicy",
+    "Supervisor",
+    "announce_membership",
+    "check_topology",
+    "classify_exit",
+    "current_generation",
+    "is_elastic_compatible",
+    "load_cohort_spec",
+    "maybe_arm_from_env",
+    "maybe_inject",
+    "mesh_shape_dict",
+    "negotiate_membership",
+    "replan_data_assignment",
+    "saved_topology",
+    "supervise_command",
+    "topology_matches",
+]

@@ -1,0 +1,126 @@
+"""Deterministic toy training run — the chaos harness's workload.
+
+``python -m accelerate_tpu.resilience._toy_train --project-dir D --steps N``
+trains a tiny least-squares model through the REAL stack (Accelerator,
+prepared DataLoader, jitted train step, committed checkpoints every
+``--save-every`` steps) with fully deterministic batches: batch ``i`` is a
+pure function of ``i``, so a run that is killed at step ``s`` and resumed
+from the step-``k`` checkpoint replays batches ``k..N`` and finishes with
+params BITWISE-identical to an uninterrupted run. That property is the chaos
+e2e's oracle (``make chaos``, ``tests/test_resilience.py``).
+
+Resume protocol: when the supervisor set ``ACCELERATE_RESUME_FROM_CHECKPOINT``
+(``Accelerator.resume_from_checkpoint``), the script restores params,
+optimizer state and the dataloader snapshot from the newest committed
+checkpoint — consumed batches are skipped by the restored loader state, not
+by any step arithmetic here. A first incarnation (or a crash before the first
+commit) starts cold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="toy_train")
+    parser.add_argument("--project-dir", required=True)
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--save-every", type=int, default=3)
+    parser.add_argument("--global-batch", type=int, default=32,
+                        help="GLOBAL batch size (the prepared loader's "
+                             "per-call batch is global/num_devices), so the "
+                             "batch stream is identical across topologies — "
+                             "the property cross-topology parity rests on")
+    parser.add_argument("--zero-stage", type=int, default=0,
+                        help="1 = shard optimizer state over dp_replicate "
+                             "(fused ZeRO-1) — the state whose buckets the "
+                             "cross-topology resume must re-pad")
+    parser.add_argument("--out", default=None,
+                        help="Where to write the final params npz "
+                             "(default <project-dir>/final_params.npz)")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    steps = args.steps
+
+    class DeterministicDS:
+        """item i -> a pure function of i (restart- and topology-invariant)."""
+
+        def __len__(self):
+            return steps * args.global_batch
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(1000 + i)
+            return {"x": rng.normal(size=(16,)).astype(np.float32)}
+
+    from accelerate_tpu import DeepSpeedPlugin
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir, automatic_checkpoint_naming=True
+        ),
+        deepspeed_plugin=(
+            DeepSpeedPlugin(zero_stage=1) if args.zero_stage == 1 else None
+        ),
+    )
+    bs = max(1, args.global_batch // acc.partial_state.num_devices)
+    params = {"w": jnp.zeros((16, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    params, opt = acc.prepare(params, optax.adam(1e-2))
+    dl = acc.prepare(DataLoader(DeterministicDS(), batch_size=bs, shuffle=False))
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] + p["b"]) ** 2) + 1e-3 * jnp.mean(
+            batch["x"]
+        )
+
+    step = acc.prepare_train_step(loss_fn, opt)
+    opt_state = opt.opt_state
+
+    resumed_from = None
+    if acc.resume_from_checkpoint:
+        try:
+            params, opt_state = acc.load_state(
+                acc.resume_from_checkpoint, params=params, opt_state=opt_state
+            )
+            resumed_from = acc.project_configuration.iteration - 1
+        except FileNotFoundError:
+            pass  # died before the first commit: start cold
+
+    ran = 0
+    metrics = {"loss": float("nan")}  # a resumed run may have nothing left to do
+    for batch in dl:
+        params, opt_state, metrics = step(params, opt_state, batch)
+        ran += 1
+        if args.save_every > 0 and ran % args.save_every == 0:
+            acc.save_state(params=params, opt_state=opt_state)
+
+    out = args.out or os.path.join(args.project_dir, "final_params.npz")
+    np.savez(out, **{k: np.asarray(v) for k, v in params.items()})
+    acc.end_training()
+    print(json.dumps({
+        "final_params": out,
+        "batches_run_this_incarnation": ran,
+        "generation": acc.restart_generation,
+        "resumed_from_iteration": resumed_from,
+        "loss": float(metrics["loss"]),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

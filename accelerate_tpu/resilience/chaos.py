@@ -1,0 +1,402 @@
+"""Deterministic fault injection: the chaos harness that proves the elastic
+supervisor actually rides through failures.
+
+A resilience layer that has never seen a failure is a hypothesis, not a
+feature. This module turns "a host got preempted mid-epoch" into a seeded,
+replayable schedule so the same SIGKILL lands at the same step in every run
+of the chaos e2e (``make chaos``, ``tests/test_resilience.py``):
+
+- :class:`ChaosSchedule` — an ordered list of :class:`Fault` entries
+  ``(point, step, rank, kind, duration_s)``; built programmatically, parsed
+  from JSON, or generated from a seed (:meth:`ChaosSchedule.seeded` — same
+  seed, same faults, forever).
+- :func:`maybe_inject` — the in-process hook, wired into the train step
+  (``Accelerator._track_step``), the host collectives
+  (``utils/operations.py``) and the prefetch producer (``data_loader.py``).
+  It is a single ``is None`` check unless ``ACCELERATE_CHAOS_SCHEDULE`` armed
+  a schedule for this process, so production hot paths pay nothing.
+
+Fault kinds model the real pod failure modes the forensics layer (PR 4) keeps
+autopsying:
+
+``sigkill``
+    Preemption: the process dies instantly, no handlers run — exactly what a
+    maintenance event does to a TPU-VM host.
+``sigterm``
+    Polite eviction: SIGTERM triggers the flight-recorder crash dump first.
+``hang``
+    A rank wedges inside a collective/step for ``duration_s`` (or forever
+    with ``duration_s=None``): the watchdog's blocked-phase detection and the
+    supervisor's heartbeat-file gap watch are the intended catchers.
+``slow``
+    A persistent straggler: every matching injection sleeps ``duration_s``,
+    degrading one host without killing it (feeds the straggler-mitigation
+    replanner, :func:`replan_data_assignment`).
+``crash``
+    A plain Python exception (``ChaosFaultError``) — the generic "training
+    code blew up" case.
+
+Faults match on injection *point* (``train_step`` / ``collective`` /
+``prefetch`` / ``any``), *step* (``None`` = any step), *rank* (``None`` =
+every rank; rank resolution uses ``state.process_identity()`` so it works
+before jax init), and *generation* (``None`` = any restart generation —
+pinning a fault to generation 0 is how a test kills the first incarnation but
+lets the resumed one finish).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+CHAOS_ENV_VAR = "ACCELERATE_CHAOS_SCHEDULE"
+
+FAULT_KINDS = ("sigkill", "sigterm", "hang", "slow", "crash")
+POINTS = ("train_step", "collective", "prefetch", "any")
+
+
+class ChaosFaultError(RuntimeError):
+    """Raised by a ``crash`` fault — the injected stand-in for arbitrary
+    training-code failure."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. ``step``/``rank``/``generation`` of ``None`` match
+    anything; ``point`` of ``"any"`` matches every injection site."""
+
+    kind: str
+    point: str = "any"
+    step: Optional[int] = None
+    rank: Optional[int] = None
+    generation: Optional[int] = None
+    duration_s: Optional[float] = 0.05
+    once: bool = True  # fire at most once per process (slow faults set False)
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (know {FAULT_KINDS})")
+        if self.point not in POINTS:
+            raise ValueError(f"unknown injection point {self.point!r} (know {POINTS})")
+
+    def matches(self, point: str, step: Optional[int], rank: int, generation: int) -> bool:
+        if self.point != "any" and self.point != point:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.generation is not None and generation != self.generation:
+            return False
+        return True
+
+
+@dataclass
+class ChaosSchedule:
+    """A deterministic, serializable fault schedule for one chaos run."""
+
+    faults: "list[Fault]" = field(default_factory=list)
+    seed: Optional[int] = None
+
+    # ------------------------------------------------------------ construction --
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        steps: int,
+        kinds: "tuple[str, ...]" = ("sigkill", "hang"),
+        n_faults: int = 2,
+        ranks: int = 1,
+        generation: Optional[int] = 0,
+    ) -> "ChaosSchedule":
+        """Generate ``n_faults`` faults at distinct steps in ``[1, steps)``,
+        deterministically from ``seed`` (a private ``random.Random`` — never
+        the global RNG, which training code may reseed). Faults default to
+        generation 0 so the restarted incarnation runs fault-free."""
+        rng = random.Random(seed)
+        candidates = list(range(1, max(2, steps)))
+        rng.shuffle(candidates)
+        faults = []
+        for i in range(n_faults):
+            kind = kinds[i % len(kinds)]
+            # a seeded hang must actually wedge the rank (only the watchdog /
+            # heartbeat watch may end it) — a finite sleep would pass the
+            # chaos assertion vacuously; slow faults degrade persistently
+            duration = None if kind == "hang" else (2.0 if kind == "slow" else 0.0)
+            faults.append(
+                Fault(
+                    kind=kind,
+                    point="train_step",
+                    step=candidates[i % len(candidates)],
+                    rank=rng.randrange(ranks) if ranks > 1 else None,
+                    generation=generation,
+                    duration_s=duration,
+                    once=kind != "slow",
+                )
+            )
+        faults.sort(key=lambda f: (f.step if f.step is not None else -1))
+        return cls(faults=faults, seed=seed)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "ChaosSchedule":
+        """Parse ``{"seed": ..., "faults": [{...}, ...]}`` (or a bare fault
+        list). ``@/path/to/file.json`` indirects through a file — schedules
+        that pin many steps get long, and env values do not."""
+        if payload.startswith("@"):
+            with open(payload[1:]) as f:
+                payload = f.read()
+        data = json.loads(payload)
+        if isinstance(data, list):
+            data = {"faults": data}
+        return cls(
+            faults=[Fault(**f) for f in data.get("faults", [])],
+            seed=data.get("seed"),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed, "faults": [asdict(f) for f in self.faults]})
+
+    # ----------------------------------------------------------------- matching --
+    def pending(self, point: str, step: Optional[int], rank: int, generation: int,
+                fired: "set[int]") -> "list[tuple[int, Fault]]":
+        return [
+            (i, f)
+            for i, f in enumerate(self.faults)
+            if (not f.once or i not in fired) and f.matches(point, step, rank, generation)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# process-level injection hook
+
+_SCHEDULE: Optional[ChaosSchedule] = None
+_FIRED: "set[int]" = set()
+_ARMED_FROM_ENV = False
+
+
+def arm(schedule: Optional[ChaosSchedule]) -> None:
+    """Install ``schedule`` for this process (tests / __main__ drivers);
+    ``None`` disarms."""
+    global _SCHEDULE, _FIRED
+    _SCHEDULE = schedule
+    _FIRED = set()
+
+
+def maybe_arm_from_env() -> Optional[ChaosSchedule]:
+    """Arm from ``ACCELERATE_CHAOS_SCHEDULE`` once per process. A malformed
+    schedule raises immediately — silently training without the faults a
+    chaos test asked for would turn every chaos assertion vacuous."""
+    global _ARMED_FROM_ENV
+    if _SCHEDULE is not None or _ARMED_FROM_ENV:
+        return _SCHEDULE
+    _ARMED_FROM_ENV = True
+    payload = os.environ.get(CHAOS_ENV_VAR, "").strip()
+    if not payload:
+        return None
+    arm(ChaosSchedule.from_json(payload))
+    return _SCHEDULE
+
+
+def is_armed() -> bool:
+    return _SCHEDULE is not None
+
+
+def _identity() -> "tuple[int, int]":
+    from ..state import process_identity
+    from .membership import current_generation
+
+    ident = process_identity()
+    return int(ident.get("process_index", 0)), current_generation()
+
+
+def maybe_inject(point: str, step: Optional[int] = None) -> None:
+    """Fire any scheduled fault matching this (point, step, rank, generation).
+
+    The wired-in call sites pass their natural coordinates: the train step its
+    step index, collectives and the prefetch producer just their point (step
+    matching then uses the flight recorder's current step, which the
+    accelerator keeps fresh). Disabled cost: one ``is None`` check.
+    """
+    if _SCHEDULE is None:
+        return
+    rank, generation = _identity()
+    if step is None:
+        from ..telemetry import flight_recorder as _flight
+
+        step = _flight.get_recorder().step
+    hits = _SCHEDULE.pending(point, step, rank, generation, _FIRED)
+    for idx, fault in hits:
+        if fault.once:
+            _FIRED.add(idx)
+        _execute(fault, point, step)
+
+
+def _execute(fault: Fault, point: str, step: Optional[int]) -> None:
+    from ..logging import get_logger
+    from ..telemetry import events as _tel
+    from ..telemetry import flight_recorder as _flight
+
+    desc = f"chaos: injecting {fault.kind} at point={point} step={step}"
+    get_logger(__name__).warning(desc)
+    _tel.emit("chaos_fault", fault=fault.kind, point=point, step=step)
+    _flight.record("chaos_fault", fault=fault.kind, point=point, step=step)
+    if fault.kind == "sigkill":
+        _tel.hard_flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.kind == "sigterm":
+        _tel.hard_flush()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # SIGTERM is asynchronous; the flight recorder's handler re-raises as
+        # an exit — give it a beat rather than racing on
+        time.sleep(30.0)
+    elif fault.kind == "hang":
+        with _flight.phase(f"chaos:hang@{point}"):
+            time.sleep(1e9 if fault.duration_s is None else fault.duration_s)
+    elif fault.kind == "slow":
+        time.sleep(fault.duration_s or 0.05)
+    elif fault.kind == "crash":
+        raise ChaosFaultError(desc)
+
+
+# ---------------------------------------------------------------------------
+# straggler mitigation: turn PR 4's --by-rank skew data into a data replan
+
+def replan_data_assignment(
+    rank_step_seconds: "dict[int, float]",
+    slow_factor: float = 1.5,
+) -> "dict[str, Any]":
+    """Decide a mitigation for a persistently slow host.
+
+    ``rank_step_seconds`` maps rank → mean step seconds (the report CLI's
+    ``--by-rank`` skew table, or ``report["ranks"]["per_rank_step_s"]``).
+    A rank whose mean exceeds ``slow_factor`` × the median is a straggler;
+    the replan assigns it proportionally less data (weights normalized so a
+    healthy cohort is all-1.0) and names it for exclusion if the supervisor
+    is about to regrow the cohort anyway.
+
+    Returns ``{"weights": {rank: w}, "stragglers": [rank, ...],
+    "exclude": [rank, ...]}`` — ``exclude`` lists ranks slower than
+    2×``slow_factor`` (bad enough that dropping the host beats feeding it
+    less).
+    """
+    if not rank_step_seconds:
+        return {"weights": {}, "stragglers": [], "exclude": []}
+    times = sorted(rank_step_seconds.values())
+    # LOWER median: with half the cohort degraded, the upper median is already
+    # polluted by the stragglers being measured
+    median = times[(len(times) - 1) // 2]
+    weights: "dict[int, float]" = {}
+    stragglers: "list[int]" = []
+    exclude: "list[int]" = []
+    for rank, t in sorted(rank_step_seconds.items()):
+        if median > 0 and t > slow_factor * median:
+            stragglers.append(rank)
+            weights[rank] = round(max(0.1, median / t), 4)
+            if t > 2 * slow_factor * median:
+                exclude.append(rank)
+        else:
+            weights[rank] = 1.0
+    return {"weights": weights, "stragglers": stragglers, "exclude": exclude}
+
+
+# ---------------------------------------------------------------------------
+# `make chaos`: the seeded end-to-end — a fault-free reference run, then a
+# supervised run under a SIGKILL schedule; final params must match bitwise.
+
+
+def main(argv=None) -> int:
+    import argparse
+    import subprocess
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from .supervisor import RestartPolicy, Supervisor
+
+    parser = argparse.ArgumentParser(prog="python -m accelerate_tpu.resilience.chaos")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--keep-dir", default=None,
+                        help="Run under this dir (kept) instead of a tempdir")
+    args = parser.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, base_env.get("PYTHONPATH")) if p
+    )
+    base_env.pop(CHAOS_ENV_VAR, None)
+
+    def toy_cmd(project_dir: str) -> "list[str]":
+        return [
+            sys.executable, "-m", "accelerate_tpu.resilience._toy_train",
+            "--project-dir", project_dir, "--steps", str(args.steps),
+            "--save-every", "2",
+        ]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = args.keep_dir or tmp
+        os.makedirs(root, exist_ok=True)
+        # 1. fault-free reference
+        ref_dir = os.path.join(root, "reference")
+        os.makedirs(ref_dir, exist_ok=True)
+        ref = subprocess.run(toy_cmd(ref_dir), env=base_env, capture_output=True,
+                             text=True, timeout=600)
+        if ref.returncode != 0:
+            print(f"chaos: reference run failed rc={ref.returncode}\n{ref.stderr[-2000:]}",
+                  file=sys.stderr)
+            return 2
+        # 2. supervised run under a seeded generation-0 SIGKILL schedule
+        chaos_dir = os.path.join(root, "chaos")
+        tel_dir = os.path.join(chaos_dir, "telemetry")
+        os.makedirs(tel_dir, exist_ok=True)
+        schedule = ChaosSchedule.seeded(
+            args.seed, steps=args.steps, kinds=("sigkill",), n_faults=1
+        )
+        env = dict(base_env)
+        env[CHAOS_ENV_VAR] = schedule.to_json()
+        env["ACCELERATE_TELEMETRY_DIR"] = tel_dir
+        sup = Supervisor(
+            [toy_cmd(chaos_dir)],
+            env=env,
+            policy=RestartPolicy(max_restarts=args.max_restarts,
+                                 backoff_base_s=0.2, grace_period_s=2.0),
+            telemetry_dir=tel_dir,
+        )
+        rc = sup.run()
+        verdict: "dict[str, Any]" = {
+            "schedule": json.loads(schedule.to_json()),
+            "supervisor_rc": rc,
+            "restarts": sup.restarts_used,
+            "causes": [i.cause for i in sup.incidents],
+        }
+        match = False
+        if rc == 0:
+            ref_params = dict(np.load(os.path.join(ref_dir, "final_params.npz")))
+            chaos_params = dict(np.load(os.path.join(chaos_dir, "final_params.npz")))
+            match = set(ref_params) == set(chaos_params) and all(
+                np.array_equal(ref_params[k], chaos_params[k]) for k in ref_params
+            )
+        verdict["final_params_bitwise_match"] = match
+        print(json.dumps(verdict))
+        ok = rc == 0 and sup.restarts_used >= 1 and match
+        print(
+            "chaos: PASS — run was SIGKILLed, auto-resumed, and finished with "
+            "bitwise-identical params" if ok
+            else "chaos: FAIL — see verdict above",
+            file=sys.stderr,
+        )
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

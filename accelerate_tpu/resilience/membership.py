@@ -1,0 +1,237 @@
+"""Cohort membership across restarts: generation counters, the roster
+handshake, and the shrink-the-mesh arithmetic for elastic resume.
+
+A restarted job is a NEW cohort: possibly fewer hosts (one stayed preempted),
+possibly renumbered ranks (contiguity is required by ``jax.distributed``).
+This module owns the bookkeeping that makes the regrown cohort coherent
+before any jax code runs:
+
+- **Restart generation** — ``ACCELERATE_RESTART_GENERATION`` counts cohort
+  incarnations (0 = first launch). Every forensic artifact and telemetry
+  stream of a supervised run carries it, chaos faults can pin to it, and the
+  roster files are namespaced by it so a stale generation-0 announcement can
+  never vote in generation 1's rendezvous.
+- **Roster handshake** — each worker :func:`announce_membership` into a
+  shared directory (``member-gen<g>-rank<k>.json``, write-then-rename like
+  the checkpoint commit markers; the same shared-fs assumption the sharded
+  loader already makes). The supervisor reads the roster to learn who is
+  actually alive, writes the authoritative :class:`CohortSpec`
+  (``cohort-gen<g>.json``), and workers :func:`load_cohort_spec` before
+  constructing state — so every rank agrees on the new world size without a
+  collective (which a half-dead cohort could not run).
+- **Shrink arithmetic** — :func:`negotiate_membership` maps "``m`` of ``n``
+  hosts survive" onto the mesh: only ``dp_replicate`` may shrink (model-
+  parallel axes are baked into the checkpointed layout); the data-parallel
+  width scales by ``m/n`` and must stay integral. Anything else raises
+  :class:`MembershipError` with the exact arithmetic that failed — the
+  supervisor then waits for the host to return instead of respawning a
+  cohort that cannot rendezvous.
+
+``state.process_identity()`` (PR 4) stays the identity source: it answers
+from the env protocol without booting jax, so announcements work in the
+window before — or instead of — backend init.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+GENERATION_ENV_VAR = "ACCELERATE_RESTART_GENERATION"
+ELASTIC_RESUME_ENV_VAR = "ACCELERATE_ELASTIC_RESUME"
+_MEMBER_NAME = "member-gen{gen:04d}-rank{rank:05d}.json"
+_COHORT_NAME = "cohort-gen{gen:04d}.json"
+
+
+class MembershipError(RuntimeError):
+    """The surviving host set cannot form a valid cohort (non-integral
+    data-parallel shrink, or a model-parallel axis would have to change)."""
+
+
+def current_generation() -> int:
+    """This process's restart generation (0 outside supervised runs; malformed
+    env degrades to 0 — the identity path must never raise)."""
+    raw = os.environ.get(GENERATION_ENV_VAR, "").strip()
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+@dataclass
+class CohortSpec:
+    """The authoritative description of one cohort incarnation."""
+
+    generation: int
+    num_processes: int
+    members: "list[int]"  # PREVIOUS ranks, in new-rank order (index = new rank)
+    dp_replicate_size: Optional[int] = None  # None = not mesh-managed
+    axis_sizes: "dict[str, int]" = field(default_factory=dict)  # full mesh intent
+
+    def to_env(self, new_rank: Optional[int] = None,
+               include_world: bool = True) -> "dict[str, str]":
+        """The env rewrite a worker of this cohort must see BEFORE state
+        construction: world size, (optionally) its new rank, the shrunken
+        ``dp_replicate``, generation, and the elastic-resume hints.
+
+        ``include_world=False`` keeps the generation/resume hints but leaves
+        ``ACCELERATE_NUM_PROCESSES``/mesh sizes alone — a supervisor managing
+        ONE child (which may itself be a rank of a launcher-configured
+        multi-host job) must not clobber the launcher's world size."""
+        env = {
+            GENERATION_ENV_VAR: str(self.generation),
+            # legacy spelling the --max_restarts loop already exposes
+            "ACCELERATE_RESTART_COUNT": str(self.generation),
+        }
+        if include_world:
+            env["ACCELERATE_NUM_PROCESSES"] = str(self.num_processes)
+            if new_rank is not None:
+                env["ACCELERATE_PROCESS_ID"] = str(new_rank)
+            if self.dp_replicate_size is not None:
+                env["PARALLELISM_CONFIG_DP_REPLICATE_SIZE"] = str(self.dp_replicate_size)
+            for axis, size in self.axis_sizes.items():
+                env[f"PARALLELISM_CONFIG_{axis.upper()}_SIZE"] = str(size)
+        if self.generation > 0:
+            env[ELASTIC_RESUME_ENV_VAR] = "1"
+            env["ACCELERATE_RESUME_FROM_CHECKPOINT"] = "latest"
+        return env
+
+
+def negotiate_membership(
+    alive: "list[int]",
+    prev_num_processes: int,
+    generation: int,
+    prev_axis_sizes: "Optional[dict[str, int]]" = None,
+) -> CohortSpec:
+    """Fit the mesh onto the surviving hosts.
+
+    ``alive`` lists the previous ranks still available (any order); the new
+    cohort renumbers them contiguously in ascending previous-rank order.
+    Only ``dp_replicate`` scales: ``new_dp = old_dp * len(alive) /
+    prev_num_processes`` must be a positive integer, and every other axis is
+    carried over unchanged. With no axis intent recorded (single-host runs,
+    tests) the spec only rewrites the world size.
+    """
+    if not alive:
+        raise MembershipError("no surviving members to form a cohort from")
+    members = sorted(set(int(r) for r in alive))
+    new_world = len(members)
+    axis_sizes = dict(prev_axis_sizes or {})
+    dp = axis_sizes.pop("dp_replicate", None)
+    new_dp = None
+    if dp is not None and prev_num_processes > 0 and new_world != prev_num_processes:
+        scaled = dp * new_world
+        if scaled % prev_num_processes != 0 or scaled // prev_num_processes < 1:
+            raise MembershipError(
+                f"cannot shrink dp_replicate={dp} from {prev_num_processes} to "
+                f"{new_world} host(s): {dp}*{new_world}/{prev_num_processes} is not a "
+                "positive integer — wait for the host to return or relaunch with an "
+                "explicit smaller topology"
+            )
+        new_dp = scaled // prev_num_processes
+    elif dp is not None:
+        new_dp = dp
+    fixed = {a: s for a, s in axis_sizes.items() if s and s > 1}
+    if fixed and new_world != prev_num_processes:
+        # model-parallel axes are frozen into the checkpoint layout; a shrink
+        # can only come out of the replicate axis
+        if new_dp is None:
+            raise MembershipError(
+                f"cohort shrank {prev_num_processes}->{new_world} but the mesh has no "
+                f"dp_replicate axis to absorb it (fixed axes: {fixed})"
+            )
+    return CohortSpec(
+        generation=generation,
+        num_processes=new_world,
+        members=members,
+        dp_replicate_size=new_dp,
+        axis_sizes=fixed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# roster handshake (shared-fs, write-then-rename — no collectives)
+
+
+def announce_membership(roster_dir: str, generation: Optional[int] = None) -> str:
+    """Drop this process's membership announcement for ``generation`` (default:
+    :func:`current_generation`). Returns the file path. Never raises on
+    identity problems — a worker that cannot announce is simply absent from
+    the roster, which is the failure the roster exists to surface."""
+    from ..state import process_identity
+
+    gen = current_generation() if generation is None else int(generation)
+    ident = process_identity()
+    rank = int(ident.get("process_index", 0))
+    os.makedirs(roster_dir, exist_ok=True)
+    path = os.path.join(roster_dir, _MEMBER_NAME.format(gen=gen, rank=rank))
+    payload = {
+        "generation": gen,
+        "rank": rank,
+        "announced_at_unix": round(time.time(), 3),
+        **{k: ident.get(k) for k in ("hostname", "pid", "num_processes", "run_id")},
+    }
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def read_roster(roster_dir: str, generation: int) -> "dict[int, dict]":
+    """All announcements for ``generation``: ``{rank: payload}``."""
+    roster: "dict[int, dict]" = {}
+    if not os.path.isdir(roster_dir):
+        return roster
+    prefix = f"member-gen{generation:04d}-rank"
+    for name in sorted(os.listdir(roster_dir)):
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(roster_dir, name)) as f:
+                payload = json.load(f)
+            roster[int(payload["rank"])] = payload
+        except (OSError, ValueError, KeyError):
+            continue  # a torn announcement is an absent member
+    return roster
+
+
+def publish_cohort_spec(roster_dir: str, spec: CohortSpec) -> str:
+    """Supervisor-side: make ``spec`` the authoritative cohort description."""
+    os.makedirs(roster_dir, exist_ok=True)
+    path = os.path.join(roster_dir, _COHORT_NAME.format(gen=spec.generation))
+    with open(path + ".tmp", "w") as f:
+        json.dump(asdict(spec), f)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def load_cohort_spec(roster_dir: str, generation: Optional[int] = None) -> Optional[CohortSpec]:
+    """Worker-side: the published spec for ``generation`` (default: this
+    process's), or None when the run is not supervised/elastic."""
+    gen = current_generation() if generation is None else int(generation)
+    path = os.path.join(roster_dir, _COHORT_NAME.format(gen=gen))
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return CohortSpec(**data)
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def await_roster(
+    roster_dir: str, generation: int, expected: int, timeout: float = 60.0
+) -> "dict[int, dict]":
+    """Block until ``expected`` members announced for ``generation`` (or the
+    timeout passes — returning whoever did show up, so the caller can decide
+    to shrink around the missing)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        roster = read_roster(roster_dir, generation)
+        if len(roster) >= expected or time.monotonic() > deadline:
+            return roster
+        time.sleep(0.05)

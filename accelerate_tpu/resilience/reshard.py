@@ -1,0 +1,147 @@
+"""Cross-topology checkpoint resume: the arithmetic that lets a checkpoint
+written at dp=N restore onto dp=M.
+
+The sharded checkpoint format (``sharded_checkpoint.py``) is already
+coordinate-based — any leaf whose *global shape* is topology-independent
+re-chunks onto a new mesh for free. Two things are NOT topology-independent,
+and this module handles both:
+
+1. **The mesh itself.** Since this PR every shard index and every
+   ``_COMMITTED`` manifest records the writing mesh's axis→size map.
+   :func:`check_topology` compares it against the resuming mesh and either
+   waves the load through (same topology), allows it (elastic resume), or
+   raises :class:`~accelerate_tpu.sharded_checkpoint.CheckpointTopologyError`
+   naming both shapes — instead of the deep jax shape error a mismatched
+   load used to die of.
+2. **Fused ZeRO-1 optimizer state.** Bucketed moment buffers are padded to a
+   multiple of the replicate width (``ceil(fill/N)*N``, PR 9), so their
+   global length CHANGES with dp size. Bucket *assignment* does not — it
+   depends only on param shapes and ``bucket_bytes`` — so re-sharding is a
+   re-pad: the real elements occupy the common prefix, the tail is zero
+   padding (grads of padding are zero, so Adam moments of padding stay zero
+   for the whole run). :func:`resize_padded_bucket`
+   truncates/zero-extends with a hard check that nothing nonzero is being
+   dropped. The elastic load paths
+   (``load_sharded_pytree(..., elastic=True)``,
+   ``checkpointing.unflatten_into(..., elastic=True)``) call it for 1-D
+   leaves whose saved and live lengths differ.
+
+The dataloader needs no re-sharding: its snapshot counts *global* batches
+consumed, and ``load_state`` restores ``skip_batches`` — the resumed epoch
+skips exactly the batches the dead incarnation finished, whatever the new
+dp width slices them into.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..sharded_checkpoint import (  # noqa: F401  (public re-exports)
+    CheckpointTopologyError,
+    read_saved_mesh,
+    resize_padded_bucket,
+)
+
+
+def mesh_shape_dict(mesh) -> "Optional[dict[str, int]]":
+    """``{axis: size}`` for a jax Mesh (or None for meshless runs)."""
+    if mesh is None:
+        return None
+    try:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except (TypeError, AttributeError):
+        return None
+
+
+def _effective(shape: "Optional[dict]") -> "dict[str, int]":
+    """Size-1 axes are replication — drop them so ``{'dp': 2}`` matches
+    ``{'dp': 2, 'tp': 1}``."""
+    return {k: int(v) for k, v in (shape or {}).items() if int(v) > 1}
+
+
+def topology_matches(saved: "Optional[dict]", current: "Optional[dict]") -> bool:
+    """True when the two mesh shapes are equivalent (or either is unknown —
+    checkpoints predating the mesh record stay loadable)."""
+    if saved is None or current is None:
+        return True
+    return _effective(saved) == _effective(current)
+
+
+def is_elastic_compatible(saved: "Optional[dict]", current: "Optional[dict]") -> bool:
+    """Can the elastic path re-shard ``saved`` onto ``current``? Only the
+    data-parallel replicate width may differ; model-parallel axes are baked
+    into the saved layout."""
+    s, c = _effective(saved), _effective(current)
+    s.pop("dp_replicate", None)
+    c.pop("dp_replicate", None)
+    return s == c
+
+
+def describe_shapes(saved: "Optional[dict]", current: "Optional[dict]") -> str:
+    def _fmt(d):
+        if not d:
+            return "<unknown>"
+        return "×".join(f"{k}={v}" for k, v in sorted(d.items()))
+
+    return f"saved mesh {_fmt(_effective(saved))} vs current mesh {_fmt(_effective(current))}"
+
+
+def check_topology(
+    saved: "Optional[dict]", current: "Optional[dict]", elastic: bool = False
+) -> bool:
+    """Gate a load across topologies.
+
+    Only a ``dp_replicate`` width change is *shape-affecting*: fused-ZeRO-1
+    bucket lengths are padded to ``ceil(fill/N)·N``, so a dp=N checkpoint
+    holds different global shapes than a dp=M template — the case that used
+    to die deep inside jax. That case returns True (re-pad buckets) under
+    ``elastic`` and raises :class:`CheckpointTopologyError` naming both
+    shapes otherwise.
+
+    Every OTHER factorization change (fsdp=8 → fsdp=4×tp=2, a different
+    process count, dropped axes) keeps all global array shapes — the
+    coordinate-based sharded loader has always handled those with live
+    templates, and they pass through untouched (returns False).
+    """
+    if topology_matches(saved, current):
+        return False
+    s, c = _effective(saved), _effective(current)
+    if s.get("dp_replicate", 1) == c.get("dp_replicate", 1):
+        return False  # pure refactorization: global shapes invariant
+    if not elastic:
+        raise CheckpointTopologyError(
+            f"checkpoint topology mismatch: {describe_shapes(saved, current)} — "
+            "the data-parallel replicate width changed, so ZeRO-1 optimizer "
+            "bucket shapes differ. Pass elastic=True to load_state (or run "
+            "under `accelerate-tpu launch --elastic`, which sets "
+            "ACCELERATE_ELASTIC_RESUME) to re-shard onto the current mesh, or "
+            "relaunch with the saved topology.",
+            saved=saved,
+            current=current,
+        )
+    return True
+
+
+def saved_topology(input_dir: str) -> "Optional[dict[str, int]]":
+    """The mesh shape a checkpoint directory was written under: the
+    ``_COMMITTED`` manifest's ``mesh`` entry, falling back to the shard
+    indices for uncommitted/legacy layouts. None when nothing recorded."""
+    import json
+    import os
+
+    from ..checkpointing import COMMITTED_MARKER
+
+    marker = os.path.join(input_dir, COMMITTED_MARKER)
+    if os.path.isfile(marker):
+        try:
+            with open(marker) as f:
+                mesh = json.load(f).get("mesh")
+            if mesh:
+                return {str(k): int(v) for k, v in mesh.items()}
+        except (OSError, ValueError):
+            pass
+    for prefix in ("model", "optimizer"):
+        mesh = read_saved_mesh(input_dir, prefix)
+        if mesh:
+            return mesh
+    return None

@@ -1,0 +1,466 @@
+"""The elastic supervisor: the loop that acts on a dead rank instead of
+autopsying it.
+
+PR 4 built the evidence chain (watchdog stall dumps, flight records, the
+exit-101 abort) and PR 5 the recovery substrate (crash-consistent
+``_COMMITTED`` checkpoints). This module closes the loop:
+``accelerate-tpu launch --elastic`` wraps the per-host spawn in a
+:class:`Supervisor` that
+
+1. **watches** child exit codes, heartbeat-file gaps (the watchdog touches
+   ``ACCELERATE_HEARTBEAT_FILE`` every tick — a stale mtime means even the
+   watchdog thread is gone, the one hang class exit codes cannot report), and
+   flight-recorder dumps (for step attribution);
+2. **classifies** every death (:func:`classify_exit`): ``0`` → done, ``101``
+   → watchdog stall-abort (restart, link the dump), signals → preemption /
+   OOM-kill (restart), other nonzero → crash — where a *repeated crash at the
+   same step* is a poison step and the supervisor stops with a diagnosis
+   instead of burning the restart budget re-dying deterministically;
+3. **tears down** the whole cohort on any failure (a half-dead SPMD cohort is
+   blocked in the old incarnation's collectives; one rank cannot rejoin it),
+   then **respawns** everyone under a new restart generation with bounded
+   exponential backoff and a max-restart budget, injecting
+   ``ACCELERATE_RESUME_FROM_CHECKPOINT=latest`` + ``ACCELERATE_ELASTIC_RESUME``
+   so the training script resumes from the newest committed checkpoint;
+4. **shrinks** when a host stays gone: ``available_fn`` reports who can come
+   back, :mod:`.membership` renumbers the cohort and rescales
+   ``dp_replicate``, and the cross-topology checkpoint loader re-shards the
+   optimizer state onto the smaller mesh.
+
+Every transition is a ``restart`` telemetry record
+(``events-supervisor.jsonl`` in the telemetry dir) carrying generation,
+cause, exit code, crash step, dump link and downtime seconds — the report
+CLI's "restarts" section aggregates them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..logging import get_logger
+from ..telemetry.watchdog import ABORT_EXIT_CODE, HEARTBEAT_FILE_ENV_VAR
+from .membership import (
+    GENERATION_ENV_VAR,
+    CohortSpec,
+    MembershipError,
+    negotiate_membership,
+    publish_cohort_spec,
+)
+
+logger = get_logger(__name__)
+
+#: Causes that indicate the environment killed us (restart is the right call).
+TRANSIENT_CAUSES = ("stall_abort", "killed", "terminated", "heartbeat_gap")
+
+
+def classify_exit(returncode: int) -> "tuple[str, bool]":
+    """``(cause, restartable)`` for a child's exit code.
+
+    ``101`` is RESERVED as the watchdog's stall-abort code
+    (``telemetry.watchdog.ABORT_EXIT_CODE``): a rank that aborted itself
+    after dumping a stall diagnosis. Negative codes are deaths by signal —
+    SIGKILL is what preemption and the OOM killer both look like.
+    """
+    if returncode == 0:
+        return "clean", False
+    if returncode == ABORT_EXIT_CODE:
+        return "stall_abort", True
+    if returncode < 0:
+        sig = -returncode
+        if sig == signal.SIGKILL:
+            return "killed", True  # preemption / OOM-killer
+        if sig == signal.SIGTERM:
+            return "terminated", True  # polite eviction
+        return f"signal:{sig}", True
+    return "crash", True
+
+
+@dataclass
+class RestartPolicy:
+    """Bounds on the supervisor's persistence."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    poison_threshold: int = 3  # same-step failures before giving up
+    heartbeat_timeout_s: float = 0.0  # 0 disables the mtime watch
+    grace_period_s: float = 5.0  # SIGTERM → SIGKILL escalation window
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before restart ``attempt`` (1-based), exponentially grown and
+        capped."""
+        return min(
+            self.backoff_max_s,
+            self.backoff_base_s * (self.backoff_factor ** max(0, attempt - 1)),
+        )
+
+
+@dataclass
+class _Incident:
+    generation: int
+    cause: str
+    exit_code: Optional[int]
+    step: Optional[int] = None
+    dump: Optional[str] = None
+
+
+class Supervisor:
+    """Supervise one cohort of per-host training processes.
+
+    ``commands`` maps previous-rank → argv; single-host elastic launch passes
+    one command. ``available_fn()`` (called before each respawn) returns the
+    previous ranks that can come back — default: all of them. ``env`` is the
+    base environment every child inherits (the launcher's env protocol).
+    """
+
+    def __init__(
+        self,
+        commands: "list[list[str]]",
+        env: "Optional[dict[str, str]]" = None,
+        policy: Optional[RestartPolicy] = None,
+        telemetry_dir: Optional[str] = None,
+        roster_dir: Optional[str] = None,
+        available_fn: Optional[Callable[[], "list[int]"]] = None,
+        axis_sizes: "Optional[dict[str, int]]" = None,
+        spawn_fn: Optional[Callable[..., "subprocess.Popen"]] = None,
+    ):
+        if not commands:
+            raise ValueError("supervisor needs at least one child command")
+        self.commands = [list(c) for c in commands]
+        self.env = dict(env if env is not None else os.environ)
+        self.policy = policy or RestartPolicy()
+        self.telemetry_dir = telemetry_dir or self.env.get(
+            "ACCELERATE_TELEMETRY_DIR", "telemetry"
+        )
+        self.roster_dir = roster_dir or os.path.join(self.telemetry_dir, "cohort")
+        self.available_fn = available_fn
+        self.axis_sizes = dict(axis_sizes or {})
+        self._spawn_fn = spawn_fn or subprocess.Popen
+        self.generation = 0
+        self.restarts_used = 0
+        self.incidents: "list[_Incident]" = []
+        self._children: "dict[int, subprocess.Popen]" = {}  # new-rank -> proc
+        self._spawned_at = 0.0
+        self._events_path = os.path.join(self.telemetry_dir, "events-supervisor.jsonl")
+        self._events_opened = False
+        self._seen_dumps: "dict[str, float]" = {}  # path -> mtime (ranks reuse names)
+
+    # -------------------------------------------------------------- telemetry --
+    def _emit(self, kind: str, **fields: Any) -> None:
+        try:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            with open(self._events_path, "a") as f:
+                if not self._events_opened:
+                    self._events_opened = True
+                    if f.tell() == 0:
+                        f.write(
+                            json.dumps(
+                                {
+                                    "kind": "meta",
+                                    "schema": 1,
+                                    "run_id": self.env.get("ACCELERATE_RUN_ID"),
+                                    "role": "supervisor",
+                                    "t": round(time.monotonic(), 6),
+                                }
+                            )
+                            + "\n"
+                        )
+                f.write(
+                    json.dumps({"kind": kind, "t": round(time.monotonic(), 6), **fields})
+                    + "\n"
+                )
+        except OSError:
+            pass  # supervision must not die of a full disk
+
+    # ----------------------------------------------------------------- spawn ----
+    def _heartbeat_file(self, new_rank: int) -> str:
+        return os.path.join(self.telemetry_dir, f"heartbeat-rank{new_rank}")
+
+    def _spawn_cohort(self, spec: CohortSpec) -> None:
+        publish_cohort_spec(self.roster_dir, spec)
+        self._children = {}
+        # The supervisor only owns the world-size env when it actually manages
+        # a multi-process cohort; with ONE supervised child (single-host
+        # elastic launch, possibly of a multi-host worker) the launcher's own
+        # ACCELERATE_NUM_PROCESSES/PROCESS_ID must survive untouched.
+        manages_world = len(self.commands) > 1
+        for new_rank, prev_rank in enumerate(spec.members):
+            child_env = dict(self.env)
+            child_env.update(
+                spec.to_env(
+                    new_rank=new_rank if manages_world else None,
+                    include_world=manages_world,
+                )
+            )
+            child_env[GENERATION_ENV_VAR] = str(spec.generation)
+            # workers announce into the SAME roster dir the supervisor reads
+            child_env["ACCELERATE_COHORT_DIR"] = self.roster_dir
+            hb = self._heartbeat_file(new_rank)
+            # a stale mtime from the PREVIOUS generation must not instantly
+            # re-trip the gap watch before the new child can arm its watchdog
+            try:
+                os.unlink(hb)
+            except OSError:
+                pass
+            child_env[HEARTBEAT_FILE_ENV_VAR] = hb
+            proc = self._spawn_fn(self.commands[prev_rank], env=child_env)
+            self._children[new_rank] = proc
+        self._spawned_at = time.monotonic()
+        logger.info(
+            f"spawned cohort generation {spec.generation}: "
+            f"{len(self._children)} process(es)"
+        )
+
+    def _teardown(self) -> None:
+        """Stop every still-running child: SIGTERM (flight recorder dumps on
+        it), grace period, then SIGKILL."""
+        live = [p for p in self._children.values() if p.poll() is None]
+        for p in live:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.policy.grace_period_s
+        for p in live:
+            try:
+                p.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+    # ------------------------------------------------------------- forensics ----
+    def _latest_dump(self) -> "tuple[Optional[str], Optional[int]]":
+        """Newest flight dump this incarnation produced (path, step) — the
+        restart record links it, and the step feeds poison detection."""
+        try:
+            candidates = [
+                os.path.join(self.telemetry_dir, n)
+                for n in os.listdir(self.telemetry_dir)
+                if n.startswith("flight-rank") and n.endswith(".json")
+            ]
+        except OSError:
+            return None, None
+        def _mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
+        fresh = [
+            p for p in candidates
+            if _mtime(p) != self._seen_dumps.get(p)
+        ]
+        if not fresh:
+            return None, None
+        newest = max(fresh, key=_mtime)
+        for p in fresh:
+            self._seen_dumps[p] = _mtime(p)
+        step = None
+        try:
+            with open(newest) as f:
+                data = json.load(f)
+            step = data.get("step")
+            if step is None:
+                for ev in reversed(data.get("events", [])):
+                    if ev.get("step") is not None:
+                        step = ev.get("step")
+                        break
+        except (OSError, ValueError):
+            pass
+        return newest, step
+
+    def _heartbeat_stale(self) -> "Optional[int]":
+        """The new-rank whose heartbeat file is stalest beyond the timeout, or
+        None. Ranks whose file never appeared are measured from spawn time —
+        the watchdog creates it at start, so a missing file past the timeout
+        means the child never even armed its forensics."""
+        timeout = self.policy.heartbeat_timeout_s
+        if timeout <= 0:
+            return None
+        now = time.time()
+        worst: "tuple[float, Optional[int]]" = (0.0, None)
+        for rank, proc in self._children.items():
+            if proc.poll() is not None:
+                continue  # an exited rank's file goes stale naturally
+            path = self._heartbeat_file(rank)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                age = time.monotonic() - self._spawned_at
+            if age > timeout and age > worst[0]:
+                worst = (age, rank)
+        return worst[1]
+
+    def _poisoned(self) -> "Optional[int]":
+        """The step the last ``poison_threshold`` incidents all crashed at, or
+        None. Transient preemption lands at different steps (or carries no
+        step at all); a deterministic bug re-dies at the same one."""
+        k = self.policy.poison_threshold
+        if k <= 0 or len(self.incidents) < k:
+            return None
+        tail = self.incidents[-k:]
+        steps = {i.step for i in tail}
+        if len(steps) == 1 and None not in steps and all(
+            i.cause not in ("killed", "terminated", "heartbeat_gap") for i in tail
+        ):
+            return tail[-1].step
+        return None
+
+    # ------------------------------------------------------------------- run ----
+    def run(self) -> int:
+        """Supervise until the cohort finishes cleanly, the restart budget is
+        exhausted, a poison step is diagnosed, or membership cannot be
+        renegotiated. Returns the exit code to propagate."""
+        # dumps already on disk belong to previous runs: remember their mtimes
+        # so only a NEW/rewritten dump gets attributed to this run's incidents
+        try:
+            for n in os.listdir(self.telemetry_dir):
+                if n.startswith("flight-rank") and n.endswith(".json"):
+                    p = os.path.join(self.telemetry_dir, n)
+                    self._seen_dumps[p] = os.path.getmtime(p)
+        except OSError:
+            pass
+        members = list(range(len(self.commands)))
+        spec = CohortSpec(
+            generation=0,
+            num_processes=len(members),
+            members=members,
+            dp_replicate_size=self.axis_sizes.get("dp_replicate"),
+            axis_sizes={a: s for a, s in self.axis_sizes.items() if a != "dp_replicate"},
+        )
+        self._emit("elastic", phase="start", processes=len(members),
+                   max_restarts=self.policy.max_restarts)
+        self._spawn_cohort(spec)
+        last_rc = 1
+        while True:
+            incident = self._watch()
+            if incident is None:  # clean finish
+                self._emit("elastic", phase="done", generation=self.generation,
+                           restarts=self.restarts_used)
+                return 0
+            failed_at = time.monotonic()
+            self._teardown()
+            self.incidents.append(incident)
+            last_rc = incident.exit_code if incident.exit_code else 1
+            poison = self._poisoned()
+            if poison is not None:
+                diagnosis = (
+                    f"poison step: the last {self.policy.poison_threshold} restarts all "
+                    f"died at step {poison} (cause {incident.cause}) — this is a "
+                    "deterministic failure, not a preemption; restarting again would "
+                    "re-die. Inspect the flight dump"
+                    + (f": {incident.dump}" if incident.dump else " in the telemetry dir")
+                )
+                logger.error(diagnosis)
+                print(f"[accelerate-tpu elastic] {diagnosis}", file=sys.stderr)
+                self._emit("restart", generation=self.generation, cause="poison_step",
+                           step=poison, exit_code=incident.exit_code,
+                           dump=incident.dump, gave_up=True)
+                return last_rc
+            if self.restarts_used >= self.policy.max_restarts:
+                msg = (
+                    f"restart budget exhausted ({self.restarts_used}/"
+                    f"{self.policy.max_restarts}); last cause: {incident.cause}"
+                    + (f", dump: {incident.dump}" if incident.dump else "")
+                )
+                logger.error(msg)
+                print(f"[accelerate-tpu elastic] {msg}", file=sys.stderr)
+                self._emit("restart", generation=self.generation, cause=incident.cause,
+                           step=incident.step, exit_code=incident.exit_code,
+                           dump=incident.dump, gave_up=True, budget_exhausted=True)
+                return last_rc
+            self.restarts_used += 1
+            delay = self.policy.backoff(self.restarts_used)
+            alive = (
+                sorted(self.available_fn())
+                if self.available_fn is not None
+                else list(range(len(self.commands)))
+            )
+            try:
+                spec = negotiate_membership(
+                    alive,
+                    prev_num_processes=len(self.commands),
+                    generation=self.generation + 1,
+                    prev_axis_sizes=self.axis_sizes or None,
+                )
+            except MembershipError as e:
+                logger.error(f"cannot renegotiate cohort: {e}")
+                self._emit("restart", generation=self.generation, cause="membership",
+                           error=str(e), gave_up=True)
+                return last_rc
+            logger.warning(
+                f"cohort gen {self.generation} died ({incident.cause}"
+                + (f", step {incident.step}" if incident.step is not None else "")
+                + f"); restart {self.restarts_used}/{self.policy.max_restarts} "
+                f"as gen {spec.generation} with {spec.num_processes} process(es) "
+                f"in {delay:.1f}s"
+                + (f" — dump: {incident.dump}" if incident.dump else "")
+            )
+            time.sleep(delay)
+            self.generation = spec.generation
+            self._spawn_cohort(spec)
+            self._emit(
+                "restart",
+                generation=spec.generation,
+                attempt=self.restarts_used,
+                cause=incident.cause,
+                exit_code=incident.exit_code,
+                step=incident.step,
+                dump=incident.dump,
+                processes=spec.num_processes,
+                downtime_s=round(time.monotonic() - failed_at, 3),
+            )
+
+    def _watch(self) -> "Optional[_Incident]":
+        """Block until the cohort finishes (returns None) or something dies /
+        goes silent (returns the incident)."""
+        while True:
+            for rank, proc in self._children.items():
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    continue
+                cause, _ = classify_exit(rc)
+                dump, step = self._latest_dump()
+                return _Incident(
+                    generation=self.generation, cause=cause, exit_code=rc,
+                    step=step, dump=dump,
+                )
+            if all(p.poll() == 0 for p in self._children.values()):
+                return None
+            stale = self._heartbeat_stale()
+            if stale is not None:
+                dump, step = self._latest_dump()
+                return _Incident(
+                    generation=self.generation, cause="heartbeat_gap",
+                    exit_code=None, step=step, dump=dump,
+                )
+            time.sleep(0.05)
+
+
+def supervise_command(
+    cmd: "list[str]",
+    env: "Optional[dict[str, str]]" = None,
+    policy: Optional[RestartPolicy] = None,
+    telemetry_dir: Optional[str] = None,
+    axis_sizes: "Optional[dict[str, int]]" = None,
+) -> int:
+    """Single-host convenience: supervise ONE child command (the
+    ``accelerate-tpu launch --elastic`` path on a laptop/single TPU-VM)."""
+    sup = Supervisor(
+        [cmd], env=env, policy=policy, telemetry_dir=telemetry_dir,
+        axis_sizes=axis_sizes,
+    )
+    return sup.run()

@@ -60,6 +60,47 @@ class CheckpointCorruptError(RuntimeError):
         self.path = path
 
 
+class CheckpointTopologyError(RuntimeError):
+    """A checkpoint was written under a different mesh topology than the one
+    loading it, and the caller did not ask for an elastic re-shard. Carries
+    ``saved``/``current`` axis→size maps so the message (and any tooling)
+    names both shapes instead of letting the load die of a deep jax shape
+    error."""
+
+    def __init__(self, message: str, saved: Optional[dict] = None,
+                 current: Optional[dict] = None):
+        super().__init__(message)
+        self.saved = saved
+        self.current = current
+
+
+def resize_padded_bucket(value: np.ndarray, target_len: int, key: str = "?") -> np.ndarray:
+    """Re-pad a 1-D ZeRO-1 bucket for a different replicate width.
+
+    Buckets are ``ceil(fill/N)*N`` long (``parallel/weight_update.py``): the
+    first ``fill`` elements are real, the tail is zero padding whose optimizer
+    moments stay zero for the whole run (padding grads are zero). Resizing to
+    ``ceil(fill/M)*M`` is therefore: keep the common prefix, zero the new
+    tail — and refuse loudly if truncation would drop a nonzero element
+    (the leaf was NOT a padded bucket, and "re-sharding" it would corrupt
+    state silently).
+    """
+    n = int(value.shape[0])
+    target_len = int(target_len)
+    if target_len == n:
+        return value
+    if target_len < n and np.any(value[target_len:]):
+        raise ValueError(
+            f"cannot elastically resize leaf {key!r} from {n} to {target_len}: "
+            f"the would-be-dropped tail contains nonzero elements, so this is "
+            "not ZeRO-1 bucket padding (topology change touched a non-bucket "
+            "leaf)"
+        )
+    out = np.zeros((target_len,), dtype=value.dtype)
+    out[: min(n, target_len)] = value[: min(n, target_len)]
+    return out
+
+
 def _ckpt_format() -> str:
     fmt = os.environ.get("ACCELERATE_TPU_CKPT_FORMAT", "bin").strip().lower()
     return fmt if fmt in ("bin", "npz") else "bin"
@@ -115,6 +156,7 @@ class ShardedTreeSnapshot:
     num_processes: int
     chunks: "dict[str, np.ndarray]" = field(default_factory=dict)
     leaves_meta: "dict[str, dict]" = field(default_factory=dict)
+    mesh_shape: "Optional[dict[str, int]]" = None  # writing mesh's axis→size
 
     @property
     def nbytes(self) -> int:
@@ -141,6 +183,15 @@ def snapshot_sharded_pytree(tree) -> ShardedTreeSnapshot:
 
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = _leaf_key(path)
+        if snap.mesh_shape is None and isinstance(leaf, jax.Array):
+            # record the writing topology (cross-topology resume guard):
+            # every NamedSharding leaf carries the mesh
+            mesh = getattr(leaf.sharding, "mesh", None)
+            if mesh is not None and hasattr(mesh, "shape"):
+                try:
+                    snap.mesh_shape = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+                except TypeError:
+                    pass
         if (
             isinstance(leaf, jax.Array)
             and hasattr(leaf, "addressable_shards")
@@ -243,7 +294,12 @@ def write_sharded_snapshot(
         heartbeat(os.path.basename(shard_file))
     with open(index_file, "w") as f:
         json.dump(
-            {"process_index": proc, "num_processes": snap.num_processes, "leaves": leaves_meta},
+            {
+                "process_index": proc,
+                "num_processes": snap.num_processes,
+                "mesh": snap.mesh_shape,
+                "leaves": leaves_meta,
+            },
             f,
         )
     if heartbeat is not None:
@@ -263,6 +319,25 @@ def save_sharded_pytree(tree, directory: str, prefix: str = "model") -> str:
     written = write_sharded_snapshot(snap, directory, prefix=prefix)
     shard = next(n for n in written if not n.endswith(".index.json"))
     return os.path.join(directory, shard)
+
+
+def read_saved_mesh(directory: str, prefix: str = "model") -> "Optional[dict[str, int]]":
+    """The mesh axis→size map recorded in a shard set's indices (first one
+    found), or None for pre-topology-record checkpoints."""
+    if not os.path.isdir(directory):
+        return None
+    for name in sorted(os.listdir(directory)):
+        m = _SHARD_RE.fullmatch(name)
+        if not m or m.group("prefix") != prefix:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                mesh = json.load(f).get("mesh")
+        except (OSError, ValueError):
+            continue
+        if mesh:
+            return {str(k): int(v) for k, v in mesh.items()}
+    return None
 
 
 def is_sharded_checkpoint(directory: str, prefix: str = "model") -> bool:
@@ -420,7 +495,8 @@ def _assemble_region(meta: dict, start: list[int], stop: list[int], reader: _Chu
     return out
 
 
-def load_sharded_pytree(template, directory: str, prefix: str = "model", plan=None):
+def load_sharded_pytree(template, directory: str, prefix: str = "model", plan=None,
+                        elastic: bool = False):
     """Restore a sharded checkpoint into the structure/shardings of ``template``.
 
     ``template`` leaves that are ``jax.Array`` are rebuilt with
@@ -433,6 +509,12 @@ def load_sharded_pytree(template, directory: str, prefix: str = "model", plan=No
     rebuilt from the PartitionSpec recorded in the shard index via
     ``plan.sharding_from_saved_spec`` — the resume-onto-a-fresh-mesh path,
     where only shapes (not placed buffers) exist before the load.
+
+    ``elastic=True`` additionally re-pads 1-D leaves whose saved length
+    differs from the template's: ZeRO-1 buckets are padded to a multiple of
+    the replicate width, so a dp=N→dp=M resume changes their global length
+    (see :func:`resize_padded_bucket` — truncation that would drop nonzero
+    data still raises).
     """
     import jax
 
@@ -453,19 +535,34 @@ def load_sharded_pytree(template, directory: str, prefix: str = "model", plan=No
             and hasattr(leaf, "dtype")
         )
         if is_live or is_spec_leaf:
-            if list(leaf.shape) != list(meta["shape"]):
-                raise ValueError(
-                    f"shape mismatch for {key!r}: live {leaf.shape} vs saved {meta['shape']}"
-                )
             np_dtype = np.float32 if meta["dtype"] == "bfloat16" else np.dtype(meta["dtype"])
+            sharding = (
+                leaf.sharding if is_live else plan.sharding_from_saved_spec(
+                    meta.get("spec"), drop_unknown_axes=elastic
+                )
+            )
+            if list(leaf.shape) != list(meta["shape"]):
+                if not (elastic and len(meta["shape"]) == 1
+                        and getattr(leaf, "ndim", None) == 1):
+                    raise ValueError(
+                        f"shape mismatch for {key!r}: live {leaf.shape} vs saved "
+                        f"{meta['shape']}"
+                        + (
+                            "" if elastic else
+                            " (a topology change? elastic resume re-pads 1-D "
+                            "ZeRO-1 buckets — see docs/resilience.md)"
+                        )
+                    )
+                full = _assemble_region(
+                    meta, [0], list(meta["shape"]), reader, np_dtype
+                )
+                data = resize_padded_bucket(full, int(leaf.shape[0]), key)
+                return jax.device_put(data.astype(leaf.dtype), sharding)
 
             def cb(index, _meta=meta, _dtype=np_dtype, _shape=tuple(leaf.shape)):
                 start, stop = _index_to_coords(index, _shape)
                 return _assemble_region(_meta, start, stop, reader, _dtype)
 
-            sharding = (
-                leaf.sharding if is_live else plan.sharding_from_saved_spec(meta.get("spec"))
-            )
             arr = jax.make_array_from_callback(tuple(leaf.shape), sharding, cb)
             if arr.dtype != leaf.dtype:
                 arr = jax.device_put(arr.astype(leaf.dtype), sharding)

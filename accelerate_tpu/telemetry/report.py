@@ -557,10 +557,54 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "data_wait_events": len(waits),
         "checkpoints": checkpoints,
         "performance": _performance_section(events, steps),
+        "restarts": _restarts_section(events),
     }
     if by_rank:
         report["ranks"] = _rank_section(events, file_rank, paths)
     return report
+
+
+def _restarts_section(events: "list[dict]") -> dict:
+    """Aggregate the elastic supervisor's ``restart``/``elastic`` records
+    (``events-supervisor.jsonl``): generation count, total downtime, cause
+    attribution (each restart record carries the classified cause and the
+    flight-dump link the supervisor harvested), and how the run ended."""
+    restarts = [e for e in events if e.get("kind") == "restart"]
+    elastic = [e for e in events if e.get("kind") == "elastic"]
+    reshards = [e for e in elastic if e.get("phase") == "reshard"]
+    chaos = [e for e in events if e.get("kind") == "chaos_fault"]
+    causes: dict = {}
+    dumps: "list[str]" = []
+    for r in restarts:
+        cause = str(r.get("cause", "?"))
+        causes[cause] = causes.get(cause, 0) + 1
+        if r.get("dump"):
+            dumps.append(str(r["dump"]))
+    gave_up = next((r for r in restarts if r.get("gave_up")), None)
+    section = {
+        "count": sum(1 for r in restarts if not r.get("gave_up")),
+        "generations": max(
+            [int(r.get("generation", 0)) for r in restarts + elastic] or [0]
+        ),
+        "downtime_s": round(
+            sum(float(r.get("downtime_s", 0.0)) for r in restarts), 3
+        ),
+        "causes": dict(sorted(causes.items())),
+        "dumps": dumps,
+        "reshards": [
+            {"saved_mesh": r.get("saved_mesh"), "current_mesh": r.get("current_mesh")}
+            for r in reshards
+        ],
+        "chaos_faults": len(chaos),
+        "completed": any(e.get("phase") == "done" for e in elastic),
+    }
+    if gave_up is not None:
+        section["gave_up"] = {
+            "cause": gave_up.get("cause"),
+            "step": gave_up.get("step"),
+            "budget_exhausted": bool(gave_up.get("budget_exhausted")),
+        }
+    return section
 
 
 def _fmt_bytes(n: float) -> str:
@@ -641,6 +685,34 @@ def format_report(report: dict) -> str:
                     f"  {phase:<12} n={d['count']}  total={d['total'] * 1e3:.2f}ms  "
                     f"p50={d['p50'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
                 )
+    rs = report.get("restarts") or {}
+    if (rs.get("count") or rs.get("generations") or rs.get("gave_up")
+            or rs.get("chaos_faults") or rs.get("reshards")):
+        ended = "completed" if rs.get("completed") else (
+            "GAVE UP" if rs.get("gave_up") else "in flight/unknown"
+        )
+        lines.append(
+            f"restarts: {rs.get('count', 0)} restart(s) over "
+            f"{rs.get('generations', 0) + 1} generation(s), downtime "
+            f"{rs.get('downtime_s', 0.0):.1f}s — run {ended}"
+        )
+        for cause, n in (rs.get("causes") or {}).items():
+            lines.append(f"  cause {cause}: {n}")
+        for r in rs.get("reshards") or []:
+            lines.append(
+                f"  elastic reshard: {r.get('saved_mesh')} -> {r.get('current_mesh')}"
+            )
+        if rs.get("dumps"):
+            lines.append(f"  flight dump(s): {', '.join(rs['dumps'][-3:])}")
+        if rs.get("chaos_faults"):
+            lines.append(f"  chaos faults injected: {rs['chaos_faults']}")
+        gu = rs.get("gave_up")
+        if gu:
+            why = "restart budget exhausted" if gu.get("budget_exhausted") else (
+                f"poison step {gu.get('step')}" if gu.get("cause") == "poison_step"
+                else str(gu.get("cause"))
+            )
+            lines.append(f"  gave up: {why}")
     perf = report.get("performance")
     if perf:
         lines.append(format_performance_section(perf))
@@ -1043,9 +1115,57 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("fused zero1 weight update", False, f"{type(exc).__name__}: {exc}")
 
+        # 11. elastic auto-resume (ISSUE 10): the resilience supervisor must
+        # ride through a SIGKILLed toy run — restart within the budget, let
+        # generation 1 finish, and leave restart telemetry the "restarts"
+        # report section can attribute
+        try:
+            _doctor_elastic(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("elastic auto-resume", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
+
+
+def _doctor_elastic(tmp: str, _check) -> None:
+    """Doctor check 11 body: supervise a toy child that SIGKILLs itself in
+    generation 0 and completes in generation 1; the supervisor must classify
+    the kill, restart within the budget, exit 0, and emit restart records
+    that aggregate into the report's restarts section."""
+    import sys
+
+    from ..resilience.supervisor import RestartPolicy, Supervisor
+
+    sup_dir = os.path.join(tmp, "elastic")
+    os.makedirs(sup_dir, exist_ok=True)
+    done = os.path.join(sup_dir, "DONE")
+    child = (
+        "import os, signal\n"
+        "if os.environ.get('ACCELERATE_RESTART_GENERATION', '0') == '0':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        f"open({done!r}, 'w').write('ok')\n"
+    )
+    sup = Supervisor(
+        [[sys.executable, "-c", child]],
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.05, grace_period_s=1.0),
+        telemetry_dir=sup_dir,
+    )
+    rc = sup.run()
+    rep = build_report([sup_dir])
+    rs = rep.get("restarts") or {}
+    text = format_report(rep)
+    ok = (
+        rc == 0
+        and sup.restarts_used == 1
+        and os.path.isfile(done)
+        and rs.get("count") == 1
+        and rs.get("completed")
+        and rs.get("causes", {}).get("killed") == 1
+        and "restarts: 1 restart(s)" in text
+    )
+    _check("elastic auto-resume", ok, f"rc={rc} restarts={rs}")
 
 
 def _doctor_fused_zero1(_check) -> None:

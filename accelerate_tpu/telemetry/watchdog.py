@@ -53,8 +53,13 @@ from . import flight_recorder
 WATCHDOG_TIMEOUT_ENV_VAR = "ACCELERATE_WATCHDOG_TIMEOUT"
 WATCHDOG_INTERVAL_ENV_VAR = "ACCELERATE_WATCHDOG_INTERVAL"
 WATCHDOG_ABORT_ENV_VAR = "ACCELERATE_WATCHDOG_ABORT"
+HEARTBEAT_FILE_ENV_VAR = "ACCELERATE_HEARTBEAT_FILE"
 
 _TRUE = {"1", "true", "yes", "y", "on"}
+# RESERVED: "stall abort". A rank exiting 101 dumped a stall diagnosis and
+# aborted itself; the elastic supervisor (resilience/supervisor.py
+# classify_exit) maps it to restart-with-dump-link. Nothing else in this
+# codebase may exit with 101.
 ABORT_EXIT_CODE = 101
 
 
@@ -95,6 +100,10 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self._stacks_file = None
         self._dumped_phases: "set[tuple]" = set()
+        # Out-of-process liveness channel (the elastic supervisor watches this
+        # file's mtime): every tick touches it, so a stale mtime means even
+        # the watchdog thread is dead — a hang class no exit code can report.
+        self.heartbeat_file = os.environ.get(HEARTBEAT_FILE_ENV_VAR, "").strip() or None
 
     # ------------------------------------------------------------- liveness --
     def register(self, name: str, **info: Any) -> None:
@@ -143,6 +152,8 @@ class Watchdog:
             )
         except OSError:
             self._stacks_file = None
+        self._touch_heartbeat_file()  # exists-from-start: a supervisor can
+        # tell "never armed" from "armed then went silent"
         self._arm_deadman()
         self._thread = threading.Thread(
             target=self._run, name="accelerate-tpu-watchdog", daemon=True
@@ -187,9 +198,20 @@ class Watchdog:
         except Exception:
             pass
 
+    def _touch_heartbeat_file(self) -> None:
+        if self.heartbeat_file is None:
+            return
+        try:
+            with open(self.heartbeat_file, "a"):
+                pass
+            os.utime(self.heartbeat_file, None)
+        except OSError:
+            pass  # liveness reporting must never kill the watchdog
+
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
             self._arm_deadman()
+            self._touch_heartbeat_file()
             try:
                 self._tick()
             except Exception:  # the watchdog must outlive anything it watches

@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..resilience.chaos import maybe_inject as _chaos_inject
 from ..state import PartialState
 from ..telemetry import events as _telemetry
 from ..telemetry import flight_recorder as _flight
@@ -276,6 +277,7 @@ def gather(tree):
     # flight-recorder annotation: a rank that hangs here is "blocked in
     # collective:gather" in the watchdog's stall dump, not just "stuck"
     _flight.record_collective("gather", _collective_signature(tree))
+    _chaos_inject("collective")
     with _flight.phase("collective:gather"):
         return recursively_apply(_gather, tree)
 
@@ -312,6 +314,7 @@ def broadcast(tree, from_process: int = 0):
     (reference ``broadcast:539``). Single-process: identity."""
     _record_comm("broadcast", tree)
     _flight.record_collective("broadcast", _collective_signature(tree))
+    _chaos_inject("collective")
     state = PartialState()
     if state.num_processes == 1:
         return tree
@@ -400,6 +403,7 @@ def reduce(tree, reduction: str = "mean", scale: float = 1.0):
     tree = _normalize_foreign(tree)
     _record_comm("reduce", tree)
     _flight.record_collective(f"reduce:{reduction}", _collective_signature(tree))
+    _chaos_inject("collective")
     with _flight.phase("collective:reduce", reduction=reduction):
         return recursively_apply(_reduce, tree)
 

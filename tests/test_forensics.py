@@ -396,11 +396,13 @@ def test_doctor_self_checks(capsys):
     # dump + stall + straggler + collective divergence + jaxlint
     # + perf cost capture + xplane trace parse + performance report (ISSUE 7)
     # + fused zero1 lint/compiled-collectives (ISSUE 9)
-    assert out.count("PASS") == 10 and "FAIL" not in out
+    # + elastic auto-resume (ISSUE 10)
+    assert out.count("PASS") == 11 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "fused zero1 compiled collectives" in out
     assert "performance report section" in out
+    assert "elastic auto-resume" in out
 
 
 # ------------------------------------------------------- integration hookups

@@ -1,0 +1,722 @@
+"""Elastic preemption-tolerant training (ISSUE 10): chaos schedules, cohort
+membership, the restart supervisor, cross-topology checkpoint re-sharding,
+and the subprocess e2e — SIGKILL mid-epoch, auto-resume, bitwise parity."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu.resilience import (
+    ChaosFaultError,
+    ChaosSchedule,
+    CheckpointTopologyError,
+    CohortSpec,
+    Fault,
+    MembershipError,
+    RestartPolicy,
+    Supervisor,
+    check_topology,
+    classify_exit,
+    negotiate_membership,
+    replan_data_assignment,
+    topology_matches,
+)
+from accelerate_tpu.resilience import chaos as chaos_mod
+from accelerate_tpu.resilience import membership as membership_mod
+from accelerate_tpu.sharded_checkpoint import (
+    read_saved_mesh,
+    resize_padded_bucket,
+    save_sharded_pytree,
+    load_sharded_pytree,
+)
+from accelerate_tpu.telemetry.report import build_report, format_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("ACCELERATE_CHAOS_SCHEDULE", None)
+    env.pop("ACCELERATE_RESTART_GENERATION", None)
+    env.pop("ACCELERATE_RESUME_FROM_CHECKPOINT", None)
+    env.pop("ACCELERATE_ELASTIC_RESUME", None)
+    # children run on a single virtual device: batch math stays trivial
+    env.pop("XLA_FLAGS", None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _toy_cmd(project_dir, steps=6, save_every=2, **flags):
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.resilience._toy_train",
+        "--project-dir", str(project_dir), "--steps", str(steps),
+        "--save-every", str(save_every), "--global-batch", "8",
+    ]
+    for k, v in flags.items():
+        cmd += [f"--{k.replace('_', '-')}", str(v)]
+    return cmd
+
+
+# ---------------------------------------------------------------------------
+# chaos schedules
+
+
+@pytest.mark.smoke
+def test_chaos_schedule_seeded_is_deterministic():
+    a = ChaosSchedule.seeded(42, steps=20, n_faults=3)
+    b = ChaosSchedule.seeded(42, steps=20, n_faults=3)
+    c = ChaosSchedule.seeded(43, steps=20, n_faults=3)
+    assert a.to_json() == b.to_json()
+    assert a.to_json() != c.to_json()
+    # round-trips through json and @file indirection
+    assert ChaosSchedule.from_json(a.to_json()) == a
+
+
+def test_chaos_schedule_file_indirection(tmp_path):
+    sched = ChaosSchedule(faults=[Fault(kind="hang", step=3, duration_s=1.0)])
+    path = tmp_path / "sched.json"
+    path.write_text(sched.to_json())
+    assert ChaosSchedule.from_json(f"@{path}") == sched
+
+
+def test_fault_matching_filters():
+    f = Fault(kind="sigkill", point="train_step", step=5, rank=1, generation=0)
+    assert f.matches("train_step", 5, rank=1, generation=0)
+    assert not f.matches("collective", 5, 1, 0)
+    assert not f.matches("train_step", 4, 1, 0)
+    assert not f.matches("train_step", 5, 0, 0)
+    assert not f.matches("train_step", 5, 1, 1)  # generation-pinned
+    anyf = Fault(kind="slow", point="any")
+    assert anyf.matches("prefetch", None, 3, 7)
+    with pytest.raises(ValueError):
+        Fault(kind="meteor")
+    with pytest.raises(ValueError):
+        Fault(kind="hang", point="nowhere")
+
+
+def test_maybe_inject_crash_fault_and_once_semantics():
+    chaos_mod.arm(ChaosSchedule(faults=[Fault(kind="crash", point="any")]))
+    try:
+        with pytest.raises(ChaosFaultError):
+            chaos_mod.maybe_inject("train_step", step=0)
+        # once=True: the same fault does not re-fire
+        chaos_mod.maybe_inject("train_step", step=1)
+    finally:
+        chaos_mod.arm(None)
+
+
+def test_maybe_inject_slow_fault_repeats():
+    chaos_mod.arm(ChaosSchedule(
+        faults=[Fault(kind="slow", point="prefetch", duration_s=0.05, once=False)]
+    ))
+    try:
+        t0 = time.monotonic()
+        chaos_mod.maybe_inject("prefetch")
+        chaos_mod.maybe_inject("prefetch")
+        assert time.monotonic() - t0 >= 0.1  # fired both times
+    finally:
+        chaos_mod.arm(None)
+
+
+def test_replan_data_assignment_straggler_and_exclusion():
+    healthy = replan_data_assignment({0: 1.0, 1: 1.0, 2: 1.0})
+    assert healthy["stragglers"] == [] and set(healthy["weights"].values()) == {1.0}
+    skew = replan_data_assignment({0: 1.0, 1: 1.0, 2: 2.0, 3: 4.0}, slow_factor=1.5)
+    assert skew["stragglers"] == [2, 3]
+    assert skew["weights"][2] == 0.5 and skew["weights"][0] == 1.0
+    assert skew["exclude"] == [3]  # 4x median > 2*slow_factor
+    assert replan_data_assignment({}) == {"weights": {}, "stragglers": [], "exclude": []}
+
+
+# ---------------------------------------------------------------------------
+# membership
+
+
+def test_negotiate_membership_shrinks_dp_replicate():
+    spec = negotiate_membership([0, 2], 4, generation=1,
+                                prev_axis_sizes={"dp_replicate": 4})
+    assert spec.num_processes == 2 and spec.members == [0, 2]
+    assert spec.dp_replicate_size == 2
+    env = spec.to_env(new_rank=1)
+    assert env["ACCELERATE_NUM_PROCESSES"] == "2"
+    assert env["ACCELERATE_PROCESS_ID"] == "1"
+    assert env["PARALLELISM_CONFIG_DP_REPLICATE_SIZE"] == "2"
+    assert env["ACCELERATE_RESTART_GENERATION"] == "1"
+    assert env["ACCELERATE_ELASTIC_RESUME"] == "1"
+    assert env["ACCELERATE_RESUME_FROM_CHECKPOINT"] == "latest"
+
+
+def test_negotiate_membership_rejects_bad_shrinks():
+    with pytest.raises(MembershipError):  # 4*3/4 = 3: fine; 4*3 % 4 != 0 -> no
+        negotiate_membership([0, 1, 2], 4, generation=1,
+                             prev_axis_sizes={"dp_replicate": 2})
+    with pytest.raises(MembershipError):  # model-parallel axes cannot absorb
+        negotiate_membership([0], 2, generation=1, prev_axis_sizes={"tp": 2})
+    with pytest.raises(MembershipError):
+        negotiate_membership([], 2, generation=1)
+
+
+def test_roster_handshake(tmp_path, monkeypatch):
+    roster_dir = str(tmp_path / "cohort")
+    monkeypatch.setenv("ACCELERATE_RESTART_GENERATION", "2")
+    monkeypatch.setenv("ACCELERATE_PROCESS_ID", "3")
+    membership_mod.announce_membership(roster_dir)
+    roster = membership_mod.read_roster(roster_dir, 2)
+    assert 3 in roster and roster[3]["generation"] == 2
+    assert membership_mod.read_roster(roster_dir, 1) == {}  # namespaced by gen
+    spec = CohortSpec(generation=2, num_processes=1, members=[3])
+    membership_mod.publish_cohort_spec(roster_dir, spec)
+    assert membership_mod.load_cohort_spec(roster_dir, 2) == spec
+    assert membership_mod.load_cohort_spec(roster_dir, 9) is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor mechanics (fast children — no jax import)
+
+
+def test_classify_exit_reserved_codes():
+    assert classify_exit(0) == ("clean", False)
+    assert classify_exit(101) == ("stall_abort", True)  # reserved: stall abort
+    assert classify_exit(-9) == ("killed", True)
+    assert classify_exit(-15) == ("terminated", True)
+    assert classify_exit(-11) == ("signal:11", True)
+    assert classify_exit(3) == ("crash", True)
+
+
+def test_restart_policy_backoff_bounded():
+    p = RestartPolicy(backoff_base_s=1.0, backoff_factor=2.0, backoff_max_s=5.0)
+    assert [p.backoff(i) for i in (1, 2, 3, 4, 5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_supervisor_clean_exit_needs_no_restart(tmp_path):
+    sup = Supervisor([[sys.executable, "-c", "pass"]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=3, backoff_base_s=0.01))
+    assert sup.run() == 0
+    assert sup.restarts_used == 0 and sup.incidents == []
+
+
+def test_supervisor_budget_exhaustion(tmp_path):
+    """A child that always crashes burns the budget, then the supervisor gives
+    up, propagates the exit code, and records the exhaustion."""
+    sup = Supervisor([[sys.executable, "-c", "import sys; sys.exit(3)"]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=1, backoff_base_s=0.01,
+                                          poison_threshold=0))
+    rc = sup.run()
+    assert rc == 3
+    assert sup.restarts_used == 1
+    assert [i.cause for i in sup.incidents] == ["crash", "crash"]
+    events = [json.loads(l) for l in
+              open(tmp_path / "events-supervisor.jsonl") if l.strip()]
+    gave_up = [e for e in events if e.get("kind") == "restart" and e.get("gave_up")]
+    assert gave_up and gave_up[0]["budget_exhausted"]
+
+
+def test_supervisor_restarts_sigkilled_child(tmp_path):
+    """SIGKILL (the preemption signature) in generation 0; generation 1 runs
+    clean — the supervisor classifies, restarts once, and finishes 0."""
+    marker = tmp_path / "DONE"
+    child = (
+        "import os, signal\n"
+        "if os.environ['ACCELERATE_RESTART_GENERATION'] == '0':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        f"open({str(marker)!r}, 'w').write('ok')\n"
+    )
+    sup = Supervisor([[sys.executable, "-c", child]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=2, backoff_base_s=0.01))
+    assert sup.run() == 0
+    assert sup.restarts_used == 1
+    assert sup.incidents[0].cause == "killed"
+    assert marker.is_file()
+
+
+def test_supervisor_poison_step_diagnosis(tmp_path, capsys):
+    """Repeated crash at the SAME step is a deterministic bug, not a
+    preemption: the supervisor must stop with a diagnosis instead of burning
+    the whole budget re-dying."""
+    child = (
+        "import json, os, sys\n"
+        f"d = {str(tmp_path)!r}\n"
+        "json.dump({'kind': 'flight_record', 'step': 7, 'events': []},\n"
+        "          open(os.path.join(d, 'flight-rank0.json'), 'w'))\n"
+        "sys.exit(1)\n"
+    )
+    sup = Supervisor([[sys.executable, "-c", child]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=10, backoff_base_s=0.01,
+                                          poison_threshold=2))
+    rc = sup.run()
+    assert rc == 1
+    # stopped after threshold same-step crashes, NOT after 10 restarts
+    assert sup.restarts_used == 1
+    assert "poison step" in capsys.readouterr().err
+    events = [json.loads(l) for l in
+              open(tmp_path / "events-supervisor.jsonl") if l.strip()]
+    poison = [e for e in events if e.get("cause") == "poison_step"]
+    assert poison and poison[0]["step"] == 7 and poison[0]["gave_up"]
+
+
+def test_supervisor_heartbeat_gap_detection(tmp_path):
+    """A child that hangs without ever touching its heartbeat file trips the
+    mtime watch — the hang class exit codes cannot report."""
+    child = "import time\ntime.sleep(600)\n"
+    sup = Supervisor([[sys.executable, "-c", child]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=0, backoff_base_s=0.01,
+                                          heartbeat_timeout_s=0.5,
+                                          grace_period_s=0.5))
+    t0 = time.monotonic()
+    rc = sup.run()
+    assert rc == 1  # budget 0: the gap exhausts it immediately
+    assert time.monotonic() - t0 < 30
+    assert sup.incidents[0].cause == "heartbeat_gap"
+
+
+def test_supervisor_resets_heartbeat_file_on_respawn(tmp_path):
+    """A stale heartbeat mtime left by the dead generation must not re-trip
+    the gap watch before the new child can arm its watchdog: the supervisor
+    deletes the file at every spawn."""
+    child = (
+        "import os, sys, time\n"
+        "hb = os.environ['ACCELERATE_HEARTBEAT_FILE']\n"
+        "open(hb, 'w').write('beat')\n"
+        "if os.environ['ACCELERATE_RESTART_GENERATION'] == '0':\n"
+        "    time.sleep(600)\n"   # silent hang: the gap watch must end gen 0
+        "for _ in range(10):\n"   # gen 1 beats healthily, outliving the
+        "    open(hb, 'w').write('beat')\n"  # leftover gen-0 mtime age
+        "    time.sleep(0.2)\n"
+        "sys.exit(0)\n"
+    )
+    sup = Supervisor([[sys.executable, "-c", child]],
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=2, backoff_base_s=0.01,
+                                          heartbeat_timeout_s=1.0,
+                                          grace_period_s=0.5))
+    assert sup.run() == 0
+    assert sup.restarts_used == 1  # only the real gen-0 hang tripped
+    assert [i.cause for i in sup.incidents] == ["heartbeat_gap"]
+
+
+def test_heartbeat_watch_ignores_cleanly_exited_ranks(tmp_path):
+    """A rank that finished (rc 0) stops touching its heartbeat file — that
+    natural staleness must not tear down the still-healthy cohort."""
+    fast = (
+        "import os\n"
+        "open(os.environ['ACCELERATE_HEARTBEAT_FILE'], 'w').write('beat')\n"
+    )
+    slow = (
+        "import os, time\n"
+        "hb = os.environ['ACCELERATE_HEARTBEAT_FILE']\n"
+        "for _ in range(15):\n"
+        "    open(hb, 'w').write('beat')\n"
+        "    time.sleep(0.2)\n"
+    )
+    sup = Supervisor(
+        [[sys.executable, "-c", fast], [sys.executable, "-c", slow]],
+        telemetry_dir=str(tmp_path),
+        policy=RestartPolicy(max_restarts=0, backoff_base_s=0.01,
+                             heartbeat_timeout_s=1.0, grace_period_s=0.5),
+    )
+    assert sup.run() == 0  # no spurious heartbeat_gap from the finished rank
+    assert sup.incidents == []
+
+
+def test_single_child_supervision_preserves_launcher_world_size(tmp_path):
+    """Supervising ONE child (which may be a rank of a multi-host job) must
+    not clobber the launcher's ACCELERATE_NUM_PROCESSES/PROCESS_ID."""
+    out = tmp_path / "env.json"
+    child = (
+        "import json, os\n"
+        f"json.dump({{k: os.environ.get(k) for k in ('ACCELERATE_NUM_PROCESSES',"
+        f" 'ACCELERATE_PROCESS_ID', 'ACCELERATE_RESTART_GENERATION')}},"
+        f" open({str(out)!r}, 'w'))\n"
+    )
+    env = dict(os.environ, ACCELERATE_NUM_PROCESSES="4", ACCELERATE_PROCESS_ID="2")
+    sup = Supervisor([[sys.executable, "-c", child]], env=env,
+                     telemetry_dir=str(tmp_path),
+                     policy=RestartPolicy(max_restarts=0, backoff_base_s=0.01))
+    assert sup.run() == 0
+    seen = json.loads(out.read_text())
+    assert seen["ACCELERATE_NUM_PROCESSES"] == "4"
+    assert seen["ACCELERATE_PROCESS_ID"] == "2"
+    assert seen["ACCELERATE_RESTART_GENERATION"] == "0"
+
+
+def test_launch_elastic_honors_explicit_zero_restarts(tmp_path):
+    """`--elastic --max_restarts 0` means supervise-but-never-restart; the
+    elastic default of 3 applies only when the flag is absent."""
+    import accelerate_tpu.commands.launch as L
+
+    captured = {}
+
+    def fake_supervise(cmd, env=None, policy=None, telemetry_dir=None,
+                       axis_sizes=None):
+        captured["policy"] = policy
+        return 0
+
+    parser = L.launch_command_parser()
+    real = L.__dict__.get("elastic_launcher")
+    import accelerate_tpu.resilience.supervisor as S
+    orig = S.__dict__["supervise_command"]
+    try:
+        S.supervise_command = fake_supervise
+        args = parser.parse_args(["--cpu", "--elastic", "--max_restarts", "0", "x.py"])
+        assert L.launch_command(args) == 0
+        assert captured["policy"].max_restarts == 0
+        args = parser.parse_args(["--cpu", "--elastic", "x.py"])
+        assert L.launch_command(args) == 0
+        assert captured["policy"].max_restarts == 3
+    finally:
+        S.supervise_command = orig
+    assert real is not None  # sanity: the launcher exists
+
+
+def test_restarts_section_renders_for_reshard_only_runs(tmp_path):
+    """A manual elastic reshard (no supervisor) must still show up in the
+    formatted report."""
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "r",
+                            "process_index": 0}) + "\n")
+        f.write(json.dumps({"kind": "elastic", "phase": "reshard",
+                            "saved_mesh": {"dp_replicate": 4},
+                            "current_mesh": {"dp_replicate": 2}}) + "\n")
+    text = format_report(build_report([str(tmp_path)]))
+    assert "elastic reshard" in text
+
+
+def test_watchdog_touches_heartbeat_file(tmp_path, monkeypatch):
+    from accelerate_tpu.telemetry.watchdog import Watchdog
+
+    hb = tmp_path / "heartbeat-rank0"
+    monkeypatch.setenv("ACCELERATE_HEARTBEAT_FILE", str(hb))
+    wd = Watchdog(timeout=30.0, interval=0.05, out_dir=str(tmp_path)).start()
+    try:
+        assert hb.is_file()  # created at start
+        first = hb.stat().st_mtime
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and hb.stat().st_mtime == first:
+            time.sleep(0.05)
+        assert hb.stat().st_mtime > first  # ticked
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-topology re-sharding
+
+
+def test_resize_padded_bucket_semantics():
+    v = np.array([1.0, 2.0, 3.0, 0.0], np.float32)  # fill=3, padded to 4
+    grown = resize_padded_bucket(v, 6)
+    np.testing.assert_array_equal(grown, [1, 2, 3, 0, 0, 0])
+    shrunk = resize_padded_bucket(grown, 3)
+    np.testing.assert_array_equal(shrunk, [1, 2, 3])
+    assert resize_padded_bucket(v, 4) is v  # no-op passthrough
+    with pytest.raises(ValueError, match="nonzero"):
+        resize_padded_bucket(v, 2)  # would drop real data
+
+
+def test_topology_matching_and_guard():
+    assert topology_matches({"dp_replicate": 4, "tp": 1}, {"dp_replicate": 4})
+    assert topology_matches(None, {"dp_replicate": 4})  # legacy: unknown passes
+    assert not topology_matches({"dp_replicate": 4}, {"dp_replicate": 2})
+    # same topology -> no resharding
+    assert check_topology({"dp_replicate": 4}, {"dp_replicate": 4}) is False
+    # pure refactorization (global shapes invariant) passes WITHOUT elastic —
+    # the coordinate loader has always handled fsdp=8 -> fsdp=4xtp=2
+    assert check_topology({"dp_shard": 8}, {"dp_shard": 4, "tp": 2}) is False
+    # a dp_replicate width change is shape-affecting (ZeRO-1 bucket padding):
+    # blocked without elastic, re-pad with
+    with pytest.raises(CheckpointTopologyError) as err:
+        check_topology({"dp_replicate": 4}, {"dp_replicate": 2})
+    assert "dp_replicate=4" in str(err.value) and "dp_replicate=2" in str(err.value)
+    assert check_topology({"dp_replicate": 4}, {"dp_replicate": 2}, elastic=True)
+    # dp change composed with other axis changes still goes through elastically
+    assert check_topology({"dp_replicate": 2, "tp": 2}, {"dp_replicate": 4},
+                          elastic=True)
+
+
+def _fused_zero1_setup(n_dev, params_host, bucket_bytes=1 << 20):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.parallel.weight_update import (
+        build_bucket_plan,
+        init_bucketed_opt_state,
+        make_fused_zero1_update,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp_replicate",))
+    repl = NamedSharding(mesh, P())
+    params = jax.device_put(params_host, repl)
+    plan = build_bucket_plan(params, "dp_replicate", n_dev, bucket_bytes)
+    tx = optax.adam(1e-2)
+    state, specs = init_bucketed_opt_state(tx, params, plan, mesh)
+    fused = make_fused_zero1_update(tx, plan, mesh, specs)
+
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    def step(p, st, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        new_p, new_st = fused(grads, st, p)
+        return new_p, new_st, loss
+
+    batch = jax.device_put(jnp.ones((4, 19), jnp.float32), repl)
+    return mesh, params, state, jax.jit(step), batch
+
+
+def test_fused_zero1_dp4_to_dp2_reshard_parity(tmp_path):
+    """The in-process re-shard core: a fused-ZeRO-1 state saved at dp=4
+    (buckets padded to 1048) restores at dp=2 (padded to 1046) via the
+    elastic loader, and continued training matches the dp=4 continuation
+    bitwise. 1045 elements were chosen so the paddings actually differ."""
+    params_host = {"w": np.linspace(-1, 1, 19 * 55, dtype=np.float32).reshape(19, 55)}
+    mesh4, p4, s4, step4, batch4 = _fused_zero1_setup(4, params_host)
+    for _ in range(2):
+        p4, s4, _ = step4(p4, s4, batch4)
+    d = str(tmp_path / "ck")
+    save_sharded_pytree(s4, d, prefix="optimizer")
+    save_sharded_pytree(p4, d, prefix="model")
+    assert read_saved_mesh(d, "optimizer") == {"dp_replicate": 4}
+    saved_mu = np.asarray(jax.device_get(s4[0].mu["b000"]))
+    assert saved_mu.shape == (1048,)
+
+    mesh2, p2_init, s2_template, step2, batch2 = _fused_zero1_setup(2, params_host)
+    assert s2_template[0].mu["b000"].shape == (1046,)
+    # non-elastic load refuses the shape change
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_sharded_pytree(s2_template, d, prefix="optimizer")
+    s2 = load_sharded_pytree(s2_template, d, prefix="optimizer", elastic=True)
+    p2 = load_sharded_pytree(p2_init, d, prefix="model", elastic=True)
+    loaded_mu = np.asarray(jax.device_get(s2[0].mu["b000"]))
+    np.testing.assert_array_equal(loaded_mu[:1045], saved_mu[:1045])
+    assert not loaded_mu[1045:].any()  # re-pad, not data
+
+    # continue one step on each topology: identical math, bitwise params
+    p4b, _, _ = step4(p4, s4, batch4)
+    p2b, _, _ = step2(p2, s2, batch2)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(p4b["w"])), np.asarray(jax.device_get(p2b["w"]))
+    )
+
+
+def test_load_state_topology_error_names_both_shapes(tmp_path):
+    """Accelerator.load_state onto a different mesh factorization fails with
+    CheckpointTopologyError up front — not a deep jax shape error — and the
+    elastic path refuses model-parallel changes too."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path),
+                                            automatic_checkpoint_naming=True),
+        parallelism_config=ParallelismConfig(dp_replicate_size=8),
+    )
+    params = {"w": np.ones((32, 8), np.float32)}
+    out = acc.save_state(params=params)
+    manifest = json.load(open(os.path.join(out, "_COMMITTED")))
+    assert manifest["mesh"]["dp_replicate"] == 8
+    acc.end_training()
+
+    AcceleratorState._reset_state()
+    acc2 = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path),
+                                            automatic_checkpoint_naming=True),
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+    )
+    with pytest.raises(CheckpointTopologyError) as err:
+        acc2.load_state(out, params=params)
+    assert "dp_replicate=8" in str(err.value) and "dp_shard=8" in str(err.value)
+    # elastic: params have topology-invariant global shapes — loads fine onto
+    # the refactorized mesh
+    restored = acc2.load_state(out, params=params, elastic=True)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), params["w"])
+    acc2.end_training()
+
+
+# ---------------------------------------------------------------------------
+# restarts telemetry -> report
+
+
+def test_restarts_report_section(tmp_path):
+    with open(tmp_path / "events-supervisor.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "r",
+                            "role": "supervisor"}) + "\n")
+        f.write(json.dumps({"kind": "elastic", "phase": "start",
+                            "processes": 2}) + "\n")
+        f.write(json.dumps({"kind": "restart", "generation": 1, "attempt": 1,
+                            "cause": "killed", "exit_code": -9, "step": 4,
+                            "dump": "flight-rank0.json",
+                            "downtime_s": 2.5}) + "\n")
+        f.write(json.dumps({"kind": "restart", "generation": 2, "attempt": 2,
+                            "cause": "stall_abort", "exit_code": 101,
+                            "downtime_s": 1.5}) + "\n")
+        f.write(json.dumps({"kind": "elastic", "phase": "reshard",
+                            "saved_mesh": {"dp_replicate": 4},
+                            "current_mesh": {"dp_replicate": 2}}) + "\n")
+        f.write(json.dumps({"kind": "elastic", "phase": "done",
+                            "generation": 2, "restarts": 2}) + "\n")
+    rep = build_report([str(tmp_path)])
+    rs = rep["restarts"]
+    assert rs["count"] == 2 and rs["generations"] == 2
+    assert rs["downtime_s"] == 4.0
+    assert rs["causes"] == {"killed": 1, "stall_abort": 1}
+    assert rs["completed"] and rs["dumps"] == ["flight-rank0.json"]
+    assert rs["reshards"][0]["saved_mesh"] == {"dp_replicate": 4}
+    text = format_report(rep)
+    assert "restarts: 2 restart(s) over 3 generation(s)" in text
+    assert "cause killed: 1" in text and "elastic reshard" in text
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: the acceptance scenario
+
+
+def test_e2e_sigkill_and_hang_autoresume_bitwise_parity(tmp_path):
+    """The headline acceptance e2e: under a seeded SIGKILL + hang fault
+    schedule, `accelerate-tpu launch --elastic` finishes training with final
+    params BITWISE-identical to the fault-free run. Generation 0 is
+    preempted (SIGKILL) mid-epoch; generation 1 wedges in a chaos hang the
+    watchdog turns into a 101 stall-abort; generation 2 runs clean — every
+    resume comes off the last committed checkpoint, and the restart
+    telemetry attributes both causes."""
+    ref_dir = tmp_path / "ref"
+    chaos_dir = tmp_path / "chaos"
+    tel_dir = chaos_dir / "telemetry"
+    for d in (ref_dir, chaos_dir, tel_dir):
+        d.mkdir(parents=True)
+
+    # the reference must see the same 8-virtual-device topology `launch --cpu`
+    # gives the supervised run: reduction order is part of bitwise parity
+    ref = subprocess.run(
+        _toy_cmd(ref_dir),
+        env=_child_env(XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                       ACCELERATE_USE_CPU="true"),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert ref.returncode == 0, ref.stderr[-2000:]
+
+    schedule = ChaosSchedule(
+        faults=[
+            Fault(kind="sigkill", point="train_step", step=3, generation=0),
+            Fault(kind="hang", point="train_step", step=1, generation=1,
+                  duration_s=None),  # forever: only the watchdog ends it
+        ],
+        seed=7,
+    )
+    env = _child_env(
+        ACCELERATE_CHAOS_SCHEDULE=schedule.to_json(),
+        ACCELERATE_TELEMETRY_DIR=str(tel_dir),
+        ACCELERATE_WATCHDOG_TIMEOUT="2",  # launch --elastic defaults ABORT=1
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch",
+         "--cpu", "--elastic", "--max_restarts", "3",
+         "--monitor_interval", "0.1", "-m",
+         "accelerate_tpu.resilience._toy_train",
+         "--project-dir", str(chaos_dir), "--steps", "6",
+         "--save-every", "2", "--global-batch", "8"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-3000:])
+    # the committed checkpoint the first resume came from predates the kill
+    assert (chaos_dir / "checkpoints" / "checkpoint_0" / "_COMMITTED").is_file()
+
+    ref_params = dict(np.load(ref_dir / "final_params.npz"))
+    chaos_params = dict(np.load(chaos_dir / "final_params.npz"))
+    assert set(ref_params) == set(chaos_params)
+    for k in ref_params:
+        np.testing.assert_array_equal(ref_params[k], chaos_params[k])
+
+    rep = build_report([str(tel_dir)])
+    rs = rep["restarts"]
+    assert rs["count"] == 2 and rs["completed"] and rs["generations"] == 2
+    assert rs["causes"] == {"killed": 1, "stall_abort": 1}
+    assert rs["dumps"]  # the stall abort dumped a flight record and linked it
+    text = format_report(rep)
+    assert "restarts: 2 restart(s) over 3 generation(s)" in text
+
+
+@pytest.mark.slow
+def test_e2e_dp4_to_dp2_elastic_resume_full_stack(tmp_path):
+    """Full-stack cross-topology resume: train+checkpoint at dp=4 (fused
+    ZeRO-1), resume the same project dir on a dp=2 device set with the elastic
+    env the supervisor injects, and match an uninterrupted dp=2 run bitwise
+    (loss-curve continuity at full precision)."""
+    a_dir, ref_dir = tmp_path / "a", tmp_path / "ref"
+    a_dir.mkdir(), ref_dir.mkdir()
+
+    def run(project_dir, n_dev, **extra_env):
+        env = _child_env(
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={n_dev}",
+            **extra_env,
+        )
+        return subprocess.run(
+            _toy_cmd(project_dir, steps=6, save_every=2, zero_stage=1),
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    r = run(a_dir, 4)
+    assert r.returncode == 0, r.stderr[-2000:]
+    # pretend the run died after checkpoint_0 committed (mid-epoch)
+    for stale in ("checkpoint_1", "checkpoint_2"):
+        p = a_dir / "checkpoints" / stale
+        if p.is_dir():
+            import shutil
+
+            shutil.rmtree(p)
+    (a_dir / "final_params.npz").unlink()
+
+    r = run(a_dir, 2, ACCELERATE_RESUME_FROM_CHECKPOINT="latest",
+            ACCELERATE_ELASTIC_RESUME="1", ACCELERATE_RESTART_GENERATION="1")
+    assert r.returncode == 0, r.stderr[-2000:]
+    resumed = json.loads(r.stdout.strip().splitlines()[-1])
+    assert resumed["resumed_from_iteration"] == 0
+
+    r = run(ref_dir, 2)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    a = dict(np.load(a_dir / "final_params.npz"))
+    ref = dict(np.load(ref_dir / "final_params.npz"))
+    for k in ref:
+        np.testing.assert_array_equal(a[k], ref[k])
+
+
+def test_launch_elastic_flag_supervises(tmp_path):
+    """`accelerate-tpu launch --elastic` routes through the supervisor: a
+    script that SIGKILLs itself in generation 0 and succeeds in generation 1
+    must leave rc 0 and a restart record."""
+    script = tmp_path / "train.py"
+    marker = tmp_path / "DONE"
+    script.write_text(
+        "import os, signal\n"
+        "if os.environ.get('ACCELERATE_RESTART_GENERATION', '0') == '0':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        f"open({str(marker)!r}, 'w').write('ok')\n"
+    )
+    tel_dir = tmp_path / "telemetry"
+    env = _child_env(ACCELERATE_TELEMETRY_DIR=str(tel_dir))
+    r = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch",
+         "--cpu", "--elastic", "--max_restarts", "2", "--monitor_interval", "0.1",
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert marker.is_file()
+    events = [json.loads(l) for l in
+              open(tel_dir / "events-supervisor.jsonl") if l.strip()]
+    restarts = [e for e in events if e["kind"] == "restart"]
+    assert len(restarts) == 1 and restarts[0]["cause"] == "killed"

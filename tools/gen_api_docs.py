@@ -195,6 +195,27 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
            "percentile", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
+    "resilience": (
+        "Resilience",
+        "Elastic preemption-tolerant training (no reference counterpart): the "
+        "`accelerate-tpu launch --elastic` supervisor (exit-code "
+        "classification, heartbeat-file gaps, bounded-backoff auto-resume, "
+        "poison-step diagnosis), cohort membership across restarts, "
+        "cross-topology checkpoint re-sharding, and the deterministic chaos "
+        "harness behind `make chaos`. See `docs/resilience.md`.",
+        [("accelerate_tpu.resilience.supervisor",
+          ["RestartPolicy", "Supervisor", "classify_exit", "supervise_command"]),
+         ("accelerate_tpu.resilience.membership",
+          ["CohortSpec", "MembershipError", "negotiate_membership",
+           "announce_membership", "read_roster", "publish_cohort_spec",
+           "load_cohort_spec", "await_roster", "current_generation"]),
+         ("accelerate_tpu.resilience.reshard",
+          ["check_topology", "topology_matches", "is_elastic_compatible",
+           "mesh_shape_dict", "saved_topology", "describe_shapes"]),
+         ("accelerate_tpu.resilience.chaos",
+          ["ChaosSchedule", "Fault", "ChaosFaultError", "arm",
+           "maybe_arm_from_env", "maybe_inject", "replan_data_assignment"])],
+    ),
     "tracking": (
         "Experiment tracking",
         "Tracker abstraction + integrations (reference `tracking.py`).",
