@@ -18,7 +18,7 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt bench-zero1 doctor lint profile chaos
+        bench-input bench-ckpt bench-zero1 bench-serve doctor lint profile chaos
 
 PYTEST := python -m pytest -q
 
@@ -94,6 +94,12 @@ bench-ckpt:
 # step time, opt-state bytes/replica, comms-overlap ratio
 bench-zero1:
 	python benchmarks/weight_update/run.py
+
+# continuous-vs-static batching through the paged-KV serving engine under a
+# seeded Poisson open-loop load: aggregate tok/s ratio, batch occupancy,
+# p50/p99 per-request latency (benchmarks/serving)
+bench-serve:
+	python benchmarks/serving/run.py
 
 # self-check: flight-recorder dump, watchdog stall detection, straggler
 # report, collective-divergence detection, the jaxlint engine, perf cost

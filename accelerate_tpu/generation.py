@@ -36,6 +36,7 @@ from .models.transformer import LlamaConfig, apply_rope, rms_norm, rope_frequenc
 __all__ = [
     "init_kv_cache",
     "generation_shardings",
+    "serving_shardings",
     "greedy_generate",
     "sample_generate",
     "beam_generate",
@@ -93,6 +94,23 @@ def generation_shardings(mesh, batch_size: int, config: LlamaConfig):
     return prompt_sharding, cache_sharding
 
 
+def serving_shardings(mesh, config: LlamaConfig):
+    """NamedSharding for the serving engine's paged block pool
+    ``[L, num_blocks, block_size, Hkv, D]`` — the paged-cache leg of the same
+    placement policy as :func:`generation_shardings`: KV heads over ``tp``
+    (where divisible) so the Megatron decode dataflow carries over unchanged;
+    the block axis stays replicated because block tables address the WHOLE
+    pool (any sequence may hold any block, so there is no batch axis to
+    shard — batch parallelism for serving is a scheduler concern: run one
+    engine per data-parallel replica)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    axes = dict(mesh.shape)
+    tp = "tp" if axes.get("tp", 1) > 1 and config.n_kv_heads % axes["tp"] == 0 else None
+    return NamedSharding(mesh, P(None, None, None, tp, None))
+
+
 def _place_for_mesh(mesh, prompt_ids, cache, config):
     """device_put prompt + cache per :func:`generation_shardings`."""
     prompt_sharding, cache_sharding = generation_shardings(mesh, prompt_ids.shape[0], config)
@@ -101,11 +119,16 @@ def _place_for_mesh(mesh, prompt_ids, cache, config):
     return prompt_ids, cache
 
 
-def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
-    """q: [B, S, H, D]; caches [B, max_len, Hkv, D]; q_positions [S] — attend
-    causally over all cache slots with position <= the query's position."""
+def _masked_attention(q, k_cache, v_cache, allow, scale=None):
+    """The decode attention core shared by the contiguous path here and the
+    paged path (``serving.kv_pager.paged_attention``): q ``[B, S, H, D]``
+    against caches ``[B, T, Hkv, D]`` under a boolean ``allow`` mask
+    broadcastable to ``[B, H, S, T]``. One implementation so the two paths
+    cannot drift — masked slots contribute EXACTLY 0 to the softmax (the
+    ``finfo.min`` fill underflows to 0.0 after the max-subtraction), which is
+    what makes paged decode bitwise-identical to contiguous decode even
+    though the gathered ``T`` differs."""
     B, S, H, D = q.shape
-    max_len = k_cache.shape[1]
     hkv = k_cache.shape[2]
     # GQA head-repeat: the H/Hkv ratio is fixed per model config, so this
     # shape branch specializes exactly once — not a per-step recompile
@@ -116,11 +139,32 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
     scale = 1.0 / np.sqrt(D) if scale is None else scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
-    kv_pos = jnp.arange(max_len)
-    allow = kv_pos[None, :] <= q_positions[:, None]  # [S, max_len]
-    logits = jnp.where(allow[None, None], logits, jnp.finfo(jnp.float32).min)
+    logits = jnp.where(allow, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def _cached_attention(q, k_cache, v_cache, q_positions, scale=None):
+    """q: [B, S, H, D]; caches [B, max_len, Hkv, D]; q_positions [S] — attend
+    causally over all cache slots with position <= the query's position."""
+    max_len = k_cache.shape[1]
+    kv_pos = jnp.arange(max_len)
+    allow = kv_pos[None, :] <= q_positions[:, None]  # [S, max_len]
+    return _masked_attention(q, k_cache, v_cache, allow[None, None], scale)
+
+
+def _project_qkv(layer_params, x, positions, cos, sin, config):
+    """Shared QKV projection + RoPE for the cached-decode layer step: x
+    ``[B, S, dim]``, per-row ``positions [B, S]``. Returns ``(q, k, v)`` in
+    BSHD; used by both the contiguous layer step here and the paged one in
+    ``serving.engine`` so projection math cannot drift between them."""
+    B, S, _ = x.shape
+    q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
+    k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+    v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+    q = apply_rope(q, cos, sin, positions=positions)
+    k = apply_rope(k, cos, sin, positions=positions)
+    return q, k, v
 
 
 def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config, mesh=None):
@@ -128,11 +172,9 @@ def _layer_step(layer_params, h, k_cache, v_cache, positions, cos, sin, config, 
     caches in place (dynamic_update_slice along the sequence axis)."""
     B, S, _ = h.shape
     x = rms_norm(h, layer_params["attn_norm"]["scale"], config.norm_eps)
-    q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
-    k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
-    v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
-    q = apply_rope(q, cos, sin, positions=jnp.broadcast_to(positions[None], (B, S)))
-    k = apply_rope(k, cos, sin, positions=jnp.broadcast_to(positions[None], (B, S)))
+    q, k, v = _project_qkv(
+        layer_params, x, jnp.broadcast_to(positions[None], (B, S)), cos, sin, config
+    )
     start = positions[0]
     k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))

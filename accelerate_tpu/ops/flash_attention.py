@@ -79,3 +79,20 @@ def flash_attention(
         qt, kt, vt, segment_ids=seg, causal=causal, sm_scale=sm_scale, block_sizes=block_sizes
     )
     return out.transpose(0, 2, 1, 3).astype(orig_dtype)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, q_positions, scale=None):
+    """Paged decode attention for the serving engine (kernel dispatch point).
+
+    q ``[B, S, H, D]``; per-layer pools ``[num_blocks, block_size, Hkv, D]``;
+    ``block_tables [B, W]`` (physical block ids, null-padded); ``q_positions
+    [B, S]``. Today every backend runs the XLA reference path
+    (``serving.kv_pager.paged_attention``: gather blocks by table, shared
+    masked-attention core — bitwise-identical to contiguous decode); a
+    Pallas paged-attention kernel that streams blocks through VMEM without
+    materializing the gathered cache (vLLM-style PagedAttention) is the TPU
+    upgrade and slots in HERE without touching engine callers, exactly like
+    :func:`flash_attention`'s pallas-vs-xla split."""
+    from ..serving.kv_pager import paged_attention as _xla_paged
+
+    return _xla_paged(q, k_pool, v_pool, block_tables, q_positions, scale)

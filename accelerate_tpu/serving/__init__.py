@@ -1,0 +1,45 @@
+"""Production serving: continuous batching over a paged KV cache.
+
+The serve-many-concurrent-requests counterpart of ``generation.py``'s
+single-stream decode (ROADMAP item 1). Three pillars:
+
+- :mod:`~accelerate_tpu.serving.kv_pager` — fixed-size KV blocks in one
+  preallocated device pool, host-side block allocator, paged attention;
+- :mod:`~accelerate_tpu.serving.scheduler` — step-granular admission,
+  immediate completion/backfill, LIFO preemption with persisted resume;
+- :mod:`~accelerate_tpu.serving.engine` — the
+  :class:`~accelerate_tpu.serving.engine.ServingEngine` step loop, compiled
+  only over the :mod:`~accelerate_tpu.serving.buckets` shape lattice so
+  admission churn never recompiles.
+
+See ``docs/serving.md`` for the guide and ``benchmarks/serving/`` for the
+continuous-vs-static Poisson-load benchmark (``make bench-serve``).
+"""
+
+from .buckets import BucketLattice
+from .engine import ServingEngine, paged_forward
+from .kv_pager import (
+    NULL_BLOCK,
+    BlockAllocator,
+    BlockAllocatorError,
+    BlockPoolExhausted,
+    init_block_pool,
+    paged_attention,
+)
+from .scheduler import Request, RequestStatus, Scheduler, SchedulingError
+
+__all__ = [
+    "BucketLattice",
+    "ServingEngine",
+    "paged_forward",
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "BlockAllocatorError",
+    "BlockPoolExhausted",
+    "init_block_pool",
+    "paged_attention",
+    "Request",
+    "RequestStatus",
+    "Scheduler",
+    "SchedulingError",
+]

@@ -1,0 +1,116 @@
+"""Bucketed shape compilation for the serving engine.
+
+Continuous batching churns: requests arrive, finish, and get evicted at step
+granularity, so the "natural" shapes of an engine step — how many batch slots
+are live, how wide the widest block table is, how long the admitted prompt is
+— change constantly. Feeding those raw shapes to ``jax.jit`` would recompile
+on nearly every admission (seconds to minutes of XLA time each, the classic
+cliff jaxlint R2 and the telemetry recompile detector exist to catch).
+
+The fix is a small static lattice: every engine step function compiles ONLY
+at shapes drawn from this lattice — batch slots and per-sequence block-table
+width rounded UP to the nearest power-of-two bucket (prefill lengths
+likewise) — so the jit cache is bounded by ``len(slot_buckets) *
+len(block_buckets)`` decode entries plus ``len(prefill_buckets)`` prefill
+entries, all warmable up front. Admission/eviction churn after warmup can
+never trigger a compile; the zero-recompile regression test and ``make
+doctor`` check 12 hold the line.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def _pow2_buckets(max_value: int, floor: int = 1) -> "tuple[int, ...]":
+    """Powers of two from ``floor`` up to and including ``max_value`` (the max
+    itself is appended when it is not a power of two, so the lattice always
+    covers the configured limit exactly)."""
+    if max_value < 1:
+        raise ValueError(f"max_value must be >= 1, got {max_value}")
+    buckets = []
+    b = max(1, floor)
+    while b < max_value:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_value)
+    return tuple(buckets)
+
+
+@dataclass(frozen=True)
+class BucketLattice:
+    """The static shape lattice the engine compiles over.
+
+    - ``slot_buckets``: decode batch sizes (live batch slots rounded up);
+    - ``block_buckets``: per-sequence block-table widths (the gather width of
+      the paged attention, rounded up to the widest live sequence's bucket);
+    - ``prefill_buckets``: padded prompt lengths for prefill.
+
+    ``bucket_for`` rounds a live value up to the smallest covering bucket and
+    refuses values beyond the lattice — an engine misconfiguration must fail
+    loudly at admission, not silently compile a 33rd shape.
+    """
+
+    slot_buckets: "tuple[int, ...]"
+    block_buckets: "tuple[int, ...]"
+    prefill_buckets: "tuple[int, ...]"
+
+    def __post_init__(self):
+        for name in ("slot_buckets", "block_buckets", "prefill_buckets"):
+            buckets = getattr(self, name)
+            if not buckets or list(buckets) != sorted(set(buckets)):
+                raise ValueError(f"{name} must be non-empty, sorted, unique: {buckets}")
+
+    @classmethod
+    def from_limits(
+        cls,
+        max_slots: int,
+        max_blocks_per_seq: int,
+        max_prefill_len: int,
+        *,
+        min_prefill_len: int = 8,
+    ) -> "BucketLattice":
+        """Power-of-two lattice covering the engine's configured limits."""
+        return cls(
+            slot_buckets=_pow2_buckets(max_slots),
+            block_buckets=_pow2_buckets(max_blocks_per_seq),
+            prefill_buckets=_pow2_buckets(max_prefill_len, floor=min_prefill_len),
+        )
+
+    @staticmethod
+    def bucket_for(value: int, buckets: "tuple[int, ...]") -> int:
+        """Smallest bucket >= ``value``; ValueError beyond the lattice."""
+        for b in buckets:
+            if value <= b:
+                return b
+        raise ValueError(
+            f"value {value} exceeds the bucket lattice (max {buckets[-1]}); "
+            "raise the engine limit this lattice was built from"
+        )
+
+    def slot_bucket(self, n_slots: int) -> int:
+        return self.bucket_for(max(1, n_slots), self.slot_buckets)
+
+    def block_bucket(self, n_blocks: int) -> int:
+        return self.bucket_for(max(1, n_blocks), self.block_buckets)
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        return self.bucket_for(max(1, prompt_len), self.prefill_buckets)
+
+    def decode_points(self) -> "list[tuple[int, int]]":
+        """All (slot, block) decode compile points, for warmup."""
+        return [(s, b) for s in self.slot_buckets for b in self.block_buckets]
+
+    def prefill_points(self) -> "list[tuple[int, int]]":
+        """All (prefill_len, block) prefill compile points. The block width of
+        a prefill call is always the WIDEST block bucket — one width per
+        prompt length keeps the prefill lattice linear in
+        ``len(prefill_buckets)`` instead of the cross product (prefill is
+        per-request, so the extra gather width costs little, and a
+        resumed sequence's table may already be wider than its prompt)."""
+        widest = self.block_buckets[-1]
+        return [(s, widest) for s in self.prefill_buckets]
+
+    def size(self) -> int:
+        """Total compile points (the warmed jit-cache budget)."""
+        return len(self.decode_points()) + len(self.prefill_points())

@@ -1,0 +1,458 @@
+"""The serving engine: continuous batching over a paged KV cache.
+
+One :class:`ServingEngine` owns the device state (params + the paged block
+pool) and two compiled step functions:
+
+- **prefill** (per request, batch 1): forward the request's full prefix
+  (prompt + any tokens generated before a preemption) in length-bucketed
+  chunks — each chunk padded to the smallest covering prefill bucket, so a
+  prefix of ANY length stays inside the compiled lattice — writing KV into
+  the request's blocks via its block table, and sample the next token from
+  the last real position's logits;
+- **decode** (batched): one token for every live batch slot in a single
+  paged-attention forward at a bucketed (slots, table-width) shape, with
+  per-slot positions, per-slot PRNG keys and per-slot fold indices so each
+  request's token stream is EXACTLY what a single-stream
+  ``generation.greedy_generate`` / ``sample_generate`` call with batch 1
+  would produce — batch composition can never leak into a request's output.
+
+Both functions compile only at :class:`~accelerate_tpu.serving.buckets.
+BucketLattice` shapes; :meth:`ServingEngine.warmup` pre-compiles every
+lattice point so admission/eviction churn after warmup is recompile-free
+(guarded by ``tests/test_serving.py`` and ``make doctor`` check 12 via the
+telemetry recompile detector).
+
+Multi-chip placement rides the existing generation surface: pass ``mesh``
+(params already sharded via ``parallel.sharding``) and the pool is placed by
+:func:`~accelerate_tpu.generation.serving_shardings` — KV heads over ``tp``,
+the same Megatron decode dataflow as ``generation_shardings``.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..generation import _project_qkv, sample_token_logits, serving_shardings
+from ..models.transformer import LlamaConfig, rms_norm, rope_frequencies
+from ..ops.flash_attention import paged_attention
+from ..telemetry import events as tel
+from .buckets import BucketLattice
+from .kv_pager import NULL_BLOCK, BlockAllocator, init_block_pool
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServingEngine", "paged_forward"]
+
+
+def _paged_layer_step(layer_params, h, k_pool, v_pool, block_tables, positions,
+                      cos, sin, config, block_size):
+    """One decoder layer over per-row positions, writing K/V into the paged
+    pool (scatter at ``(block_tables[b, pos // block_size], pos %
+    block_size)``) — the paged counterpart of ``generation._layer_step``,
+    built from the same shared pieces (``_project_qkv``, ``llama_ffn``, the
+    masked-attention core) so the math cannot drift."""
+    B, S, _ = h.shape
+    x = rms_norm(h, layer_params["attn_norm"]["scale"], config.norm_eps)
+    q, k, v = _project_qkv(layer_params, x, positions, cos, sin, config)
+    W = block_tables.shape[1]
+    logical = positions // block_size
+    phys = jnp.take_along_axis(block_tables, jnp.minimum(logical, W - 1), axis=1)
+    # positions past the table (padded prefill tail) and inactive slots write
+    # to the null block — a pad write may never land in a live block
+    phys = jnp.where(logical < W, phys, NULL_BLOCK)
+    off = positions % block_size
+    k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+    attn = paged_attention(q, k_pool, v_pool, block_tables, positions)
+    h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
+    x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
+    from ..models.transformer import llama_ffn
+
+    capacity_factor = None
+    # decode-vs-prefill program split, same two-bucket shape family as the
+    # contiguous path (generation._layer_step) — not a per-step retrace
+    if config.moe_experts > 0 and S == 1:  # jaxlint: disable=R2
+        capacity_factor = max(config.moe_capacity_factor, config.moe_experts / config.moe_top_k)
+    y, _ = llama_ffn(layer_params, x, config, capacity_factor=capacity_factor)
+    return h + y, k_pool, v_pool
+
+
+def paged_forward(params, ids, pool, block_tables, positions, config: LlamaConfig,
+                  block_size: int):
+    """Forward ``ids [B, S]`` at per-row ``positions [B, S]`` against the
+    paged pool. Returns ``(logits [B, S, vocab], new_pool)`` — the paged
+    counterpart of ``generation._forward_cached``."""
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    h = params["embed_tokens"]["embedding"][ids]
+
+    def layer(carry, xs):
+        h = carry
+        layer_params, k_p, v_p = xs
+        h, k_p, v_p = _paged_layer_step(
+            layer_params, h, k_p, v_p, block_tables, positions, cos, sin,
+            config, block_size,
+        )
+        return h, (k_p, v_p)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer, h, (params["layers"], pool["k"], pool["v"]),
+        unroll=config.unroll_layers,
+    )
+    h = rms_norm(h, params["final_norm"]["scale"], config.norm_eps)
+    if config.tie_embeddings:
+        logits = h @ params["embed_tokens"]["embedding"].T
+    else:
+        logits = h @ params["lm_head"]["kernel"]
+    return logits, {"k": k_new, "v": v_new}
+
+
+class ServingEngine:
+    """Continuous-batching serving engine over a paged KV cache.
+
+    ``submit`` enqueues requests; each ``step`` admits what fits (prefill in
+    length buckets), decodes one token for every live slot, completes/frees
+    finished sequences and backfills their slots. Pool pressure preempts the
+    youngest request (progress persisted, resumed later with identical
+    output). Sampling knobs are engine-level (compiled into the step
+    functions — per-request knobs would multiply the compile lattice);
+    ``temperature=0`` is greedy. Emits ``serving`` / ``serving_request``
+    telemetry records when telemetry is enabled.
+    """
+
+    def __init__(
+        self,
+        params,
+        config: LlamaConfig,
+        *,
+        num_blocks: int = 64,
+        block_size: int = 16,
+        max_slots: int = 4,
+        max_prefill_len: Optional[int] = None,
+        max_blocks_per_seq: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        cache_dtype=jnp.bfloat16,
+        mesh=None,
+        continuous: bool = True,
+        admit_watermark_blocks: int = 0,
+        lattice: Optional[BucketLattice] = None,
+    ):
+        self.params = params
+        self.config = config
+        self.block_size = block_size
+        self.max_slots = max_slots
+        self.mesh = mesh
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = self.allocator.usable_blocks
+        max_prefill_len = max_prefill_len or min(
+            config.max_seq_len, max_blocks_per_seq * block_size
+        )
+        if max_prefill_len > max_blocks_per_seq * block_size:
+            raise ValueError(
+                f"max_prefill_len={max_prefill_len} exceeds "
+                f"{max_blocks_per_seq} block(s) x {block_size} slots"
+            )
+        self.lattice = lattice or BucketLattice.from_limits(
+            max_slots, max_blocks_per_seq, max_prefill_len
+        )
+        self.scheduler = Scheduler(
+            self.allocator, max_slots,
+            continuous=continuous, admit_watermark_blocks=admit_watermark_blocks,
+            # a sequence's block table can never exceed the lattice's widest
+            # bucket, and its positions can never exceed the RoPE table —
+            # admission rejects worst cases beyond either up front
+            max_seq_blocks=self.lattice.block_buckets[-1],
+            max_seq_tokens=config.max_seq_len,
+        )
+        self.pool = init_block_pool(config, num_blocks, block_size, cache_dtype)
+        if mesh is not None:
+            sharding = serving_shardings(mesh, config)
+            self.pool = jax.tree_util.tree_map(
+                lambda c: jax.device_put(c, sharding), self.pool
+            )
+
+        if temperature == 0.0:
+            def select_one(row, key):
+                return jnp.argmax(row, axis=-1)
+        else:
+            def select_one(row, key):
+                return sample_token_logits(
+                    row[None], key, temperature=temperature, top_k=top_k, top_p=top_p
+                )[0]
+
+        def _prefill(params, pool, ids, table, start, last_idx, key, token_idx):
+            # one CHUNK of a prefix: ids [1, Sb] holds the tokens at absolute
+            # positions start..start+Sb-1 (the host loop feeds long prefixes
+            # through the largest bucket chunk by chunk); the sampled token is
+            # meaningful only for the final chunk (last_idx = last real row)
+            B, Sb = ids.shape
+            positions = start + jnp.broadcast_to(jnp.arange(Sb)[None], (B, Sb))
+            logits, pool = paged_forward(
+                params, ids, pool, table, positions, config, block_size
+            )
+            last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=1, keepdims=False)
+            tok = select_one(last[0], jax.random.fold_in(key, token_idx))
+            return pool, tok.astype(jnp.int32)
+
+        def _decode(params, pool, last_tok, tables, positions, keys, token_idx):
+            logits, pool = paged_forward(
+                params, last_tok[:, None], pool, tables, positions[:, None],
+                config, block_size,
+            )
+            folded = jax.vmap(jax.random.fold_in)(keys, token_idx)
+            tok = jax.vmap(select_one)(logits[:, -1], folded)
+            return pool, tok.astype(jnp.int32)
+
+        self.prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
+        self.decode_fn = jax.jit(_decode, donate_argnums=(1,))
+
+        # stats for the telemetry records / bench payloads
+        self.steps = 0
+        self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self.prefill_calls = 0
+        self.max_running = 0
+        self._occupancy_sum = 0.0
+        self._occupancy_steps = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        eos_token_id: Optional[int] = None,
+        rng_seed: int = 0,
+        arrival_t: Optional[float] = None,
+    ) -> Request:
+        """Enqueue one request; returns its :class:`Request` handle (live —
+        ``generated``/``status`` update as the engine steps)."""
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+            rng_seed=rng_seed,
+            arrival_t=time.monotonic() if arrival_t is None else arrival_t,
+        )
+        self.scheduler.submit(req)
+        return req
+
+    def warmup(self) -> dict:
+        """Compile every lattice point up front (decode (slots, width) cross
+        product + per-length prefill) so serving never pays a compile — and so
+        the recompile detector's baseline is exact. Returns the per-function
+        compile counts; the jit caches must never grow past them."""
+        key = np.zeros((2,), np.uint32)
+        for Sb, W in self.lattice.prefill_points():
+            ids = np.zeros((1, Sb), np.int32)
+            table = np.full((1, W), NULL_BLOCK, np.int32)
+            self.pool, tok = self.prefill_fn(
+                self.params, self.pool, ids, table, np.int32(0), np.int32(0),
+                key, np.int32(0),
+            )
+        for Bb, W in self.lattice.decode_points():
+            last = np.zeros((Bb,), np.int32)
+            tables = np.full((Bb, W), NULL_BLOCK, np.int32)
+            positions = np.zeros((Bb,), np.int32)
+            keys = np.zeros((Bb, 2), np.uint32)
+            token_idx = np.zeros((Bb,), np.int32)
+            self.pool, tok = self.decode_fn(
+                self.params, self.pool, last, tables, positions, keys, token_idx
+            )
+        jax.block_until_ready(tok)
+        counts = self.jit_cache_sizes()
+        if tel.is_enabled():
+            tel.emit("serving", phase="warmup", **counts)
+        return counts
+
+    def jit_cache_sizes(self) -> dict:
+        """Compiled-entry counts for the two step functions — after
+        :meth:`warmup` these must equal the lattice sizes forever."""
+        return {
+            "prefill_compiles": int(self.prefill_fn._cache_size()),
+            "decode_compiles": int(self.decode_fn._cache_size()),
+        }
+
+    # -- the step loop -------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> "list[Request]":
+        """One engine iteration: admit+prefill, decode one token for every
+        live slot, complete/free finished sequences. Returns the requests
+        that left the engine this step — status FINISHED, or REJECTED (with
+        ``Request.error`` set) for requests whose worst case can never fit
+        this engine's pool/lattice."""
+        now = time.monotonic() if now is None else now
+        finished: "list[Request]" = []
+
+        prefills = 0
+        prefill_tokens_before = self.prefill_tokens
+        admitted = self.scheduler.admissions()
+        while self.scheduler.rejected:
+            req = self.scheduler.rejected.pop()
+            req.finish_t = now
+            finished.append(req)  # returned to the caller, status REJECTED
+            if tel.is_enabled():
+                tel.emit(
+                    "serving_request", rid=req.rid, error=req.error,
+                    new_tokens=0, prompt_tokens=int(req.prompt.size),
+                )
+        for req in admitted:
+            self._prefill_request(req, now)
+            prefills += 1
+            if req.done:
+                self.scheduler.complete(req, now)
+                self._emit_completion(req)
+                finished.append(req)
+
+        running = [r for r in self.scheduler.running()]
+        if running:
+            # reserve the next KV slot for every live sequence FIRST: a grow
+            # may preempt the youngest, and the decode batch must be built
+            # from the survivors
+            for req in list(running):
+                if req.slot is not None:
+                    self.scheduler.grow(req)
+            running = self.scheduler.running()
+        if running:
+            self._decode_batch(running)
+            for req in running:
+                if req.done:
+                    self.scheduler.complete(req, now)
+                    self._emit_completion(req)
+                    finished.append(req)
+
+        self.steps += 1
+        occupancy = len(running) / self.max_slots
+        self.max_running = max(self.max_running, len(running))
+        self._occupancy_sum += occupancy
+        self._occupancy_steps += 1
+        if tel.is_enabled():
+            alloc = self.allocator.stats()
+            tel.emit(
+                "serving",
+                phase="step",
+                queue_depth=self.scheduler.queue_depth,
+                running=len(running),
+                occupancy=round(occupancy, 6),
+                prefills=prefills,
+                prefill_tokens=self.prefill_tokens - prefill_tokens_before,
+                decode_tokens=len(running),
+                preemptions=self.scheduler.preemption_count,
+                free_blocks=alloc["free_blocks"],
+                live_tokens=alloc["live_tokens"],
+                block_occupancy=alloc["occupancy"],
+                fragmentation=alloc["fragmentation"],
+            )
+        return finished
+
+    def run(self, max_steps: int = 100_000) -> "list[Request]":
+        """Step until idle (every submitted request finished); returns all
+        completions in finish order."""
+        done: "list[Request]" = []
+        for _ in range(max_steps):
+            if self.scheduler.idle():
+                return done
+            done.extend(self.step())
+        raise RuntimeError(f"engine not idle after {max_steps} steps")
+
+    # -- internals -----------------------------------------------------------
+
+    def _request_key(self, req: Request) -> np.ndarray:
+        # cached: the key is a pure function of rng_seed, and rebuilding it
+        # would add a device dispatch per slot per decode step
+        if req._key is None:
+            req._key = np.asarray(jax.random.PRNGKey(req.rng_seed), np.uint32)
+        return req._key
+
+    def _prefill_request(self, req: Request, now: float) -> None:
+        """Prefill the request's full prefix in length-bucketed CHUNKS: each
+        chunk runs at the smallest covering prefill bucket (the largest
+        bucket for all but the tail), so arbitrarily long prefixes — e.g. a
+        resumed request's prompt + generated — stay inside the compiled
+        lattice. Only the final chunk's sampled token is kept."""
+        prefix = req.output_ids()
+        W = self.lattice.prefill_points()[0][1]
+        table = self.allocator.block_table(req.rid, pad_to=W)[None]
+        chunk_cap = self.lattice.prefill_buckets[-1]
+        key = self._request_key(req)
+        token_idx = np.int32(len(req.generated))
+        start = 0
+        while start < prefix.size:
+            chunk = prefix[start : start + chunk_cap]
+            Sb = self.lattice.prefill_bucket(chunk.size)
+            ids = np.zeros((1, Sb), np.int32)
+            ids[0, : chunk.size] = chunk
+            self.pool, tok = self.prefill_fn(
+                self.params, self.pool, ids, table, np.int32(start),
+                np.int32(chunk.size - 1), key, token_idx,
+            )
+            start += chunk.size
+        req.generated.append(int(tok))
+        if req.first_token_t is None:
+            req.first_token_t = now
+        self.prefill_tokens += int(prefix.size)
+        self.prefill_calls += 1
+
+    def _decode_batch(self, running: "list[Request]") -> None:
+        Bb = self.lattice.slot_bucket(len(running))
+        W = self.lattice.block_bucket(
+            max(self.allocator.num_seq_blocks(r.rid) for r in running)
+        )
+        last = np.zeros((Bb,), np.int32)
+        tables = np.full((Bb, W), NULL_BLOCK, np.int32)
+        positions = np.zeros((Bb,), np.int32)
+        keys = np.zeros((Bb, 2), np.uint32)
+        token_idx = np.zeros((Bb,), np.int32)
+        for i, req in enumerate(running):
+            last[i] = req.generated[-1]
+            tables[i] = self.allocator.block_table(req.rid, pad_to=W)
+            positions[i] = req.prefix_len - 1
+            keys[i] = self._request_key(req)
+            token_idx[i] = len(req.generated)
+        self.pool, toks = self.decode_fn(
+            self.params, self.pool, last, tables, positions, keys, token_idx
+        )
+        toks = np.asarray(jax.device_get(toks))
+        for i, req in enumerate(running):
+            req.generated.append(int(toks[i]))
+        self.decode_tokens += len(running)
+
+    def _emit_completion(self, req: Request) -> None:
+        if not tel.is_enabled():
+            return
+        tel.emit(
+            "serving_request",
+            rid=req.rid,
+            prompt_tokens=int(req.prompt.size),
+            new_tokens=len(req.generated),
+            latency_s=round((req.finish_t or 0.0) - req.arrival_t, 6),
+            ttft_s=round((req.first_token_t or 0.0) - req.arrival_t, 6)
+            if req.first_token_t is not None
+            else None,
+            preemptions=req.preemptions,
+        )
+
+    def stats(self) -> dict:
+        return {
+            "steps": self.steps,
+            "decode_tokens": self.decode_tokens,
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_calls": self.prefill_calls,
+            "preemptions": self.scheduler.preemption_count,
+            "max_running": self.max_running,
+            "mean_occupancy": round(
+                self._occupancy_sum / max(self._occupancy_steps, 1), 6
+            ),
+            **self.jit_cache_sizes(),
+            **self.allocator.stats(),
+        }

@@ -1,0 +1,241 @@
+"""Paged KV cache: fixed-size blocks in one preallocated device pool.
+
+The single-stream decode path (``generation.init_kv_cache``) reserves
+``max_len`` cache slots per sequence up front — fine for one request, fatal
+for serving: a 16-token reply and a 2k-token reply would each pin
+``max_len`` slots, so heterogeneous traffic wastes most of HBM on slots that
+are never written. The paged design (vLLM's PagedAttention, arXiv:2309.06180)
+carves ONE preallocated pool into fixed-size blocks:
+
+- device side: ``{"k","v"}: [L, num_blocks, block_size, Hkv, D]`` — allocated
+  once at engine start, never resized (no allocation churn, no recompiles);
+- host side: :class:`BlockAllocator` — a free list plus per-sequence block
+  tables mapping logical block index -> physical block. Sequences grow one
+  block at a time (``append``), release everything on completion/eviction
+  (``free``), and the freed blocks are immediately reusable by any sequence,
+  so memory tracks the LIVE token count instead of the worst case.
+
+Physical block 0 is reserved as the **null block**: inactive batch slots and
+padded table entries point at it, so their (masked, never-read) scatter
+writes can never corrupt a live sequence's cache.
+
+:func:`paged_attention` is the paged variant of the contiguous
+``generation._cached_attention``: gather the sequence's blocks via its block
+table, then run the SAME shared masked-attention core
+(``generation._masked_attention``) — masked slots contribute exactly 0 to the
+softmax, so paged decode is bitwise-identical to contiguous decode (the
+parity tests in ``tests/test_serving.py`` hold this line).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..generation import _masked_attention
+from ..models.transformer import LlamaConfig
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockPoolExhausted",
+    "BlockAllocatorError",
+    "BlockAllocator",
+    "init_block_pool",
+    "paged_attention",
+]
+
+#: physical block index reserved for inactive/padded writes (never allocated)
+NULL_BLOCK = 0
+
+
+class BlockAllocatorError(RuntimeError):
+    """Misuse of the allocator: double-free, append/lookup after free."""
+
+
+class BlockPoolExhausted(RuntimeError):
+    """No free block available — the scheduler should preempt or defer."""
+
+
+def init_block_pool(
+    config: LlamaConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> dict:
+    """Device pool ``{"k","v"}: [L, num_blocks, block_size, Hkv, D]``
+    (``num_blocks`` INCLUDES the reserved null block 0)."""
+    shape = (config.n_layers, num_blocks, block_size, config.n_kv_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+class BlockAllocator:
+    """Host-side block bookkeeping for one device pool.
+
+    Free blocks live on a LIFO free list (hot reuse: a just-freed block is
+    handed out next, so the working set stays compact). Per-sequence state is
+    a block table (physical block ids, logical order) plus the sequence's
+    token count; ``append`` grows the table only when the token count crosses
+    a block boundary. Fragmentation here is purely INTERNAL (the unwritten
+    tail of each sequence's last block) — fixed-size blocks cannot fragment
+    externally, which is the point of paging.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the reserved null block)")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO: lowest ids are handed out first at start, re-frees come back
+        # on top. Block 0 is never on the list (reserved null block).
+        self._free: "list[int]" = list(range(num_blocks - 1, 0, -1))
+        self._tables: "dict[object, list[int]]" = {}
+        self._tokens: "dict[object, int]" = {}
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        """Allocatable blocks (pool minus the null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - self.free_blocks
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens``."""
+        return max(1, -(-n_tokens // self.block_size))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= self.free_blocks
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def allocate(self, seq_id, n_tokens: int) -> "list[int]":
+        """Create a sequence holding ``n_tokens`` (its prompt); returns the
+        block table. :class:`BlockPoolExhausted` when the pool can't cover it
+        (nothing is allocated on failure — all-or-nothing)."""
+        if seq_id in self._tables:
+            raise BlockAllocatorError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_for(n_tokens)
+        if need > self.free_blocks:
+            raise BlockPoolExhausted(
+                f"need {need} block(s) for {n_tokens} token(s), "
+                f"only {self.free_blocks} free"
+            )
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._tokens[seq_id] = n_tokens
+        return list(table)
+
+    def append(self, seq_id, n_tokens: int = 1) -> "list[int]":
+        """Grow a sequence by ``n_tokens``; allocates new block(s) only when
+        the count crosses a block boundary. Returns the block ids newly
+        allocated (often empty). On exhaustion the sequence is left unchanged
+        and :class:`BlockPoolExhausted` propagates — the scheduler preempts."""
+        if seq_id not in self._tables:
+            raise BlockAllocatorError(
+                f"append on unknown/freed sequence {seq_id!r} (use-after-free?)"
+            )
+        have = len(self._tables[seq_id])
+        need = self.blocks_for(self._tokens[seq_id] + n_tokens) - have
+        if need > self.free_blocks:
+            raise BlockPoolExhausted(
+                f"sequence {seq_id!r} needs {need} more block(s), "
+                f"only {self.free_blocks} free"
+            )
+        new = [self._free.pop() for _ in range(max(0, need))]
+        self._tables[seq_id].extend(new)
+        self._tokens[seq_id] += n_tokens
+        return new
+
+    def free(self, seq_id) -> int:
+        """Release all of a sequence's blocks back to the free list; returns
+        how many. Double-free raises :class:`BlockAllocatorError`."""
+        if seq_id not in self._tables:
+            raise BlockAllocatorError(f"double free of sequence {seq_id!r}")
+        table = self._tables.pop(seq_id)
+        del self._tokens[seq_id]
+        self._free.extend(reversed(table))  # LIFO: first-allocated reused last
+        return len(table)
+
+    # -- views ---------------------------------------------------------------
+
+    def block_table(self, seq_id, pad_to: Optional[int] = None) -> np.ndarray:
+        """The sequence's physical block ids (logical order) as int32,
+        padded with the null block to ``pad_to`` (the bucketed table width)."""
+        if seq_id not in self._tables:
+            raise BlockAllocatorError(
+                f"block_table of unknown/freed sequence {seq_id!r} (use-after-free?)"
+            )
+        table = self._tables[seq_id]
+        width = len(table) if pad_to is None else pad_to
+        if len(table) > width:
+            raise ValueError(f"table of {len(table)} block(s) does not fit pad_to={pad_to}")
+        out = np.full((width,), NULL_BLOCK, np.int32)
+        out[: len(table)] = table
+        return out
+
+    def tokens(self, seq_id) -> int:
+        if seq_id not in self._tokens:
+            raise BlockAllocatorError(f"tokens of unknown/freed sequence {seq_id!r}")
+        return self._tokens[seq_id]
+
+    def num_seq_blocks(self, seq_id) -> int:
+        if seq_id not in self._tables:
+            raise BlockAllocatorError(f"blocks of unknown/freed sequence {seq_id!r}")
+        return len(self._tables[seq_id])
+
+    def live_sequences(self) -> "list":
+        return list(self._tables)
+
+    def occupancy(self) -> float:
+        """Fraction of usable blocks currently allocated."""
+        return self.used_blocks / self.usable_blocks
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation: fraction of ALLOCATED slots not holding a
+        token (the unwritten tails of last blocks). 0.0 when nothing is
+        allocated."""
+        allocated_slots = self.used_blocks * self.block_size
+        if not allocated_slots:
+            return 0.0
+        live_tokens = sum(self._tokens.values())
+        return (allocated_slots - live_tokens) / allocated_slots
+
+    def stats(self) -> dict:
+        return {
+            "block_size": self.block_size,
+            "usable_blocks": self.usable_blocks,
+            "free_blocks": self.free_blocks,
+            "used_blocks": self.used_blocks,
+            "sequences": len(self._tables),
+            "live_tokens": sum(self._tokens.values()),
+            "occupancy": round(self.occupancy(), 6),
+            "fragmentation": round(self.fragmentation(), 6),
+        }
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, q_positions, scale=None):
+    """Paged variant of ``generation._cached_attention``.
+
+    q ``[B, S, H, D]``; per-layer pools ``[num_blocks, block_size, Hkv, D]``;
+    ``block_tables [B, W]`` (physical block ids, null-padded);
+    ``q_positions [B, S]`` per-row absolute positions. Gathers each row's
+    blocks into a contiguous ``[B, W*block_size, Hkv, D]`` view and runs the
+    shared masked-attention core: a slot at gathered position ``t`` holds
+    logical token ``t`` of that sequence, and only slots with ``t <=
+    q_position`` are attended, so null/stale slots are masked to an exact
+    0 contribution (bitwise parity with the contiguous path)."""
+    B = q.shape[0]
+    k_cache = k_pool[block_tables].reshape(B, -1, k_pool.shape[2], k_pool.shape[3])
+    v_cache = v_pool[block_tables].reshape(B, -1, v_pool.shape[2], v_pool.shape[3])
+    kv_pos = jnp.arange(k_cache.shape[1])
+    allow = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, S, T]
+    return _masked_attention(q, k_cache, v_cache, allow[:, None], scale)

@@ -435,6 +435,48 @@ def _performance_section(events: "list[dict]", steps: "list[dict]") -> Optional[
     }
 
 
+def _serving_section(events: "list[dict]") -> Optional[dict]:
+    """Aggregate the serving engine's per-step ``serving`` records and
+    per-completion ``serving_request`` records (``serving/engine.py``):
+    queue depth / batch occupancy / block-pool distributions, the
+    prefill-vs-decode token split, aggregate decode tokens/s over the record
+    span, and per-request latency + time-to-first-token percentiles.
+    ``None`` when the streams carry no serving records."""
+    steps = [e for e in events if e.get("kind") == "serving" and e.get("phase") == "step"]
+    reqs = [e for e in events if e.get("kind") == "serving_request"]
+    if not steps and not reqs:
+        return None
+    decode_tokens = sum(int(s.get("decode_tokens", 0)) for s in steps)
+    prefill_tokens = sum(int(s.get("prefill_tokens", 0)) for s in steps)
+    ts = sorted(float(s.get("t", 0.0)) for s in steps)
+    span = ts[-1] - ts[0] if len(ts) >= 2 else 0.0
+    completed = [r for r in reqs if not r.get("error")]
+    section = {
+        "steps": len(steps),
+        "queue_depth": _dist([float(s.get("queue_depth", 0)) for s in steps]),
+        "occupancy": _dist([float(s.get("occupancy", 0.0)) for s in steps]),
+        "block_occupancy": _dist([float(s.get("block_occupancy", 0.0)) for s in steps]),
+        "fragmentation": _dist([float(s.get("fragmentation", 0.0)) for s in steps]),
+        "decode_tokens": decode_tokens,
+        "prefill_tokens": prefill_tokens,
+        "tokens_per_s": round(decode_tokens / span, 2) if span > 0 else None,
+        "preemptions": max((int(s.get("preemptions", 0)) for s in steps), default=0),
+        "requests": {
+            "completed": len(completed),
+            "rejected": sum(1 for r in reqs if r.get("error")),
+            "preempted": sum(1 for r in completed if r.get("preemptions")),
+            "new_tokens": sum(int(r.get("new_tokens", 0)) for r in completed),
+            "latency_s": _dist(
+                [float(r["latency_s"]) for r in completed if r.get("latency_s") is not None]
+            ),
+            "ttft_s": _dist(
+                [float(r["ttft_s"]) for r in completed if r.get("ttft_s") is not None]
+            ),
+        },
+    }
+    return section
+
+
 def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
     events = load_events(paths)
     metas = [e for e in events if e.get("kind") == "meta"]
@@ -557,6 +599,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "data_wait_events": len(waits),
         "checkpoints": checkpoints,
         "performance": _performance_section(events, steps),
+        "serving": _serving_section(events),
         "restarts": _restarts_section(events),
     }
     if by_rank:
@@ -716,6 +759,9 @@ def format_report(report: dict) -> str:
     perf = report.get("performance")
     if perf:
         lines.append(format_performance_section(perf))
+    serving = report.get("serving")
+    if serving:
+        lines.append(format_serving_section(serving))
     m = report["memory"]
     lines.append(
         "memory peaks: device "
@@ -809,6 +855,45 @@ def format_performance_section(perf: dict) -> str:
         lines.append(
             f"  WARNING: {perf['trace_errors']} trace window(s) failed to start "
             "(another profiler session was active)"
+        )
+    return "\n".join(lines)
+
+
+def format_serving_section(serving: dict) -> str:
+    """Human rendering of the serving engine's queue/occupancy/latency
+    aggregation (see ``docs/serving.md`` for how to read it)."""
+    lines = ["serving:"]
+    tok_s = serving.get("tokens_per_s")
+    lines.append(
+        f"  {serving['steps']} engine step(s) — decode {serving['decode_tokens']} "
+        f"token(s), prefill {serving['prefill_tokens']} token(s)"
+        + (f", {tok_s:.1f} decode tok/s" if tok_s is not None else "")
+    )
+    occ = serving.get("occupancy") or {}
+    qd = serving.get("queue_depth") or {}
+    blk = serving.get("block_occupancy") or {}
+    if occ.get("count"):
+        lines.append(
+            f"  batch occupancy p50={occ['p50']:.2f} max={occ['max']:.2f}  "
+            f"queue depth p50={qd['p50']:.1f} max={qd['max']:.0f}  "
+            f"block occupancy p50={blk['p50']:.2f} max={blk['max']:.2f}"
+        )
+    if serving.get("preemptions"):
+        lines.append(f"  preemptions: {serving['preemptions']} (pool pressure evictions)")
+    reqs = serving.get("requests") or {}
+    if reqs.get("completed"):
+        lat = reqs.get("latency_s") or {}
+        ttft = reqs.get("ttft_s") or {}
+        lat_s = (
+            f"  latency p50={lat['p50'] * 1e3:.1f}ms p99={lat['p99'] * 1e3:.1f}ms"
+            if lat.get("count") else ""
+        )
+        ttft_s = f"  ttft p50={ttft['p50'] * 1e3:.1f}ms" if ttft.get("count") else ""
+        lines.append(
+            f"  requests: {reqs['completed']} completed "
+            f"({reqs.get('preempted', 0)} preempted-and-resumed, "
+            f"{reqs.get('rejected', 0)} rejected), "
+            f"{reqs['new_tokens']} token(s) generated{lat_s}{ttft_s}"
         )
     return "\n".join(lines)
 
@@ -1124,6 +1209,16 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("elastic auto-resume", False, f"{type(exc).__name__}: {exc}")
 
+        # 12. serving engine (ISSUE 11): continuous batching over the paged
+        # KV cache on CPU — staggered variable-length requests must all match
+        # their single-stream reference, batch occupancy must exceed 1, and
+        # the warmed bucket lattice must absorb all churn with ZERO
+        # post-warmup recompiles (the jit caches are the oracle)
+        try:
+            _doctor_serving(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("serving engine", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -1166,6 +1261,73 @@ def _doctor_elastic(tmp: str, _check) -> None:
         and "restarts: 1 restart(s)" in text
     )
     _check("elastic auto-resume", ok, f"rc={rc} restarts={rs}")
+
+
+def _doctor_serving(tmp: str, _check) -> None:
+    """Doctor check 12 body: spin up the serving engine on the CPU backend,
+    submit staggered variable-length greedy requests, and require (a) every
+    completion identical to its single-stream ``greedy_generate`` reference,
+    (b) batch occupancy > 1 at some step (continuous batching actually
+    batched), (c) jit caches frozen at the warmed bucket counts (zero
+    post-warmup recompiles), and (d) the serving report section renders."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..generation import greedy_generate
+    from ..models import LlamaConfig, init_llama
+    from ..serving import BucketLattice, ServingEngine
+    from . import events as tel_events
+
+    config = LlamaConfig.tiny()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    serve_dir = os.path.join(tmp, "serving")
+    tel_events.enable(out_dir=serve_dir, run_id="doctor-serving")
+    try:
+        engine = ServingEngine(
+            params, config, num_blocks=33, block_size=8, max_slots=4,
+            lattice=BucketLattice(
+                slot_buckets=(2, 4), block_buckets=(4,), prefill_buckets=(32,)
+            ),
+        )
+        warmed = engine.warmup()
+        rng = np.random.default_rng(0)
+        specs = [(5, 7), (13, 11), (21, 5), (9, 9), (12, 6)]
+        prompts = [rng.integers(0, config.vocab_size, (s,)).astype(np.int32) for s, _ in specs]
+        # staggered arrivals: two up front, the rest injected mid-flight
+        reqs = [engine.submit(prompts[i], specs[i][1], rng_seed=i) for i in range(2)]
+        for i in range(2, len(specs)):
+            engine.step()
+            reqs.append(engine.submit(prompts[i], specs[i][1], rng_seed=i))
+        engine.run()
+    finally:
+        tel_events.disable()
+    mismatched = []
+    for i, ((_, max_new), req) in enumerate(zip(specs, reqs)):
+        ref = greedy_generate(params, prompts[i][None], config, max_new_tokens=max_new)
+        if not np.array_equal(np.asarray(ref[0]), req.output_ids()):
+            mismatched.append(i)
+    stats = engine.stats()
+    report = build_report([serve_dir])
+    serving = report.get("serving") or {}
+    text = format_report(report)
+    ok = (
+        not mismatched
+        and stats["max_running"] > 1
+        and engine.jit_cache_sizes() == warmed
+        and (serving.get("requests") or {}).get("completed") == len(specs)
+        and "serving:" in text
+        and "batch occupancy" in text
+    )
+    _check(
+        "serving engine",
+        ok,
+        f"mismatched={mismatched} max_running={stats['max_running']} "
+        f"caches={engine.jit_cache_sizes()} warmed={warmed}",
+    )
 
 
 def _doctor_fused_zero1(_check) -> None:

@@ -812,6 +812,33 @@ def run_bench_weight_update(on_tpu: bool) -> dict:
     }
 
 
+def run_bench_serving(on_tpu: bool) -> dict:
+    """Serving config (ISSUE 11): continuous-vs-static batching ratio under a
+    seeded Poisson open-loop load through the paged-KV serving engine, plus
+    the continuous leg's occupancy and p50/p99 per-request latency.
+    Delegates to ``benchmarks/serving/run.py`` (same engine `make
+    bench-serve` runs)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "serving", "run.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_serving_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_bench_serving(on_tpu)
+    return {
+        "metric": "serving throughput ratio (continuous/static batching)",
+        "value": out["value"],
+        "unit": out["unit"],
+        "continuous": out["continuous"],
+        "static": out["static"],
+        "p99_latency_ms": out["p99_latency_ms"],
+        "requests": out["requests"],
+        "max_slots": out["max_slots"],
+    }
+
+
 def run_bench_checkpoint_stall(on_tpu: bool) -> dict:
     """Checkpoint-stall config (ISSUE 5 acceptance): exposed-stall ratio of
     async vs sync ``save_state`` around a fixed-cadence step loop — how much
@@ -1376,6 +1403,7 @@ def main():
         ("compile_time_llama1b", run_bench_compile_time),
         ("checkpoint_stall", run_bench_checkpoint_stall),
         ("weight_update", run_bench_weight_update),
+        ("serving", run_bench_serving),
     ):
         if _remaining() < 120:
             configs[name] = {

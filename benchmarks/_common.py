@@ -32,3 +32,13 @@ def detect_backend(probe_timeout: int = 120) -> bool:
 
 def emit(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
+
+
+def percentile(values, p):
+    """Round-half-rank percentile of an unsorted list (the benches' shared
+    definition; telemetry.report.percentile is the ceil-rank variant)."""
+    values = sorted(values)
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, max(0, int(round(p / 100 * (len(values) - 1)))))
+    return values[idx]

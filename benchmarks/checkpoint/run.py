@@ -24,15 +24,7 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from _common import detect_backend, emit
-
-
-def _percentile(values, p):
-    values = sorted(values)
-    if not values:
-        return 0.0
-    idx = min(len(values) - 1, max(0, int(round(p / 100 * (len(values) - 1)))))
-    return values[idx]
+from _common import detect_backend, emit, percentile as _percentile
 
 
 def _params(mb: float):

@@ -1,0 +1,177 @@
+"""Serving microbench: continuous vs static batching under Poisson load.
+
+Replays ONE seeded open-loop workload — Poisson arrivals (exponential
+inter-arrival gaps measured in engine steps), uniformly random prompt and
+completion lengths — through the :class:`~accelerate_tpu.serving.engine.
+ServingEngine` twice:
+
+- ``continuous``: in-flight batching — requests join the running batch at
+  step granularity, finished slots are backfilled immediately;
+- ``static``: gang admission — a batch is admitted only into an idle engine
+  and drained to the LAST member's completion before the next forms (the
+  classic serving baseline continuous batching exists to beat).
+
+Both legs share the warmed bucket lattice, so every timed step runs
+compiled code; the ratio isolates scheduling, not compilation. Reports
+aggregate generated tok/s (wall), mean batch occupancy, and p50/p99
+per-request latency + TTFT (arrival -> finish, wall). Emits one JSON line
+per the bench.py conventions; ``make bench-serve`` runs it, and bench.py's
+``serving`` config carries it in the round payload.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import detect_backend, emit, percentile as _percentile
+
+
+def build_workload(n_requests, seed, prompt_lens, new_tokens, rate, vocab_size):
+    """Seeded open-loop arrival schedule: [(arrival_step, prompt, max_new)].
+    ``rate`` is mean arrivals per engine step (Poisson: exponential gaps)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    workload = []
+    for _ in range(n_requests):
+        t += rng.exponential(1.0 / rate)
+        prompt = rng.integers(0, vocab_size, (int(rng.integers(*prompt_lens)),))
+        workload.append((int(t), prompt.astype(np.int32), int(rng.integers(*new_tokens))))
+    return workload
+
+
+def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
+            block_size, lattice):
+    """One scheduling policy over the shared workload; returns its metrics."""
+    from accelerate_tpu.serving import RequestStatus, ServingEngine
+
+    engine = ServingEngine(
+        params, config, num_blocks=num_blocks, block_size=block_size,
+        max_slots=max_slots, lattice=lattice, continuous=continuous,
+    )
+    engine.warmup()  # all buckets compiled before the clock starts
+    completed = []
+    next_req = 0
+    step = 0
+    t0 = time.monotonic()
+    while next_req < len(workload) or not engine.scheduler.idle():
+        while next_req < len(workload) and workload[next_req][0] <= step:
+            _, prompt, max_new = workload[next_req]
+            engine.submit(prompt, max_new, rng_seed=next_req)
+            next_req += 1
+        if engine.scheduler.idle():
+            step += 1  # idle tick: nothing due yet, no device work
+            continue
+        completed.extend(engine.step())
+        step += 1
+    wall = time.monotonic() - t0
+    # step() also returns REJECTED requests (pool/lattice misconfiguration):
+    # keep them out of the throughput/latency aggregates — and out of the
+    # continuous/static comparison — but report them (a silently shrunken
+    # workload would fake the ratio)
+    rejected = [r for r in completed if r.status is not RequestStatus.FINISHED]
+    completed = [r for r in completed if r.status is RequestStatus.FINISHED]
+    tokens = sum(len(r.generated) for r in completed)
+    latencies = [r.finish_t - r.arrival_t for r in completed]
+    ttfts = [r.first_token_t - r.arrival_t for r in completed if r.first_token_t]
+    stats = engine.stats()
+    return {
+        "completed": len(completed),
+        "rejected": len(rejected),
+        "tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+        "engine_steps": stats["steps"],
+        "mean_occupancy": stats["mean_occupancy"],
+        "preemptions": stats["preemptions"],
+        "p50_latency_ms": round(_percentile(latencies, 50) * 1e3, 2),
+        "p99_latency_ms": round(_percentile(latencies, 99) * 1e3, 2),
+        "p50_ttft_ms": round(_percentile(ttfts, 50) * 1e3, 2),
+        "continuous": continuous,
+    }
+
+
+def run_bench_serving(
+    on_tpu: bool,
+    requests: int = 32,
+    rate: float = 2.0,
+    seed: int = 0,
+    max_slots: int = 4,
+    num_blocks: int = 49,
+    block_size: int = 8,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig, init_llama
+    from accelerate_tpu.serving import BucketLattice
+
+    if on_tpu:
+        config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+                             n_kv_heads=8, max_seq_len=512)
+        prompt_lens, new_tokens = (16, 96), (8, 64)
+        max_slots, num_blocks, block_size = max(max_slots, 8), 160, 16
+    else:
+        config = LlamaConfig.tiny()
+        # heterogeneous completion lengths are the whole point: static
+        # batching drains every gang to its slowest member while continuous
+        # backfills the freed slots at step granularity
+        prompt_lens, new_tokens = (4, 24), (2, 40)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    max_len = prompt_lens[1] + new_tokens[1]
+    lattice = BucketLattice.from_limits(
+        max_slots, -(-max_len // block_size) + 1, prompt_lens[1] + new_tokens[1]
+    )
+    workload = build_workload(
+        requests, seed, prompt_lens, new_tokens, rate, config.vocab_size
+    )
+    kw = dict(max_slots=max_slots, num_blocks=num_blocks, block_size=block_size,
+              lattice=lattice)
+    continuous = run_leg(params, config, workload, continuous=True, **kw)
+    static = run_leg(params, config, workload, continuous=False, **kw)
+    return {
+        "bench": "serving",
+        "unit": "throughput_ratio(continuous/static)",
+        "value": round(
+            continuous["tokens_per_s"] / max(static["tokens_per_s"], 1e-9), 3
+        ),
+        "continuous": continuous,
+        "static": static,
+        "p99_latency_ms": continuous["p99_latency_ms"],
+        "requests": requests,
+        "arrival_rate_per_step": rate,
+        "prompt_lens": list(prompt_lens),
+        "new_tokens": list(new_tokens),
+        "max_slots": max_slots,
+        "num_blocks": num_blocks,
+        "block_size": block_size,
+        "on_tpu": on_tpu,
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=2.0,
+                    help="mean Poisson arrivals per engine step (open loop)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--num-blocks", type=int, default=49)
+    ap.add_argument("--block-size", type=int, default=8)
+    args = ap.parse_args()
+    emit(
+        run_bench_serving(
+            on_tpu=detect_backend(),
+            requests=args.requests,
+            rate=args.rate,
+            seed=args.seed,
+            max_slots=args.max_slots,
+            num_blocks=args.num_blocks,
+            block_size=args.block_size,
+        )
+    )

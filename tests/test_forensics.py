@@ -397,9 +397,11 @@ def test_doctor_self_checks(capsys):
     # + perf cost capture + xplane trace parse + performance report (ISSUE 7)
     # + fused zero1 lint/compiled-collectives (ISSUE 9)
     # + elastic auto-resume (ISSUE 10)
-    assert out.count("PASS") == 11 and "FAIL" not in out
+    # + serving engine (ISSUE 11)
+    assert out.count("PASS") == 12 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
+    assert "serving engine" in out
     assert "fused zero1 compiled collectives" in out
     assert "performance report section" in out
     assert "elastic auto-resume" in out

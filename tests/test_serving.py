@@ -1,0 +1,488 @@
+"""Serving subsystem tests (ISSUE 11): paged KV cache + continuous batching.
+
+The two acceptance lines these tests hold:
+
+- paged decode through the engine is IDENTICAL to the single-stream
+  ``generation`` decode for every admitted request — greedy and sampled
+  (fixed key), including sequences whose blocks are non-contiguous in the
+  pool and sequences that were preempted and resumed;
+- admission/completion/eviction churn after bucket warmup never grows the
+  jit caches (the telemetry recompile detector is the oracle).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.generation import _cached_attention, greedy_generate, sample_generate
+from accelerate_tpu.models import LlamaConfig, init_llama
+from accelerate_tpu.serving import (
+    NULL_BLOCK,
+    BlockAllocator,
+    BlockAllocatorError,
+    BlockPoolExhausted,
+    BucketLattice,
+    Request,
+    RequestStatus,
+    Scheduler,
+    SchedulingError,
+    ServingEngine,
+    paged_attention,
+)
+
+CONFIG = LlamaConfig.tiny()
+SMALL_LATTICE = BucketLattice(slot_buckets=(2, 4), block_buckets=(4,), prefill_buckets=(32,))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(CONFIG, jax.random.PRNGKey(0))
+    )
+
+
+@pytest.fixture(scope="module")
+def greedy_engine(params):
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=4, lattice=SMALL_LATTICE
+    )
+    engine.warmup()
+    return engine
+
+
+def _prompts(seed, lengths):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CONFIG.vocab_size, (n,)).astype(np.int32) for n in lengths]
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+
+
+@pytest.mark.smoke
+def test_allocator_lifecycle_and_accounting():
+    alloc = BlockAllocator(num_blocks=9, block_size=4)
+    assert alloc.usable_blocks == 8 and alloc.free_blocks == 8
+    table = alloc.allocate("a", 6)  # 6 tokens -> 2 blocks
+    assert len(table) == 2 and NULL_BLOCK not in table
+    assert alloc.used_blocks == 2 and alloc.tokens("a") == 6
+    # internal fragmentation: 8 allocated slots, 6 live tokens
+    assert alloc.fragmentation() == pytest.approx(2 / 8)
+    assert alloc.occupancy() == pytest.approx(2 / 8)
+    # append within the last block allocates nothing; crossing allocates one
+    assert alloc.append("a", 2) == []
+    new = alloc.append("a", 1)
+    assert len(new) == 1 and alloc.num_seq_blocks("a") == 3
+    assert alloc.free("a") == 3
+    assert alloc.free_blocks == 8 and alloc.stats()["live_tokens"] == 0
+
+
+def test_allocator_free_list_reuse_and_nonmonotonic_tables():
+    alloc = BlockAllocator(num_blocks=9, block_size=4)
+    (x,) = alloc.allocate("x", 1)
+    (y,) = alloc.allocate("y", 1)
+    (z,) = alloc.allocate("z", 1)
+    alloc.free("y")
+    # LIFO free list: the just-freed block is handed out next...
+    grown = alloc.append("z", 4)
+    assert grown == [y]
+    # ...which makes z's table non-monotonic in physical block ids
+    table = alloc.block_table("z")
+    assert table.tolist() == [z, y] and z > y
+    # padding fills with the null block
+    assert alloc.block_table("z", pad_to=4).tolist() == [z, y, NULL_BLOCK, NULL_BLOCK]
+
+
+def test_allocator_errors():
+    alloc = BlockAllocator(num_blocks=4, block_size=2)
+    alloc.allocate("a", 2)
+    with pytest.raises(BlockAllocatorError, match="already allocated"):
+        alloc.allocate("a", 1)
+    with pytest.raises(BlockPoolExhausted):
+        alloc.allocate("big", 100)
+    assert "big" not in alloc.live_sequences()  # all-or-nothing
+    alloc.free("a")
+    with pytest.raises(BlockAllocatorError, match="double free"):
+        alloc.free("a")
+    with pytest.raises(BlockAllocatorError, match="use-after-free"):
+        alloc.append("a", 1)
+    with pytest.raises(BlockAllocatorError, match="use-after-free"):
+        alloc.block_table("a")
+
+
+def test_allocator_exhaustion_leaves_sequence_unchanged():
+    alloc = BlockAllocator(num_blocks=3, block_size=2)
+    alloc.allocate("a", 2)
+    alloc.allocate("b", 2)
+    with pytest.raises(BlockPoolExhausted):
+        alloc.append("a", 4)  # needs 2 more blocks, 0 free
+    assert alloc.tokens("a") == 2 and alloc.num_seq_blocks("a") == 1
+
+
+# ---------------------------------------------------------------------------
+# bucket lattice
+
+
+def test_bucket_lattice_rounding_and_limits():
+    lat = BucketLattice.from_limits(max_slots=6, max_blocks_per_seq=5, max_prefill_len=48)
+    assert lat.slot_buckets == (1, 2, 4, 6)
+    assert lat.block_buckets == (1, 2, 4, 5)
+    assert lat.prefill_buckets == (8, 16, 32, 48)
+    assert lat.slot_bucket(3) == 4 and lat.slot_bucket(0) == 1
+    assert lat.block_bucket(5) == 5
+    assert lat.prefill_bucket(9) == 16
+    with pytest.raises(ValueError, match="exceeds the bucket lattice"):
+        lat.prefill_bucket(49)
+    # every prefill point pairs with the single widest block bucket
+    assert lat.prefill_points() == [(8, 5), (16, 5), (32, 5), (48, 5)]
+    assert lat.size() == len(lat.decode_points()) + len(lat.prefill_points())
+
+
+# ---------------------------------------------------------------------------
+# paged attention parity (the bitwise micro-proof)
+
+
+def test_paged_attention_bitwise_matches_contiguous_on_scrambled_blocks():
+    """A sequence scattered over non-contiguous, out-of-order physical blocks
+    must attend bitwise-identically to the same values in a contiguous cache
+    — gather correctness plus exact-zero masking of null/stale slots."""
+    rng = np.random.default_rng(0)
+    B, S, H, D, Hkv = 1, 3, 4, 32, 2
+    max_len, bs = 24, 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32)).astype(jnp.bfloat16)
+    k_full = rng.normal(size=(B, max_len, Hkv, D)).astype(np.float32)
+    v_full = rng.normal(size=(B, max_len, Hkv, D)).astype(np.float32)
+    seq_len = 19
+    q_positions = jnp.asarray([[seq_len - 3, seq_len - 2, seq_len - 1]], jnp.int32)
+    ref = jax.jit(_cached_attention)(
+        q,
+        jnp.asarray(k_full).astype(jnp.bfloat16),
+        jnp.asarray(v_full).astype(jnp.bfloat16),
+        q_positions[0],
+    )
+    # scatter the 19 live tokens into scrambled blocks; garbage elsewhere
+    nb = 6
+    pool_k = rng.normal(size=(nb, bs, Hkv, D)).astype(np.float32)
+    pool_v = rng.normal(size=(nb, bs, Hkv, D)).astype(np.float32)
+    table = [5, 2, 4]  # logical block i -> scrambled physical id
+    for i in range(seq_len):
+        blk, off = divmod(i, bs)
+        pool_k[table[blk], off] = k_full[0, i]
+        pool_v[table[blk], off] = v_full[0, i]
+    out = jax.jit(paged_attention)(
+        q,
+        jnp.asarray(pool_k).astype(jnp.bfloat16),
+        jnp.asarray(pool_v).astype(jnp.bfloat16),
+        jnp.asarray([table + [NULL_BLOCK]], jnp.int32),  # null-padded width 4
+        q_positions,
+    )
+    assert np.array_equal(
+        np.asarray(ref, np.float32), np.asarray(out, np.float32)
+    ), "paged attention diverged from the contiguous cache"
+
+
+# ---------------------------------------------------------------------------
+# engine decode parity vs the single-stream reference
+
+
+def test_engine_greedy_parity_with_noncontiguous_blocks(params, greedy_engine):
+    engine = greedy_engine
+    prompts = _prompts(0, (5, 13, 21, 9))
+    max_new = (7, 11, 5, 9)
+    reqs = [
+        engine.submit(p, m, rng_seed=i) for i, (p, m) in enumerate(zip(prompts, max_new))
+    ]
+    # step until mid-flight, then prove at least one live sequence's blocks
+    # are non-contiguous (concurrent growth interleaves the pool)
+    noncontiguous = False
+    for _ in range(4):
+        engine.step()
+        for req in engine.scheduler.running():
+            table = engine.allocator.block_table(req.rid)
+            if len(table) > 1 and np.any(np.diff(table) != 1):
+                noncontiguous = True
+    engine.run()
+    assert noncontiguous, "concurrent requests never interleaved pool blocks"
+    for i, (p, m) in enumerate(zip(prompts, max_new)):
+        ref = greedy_generate(params, p[None], CONFIG, max_new_tokens=m)
+        assert np.array_equal(np.asarray(ref[0]), reqs[i].output_ids()), f"request {i}"
+
+
+def test_engine_chunked_prefill_parity_beyond_largest_bucket(params):
+    """A prefix longer than the largest prefill bucket must chunk through it
+    (length-bucketed chunked prefill) and still match the single-stream
+    reference exactly."""
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=17, block_size=8, max_slots=2,
+        max_blocks_per_seq=8,
+        lattice=BucketLattice(slot_buckets=(2,), block_buckets=(8,),
+                              prefill_buckets=(16, 32)),
+    )
+    engine.warmup()
+    prompt = _prompts(9, (45,))[0]  # 45 > the 32-wide largest prefill bucket
+    req = engine.submit(prompt, 6, rng_seed=3)
+    engine.run()
+    ref = greedy_generate(params, prompt[None], CONFIG, max_new_tokens=6)
+    assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+    # chunking stayed inside the warmed lattice: no new compiles
+    assert engine.jit_cache_sizes() == {
+        "prefill_compiles": 2, "decode_compiles": 1
+    }
+
+
+def test_engine_sampled_parity_fixed_keys(params):
+    knobs = dict(temperature=0.8, top_k=7, top_p=0.95)
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=4,
+        lattice=SMALL_LATTICE, **knobs,
+    )
+    engine.warmup()
+    prompts = _prompts(1, (6, 17, 11))
+    max_new = (9, 6, 12)
+    reqs = [
+        engine.submit(p, m, rng_seed=100 + i)
+        for i, (p, m) in enumerate(zip(prompts, max_new))
+    ]
+    engine.run()
+    for i, (p, m) in enumerate(zip(prompts, max_new)):
+        ref = sample_generate(
+            params, p[None], CONFIG, max_new_tokens=m,
+            rng_key=jax.random.PRNGKey(100 + i), **knobs,
+        )
+        assert np.array_equal(np.asarray(ref[0]), reqs[i].output_ids()), f"request {i}"
+
+
+def test_engine_preemption_resumes_with_identical_output(params):
+    """Pool pressure must evict the youngest request and resume it later with
+    output identical to an uninterrupted single-stream run."""
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=10, block_size=8, max_slots=4,
+        max_blocks_per_seq=8,
+        lattice=BucketLattice(slot_buckets=(1, 2, 4), block_buckets=(4, 8),
+                              prefill_buckets=(32,)),
+    )
+    engine.warmup()
+    prompts = _prompts(2, (16, 14, 15))
+    reqs = [engine.submit(p, 16, rng_seed=i) for i, p in enumerate(prompts)]
+    engine.run()
+    assert engine.scheduler.preemption_count >= 1
+    assert any(r.preemptions >= 1 for r in reqs)
+    for i, p in enumerate(prompts):
+        ref = greedy_generate(params, p[None], CONFIG, max_new_tokens=16)
+        assert np.array_equal(np.asarray(ref[0]), reqs[i].output_ids()), f"request {i}"
+
+
+def test_engine_eos_frees_slot_and_backfills(params, greedy_engine):
+    """A request hitting eos stops early and its slot is backfilled by the
+    queue at the next step (continuous batching's whole point)."""
+    engine = greedy_engine
+    prompts = _prompts(3, (8, 8, 8, 8, 8, 8))
+    # learn what token the model actually emits first, then use it as eos
+    probe = engine.submit(prompts[0], 2, rng_seed=0)
+    engine.run()
+    eos = probe.generated[0]
+    reqs = [engine.submit(p, 12, eos_token_id=eos, rng_seed=i) for i, p in enumerate(prompts[1:])]
+    done = engine.run()
+    assert len(done) == len(reqs)
+    for req in reqs:
+        assert req.generated[-1] == eos or len(req.generated) == 12
+        ref = greedy_generate(
+            params, req.prompt[None], CONFIG, max_new_tokens=12, eos_token_id=eos
+        )
+        # reference pads with eos after finishing; the engine stops — compare
+        # the engine's tokens against the reference prefix
+        n = req.output_ids().size
+        assert np.array_equal(np.asarray(ref[0])[:n], req.output_ids())
+
+
+def test_engine_rejects_impossible_request(params):
+    big = _prompts(4, (26,))[0]  # 26 + 4 tokens -> 4 blocks, cap is 2
+    small = ServingEngine(
+        params, CONFIG, num_blocks=3, block_size=8, max_slots=2,
+        lattice=BucketLattice(slot_buckets=(2,), block_buckets=(2,), prefill_buckets=(32,)),
+    )
+    small.warmup()
+    req = small.submit(big, 4)
+    ok = small.submit(_prompts(5, (6,))[0], 3)
+    done = small.run()
+    # the impossible request is returned with a REJECTED status + reason,
+    # never silently dropped; the queue behind it still drains
+    assert req in done and req.status is RequestStatus.REJECTED
+    assert req.generated == [] and "per-sequence cap" in req.error
+    assert ok in done and len(ok.generated) == 3
+
+
+def test_engine_rejects_request_outgrowing_the_block_lattice(params):
+    """A request whose prompt fits but whose worst case (prompt + max_new)
+    outgrows the lattice's widest block table must be rejected at ADMISSION
+    — not crash the engine mid-decode with blocks leaked."""
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=2,
+        lattice=BucketLattice(slot_buckets=(2,), block_buckets=(4,),
+                              prefill_buckets=(16,)),
+    )
+    engine.warmup()
+    # 10 + 30 = 40 tokens -> 5 blocks: fits the 32-block pool, NOT the
+    # 4-wide table cap (the review finding's reproducer)
+    doomed = engine.submit(_prompts(6, (10,))[0], 30)
+    ok = engine.submit(_prompts(7, (10,))[0], 8)
+    done = engine.run()
+    assert doomed.status is RequestStatus.REJECTED and doomed in done
+    assert ok in done and len(ok.generated) == 8
+    assert engine.allocator.stats()["sequences"] == 0  # nothing leaked
+
+
+def test_scheduler_static_mode_gang_admission():
+    alloc = BlockAllocator(num_blocks=17, block_size=8)
+    sched = Scheduler(alloc, max_slots=2, continuous=False)
+    reqs = [Request(prompt=np.arange(4) + 1, max_new_tokens=4) for _ in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    first = sched.admissions()
+    assert len(first) == 2  # gang of two
+    # nothing admits while the gang is running — even with a free slot
+    sched.complete(first[0], now=0.0)
+    assert sched.admissions() == []
+    sched.complete(first[1], now=0.0)
+    assert sched.admissions() == [reqs[2]]  # only on a fully drained engine
+
+
+def test_engine_rejects_request_beyond_rope_table(params):
+    """Worst case (prefix + max_new) past config.max_seq_len must be rejected
+    at admission: positions past the RoPE table would be silently clamped by
+    the cos/sin gathers, corrupting output with no error."""
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=40, block_size=8, max_slots=1,
+        lattice=BucketLattice(slot_buckets=(1,), block_buckets=(39,),
+                              prefill_buckets=(32,)),
+    )
+    # 30 + 250 = 280 tokens: fits the 39-block cap (35 blocks) but exceeds
+    # tiny's max_seq_len of 256 — the token rule, not the block rule, fires
+    doomed = engine.submit(_prompts(10, (30,))[0], 250)
+    done = engine.run()
+    assert doomed in done and doomed.status is RequestStatus.REJECTED
+    assert "max_seq_len" in doomed.error
+
+
+def test_scheduler_grow_error_is_a_guarded_backstop():
+    """Admission's worst-case check makes grow()'s pool-exhaustion path
+    unreachable through the engine, but the scheduler keeps it as a backstop:
+    a sequence that somehow outgrows the pool with nothing left to evict
+    raises a clear SchedulingError instead of a deep allocator error."""
+    alloc = BlockAllocator(num_blocks=3, block_size=2)
+    sched = Scheduler(alloc, max_slots=2)
+    req = Request(prompt=np.arange(2) + 1, max_new_tokens=1)  # worst 3 tokens: admits
+    sched.submit(req)
+    assert sched.admissions() == [req]
+    with pytest.raises(SchedulingError, match="no other sequence left to evict"):
+        for _ in range(8):  # grown past its declared max_new, past the pool
+            sched.grow(req)
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile churn guard (telemetry recompile detector as the oracle)
+
+
+def test_zero_recompiles_through_admission_churn(params):
+    from accelerate_tpu.telemetry.step_profiler import RecompileWatcher
+
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=17, block_size=4, max_slots=4,
+        max_blocks_per_seq=8,
+        lattice=BucketLattice(slot_buckets=(2, 4), block_buckets=(4, 8),
+                              prefill_buckets=(16, 32)),
+    )
+    warmed = engine.warmup()
+    assert warmed["decode_compiles"] == len(engine.lattice.decode_points())
+    assert warmed["prefill_compiles"] == len(engine.lattice.prefill_points())
+    watcher = RecompileWatcher()
+    watcher.register("serving_prefill", engine.prefill_fn)
+    watcher.register("serving_decode", engine.decode_fn)
+
+    # churn across every bucket: light load (1 slot), full load (4 slots),
+    # short and long prompts (both prefill buckets), sequences crossing the
+    # 4->8 block-width boundary, eviction pressure, staggered arrivals
+    rng = np.random.default_rng(7)
+    lengths = [3, 14, 30, 9, 22, 5, 28, 12]
+    news = [4, 9, 2, 14, 6, 11, 3, 8]
+    reqs = []
+    for i in range(0, len(lengths), 2):
+        for j in (i, i + 1):
+            prompt = rng.integers(0, CONFIG.vocab_size, (lengths[j],)).astype(np.int32)
+            reqs.append(engine.submit(prompt, news[j], rng_seed=j))
+        engine.step()
+    engine.run()
+    assert all(r.done for r in reqs)
+
+    # the oracle: jit caches frozen at the warmed counts, watcher sees zero
+    # cache misses after warmup
+    assert engine.jit_cache_sizes() == warmed
+    assert watcher.poll(emit=False) == {}
+
+
+# ---------------------------------------------------------------------------
+# telemetry + report
+
+
+def test_serving_telemetry_and_report_section(params, tmp_path):
+    from accelerate_tpu.telemetry import events as tel
+    from accelerate_tpu.telemetry.report import build_report, format_report
+
+    tel.enable(out_dir=str(tmp_path), run_id="serving-test")
+    try:
+        engine = ServingEngine(
+            params, CONFIG, num_blocks=33, block_size=8, max_slots=4,
+            lattice=SMALL_LATTICE,
+        )
+        engine.warmup()
+        for i, (p, m) in enumerate(zip(_prompts(8, (5, 12, 9)), (6, 4, 8))):
+            engine.submit(p, m, rng_seed=i)
+        engine.run()
+    finally:
+        tel.disable()
+
+    report = build_report([str(tmp_path)])
+    serving = report["serving"]
+    assert serving["steps"] == engine.steps
+    assert serving["requests"]["completed"] == 3
+    assert serving["requests"]["new_tokens"] == 6 + 4 + 8
+    assert serving["decode_tokens"] == engine.decode_tokens
+    assert serving["prefill_tokens"] == engine.prefill_tokens
+    assert serving["occupancy"]["max"] > 0.5  # batched, not serialized
+    assert serving["requests"]["latency_s"]["count"] == 3
+    text = format_report(report)
+    assert "serving:" in text and "batch occupancy" in text and "requests: 3 completed" in text
+
+
+def test_report_without_serving_records_omits_section(tmp_path):
+    from accelerate_tpu.telemetry.report import build_report, format_report
+
+    (tmp_path / "events-rank0.jsonl").write_text(
+        '{"kind": "meta", "schema": 1, "run_id": "r", "process_index": 0, '
+        '"num_processes": 1}\n'
+    )
+    report = build_report([str(tmp_path)])
+    assert report["serving"] is None
+    assert "serving:" not in format_report(report)
+
+
+# ---------------------------------------------------------------------------
+# multi-chip placement surface
+
+
+def test_serving_shardings_places_kv_heads_on_tp():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accelerate_tpu.generation import serving_shardings
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("dp", "tp"))
+    sharding = serving_shardings(mesh, CONFIG)  # tiny config: 2 kv heads % tp=2 == 0
+    assert sharding.spec == P(None, None, None, "tp", None)
+    # indivisible kv heads stay replicated
+    import dataclasses
+
+    odd = dataclasses.replace(CONFIG, n_heads=3, n_kv_heads=3)
+    assert serving_shardings(mesh, odd).spec == P(None, None, None, None, None)

@@ -88,9 +88,27 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
     "inference": (
         "Inference",
         "KV-cache generation and pipeline-parallel inference "
-        "(reference `inference.py` PiPPy route).",
+        "(reference `inference.py` PiPPy route). Concurrent-request serving "
+        "lives in `accelerate_tpu.serving` (see the serving page).",
         [("accelerate_tpu.generation", None),
          ("accelerate_tpu.parallel.pipeline", None)],
+    ),
+    "serving": (
+        "Serving",
+        "Continuous batching over a paged KV cache (no reference "
+        "counterpart): step-granular admission into running decode batches, "
+        "fixed-size KV blocks in one preallocated pool with a host-side "
+        "allocator, watermark/LIFO preemption with persisted resume, and a "
+        "static bucket lattice so admission churn never recompiles. See "
+        "`docs/serving.md` for the guide and `benchmarks/serving/` "
+        "(`make bench-serve`) for the continuous-vs-static benchmark.",
+        [("accelerate_tpu.serving.engine", ["ServingEngine", "paged_forward"]),
+         ("accelerate_tpu.serving.kv_pager",
+          ["BlockAllocator", "BlockAllocatorError", "BlockPoolExhausted",
+           "init_block_pool", "paged_attention"]),
+         ("accelerate_tpu.serving.scheduler",
+          ["Request", "RequestStatus", "Scheduler", "SchedulingError"]),
+         ("accelerate_tpu.serving.buckets", ["BucketLattice"])],
     ),
     "analysis": (
         "Static analysis (jaxlint)",
@@ -191,8 +209,9 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
           ["Watchdog", "start", "stop", "maybe_start_from_env", "get_watchdog",
            "beat", "register", "unregister", "env_timeout"]),
          ("accelerate_tpu.telemetry.report",
-          ["build_report", "format_report", "format_rank_section", "load_events",
-           "percentile", "run_doctor", "main"]),
+          ["build_report", "format_report", "format_rank_section",
+           "format_serving_section", "load_events", "percentile", "run_doctor",
+           "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "resilience": (
