@@ -96,15 +96,19 @@ bench-zero1:
 	python benchmarks/weight_update/run.py
 
 # continuous-vs-static batching through the paged-KV serving engine under a
-# seeded Poisson open-loop load: aggregate tok/s ratio, batch occupancy,
-# p50/p99 per-request latency (benchmarks/serving)
+# seeded Poisson open-loop load (aggregate tok/s ratio, batch occupancy,
+# p50/p99 per-request latency), plus the replicated-router leg: tok/s
+# scaling over N replicas and no-lost-requests + output parity under a
+# replica kill (benchmarks/serving)
 bench-serve:
 	python benchmarks/serving/run.py
 
 # self-check: flight-recorder dump, watchdog stall detection, straggler
 # report, collective-divergence detection, the jaxlint engine, perf cost
-# capture, xplane trace parsing and the performance report section against
-# synthetic inputs (telemetry/report.py run_doctor)
+# capture, xplane trace parsing, the performance report section, fused
+# ZeRO-1, elastic auto-resume, the serving engine, and the replicated
+# serving router (2 replicas, one chaos-killed mid-load, exactly-once +
+# bitwise parity) against synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor
 

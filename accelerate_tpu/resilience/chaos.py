@@ -50,6 +50,7 @@ import json
 import os
 import random
 import signal
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
@@ -57,7 +58,10 @@ from typing import Any, Optional
 CHAOS_ENV_VAR = "ACCELERATE_CHAOS_SCHEDULE"
 
 FAULT_KINDS = ("sigkill", "sigterm", "hang", "slow", "crash")
-POINTS = ("train_step", "collective", "prefetch", "any")
+# "serving_decode" fires inside ServingEngine.step (serving/engine.py): a
+# seeded replica kill/hang/slow lands mid-decode, which is what the router's
+# failover chaos tests and `make doctor` check 13 exercise
+POINTS = ("train_step", "collective", "prefetch", "serving_decode", "any")
 
 
 class ChaosFaultError(RuntimeError):
@@ -113,11 +117,13 @@ class ChaosSchedule:
         n_faults: int = 2,
         ranks: int = 1,
         generation: Optional[int] = 0,
+        point: str = "train_step",
     ) -> "ChaosSchedule":
         """Generate ``n_faults`` faults at distinct steps in ``[1, steps)``,
         deterministically from ``seed`` (a private ``random.Random`` — never
         the global RNG, which training code may reseed). Faults default to
-        generation 0 so the restarted incarnation runs fault-free."""
+        generation 0 so the restarted incarnation runs fault-free; ``point``
+        picks the injection site (serving chaos uses ``"serving_decode"``)."""
         rng = random.Random(seed)
         candidates = list(range(1, max(2, steps)))
         rng.shuffle(candidates)
@@ -131,7 +137,7 @@ class ChaosSchedule:
             faults.append(
                 Fault(
                     kind=kind,
-                    point="train_step",
+                    point=point,
                     step=candidates[i % len(candidates)],
                     rank=rng.randrange(ranks) if ranks > 1 else None,
                     generation=generation,
@@ -177,6 +183,9 @@ class ChaosSchedule:
 _SCHEDULE: Optional[ChaosSchedule] = None
 _FIRED: "set[int]" = set()
 _ARMED_FROM_ENV = False
+# serving replicas inject from concurrent engine threads: matching and the
+# once-marking must be atomic or a once-fault could fire in two replicas
+_MATCH_LOCK = threading.Lock()
 
 
 def arm(schedule: Optional[ChaosSchedule]) -> None:
@@ -229,10 +238,13 @@ def maybe_inject(point: str, step: Optional[int] = None) -> None:
         from ..telemetry import flight_recorder as _flight
 
         step = _flight.get_recorder().step
-    hits = _SCHEDULE.pending(point, step, rank, generation, _FIRED)
+    with _MATCH_LOCK:  # match + mark atomically; execute OUTSIDE the lock
+        # (a hang fault holds forever — other threads must stay injectable)
+        hits = _SCHEDULE.pending(point, step, rank, generation, _FIRED)
+        for idx, fault in hits:
+            if fault.once:
+                _FIRED.add(idx)
     for idx, fault in hits:
-        if fault.once:
-            _FIRED.add(idx)
         _execute(fault, point, step)
 
 

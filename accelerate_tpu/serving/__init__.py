@@ -1,7 +1,8 @@
-"""Production serving: continuous batching over a paged KV cache.
+"""Production serving: continuous batching over a paged KV cache, replicated
+behind a fault-tolerant router.
 
 The serve-many-concurrent-requests counterpart of ``generation.py``'s
-single-stream decode (ROADMAP item 1). Three pillars:
+single-stream decode (ROADMAP item 1). Five pillars:
 
 - :mod:`~accelerate_tpu.serving.kv_pager` — fixed-size KV blocks in one
   preallocated device pool, host-side block allocator, paged attention;
@@ -10,12 +11,27 @@ single-stream decode (ROADMAP item 1). Three pillars:
 - :mod:`~accelerate_tpu.serving.engine` — the
   :class:`~accelerate_tpu.serving.engine.ServingEngine` step loop, compiled
   only over the :mod:`~accelerate_tpu.serving.buckets` shape lattice so
-  admission churn never recompiles.
+  admission churn never recompiles;
+- :mod:`~accelerate_tpu.serving.replica` — one warmed engine per unit of
+  failure (thread- or subprocess-backed), streaming per-step token progress;
+- :mod:`~accelerate_tpu.serving.router` +
+  :mod:`~accelerate_tpu.serving.admission` — health-checked
+  least-outstanding-tokens dispatch over N replicas with deadlines,
+  exactly-once token-exact failover, token-bucket admission, priority
+  shedding (distinct ``SHED`` status) and bounded-queue backpressure.
 
 See ``docs/serving.md`` for the guide and ``benchmarks/serving/`` for the
-continuous-vs-static Poisson-load benchmark (``make bench-serve``).
+continuous-vs-static and replicated Poisson-load benchmarks
+(``make bench-serve``).
 """
 
+from .admission import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    AdmissionController,
+    AdmissionVerdict,
+    TokenBucket,
+)
 from .buckets import BucketLattice
 from .engine import ServingEngine, paged_forward
 from .kv_pager import (
@@ -26,6 +42,8 @@ from .kv_pager import (
     init_block_pool,
     paged_attention,
 )
+from .replica import LocalReplica, ProcessReplica, ReplicaSpec, ReplicaState
+from .router import RouterRequest, RouterRequestStatus, ServingRouter
 from .scheduler import Request, RequestStatus, Scheduler, SchedulingError
 
 __all__ = [
@@ -42,4 +60,16 @@ __all__ = [
     "RequestStatus",
     "Scheduler",
     "SchedulingError",
+    "PRIORITY_INTERACTIVE",
+    "PRIORITY_BATCH",
+    "TokenBucket",
+    "AdmissionVerdict",
+    "AdmissionController",
+    "ReplicaState",
+    "ReplicaSpec",
+    "LocalReplica",
+    "ProcessReplica",
+    "RouterRequest",
+    "RouterRequestStatus",
+    "ServingRouter",
 ]

@@ -43,11 +43,20 @@ from ..generation import _project_qkv, sample_token_logits, serving_shardings
 from ..models.transformer import LlamaConfig, rms_norm, rope_frequencies
 from ..ops.flash_attention import paged_attention
 from ..telemetry import events as tel
+from ..telemetry import watchdog as _watchdog
 from .buckets import BucketLattice
 from .kv_pager import NULL_BLOCK, BlockAllocator, init_block_pool
 from .scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "paged_forward"]
+
+
+def _chaos_inject(point: str, step: int) -> None:
+    # lazy import: resilience pulls in the supervisor stack, which serving
+    # must not pay for (or cyclically import) at module load
+    from ..resilience import chaos as _chaos
+
+    _chaos.maybe_inject(point, step=step)
 
 
 def _paged_layer_step(layer_params, h, k_pool, v_pool, block_tables, positions,
@@ -144,12 +153,17 @@ class ServingEngine:
         continuous: bool = True,
         admit_watermark_blocks: int = 0,
         lattice: Optional[BucketLattice] = None,
+        heartbeat_name: str = "serving_decode",
     ):
         self.params = params
         self.config = config
         self.block_size = block_size
         self.max_slots = max_slots
         self.mesh = mesh
+        # watchdog heartbeat source for the decode loop: a hang inside a
+        # batched decode produces a stall dump naming this engine (replicas
+        # suffix their name so a stuck replica is attributable)
+        self.heartbeat_name = heartbeat_name
         self.allocator = BlockAllocator(num_blocks, block_size)
         if max_blocks_per_seq is None:
             max_blocks_per_seq = self.allocator.usable_blocks
@@ -234,9 +248,17 @@ class ServingEngine:
         eos_token_id: Optional[int] = None,
         rng_seed: int = 0,
         arrival_t: Optional[float] = None,
+        generated: Optional["list[int]"] = None,
     ) -> Request:
         """Enqueue one request; returns its :class:`Request` handle (live —
-        ``generated``/``status`` update as the engine steps)."""
+        ``generated``/``status`` update as the engine steps).
+
+        ``generated`` seeds the request with tokens already produced by a
+        PREVIOUS engine (the router's cross-replica failover resume): the
+        prefill covers ``prompt + generated`` and sampling continues at fold
+        index ``len(generated)`` — exactly the scheduler's preempt/resume
+        state, so the continuation is bitwise-identical to an unfailed run.
+        ``max_new_tokens`` stays the request's TOTAL new-token budget."""
         req = Request(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -244,6 +266,13 @@ class ServingEngine:
             rng_seed=rng_seed,
             arrival_t=time.monotonic() if arrival_t is None else arrival_t,
         )
+        if generated:
+            if len(generated) >= max_new_tokens:
+                raise ValueError(
+                    f"resume with {len(generated)} generated token(s) >= "
+                    f"max_new_tokens={max_new_tokens}: nothing left to decode"
+                )
+            req.generated = [int(t) for t in generated]
         self.scheduler.submit(req)
         return req
 
@@ -292,6 +321,10 @@ class ServingEngine:
         ``Request.error`` set) for requests whose worst case can never fit
         this engine's pool/lattice."""
         now = time.monotonic() if now is None else now
+        # chaos fault point: a seeded replica kill/hang/slow lands HERE, mid
+        # decode loop (resilience/chaos.py, point "serving_decode") — one
+        # ``is None`` check when disarmed
+        _chaos_inject("serving_decode", self.steps)
         finished: "list[Request]" = []
 
         prefills = 0
@@ -332,6 +365,13 @@ class ServingEngine:
                     finished.append(req)
 
         self.steps += 1
+        if self.scheduler.idle():
+            # an idle engine is not a stalled one: deregister so a quiet
+            # traffic window can never trip the watchdog (the next step's
+            # beat re-registers the source)
+            _watchdog.unregister(self.heartbeat_name)
+        else:
+            _watchdog.beat(self.heartbeat_name, step=self.steps)
         occupancy = len(running) / self.max_slots
         self.max_running = max(self.max_running, len(running))
         self._occupancy_sum += occupancy

@@ -1,0 +1,487 @@
+"""Engine replicas: one warmed :class:`~accelerate_tpu.serving.engine.
+ServingEngine` per unit of failure, behind a transport the router can watch.
+
+The Podracer lesson (PAPERS.md, 2104.06272) applied to serving: treat each
+engine as PREEMPTIBLE — it can crash, hang, or slow down at any step — and
+make the unit above it (the :class:`~accelerate_tpu.serving.router.
+ServingRouter`) route work around the failure instead of sharing its fate.
+Two transports implement the same replica surface:
+
+- :class:`LocalReplica` — the engine loop in a daemon thread of this
+  process. Zero spawn cost, shares the imported jax runtime; the transport
+  for benchmarks, doctor check 13, and fast tier-1 tests. A thread cannot
+  be SIGKILLed, so abrupt death is modeled by :meth:`~LocalReplica.kill`
+  (the loop exits without flushing in-flight work) or a chaos ``crash``
+  fault.
+- :class:`ProcessReplica` — the engine loop in a child process
+  (``python -m accelerate_tpu.serving.replica``), speaking JSON lines over
+  stdin/stdout. Real OS-level failure semantics: a chaos ``sigkill`` is an
+  actual SIGKILL (no handlers run, in-flight state gone), a ``hang`` wedges
+  the child until the router's heartbeat watch declares it dead.
+
+Both run the same :class:`_EngineWorker` loop: drain submit commands, step
+the engine, and stream one ``step`` event per engine step carrying each
+request's newly generated tokens. Those per-step progress deltas are what
+make failover token-exact — the router always holds every in-flight
+request's ``generated``-so-far, so a survivor resumes via
+``ServingEngine.submit(generated=...)`` (the scheduler's preempt/resume
+state) and the retried output is bitwise-identical to an unfailed run.
+
+The worker registers the engine as watchdog heartbeat source
+``serving_decode:<name>`` (beats per step), so a hang inside batched decode
+produces a stall dump naming the replica — the same forensics train steps
+get.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "REPLICA_SPEC_ENV_VAR",
+    "ReplicaState",
+    "ReplicaSpec",
+    "LocalReplica",
+    "ProcessReplica",
+]
+
+REPLICA_SPEC_ENV_VAR = "ACCELERATE_REPLICA_SPEC"
+
+
+class ReplicaState(enum.Enum):
+    STARTING = "starting"  # spawned, engine still building/warming
+    HEALTHY = "healthy"    # ready event seen, heartbeats fresh
+    DRAINING = "draining"  # no new dispatch; in-flight work finishes
+    DEAD = "dead"          # crashed or stalled; in-flight work failed over
+
+
+@dataclass(frozen=True)
+class ReplicaSpec:
+    """A serializable engine recipe, so every replica — thread or child
+    process — builds the SAME engine over the SAME params (``init_llama``
+    with ``param_seed`` is deterministic per backend), which is what makes
+    cross-replica retry bitwise-safe. ``model`` holds ``LlamaConfig`` field
+    overrides; bucket tuples of ``None`` fall back to the engine's
+    power-of-two lattice."""
+
+    model: "dict[str, Any]"
+    param_seed: int = 0
+    num_blocks: int = 49
+    block_size: int = 8
+    max_slots: int = 4
+    max_blocks_per_seq: Optional[int] = None
+    slot_buckets: Optional["tuple[int, ...]"] = None
+    block_buckets: Optional["tuple[int, ...]"] = None
+    prefill_buckets: Optional["tuple[int, ...]"] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    param_dtype: str = "bfloat16"
+
+    def config(self):
+        from ..models.transformer import LlamaConfig
+
+        return LlamaConfig(**self.model)
+
+    def build_params(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import init_llama
+
+        dtype = jnp.dtype(self.param_dtype)
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(dtype),
+            init_llama(self.config(), jax.random.PRNGKey(self.param_seed)),
+        )
+
+    def lattice(self):
+        from .buckets import BucketLattice
+
+        if self.slot_buckets is None:
+            return None
+        return BucketLattice(
+            slot_buckets=tuple(self.slot_buckets),
+            block_buckets=tuple(self.block_buckets),
+            prefill_buckets=tuple(self.prefill_buckets),
+        )
+
+    def build_engine(self, heartbeat_name: str = "serving_decode"):
+        from .engine import ServingEngine
+
+        return ServingEngine(
+            self.build_params(),
+            self.config(),
+            num_blocks=self.num_blocks,
+            block_size=self.block_size,
+            max_slots=self.max_slots,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            lattice=self.lattice(),
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+            heartbeat_name=heartbeat_name,
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, payload: str) -> "ReplicaSpec":
+        return cls(**json.loads(payload))
+
+
+# ---------------------------------------------------------------------------
+# the worker loop (shared by both transports)
+
+
+class _EngineWorker:
+    """Drive one engine from a command stream, emitting an event stream.
+
+    Commands: ``{"cmd": "submit", "rid", "prompt", "max_new", "eos",
+    "rng_seed", "generated"}`` and ``{"cmd": "stop"}``. Events: ``ready``
+    (warmup compile counts), ``step`` (per engine step: progress deltas per
+    request), ``done`` (terminal status + authoritative full token list),
+    ``beat`` (throttled idle liveness), ``fatal`` (the loop died on an
+    exception — chaos ``crash`` faults land here)."""
+
+    def __init__(
+        self,
+        engine,
+        recv: Callable[[float], Optional[dict]],
+        send: Callable[[dict], None],
+        killed: Optional[threading.Event] = None,
+        idle_beat_s: float = 0.1,
+    ):
+        self.engine = engine
+        self.recv = recv
+        self.send = send
+        self.killed = killed or threading.Event()
+        self.idle_beat_s = idle_beat_s
+
+    def run(self) -> None:
+        import numpy as np
+
+        from .scheduler import RequestStatus
+
+        try:
+            self.send({"event": "ready", **self.engine.warmup()})
+            handles: "dict[str, Any]" = {}  # router rid -> engine Request
+            sent: "dict[str, int]" = {}  # router rid -> tokens already reported
+            last_beat = 0.0
+            while not self.killed.is_set():
+                cmd = self.recv(self.idle_beat_s if self.engine.scheduler.idle() else 0.0)
+                while cmd is not None:
+                    if cmd.get("cmd") == "stop":
+                        return
+                    if cmd.get("cmd") == "submit":
+                        req = self.engine.submit(
+                            np.asarray(cmd["prompt"], np.int32),
+                            int(cmd["max_new"]),
+                            eos_token_id=cmd.get("eos"),
+                            rng_seed=int(cmd.get("rng_seed", 0)),
+                            generated=cmd.get("generated") or None,
+                        )
+                        handles[cmd["rid"]] = req
+                        sent[cmd["rid"]] = len(req.generated)
+                    cmd = self.recv(0.0)
+                if self.engine.scheduler.idle():
+                    now = time.monotonic()
+                    if now - last_beat >= self.idle_beat_s:
+                        last_beat = now
+                        self.send({"event": "beat"})
+                    continue
+                finished = self.engine.step()
+                progress = {}
+                for rid, req in handles.items():
+                    n = len(req.generated)
+                    if n > sent[rid]:
+                        progress[rid] = [int(t) for t in req.generated[sent[rid] :]]
+                        sent[rid] = n
+                self.send(
+                    {
+                        "event": "step",
+                        "step": self.engine.steps,
+                        "running": len(self.engine.scheduler.running()),
+                        "queued": self.engine.scheduler.queue_depth,
+                        "progress": progress,
+                    }
+                )
+                for req in finished:
+                    rid = next(k for k, v in handles.items() if v is req)
+                    self.send(
+                        {
+                            "event": "done",
+                            "rid": rid,
+                            "status": "finished"
+                            if req.status is RequestStatus.FINISHED
+                            else "rejected",
+                            "tokens": [int(t) for t in req.generated],
+                            "error": req.error,
+                            "preemptions": req.preemptions,
+                        }
+                    )
+                    handles.pop(rid)
+                    sent.pop(rid)
+        except BaseException as exc:  # the router must hear about ANY death
+            try:
+                self.send({"event": "fatal", "error": f"{type(exc).__name__}: {exc}"})
+            except Exception:
+                pass  # transport already gone — the heartbeat watch catches it
+
+
+# ---------------------------------------------------------------------------
+# transports
+
+
+class LocalReplica:
+    """The worker loop in a daemon thread of this process."""
+
+    transport = "thread"
+
+    def __init__(self, name: str, spec: ReplicaSpec, *, idle_beat_s: float = 0.05):
+        self.name = name
+        self.spec = spec
+        self.state = ReplicaState.STARTING
+        self._inbox: "queue.Queue[dict]" = queue.Queue()
+        self._outbox: "queue.Queue[dict]" = queue.Queue()
+        self._killed = threading.Event()
+        self._worker: Optional[_EngineWorker] = None
+
+        def _run():
+            engine = spec.build_engine(heartbeat_name=f"serving_decode:{name}")
+            self._worker = _EngineWorker(
+                engine,
+                recv=self._recv,
+                send=self._outbox.put,
+                killed=self._killed,
+                idle_beat_s=idle_beat_s,
+            )
+            self._worker.run()
+
+        self._thread = threading.Thread(
+            target=_run, name=f"serving-replica-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _recv(self, timeout: float) -> Optional[dict]:
+        try:
+            return self._inbox.get(timeout=timeout) if timeout > 0 else self._inbox.get_nowait()
+        except queue.Empty:
+            return None
+
+    # -- router surface ------------------------------------------------------
+
+    def submit(self, payload: dict) -> None:
+        self._inbox.put(dict(payload, cmd="submit"))
+
+    def drain_events(self) -> "list[dict]":
+        events = []
+        while True:
+            try:
+                events.append(self._outbox.get_nowait())
+            except queue.Empty:
+                return events
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def kill(self) -> None:
+        """Abrupt death: the loop exits at its next check WITHOUT reporting
+        in-flight work (a hung loop never reaches the check — the heartbeat
+        watch handles that, same as a real process)."""
+        self._killed.set()
+
+    def stop(self) -> None:
+        self._inbox.put({"cmd": "stop"})
+
+    def close(self, timeout: float = 5.0) -> None:
+        self.stop()
+        self._killed.set()
+        self._thread.join(timeout=timeout)
+
+
+class ProcessReplica:
+    """The worker loop in a child process, JSON lines over stdin/stdout.
+
+    ``chaos_schedule`` (a JSON string / ``@file`` ref, see
+    ``resilience/chaos.py``) arms fault injection in the CHILD only — the
+    way chaos tests kill one replica mid-decode without touching the
+    router's process."""
+
+    transport = "process"
+
+    def __init__(
+        self,
+        name: str,
+        spec: ReplicaSpec,
+        *,
+        chaos_schedule: Optional[str] = None,
+        env: Optional[dict] = None,
+        idle_beat_s: float = 0.05,
+    ):
+        from ..resilience.chaos import CHAOS_ENV_VAR
+
+        self.name = name
+        self.spec = spec
+        self.state = ReplicaState.STARTING
+        self._outbox: "queue.Queue[dict]" = queue.Queue()
+        # the child inherits the parent's environment verbatim (no platform
+        # pinning: silently forcing JAX_PLATFORMS=cpu would downgrade every
+        # process replica on a TPU host with no error, only bad throughput —
+        # CPU-only tests pass JAX_PLATFORMS=cpu themselves)
+        child_env = dict(os.environ if env is None else env)
+        child_env[REPLICA_SPEC_ENV_VAR] = spec.to_json()
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo, child_env.get("PYTHONPATH")) if p
+        )
+        if chaos_schedule is not None:
+            child_env[CHAOS_ENV_VAR] = chaos_schedule
+        else:
+            child_env.pop(CHAOS_ENV_VAR, None)  # a parent-armed schedule must
+            # not leak into every replica — chaos targets are explicit
+        # -c instead of -m: runpy would re-execute a module the package
+        # __init__ already imported and warn about it
+        worker = (
+            "import sys; from accelerate_tpu.serving.replica import _worker_main; "
+            "sys.exit(_worker_main(sys.argv[1:]))"
+        )
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                worker,
+                "--name",
+                name,
+                "--idle-beat-s",
+                str(idle_beat_s),
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,  # pass through: replica tracebacks stay debuggable
+            text=True,
+            bufsize=1,
+            env=child_env,
+        )
+        self._reader = threading.Thread(
+            target=self._pump, name=f"serving-replica-{name}-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self._outbox.put(json.loads(line))
+            except ValueError:
+                pass  # stray non-protocol output (jax logs) — never fatal
+
+    # -- router surface ------------------------------------------------------
+
+    def submit(self, payload: dict) -> None:
+        try:
+            self.proc.stdin.write(json.dumps(dict(payload, cmd="submit")) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass  # child died — the router's liveness check fails it over
+
+    def drain_events(self) -> "list[dict]":
+        events = []
+        while True:
+            try:
+                events.append(self._outbox.get_nowait())
+            except queue.Empty:
+                return events
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+    def stop(self) -> None:
+        try:
+            self.proc.stdin.write(json.dumps({"cmd": "stop"}) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.stop()
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# child entry point: `python -m accelerate_tpu.serving.replica`
+
+
+def _worker_main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="python -m accelerate_tpu.serving.replica")
+    parser.add_argument("--name", default="replica")
+    parser.add_argument("--idle-beat-s", type=float, default=0.05)
+    args = parser.parse_args(argv)
+
+    payload = os.environ.get(REPLICA_SPEC_ENV_VAR, "").strip()
+    if not payload:
+        print(f"{REPLICA_SPEC_ENV_VAR} not set", file=sys.stderr)
+        return 2
+    spec = ReplicaSpec.from_json(payload)
+
+    from ..resilience import chaos
+    from ..telemetry import watchdog
+
+    chaos.maybe_arm_from_env()
+    watchdog.maybe_start_from_env()
+
+    engine = spec.build_engine(heartbeat_name=f"serving_decode:{args.name}")
+
+    inbox: "queue.Queue[dict]" = queue.Queue()
+
+    def _pump_stdin():
+        for line in sys.stdin:
+            line = line.strip()
+            if line:
+                try:
+                    inbox.put(json.loads(line))
+                except ValueError:
+                    pass
+        inbox.put({"cmd": "stop"})  # router closed the pipe: shut down
+
+    threading.Thread(target=_pump_stdin, daemon=True).start()
+
+    def _recv(timeout: float) -> Optional[dict]:
+        try:
+            return inbox.get(timeout=timeout) if timeout > 0 else inbox.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _send(event: dict) -> None:
+        sys.stdout.write(json.dumps(event) + "\n")
+        sys.stdout.flush()
+
+    _EngineWorker(engine, recv=_recv, send=_send, idle_beat_s=args.idle_beat_s).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_worker_main())

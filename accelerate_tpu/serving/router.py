@@ -1,0 +1,561 @@
+"""The serving router: N replicated engines, one front door, no lost work.
+
+PR 11's :class:`~accelerate_tpu.serving.engine.ServingEngine` decodes fast
+but dies alone: a wedged or SIGKILLed engine loses every in-flight request,
+and its only overload answer is hard rejection. The router closes both gaps
+by treating replicas as preemptible compute (Podracer, PAPERS.md
+2104.06272) behind a clean dispatch boundary (the MPMD-disaggregation
+router/replica split, PAPERS.md 2412.14374):
+
+- **dispatch** — queued requests go to the HEALTHY replica with the fewest
+  outstanding tokens (prompt + remaining budget of everything in flight
+  there), bounded per replica so one engine never hoards the queue;
+- **health** — every replica event refreshes a heartbeat; a replica whose
+  process/thread died, whose worker reported ``fatal``, or whose heartbeat
+  went stale while it held work is marked DEAD (and killed, so a wedged
+  child doesn't linger). ``drain()`` marks a replica DRAINING: in-flight
+  work finishes, nothing new is dispatched — the rolling-restart state.
+  Each replica is also a watchdog heartbeat source
+  (``serving_replica:<name>``), so a stall produces a flight-recorder dump
+  naming the replica;
+- **failover** — a DEAD replica's in-flight requests re-queue at the FRONT
+  with their ``generated``-so-far (streamed per step by the worker) and
+  resume on a survivor via ``ServingEngine.submit(generated=...)``. Because
+  sampling is a pure function of (prompt, rng_seed, fold index), the
+  retried output is BITWISE-identical to an unfailed run, and terminal
+  dedup (a request finalizes exactly once, stale-replica events are
+  ignored) makes retry exactly-once;
+- **overload** — admission runs through
+  :class:`~accelerate_tpu.serving.admission.AdmissionController`:
+  token-bucket rate limiting, bounded priority queues, shedding with a
+  distinct :attr:`RouterRequestStatus.SHED` outcome, and per-request
+  deadlines (expired queued work returns ``EXPIRED`` instead of occupying a
+  slot).
+
+``tests/test_router.py`` holds the invariants (chaos SIGKILL + wedge-forever
+hang under Poisson load → every admitted request completes exactly once,
+bitwise-equal to the single-stream reference; shed paths by priority), and
+``make doctor`` check 13 re-proves them end to end.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..telemetry import events as tel
+from ..telemetry import watchdog as _watchdog
+from .admission import PRIORITY_BATCH, AdmissionController
+from .replica import ReplicaState
+
+__all__ = ["RouterRequestStatus", "RouterRequest", "ServingRouter"]
+
+
+class RouterRequestStatus(enum.Enum):
+    QUEUED = "queued"        # admitted, waiting for a replica
+    DISPATCHED = "dispatched"  # in flight on a replica
+    FINISHED = "finished"    # completed exactly once; ``generated`` is final
+    SHED = "shed"            # refused by overload control (rate/queue/displaced)
+    EXPIRED = "expired"      # deadline passed before dispatch
+    FAILED = "failed"        # retries exhausted / engine rejection / no replicas
+
+    @property
+    def terminal(self) -> bool:
+        return self not in (RouterRequestStatus.QUEUED, RouterRequestStatus.DISPATCHED)
+
+
+_rid_counter = itertools.count()
+
+
+@dataclass(eq=False)  # identity equality: requests are stateful handles
+class RouterRequest:
+    """One routed request plus its durable progress. ``generated`` is kept
+    current from the worker's per-step progress events, which is exactly the
+    state failover resume needs."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rid: str = field(default_factory=lambda: f"q{next(_rid_counter)}")
+    eos_token_id: Optional[int] = None
+    rng_seed: int = 0
+    priority: int = PRIORITY_BATCH
+    deadline_t: Optional[float] = None  # absolute, in router-clock time
+    arrival_t: float = 0.0
+
+    status: RouterRequestStatus = RouterRequestStatus.QUEUED
+    generated: "list[int]" = field(default_factory=list)
+    replica: Optional[str] = None
+    retries: int = 0  # failover re-dispatches survived
+    # len(generated) at the moment of the CURRENT dispatch: until progress
+    # moves past it, the new replica still owes the (re-)prefill of
+    # prompt + generated — the load metric must count that work
+    _resume_from: int = field(default=0, repr=False)
+    preemptions: int = 0
+    error: Optional[str] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+
+    @property
+    def cost_tokens(self) -> int:
+        """Worst-case token cost, what admission charges."""
+        return int(self.prompt.size) + self.max_new_tokens
+
+    @property
+    def remaining_tokens(self) -> int:
+        return max(0, self.max_new_tokens - len(self.generated))
+
+    @property
+    def done_decoding(self) -> bool:
+        """All tokens already streamed back — nothing left to resume."""
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (
+            self.eos_token_id is not None
+            and bool(self.generated)
+            and self.generated[-1] == self.eos_token_id
+        )
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated, the ``greedy_generate`` layout."""
+        return np.concatenate([self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class ServingRouter:
+    """Health-checked dispatch over replicated serving engines."""
+
+    def __init__(
+        self,
+        replicas: "list",
+        *,
+        admission: Optional[AdmissionController] = None,
+        health_timeout_s: float = 5.0,
+        max_retries: int = 3,
+        max_outstanding_per_replica: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.replicas: "dict[str, Any]" = {r.name: r for r in replicas}
+        self.clock = clock
+        self.admission = admission or AdmissionController(clock=clock)
+        self.health_timeout_s = float(health_timeout_s)
+        self.max_retries = int(max_retries)
+        self.max_outstanding_per_replica = max_outstanding_per_replica
+        now = clock()
+        self._last_event: "dict[str, float]" = {n: now for n in self.replicas}
+        self._inflight: "dict[str, RouterRequest]" = {}
+        # cumulative counters (the telemetry records carry these, so the
+        # report section can take a max instead of re-summing)
+        self.dispatched = 0
+        self.completed = 0
+        self.failed = 0
+        self.expired = 0
+        self.shed = 0
+        self.failovers = 0
+        self.shed_by_reason: "dict[str, int]" = {}
+        self._per_replica: "dict[str, dict]" = {
+            n: {"dispatched": 0, "completed": 0, "failovers": 0} for n in self.replicas
+        }
+        for n in self.replicas:
+            _watchdog.register(f"serving_replica:{n}")
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        eos_token_id: Optional[int] = None,
+        rng_seed: int = 0,
+        priority: int = PRIORITY_BATCH,
+        deadline_s: Optional[float] = None,
+        arrival_t: Optional[float] = None,
+    ) -> RouterRequest:
+        """Admit-or-shed one request. Always returns the handle — check
+        ``status``: SHED means overload control refused it NOW (distinct
+        from any failure), QUEUED means the router owns it until a terminal
+        state."""
+        now = self.clock() if arrival_t is None else arrival_t
+        req = RouterRequest(
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+            rng_seed=rng_seed,
+            priority=priority,
+            deadline_t=(now + deadline_s) if deadline_s is not None else None,
+            arrival_t=now,
+        )
+        verdict = self.admission.try_admit(req, cost=req.cost_tokens, now=now)
+        for victim in verdict.evicted:
+            self._finalize(
+                victim, RouterRequestStatus.SHED, now,
+                error="shed: displaced by higher-priority admission",
+            )
+        if not verdict.admitted:
+            self._finalize(req, RouterRequestStatus.SHED, now, error=f"shed: {verdict.reason}")
+        return req
+
+    # -- the poll loop -------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> "list[RouterRequest]":
+        """One router iteration: drain replica events, health-check, expire
+        deadlines, dispatch. Returns the requests that reached a terminal
+        state during this poll."""
+        now = self.clock() if now is None else now
+        self._terminal_this_poll: "list[RouterRequest]" = []
+        activity = self._drain_events(now)
+        activity |= self._check_health(now)
+        for req in self.admission.expire(now):
+            self._finalize(
+                req, RouterRequestStatus.EXPIRED, now,
+                error="expired: deadline passed before dispatch",
+            )
+            activity = True
+        activity |= self._dispatch(now)
+        if activity and tel.is_enabled():
+            self._emit_poll(now)
+        return self._terminal_this_poll
+
+    def run(
+        self, *, timeout_s: float = 300.0, poll_interval_s: float = 0.002
+    ) -> "list[RouterRequest]":
+        """Poll until every admitted request is terminal; returns them in
+        finish order. Raises RuntimeError on wall-clock timeout (the router
+        must never wedge silently — that is the failure mode this PR
+        exists to kill)."""
+        done: "list[RouterRequest]" = []
+        deadline = time.monotonic() + timeout_s
+        while True:
+            done.extend(self.poll())
+            if not self._inflight and self.admission.depth == 0:
+                return done
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"router not idle after {timeout_s}s: "
+                    f"{len(self._inflight)} in flight, {self.admission.depth} queued"
+                )
+            time.sleep(poll_interval_s)
+
+    def wait_ready(self, timeout_s: float = 300.0, poll_interval_s: float = 0.01) -> None:
+        """Block until no replica is STARTING (each is HEALTHY — warmed and
+        compiled — or already DEAD). Benchmarks and tests call this so the
+        measured window never includes warmup, and so load balancing sees
+        the whole fleet instead of whichever replica compiled first."""
+        deadline = time.monotonic() + timeout_s
+        while any(r.state is ReplicaState.STARTING for r in self.replicas.values()):
+            self.poll()
+            if time.monotonic() > deadline:
+                starting = [
+                    n for n, r in self.replicas.items()
+                    if r.state is ReplicaState.STARTING
+                ]
+                raise RuntimeError(f"replicas never became ready: {starting}")
+            time.sleep(poll_interval_s)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def drain(self, name: str) -> None:
+        """Stop dispatching to ``name``; its in-flight work finishes."""
+        rep = self.replicas[name]
+        if rep.state in (ReplicaState.STARTING, ReplicaState.HEALTHY):
+            rep.state = ReplicaState.DRAINING
+            self._emit_replica(rep, self.clock())
+
+    def close(self) -> None:
+        for n, rep in self.replicas.items():
+            _watchdog.unregister(f"serving_replica:{n}")
+            try:
+                rep.close()
+            except Exception:
+                rep.kill()
+
+    # -- internals -----------------------------------------------------------
+
+    def _outstanding(self, name: str) -> "list[RouterRequest]":
+        return [r for r in self._inflight.values() if r.replica == name]
+
+    def outstanding_tokens(self, name: str) -> int:
+        """The dispatch-balancing load metric: remaining new-token budget of
+        everything in flight on ``name``, plus the (re-)prefill still owed —
+        ``prompt + generated-at-dispatch`` for any request that has not yet
+        produced a token on THIS replica (a failover resume re-prefills its
+        whole prefix, which is exactly why a freshly burdened survivor must
+        not look light)."""
+        total = 0
+        for r in self._outstanding(name):
+            total += r.remaining_tokens
+            if len(r.generated) == r._resume_from:
+                total += int(r.prompt.size) + r._resume_from
+        return total
+
+    def _drain_events(self, now: float) -> bool:
+        activity = False
+        for name, rep in self.replicas.items():
+            events = rep.drain_events()
+            if rep.state is ReplicaState.DEAD:
+                continue  # drained to drop: a zombie's late results must not
+                # double-complete work a survivor now owns
+            for ev in events:
+                self._last_event[name] = now
+                _watchdog.beat(f"serving_replica:{name}")
+                kind = ev.get("event")
+                if kind == "ready" and rep.state is ReplicaState.STARTING:
+                    rep.state = ReplicaState.HEALTHY
+                    self._emit_replica(rep, now)
+                    activity = True
+                elif kind == "step":
+                    for rid, toks in (ev.get("progress") or {}).items():
+                        req = self._inflight.get(rid)
+                        if req is None or req.replica != name:
+                            continue
+                        if req.first_token_t is None:
+                            req.first_token_t = now
+                        req.generated.extend(int(t) for t in toks)
+                elif kind == "done":
+                    req = self._inflight.get(ev.get("rid"))
+                    if req is None or req.replica != name:
+                        continue  # stale: this request was failed over already
+                    del self._inflight[req.rid]
+                    if ev.get("status") == "finished":
+                        req.generated = [int(t) for t in ev.get("tokens", [])]
+                        req.preemptions = int(ev.get("preemptions", 0))
+                        self.completed += 1
+                        self._per_replica[name]["completed"] += 1
+                        self._finalize(req, RouterRequestStatus.FINISHED, now, count=False)
+                    else:  # the engine itself rejected it (pool/lattice cap):
+                        # no replica can run it — a retry would reject again
+                        self._finalize(
+                            req, RouterRequestStatus.FAILED, now,
+                            error=ev.get("error") or "rejected by engine",
+                        )
+                    activity = True
+                elif kind == "fatal":
+                    self._fail_replica(rep, f"worker died: {ev.get('error')}", now)
+                    activity = True
+                    break  # remaining events are from a dead worker
+        return activity
+
+    def _check_health(self, now: float) -> bool:
+        activity = False
+        for name, rep in self.replicas.items():
+            if rep.state is ReplicaState.DEAD:
+                continue
+            if not rep.alive():
+                self._fail_replica(rep, "replica process/thread died", now)
+                activity = True
+                continue
+            age = now - self._last_event[name]
+            if self._outstanding(name) and age > self.health_timeout_s:
+                self._fail_replica(
+                    rep, f"heartbeat stale for {age:.1f}s with work in flight", now
+                )
+                activity = True
+        return activity
+
+    def _fail_replica(self, rep, reason: str, now: float) -> None:
+        """DEAD transition + failover of everything in flight there."""
+        rep.state = ReplicaState.DEAD
+        rep.reason = reason
+        # a declared-dead replica is diagnosed, not stalling: stop watching
+        # it so the watchdog doesn't re-dump a known death every interval
+        _watchdog.unregister(f"serving_replica:{rep.name}")
+        try:
+            rep.kill()  # reap a wedged child; harmless if already gone
+        except Exception:
+            pass
+        if tel.is_enabled():
+            tel.emit("serving_replica", replica=rep.name, state="dead", reason=reason)
+        self._emit_replica(rep, now)
+        for req in self._outstanding(rep.name):
+            del self._inflight[req.rid]
+            req.replica = None
+            req.retries += 1
+            self.failovers += 1
+            self._per_replica[rep.name]["failovers"] += 1
+            if req.done_decoding:
+                # every token was already streamed back before the death —
+                # the work is done, only the done event was lost
+                self.completed += 1
+                self._finalize(req, RouterRequestStatus.FINISHED, now, count=False)
+            elif req.retries > self.max_retries:
+                self._finalize(
+                    req, RouterRequestStatus.FAILED, now,
+                    error=f"failed: {req.retries} replica deaths (last: {reason})",
+                )
+            else:
+                req.status = RouterRequestStatus.QUEUED
+                self.admission.requeue_front(req)
+
+    def _replica_capacity(self, rep) -> int:
+        if self.max_outstanding_per_replica is not None:
+            return self.max_outstanding_per_replica
+        max_slots = getattr(getattr(rep, "spec", None), "max_slots", 4)
+        return 2 * max_slots  # slots busy + one queued wave behind them
+
+    def _dispatch(self, now: float) -> bool:
+        live = [
+            r for r in self.replicas.values()
+            if r.state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
+        ]
+        if not live:
+            # every replica is DEAD or DRAINING — and DRAINING never returns
+            # to HEALTHY, so queued work can never run. Fail it loudly (the
+            # in-flight work on DRAINING replicas still finishes normally);
+            # the alternative is wedging until run()'s timeout.
+            draining = any(
+                r.state is ReplicaState.DRAINING for r in self.replicas.values()
+            )
+            reason = (
+                "failed: no dispatchable replicas (all draining or dead)"
+                if draining else "failed: no live replicas"
+            )
+            failed_any = False
+            while True:
+                req = self.admission.pop_next()
+                if req is None:
+                    return failed_any
+                self._finalize(req, RouterRequestStatus.FAILED, now, error=reason)
+                failed_any = True
+        activity = False
+        while True:
+            ready = [
+                r for r in live
+                if r.state is ReplicaState.HEALTHY
+                and len(self._outstanding(r.name)) < self._replica_capacity(r)
+            ]
+            if not ready:
+                return activity
+            req = self.admission.pop_next()
+            if req is None:
+                return activity
+            if req.deadline_t is not None and req.deadline_t < now:
+                self._finalize(
+                    req, RouterRequestStatus.EXPIRED, now,
+                    error="expired: deadline passed before dispatch",
+                )
+                activity = True
+                continue
+            target = min(ready, key=lambda r: self.outstanding_tokens(r.name))
+            req.replica = target.name
+            req._resume_from = len(req.generated)
+            req.status = RouterRequestStatus.DISPATCHED
+            self._inflight[req.rid] = req
+            self.dispatched += 1
+            self._per_replica[target.name]["dispatched"] += 1
+            target.submit(
+                {
+                    "rid": req.rid,
+                    "prompt": [int(t) for t in req.prompt],
+                    "max_new": req.max_new_tokens,
+                    "eos": req.eos_token_id,
+                    "rng_seed": req.rng_seed,
+                    "generated": list(req.generated),
+                }
+            )
+            activity = True
+
+    def _finalize(
+        self,
+        req: RouterRequest,
+        status: RouterRequestStatus,
+        now: float,
+        error: Optional[str] = None,
+        count: bool = True,
+    ) -> None:
+        req.status = status
+        req.finish_t = now
+        if error is not None:
+            req.error = error
+        if count:
+            if status is RouterRequestStatus.SHED:
+                self.shed += 1
+                reason = (error or "shed: ?").split("shed: ", 1)[-1]
+                self.shed_by_reason[reason] = self.shed_by_reason.get(reason, 0) + 1
+            elif status is RouterRequestStatus.EXPIRED:
+                self.expired += 1
+            elif status is RouterRequestStatus.FAILED:
+                self.failed += 1
+        terminal = getattr(self, "_terminal_this_poll", None)
+        if terminal is not None and status is not RouterRequestStatus.SHED:
+            terminal.append(req)
+        if tel.is_enabled():
+            tel.emit(
+                "router",
+                phase="request",
+                rid=req.rid,
+                outcome=status.value,
+                priority=req.priority,
+                replica=req.replica,
+                retries=req.retries,
+                prompt_tokens=int(req.prompt.size),
+                new_tokens=len(req.generated),
+                latency_s=round(now - req.arrival_t, 6),
+                ttft_s=round(req.first_token_t - req.arrival_t, 6)
+                if req.first_token_t is not None
+                else None,
+                error=req.error,
+            )
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _emit_replica(self, rep, now: float) -> None:
+        if not tel.is_enabled():
+            return
+        per = self._per_replica[rep.name]
+        tel.emit(
+            "serving_replica",
+            replica=rep.name,
+            state=rep.state.value,
+            transport=getattr(rep, "transport", "?"),
+            outstanding_requests=len(self._outstanding(rep.name)),
+            outstanding_tokens=self.outstanding_tokens(rep.name),
+            heartbeat_age_s=round(now - self._last_event[rep.name], 3),
+            dispatched=per["dispatched"],
+            completed=per["completed"],
+            failovers=per["failovers"],
+        )
+
+    def _emit_poll(self, now: float) -> None:
+        tel.emit(
+            "router",
+            phase="poll",
+            queued=self.admission.depth,
+            queued_by_priority={str(k): v for k, v in self.admission.depth_by_priority().items()},
+            inflight=len(self._inflight),
+            dispatched=self.dispatched,
+            completed=self.completed,
+            shed=self.shed,
+            expired=self.expired,
+            failed=self.failed,
+            failovers=self.failovers,
+            replicas={n: r.state.value for n, r in self.replicas.items()},
+        )
+        for rep in self.replicas.values():
+            self._emit_replica(rep, now)
+
+    def stats(self) -> dict:
+        return {
+            "replicas": {n: r.state.value for n, r in self.replicas.items()},
+            "queued": self.admission.depth,
+            "inflight": len(self._inflight),
+            "dispatched": self.dispatched,
+            "completed": self.completed,
+            "failed": self.failed,
+            "expired": self.expired,
+            "shed": self.shed,
+            "shed_by_reason": dict(self.shed_by_reason),
+            "failovers": self.failovers,
+            "per_replica": {n: dict(v) for n, v in self._per_replica.items()},
+        }

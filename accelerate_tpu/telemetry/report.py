@@ -477,6 +477,76 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
     return section
 
 
+def _router_section(events: "list[dict]") -> Optional[dict]:
+    """Aggregate the serving router's ``router`` records (``phase: "poll"``
+    carries cumulative counters, ``phase: "request"`` one terminal outcome
+    per request) and per-replica ``serving_replica`` records
+    (``serving/router.py``): replica health table, dispatch/failover totals,
+    shed/expired attribution, and finished-request latency percentiles.
+    ``None`` when the streams carry no router records."""
+    polls = [e for e in events if e.get("kind") == "router" and e.get("phase") == "poll"]
+    reqs = [e for e in events if e.get("kind") == "router" and e.get("phase") == "request"]
+    reps = [e for e in events if e.get("kind") == "serving_replica"]
+    if not polls and not reqs and not reps:
+        return None
+    outcomes: dict = {}
+    shed_reasons: dict = {}
+    for r in reqs:
+        outcome = str(r.get("outcome", "?"))
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        if outcome == "shed" and r.get("error"):
+            reason = str(r["error"]).split("shed: ", 1)[-1]
+            shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+    finished = [r for r in reqs if r.get("outcome") == "finished"]
+
+    def _cum(key: str) -> int:
+        # poll records carry cumulative counters; fall back to per-request
+        # outcome counts when only request records made it into the stream
+        if polls:
+            return max(int(p.get(key, 0)) for p in polls)
+        return 0
+
+    # request-record reconstructions for poll-less streams (must stay
+    # consistent with `requests.retried` — a section claiming retries
+    # happened with zero failovers would read as data loss)
+    retries_total = sum(int(r.get("retries", 0)) for r in reqs)
+    ran = [r for r in reqs if r.get("outcome") in ("finished", "failed") and r.get("replica")]
+
+    replicas: dict = {}
+    for r in reps:
+        name = str(r.get("replica", "?"))
+        rec = replicas.setdefault(
+            name, {"state": "?", "dispatched": 0, "completed": 0, "failovers": 0}
+        )
+        rec["state"] = str(r.get("state", rec["state"]))  # records are in order
+        for key in ("dispatched", "completed", "failovers"):
+            if r.get(key) is not None:
+                rec[key] = max(rec[key], int(r[key]))
+    return {
+        "polls": len(polls),
+        "queue_depth": _dist([float(p.get("queued", 0)) for p in polls]),
+        "dispatched": _cum("dispatched") or len(ran) + retries_total,
+        "completed": _cum("completed") or outcomes.get("finished", 0),
+        "failovers": _cum("failovers") or retries_total,
+        "shed": _cum("shed") or outcomes.get("shed", 0),
+        "expired": _cum("expired") or outcomes.get("expired", 0),
+        "failed": _cum("failed") or outcomes.get("failed", 0),
+        "outcomes": dict(sorted(outcomes.items())),
+        "shed_reasons": dict(sorted(shed_reasons.items())),
+        "requests": {
+            "finished": len(finished),
+            "retried": sum(1 for r in finished if int(r.get("retries", 0)) > 0),
+            "latency_s": _dist(
+                [float(r["latency_s"]) for r in finished if r.get("latency_s") is not None]
+            ),
+            "ttft_s": _dist(
+                [float(r["ttft_s"]) for r in finished if r.get("ttft_s") is not None]
+            ),
+        },
+        "replicas": dict(sorted(replicas.items())),
+    }
+
+
 def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
     events = load_events(paths)
     metas = [e for e in events if e.get("kind") == "meta"]
@@ -600,6 +670,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "checkpoints": checkpoints,
         "performance": _performance_section(events, steps),
         "serving": _serving_section(events),
+        "router": _router_section(events),
         "restarts": _restarts_section(events),
     }
     if by_rank:
@@ -762,6 +833,9 @@ def format_report(report: dict) -> str:
     serving = report.get("serving")
     if serving:
         lines.append(format_serving_section(serving))
+    router = report.get("router")
+    if router:
+        lines.append(format_router_section(router))
     m = report["memory"]
     lines.append(
         "memory peaks: device "
@@ -894,6 +968,57 @@ def format_serving_section(serving: dict) -> str:
             f"({reqs.get('preempted', 0)} preempted-and-resumed, "
             f"{reqs.get('rejected', 0)} rejected), "
             f"{reqs['new_tokens']} token(s) generated{lat_s}{ttft_s}"
+        )
+    return "\n".join(lines)
+
+
+def format_router_section(router: dict) -> str:
+    """Human rendering of the serving router's replica-health / failover /
+    shed aggregation (see ``docs/serving.md`` "Running replicated")."""
+    lines = ["router:"]
+    replicas = router.get("replicas") or {}
+    if replicas:
+        by_state: dict = {}
+        for rec in replicas.values():
+            by_state[rec["state"]] = by_state.get(rec["state"], 0) + 1
+        states = ", ".join(f"{n} {s}" for s, n in sorted(by_state.items()))
+        lines.append(f"  replicas: {len(replicas)} ({states})")
+        for name, rec in replicas.items():
+            fo = f", {rec['failovers']} failover(s)" if rec.get("failovers") else ""
+            lines.append(
+                f"    {name}: {rec['state']} — dispatched {rec['dispatched']}, "
+                f"completed {rec['completed']}{fo}"
+            )
+    lines.append(
+        f"  dispatched {router.get('dispatched', 0)}, completed "
+        f"{router.get('completed', 0)}, failover re-dispatches "
+        f"{router.get('failovers', 0)}"
+    )
+    qd = router.get("queue_depth") or {}
+    if qd.get("count"):
+        lines.append(f"  queue depth p50={qd['p50']:.1f} max={qd['max']:.0f}")
+    shed = router.get("shed", 0)
+    expired = router.get("expired", 0)
+    failed = router.get("failed", 0)
+    if shed or expired or failed:
+        reasons = router.get("shed_reasons") or {}
+        reason_s = (
+            " (" + ", ".join(f"{r} {n}" for r, n in reasons.items()) + ")"
+            if reasons else ""
+        )
+        lines.append(f"  shed {shed}{reason_s}, expired {expired}, failed {failed}")
+    reqs = router.get("requests") or {}
+    if reqs.get("finished"):
+        lat = reqs.get("latency_s") or {}
+        ttft = reqs.get("ttft_s") or {}
+        lat_s = (
+            f"  latency p50={lat['p50'] * 1e3:.1f}ms p99={lat['p99'] * 1e3:.1f}ms"
+            if lat.get("count") else ""
+        )
+        ttft_s = f"  ttft p50={ttft['p50'] * 1e3:.1f}ms" if ttft.get("count") else ""
+        lines.append(
+            f"  requests: {reqs['finished']} finished "
+            f"({reqs.get('retried', 0)} resumed across replicas){lat_s}{ttft_s}"
         )
     return "\n".join(lines)
 
@@ -1219,6 +1344,16 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("serving engine", False, f"{type(exc).__name__}: {exc}")
 
+        # 13. replicated serving router (ISSUE 12): two warmed CPU replicas
+        # behind the router, a seeded chaos fault killing one MID-LOAD — the
+        # survivor must absorb the failover with token-exact resume, every
+        # request must complete exactly once bitwise-equal to its
+        # single-stream reference, and the router report section must render
+        try:
+            _doctor_router(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("replicated serving router", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -1327,6 +1462,128 @@ def _doctor_serving(tmp: str, _check) -> None:
         ok,
         f"mismatched={mismatched} max_running={stats['max_running']} "
         f"caches={engine.jit_cache_sizes()} warmed={warmed}",
+    )
+
+
+def _doctor_router(tmp: str, _check) -> None:
+    """Doctor check 13 body: spin two thread-backed CPU replicas behind the
+    ServingRouter, arm a seeded chaos ``crash`` fault at the serving_decode
+    point (the in-process stand-in for SIGKILL — the real-SIGKILL /
+    wedge-forever variants run as the slow-marked subprocess tests in
+    ``tests/test_router.py``), kill one replica mid-load, and require (a)
+    exactly one replica DEAD with ≥1 failover, (b) every request FINISHED
+    exactly once with output bitwise-equal to its single-stream
+    ``greedy_generate`` reference, (c) an overload burst sheds by priority
+    against a bounded queue (batch displaced by interactive, overflow shed
+    with the distinct SHED status, everything admitted still finishing),
+    and (d) the router report section renders with the replica table."""
+    import dataclasses
+
+    import numpy as np
+
+    from ..models import LlamaConfig
+    from ..resilience import chaos
+    from ..resilience.chaos import ChaosSchedule, Fault
+    from ..serving import (
+        PRIORITY_INTERACTIVE,
+        AdmissionController,
+        LocalReplica,
+        ReplicaSpec,
+        ReplicaState,
+        RouterRequestStatus,
+        ServingRouter,
+    )
+    from . import events as tel_events
+
+    config = LlamaConfig.tiny()
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config), num_blocks=33, block_size=8,
+        max_slots=2, slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(16,),
+    )
+    router_dir = os.path.join(tmp, "router")
+    tel_events.enable(out_dir=router_dir, run_id="doctor-router")
+    router = None
+    try:
+        # the fault is once-matched under a lock, so EXACTLY one replica
+        # thread dies when it reaches engine step 4 mid-decode
+        chaos.arm(ChaosSchedule(
+            faults=[Fault(kind="crash", point="serving_decode", step=4)]
+        ))
+        replicas = [LocalReplica(f"r{i}", spec) for i in range(2)]
+        router = ServingRouter(
+            replicas,
+            admission=AdmissionController(max_queue=8),
+            health_timeout_s=10.0,
+        )
+        router.wait_ready(timeout_s=300)
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(6):
+            prompt = rng.integers(0, config.vocab_size, (int(rng.integers(4, 12)),))
+            reqs.append((prompt.astype(np.int32), 8,
+                         router.submit(prompt.astype(np.int32), 8, rng_seed=i)))
+        router.run(timeout_s=300)
+
+        # overload burst against the 8-deep bound, submitted without polling
+        # so nothing dispatches: batch fills the queue, interactive displaces
+        # the newest batch entry, batch overflow sheds outright
+        small = np.arange(4, dtype=np.int32) + 1
+        burst = [router.submit(small, 4, rng_seed=50 + i) for i in range(8)]
+        displacer = router.submit(small, 4, priority=PRIORITY_INTERACTIVE, rng_seed=60)
+        overflow = router.submit(small, 4, rng_seed=61)
+        depth_bounded = router.admission.depth <= 8
+        router.run(timeout_s=300)
+    finally:
+        chaos.arm(None)
+        if router is not None:
+            router.close()
+        tel_events.disable()
+
+    from ..generation import greedy_generate
+
+    params = spec.build_params()
+    mismatched = []
+    not_finished = []
+    for i, (prompt, max_new, req) in enumerate(reqs):
+        if req.status is not RouterRequestStatus.FINISHED:
+            not_finished.append((i, req.status.value, req.error))
+            continue
+        ref = greedy_generate(params, prompt[None], config, max_new_tokens=max_new)
+        if not np.array_equal(np.asarray(ref[0]), req.output_ids()):
+            mismatched.append(i)
+    dead = [n for n, r in router.replicas.items() if r.state is ReplicaState.DEAD]
+    report = build_report([router_dir])
+    text = format_report(report)
+    section = report.get("router") or {}
+    admitted_burst = [r for r in burst if r.status is not RouterRequestStatus.SHED]
+    shed_ok = (
+        depth_bounded
+        # interactive displaced exactly one batch request, overflow was shed
+        and displacer.status is RouterRequestStatus.FINISHED
+        and overflow.status is RouterRequestStatus.SHED
+        and "queue-full" in (overflow.error or "")
+        and sum(1 for r in burst if r.status is RouterRequestStatus.SHED) == 1
+        and "displaced" in (burst[-1].error or "")
+        and all(r.status is RouterRequestStatus.FINISHED for r in admitted_burst)
+    )
+    ok = (
+        not not_finished
+        and not mismatched
+        and len(dead) == 1
+        and router.failovers >= 1
+        and shed_ok
+        and section.get("completed") == len(reqs) + len(admitted_burst) + 1
+        and (section.get("shed_reasons") or {}).get("queue-full") == 1
+        and "router:" in text
+        and "failover re-dispatches" in text
+        and any(f"{dead[0]}: dead" in line for line in text.splitlines())
+    )
+    _check(
+        "replicated serving router",
+        ok,
+        f"not_finished={not_finished} mismatched={mismatched} dead={dead} "
+        f"failovers={router.failovers} shed_ok={shed_ok} "
+        f"section_completed={section.get('completed')}",
     )
 
 

@@ -827,6 +827,7 @@ def run_bench_serving(on_tpu: bool) -> dict:
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     out = mod.run_bench_serving(on_tpu)
+    replicated = mod.run_bench_replicated(on_tpu)
     return {
         "metric": "serving throughput ratio (continuous/static batching)",
         "value": out["value"],
@@ -836,6 +837,12 @@ def run_bench_serving(on_tpu: bool) -> dict:
         "p99_latency_ms": out["p99_latency_ms"],
         "requests": out["requests"],
         "max_slots": out["max_slots"],
+        # ISSUE 12 router leg: tok/s scaling over data-parallel replicas and
+        # the no-lost-requests + output-parity invariants under a replica kill
+        "replicated_scaling": replicated["value"],
+        "replicated": replicated["replicated"],
+        "replica_kill": replicated["replica_kill"],
+        "kill_outputs_match_unkilled": replicated["kill_outputs_match_unkilled"],
     }
 
 

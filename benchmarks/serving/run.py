@@ -17,6 +17,13 @@ aggregate generated tok/s (wall), mean batch occupancy, and p50/p99
 per-request latency + TTFT (arrival -> finish, wall). Emits one JSON line
 per the bench.py conventions; ``make bench-serve`` runs it, and bench.py's
 ``serving`` config carries it in the round payload.
+
+The **replicated leg** (ISSUE 12) drains the same seeded workload through
+the ``ServingRouter`` over 1 and N thread-backed replicas (aggregate tok/s
+scaling), then once more with one replica killed mid-load: zero requests
+may be lost, the kill run's outputs must be bitwise-identical to the
+unkilled run (token-exact failover resume), and the p99 shows the failover
+latency tax.
 """
 
 import argparse
@@ -94,6 +101,132 @@ def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
     }
 
 
+def _drain_through_router(spec, workload, *, n_replicas, kill_after=None,
+                          health_timeout_s=10.0):
+    """Drain the whole workload as a backlog through a router over
+    ``n_replicas`` thread-backed replicas; optionally SIGKILL-equivalent one
+    replica after ``kill_after`` completions (abrupt: in-flight work is
+    failed over with token-exact resume). Returns the leg metrics plus every
+    request's output tokens so the kill leg can be parity-checked against
+    the unkilled one."""
+    import time as _time
+
+    from accelerate_tpu.serving import (
+        AdmissionController,
+        LocalReplica,
+        RouterRequestStatus,
+        ServingRouter,
+    )
+
+    replicas = [LocalReplica(f"r{i}", spec) for i in range(n_replicas)]
+    router = ServingRouter(
+        replicas,
+        # the whole workload is submitted as one backlog: size the queue so
+        # the throughput legs never shed (shedding is admission.py's job and
+        # has its own tests; here it would just shrink the measured work)
+        admission=AdmissionController(max_queue=len(workload) + 1),
+        health_timeout_s=health_timeout_s,
+    )
+    try:
+        router.wait_ready()
+        t0 = _time.monotonic()
+        reqs = [
+            router.submit(prompt, max_new, rng_seed=i)
+            for i, (_, prompt, max_new) in enumerate(workload)
+        ]
+        killed = False
+        # every-request-terminal, not a poll-return count: SHED finalizes at
+        # submit time and never appears in poll()'s terminal list
+        while not all(r.status.terminal for r in reqs):
+            router.poll()
+            finished = sum(
+                1 for r in reqs if r.status is RouterRequestStatus.FINISHED
+            )
+            if kill_after is not None and not killed and finished >= kill_after:
+                router.replicas["r0"].kill()
+                killed = True
+            _time.sleep(0.001)
+            if _time.monotonic() - t0 > 600:
+                raise RuntimeError("replicated leg wedged (>600s)")
+        wall = _time.monotonic() - t0
+        completed = [r for r in reqs if r.status is RouterRequestStatus.FINISHED]
+        tokens = sum(len(r.generated) for r in completed)
+        latencies = [r.finish_t - r.arrival_t for r in completed]
+        return {
+            "replicas": n_replicas,
+            "completed": len(completed),
+            "lost": len(reqs) - len(completed),
+            "tokens": tokens,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+            "failovers": router.failovers,
+            "p50_latency_ms": round(_percentile(latencies, 50) * 1e3, 2),
+            "p99_latency_ms": round(_percentile(latencies, 99) * 1e3, 2),
+            "outputs": [[int(t) for t in r.generated] for r in reqs],
+        }
+    finally:
+        router.close()
+
+
+def run_bench_replicated(
+    on_tpu: bool,
+    requests: int = 16,
+    seed: int = 0,
+    n_replicas: int = 2,
+    max_slots: int = 4,
+    num_blocks: int = 49,
+    block_size: int = 8,
+) -> dict:
+    """The router leg (ISSUE 12): the SAME seeded workload drained through 1
+    replica, through ``n_replicas``, and through ``n_replicas`` with one
+    replica killed mid-load. Reports aggregate tok/s scaling, the kill leg's
+    p99 + failover count, and whether the kill leg's outputs are bitwise
+    identical to the unkilled run (greedy decode is deterministic, so any
+    difference means failover resume corrupted a stream)."""
+    import dataclasses
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.serving import ReplicaSpec
+
+    config = LlamaConfig.tiny()
+    prompt_lens, new_tokens = (4, 24), (2, 40)
+    max_len = prompt_lens[1] + new_tokens[1]
+    # one coarse bucket per axis: replicated legs pay one decode + one
+    # prefill compile per replica engine instead of the full lattice
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config),
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_slots=max_slots,
+        slot_buckets=(max_slots,),
+        block_buckets=(-(-max_len // block_size) + 1,),
+        prefill_buckets=(prompt_lens[1] + new_tokens[1],),
+    )
+    workload = build_workload(
+        requests, seed, prompt_lens, new_tokens, 2.0, config.vocab_size
+    )
+    one = _drain_through_router(spec, workload, n_replicas=1)
+    many = _drain_through_router(spec, workload, n_replicas=n_replicas)
+    kill = _drain_through_router(
+        spec, workload, n_replicas=n_replicas, kill_after=max(1, requests // 4)
+    )
+    parity = kill["outputs"] == many["outputs"]
+    for leg in (one, many, kill):
+        leg.pop("outputs")
+    return {
+        "bench": "serving_replicated",
+        "unit": f"tokens_per_s_scaling({n_replicas}r/1r)",
+        "value": round(many["tokens_per_s"] / max(one["tokens_per_s"], 1e-9), 3),
+        "one_replica": one,
+        "replicated": many,
+        "replica_kill": kill,
+        "kill_outputs_match_unkilled": parity,
+        "requests": requests,
+        "n_replicas": n_replicas,
+        "on_tpu": on_tpu,
+    }
+
+
 def run_bench_serving(
     on_tpu: bool,
     requests: int = 32,
@@ -163,15 +296,28 @@ if __name__ == "__main__":
     ap.add_argument("--max-slots", type=int, default=4)
     ap.add_argument("--num-blocks", type=int, default=49)
     ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--replicated-requests", type=int, default=16,
+                    help="workload size for the router leg (0 skips it)")
+    ap.add_argument("--n-replicas", type=int, default=2)
     args = ap.parse_args()
-    emit(
-        run_bench_serving(
-            on_tpu=detect_backend(),
-            requests=args.requests,
-            rate=args.rate,
+    on_tpu = detect_backend()
+    out = run_bench_serving(
+        on_tpu=on_tpu,
+        requests=args.requests,
+        rate=args.rate,
+        seed=args.seed,
+        max_slots=args.max_slots,
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+    )
+    if args.replicated_requests > 0:
+        out["replicated"] = run_bench_replicated(
+            on_tpu=on_tpu,
+            requests=args.replicated_requests,
             seed=args.seed,
+            n_replicas=args.n_replicas,
             max_slots=args.max_slots,
             num_blocks=args.num_blocks,
             block_size=args.block_size,
         )
-    )
+    emit(out)

@@ -125,6 +125,7 @@ def test_serving_benchmark_smoke():
     out = run_script(
         "benchmarks/serving/run.py",
         "--requests", "12", "--rate", "2.0", "--max-slots", "4",
+        "--replicated-requests", "8",
         timeout=420,
     )
     assert out["bench"] == "serving"
@@ -139,6 +140,18 @@ def test_serving_benchmark_smoke():
     assert out["continuous"]["tokens"] == out["static"]["tokens"]
     assert out["continuous"]["mean_occupancy"] > out["static"]["mean_occupancy"]
     assert out["p99_latency_ms"] == out["continuous"]["p99_latency_ms"]
+    # replicated router leg (ISSUE 12): no scaling-margin bar at reduced
+    # scale, but the robustness invariants are absolute — nothing lost, the
+    # kill run's outputs bitwise-equal to the unkilled run, failover fired
+    rep = out["replicated"]
+    assert rep["bench"] == "serving_replicated" and rep["value"] > 0
+    for leg in ("one_replica", "replicated", "replica_kill"):
+        assert rep[leg]["completed"] == 8
+        assert rep[leg]["lost"] == 0
+        assert rep[leg]["tokens_per_s"] > 0
+    assert rep["replica_kill"]["failovers"] >= 1
+    assert rep["kill_outputs_match_unkilled"] is True
+    assert rep["replica_kill"]["p99_latency_ms"] >= rep["replica_kill"]["p50_latency_ms"]
 
 
 def test_benchmark_dirs_are_documented():

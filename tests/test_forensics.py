@@ -388,6 +388,10 @@ def test_report_cli_json_includes_ranks(tmp_path, capsys):
     assert "ranks" not in json.loads(capsys.readouterr().out)
 
 
+@pytest.mark.slow  # the full doctor is minutes of subprocess e2e on a small
+# box (fused-zero1 8-device compile child, elastic supervisor children, two
+# serving engines + two router replicas, all warmed); `make doctor` runs the
+# same thing as its own CI lane, so the timed tier-1 window doesn't pay twice
 def test_doctor_self_checks(capsys):
     from accelerate_tpu.telemetry.report import run_doctor
 
@@ -398,10 +402,12 @@ def test_doctor_self_checks(capsys):
     # + fused zero1 lint/compiled-collectives (ISSUE 9)
     # + elastic auto-resume (ISSUE 10)
     # + serving engine (ISSUE 11)
-    assert out.count("PASS") == 12 and "FAIL" not in out
+    # + replicated serving router (ISSUE 12)
+    assert out.count("PASS") == 13 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out
+    assert "replicated serving router" in out
     assert "fused zero1 compiled collectives" in out
     assert "performance report section" in out
     assert "elastic auto-resume" in out

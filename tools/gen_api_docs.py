@@ -99,16 +99,25 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         "counterpart): step-granular admission into running decode batches, "
         "fixed-size KV blocks in one preallocated pool with a host-side "
         "allocator, watermark/LIFO preemption with persisted resume, and a "
-        "static bucket lattice so admission churn never recompiles. See "
+        "static bucket lattice so admission churn never recompiles — "
+        "replicated behind a health-checked router with token-exact "
+        "failover, deadlines, and graceful overload shedding. See "
         "`docs/serving.md` for the guide and `benchmarks/serving/` "
-        "(`make bench-serve`) for the continuous-vs-static benchmark.",
+        "(`make bench-serve`) for the continuous-vs-static and replicated "
+        "benchmarks.",
         [("accelerate_tpu.serving.engine", ["ServingEngine", "paged_forward"]),
          ("accelerate_tpu.serving.kv_pager",
           ["BlockAllocator", "BlockAllocatorError", "BlockPoolExhausted",
            "init_block_pool", "paged_attention"]),
          ("accelerate_tpu.serving.scheduler",
           ["Request", "RequestStatus", "Scheduler", "SchedulingError"]),
-         ("accelerate_tpu.serving.buckets", ["BucketLattice"])],
+         ("accelerate_tpu.serving.buckets", ["BucketLattice"]),
+         ("accelerate_tpu.serving.router",
+          ["ServingRouter", "RouterRequest", "RouterRequestStatus"]),
+         ("accelerate_tpu.serving.replica",
+          ["ReplicaSpec", "ReplicaState", "LocalReplica", "ProcessReplica"]),
+         ("accelerate_tpu.serving.admission",
+          ["AdmissionController", "AdmissionVerdict", "TokenBucket"])],
     ),
     "analysis": (
         "Static analysis (jaxlint)",
@@ -210,8 +219,8 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
            "beat", "register", "unregister", "env_timeout"]),
          ("accelerate_tpu.telemetry.report",
           ["build_report", "format_report", "format_rank_section",
-           "format_serving_section", "load_events", "percentile", "run_doctor",
-           "main"]),
+           "format_serving_section", "format_router_section", "load_events",
+           "percentile", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "resilience": (
