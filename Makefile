@@ -18,7 +18,8 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt bench-zero1 bench-serve doctor lint profile chaos
+        bench-input bench-ckpt bench-zero1 bench-serve bench-compile doctor \
+        lint profile chaos
 
 PYTEST := python -m pytest -q
 
@@ -103,12 +104,21 @@ bench-zero1:
 bench-serve:
 	python benchmarks/serving/run.py
 
+# zero-cold-start recovery (benchmarks/compile_time, compile_cache/):
+# restart-to-first-step and replica-boot-to-first-token, cold vs warm
+# through the persistent AOT executable cache, with hit/miss counts from
+# the compile_cache telemetry records in the payload
+bench-compile:
+	python benchmarks/compile_time/run.py
+
 # self-check: flight-recorder dump, watchdog stall detection, straggler
 # report, collective-divergence detection, the jaxlint engine, perf cost
 # capture, xplane trace parsing, the performance report section, fused
-# ZeRO-1, elastic auto-resume, the serving engine, and the replicated
+# ZeRO-1, elastic auto-resume, the serving engine, the replicated
 # serving router (2 replicas, one chaos-killed mid-load, exactly-once +
-# bitwise parity) against synthetic inputs (telemetry/report.py run_doctor)
+# bitwise parity), and the persistent compile cache (subprocess restart
+# hits with zero recompiles; poisoned entry quarantined + clean fallback)
+# against synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor
 

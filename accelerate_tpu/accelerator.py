@@ -1089,6 +1089,8 @@ class Accelerator:
         from .telemetry import perf as _perf
         from .telemetry import watchdog as _watchdog
 
+        from . import compile_cache as _ccache
+
         step_telemetry = self._step_telemetry
         flight = _flight.get_recorder()
         trace_windows = self._trace_windows
@@ -1097,6 +1099,13 @@ class Accelerator:
         # re-attached before every step so records from interleaved step fns
         # (train + a second loop) never carry each other's roofline numbers
         perf_cost: list = [None, False]  # [cost, capture_attempted]
+        # Warm-restart probe state: on restart generations >= 1 (the elastic
+        # supervisor respawned us) the persistent compile cache is probed once
+        # before the first call — a hit runs the DESERIALIZED executable and
+        # the restart never pays this function's XLA compile
+        # [loaded executable | None, probe_attempted, cache key | None]
+        cached_exec: list = [None, False, None]
+        restart_generation = self.restart_generation
 
         def step_and_track(params, opt_state, batch):
             # forensics: the flight ring always knows the current step, and an
@@ -1108,18 +1117,46 @@ class Accelerator:
             _chaos.maybe_inject("train_step", step=step_index)
             if trace_windows is not None:
                 trace_windows.on_step_start(step_index)
+            if not cached_exec[1]:
+                cached_exec[1] = True
+                if restart_generation >= 1 and _ccache.cache_enabled():
+                    cached_exec[0], cached_exec[2] = _ccache.maybe_load_executable(
+                        kind, step_fn, (params, opt_state, batch), mesh=self.mesh
+                    )
+
+            def run_step(p, o, b):
+                if cached_exec[0] is None:
+                    return step_fn(p, o, b)
+                # AOT input checking rejects BEFORE execution, so a stale
+                # cached executable falls back to the jit path (which then
+                # compiles as a cold start would) without consuming donations
+                out, usable = _ccache.call_with_fallback(
+                    kind, cached_exec[0], step_fn, (p, o, b), key=cached_exec[2]
+                )
+                if not usable:
+                    cached_exec[0] = None
+                return out
+
             try:
                 if _tel.is_enabled():
                     if not perf_cost[1] and _perf.capture_enabled():
                         perf_cost[1] = True
-                        perf_cost[0] = _perf.capture_compiled(
-                            kind, step_fn, (params, opt_state, batch)
-                        )
+                        if cached_exec[0] is not None:
+                            # warm restart: the cost analysis rides the loaded
+                            # executable — no capture AOT compile either
+                            perf_cost[0] = _perf.capture_from_executable(
+                                kind, cached_exec[0]
+                            )
+                        else:
+                            perf_cost[0] = _perf.capture_compiled(
+                                kind, step_fn, (params, opt_state, batch),
+                                mesh=self.mesh,
+                            )
                     step_telemetry.set_step_cost(perf_cost[0])
                     with step_telemetry.step():
-                        new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+                        new_params, new_opt_state, metrics = run_step(params, opt_state, batch)
                 else:
-                    new_params, new_opt_state, metrics = step_fn(params, opt_state, batch)
+                    new_params, new_opt_state, metrics = run_step(params, opt_state, batch)
                     step_telemetry.step_index += 1
             finally:
                 if trace_windows is not None:

@@ -1,0 +1,67 @@
+"""Zero-cold-start recovery: a crash-safe persistent cache of serialized AOT
+executables (ROADMAP item 5).
+
+:mod:`~accelerate_tpu.compile_cache.cache` is the content-addressed on-disk
+store (keys, the staged-fsync-CRC-manifest-rename commit protocol,
+quarantine-on-corruption reads, size-capped eviction);
+:mod:`~accelerate_tpu.compile_cache.runtime` is the consumer surface (env
+knobs, telemetry, the load-or-compile helpers the Accelerator, the serving
+engine warmup and the elastic supervisor call).
+
+See ``docs/compile_cache.md`` for layout, crash/corruption semantics,
+cross-host sharing and the knobs; ``benchmarks/compile_time/`` measures the
+restart-to-first-step and replica-boot-to-first-token wins (``make
+bench-compile``).
+"""
+
+from .cache import (
+    MANIFEST_NAME,
+    PAYLOAD_NAME,
+    QUARANTINE_DIRNAME,
+    SCHEMA_VERSION,
+    CacheKey,
+    CompileCache,
+    LoadResult,
+    StoreResult,
+    compile_flags,
+    environment_fingerprint,
+    key_from_lowered,
+)
+from .runtime import (
+    CACHE_DIR_ENV_VAR,
+    CACHE_ENV_VAR,
+    CACHE_MAX_MB_ENV_VAR,
+    aot_compile,
+    cache_enabled,
+    call_with_fallback,
+    configured_cache_dir,
+    get_cache,
+    maybe_export,
+    maybe_load_executable,
+    pretouch,
+)
+
+__all__ = [
+    "CACHE_ENV_VAR",
+    "CACHE_DIR_ENV_VAR",
+    "CACHE_MAX_MB_ENV_VAR",
+    "MANIFEST_NAME",
+    "PAYLOAD_NAME",
+    "QUARANTINE_DIRNAME",
+    "SCHEMA_VERSION",
+    "CacheKey",
+    "CompileCache",
+    "LoadResult",
+    "StoreResult",
+    "aot_compile",
+    "cache_enabled",
+    "call_with_fallback",
+    "compile_flags",
+    "configured_cache_dir",
+    "environment_fingerprint",
+    "get_cache",
+    "key_from_lowered",
+    "maybe_export",
+    "maybe_load_executable",
+    "pretouch",
+]

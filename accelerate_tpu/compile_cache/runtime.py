@@ -1,0 +1,283 @@
+"""Runtime surface of the executable cache: env knobs, telemetry, and the
+load-or-compile helpers the consumers call.
+
+Three consumers define recovery time, and each gets a one-call integration:
+
+- the :class:`~accelerate_tpu.accelerator.Accelerator` probes the cache
+  before its first step on restart generations >= 1
+  (:func:`maybe_load_executable` — load-only, never compiles: a miss just
+  means the jit path pays the compile as today, and
+  ``telemetry/perf.py``'s cost capture then *exports* the executable so the
+  NEXT generation hits);
+- the serving engine's warmup AOT-compiles every lattice point through
+  :func:`aot_compile` (hit → load in milliseconds, miss → compile once and
+  export), so a replacement replica boots warm;
+- the elastic supervisor calls :func:`pretouch` before every (re)spawn so a
+  missing or read-only cache directory degrades to a VISIBLE cold start
+  instead of a silent one.
+
+Every outcome is one ``compile_cache`` telemetry record
+(hit/miss/corrupt/fallback/store/... + bytes + load seconds — schema in
+``docs/telemetry.md``); the report CLI aggregates them into a "compile
+cache" section.
+
+Knobs: ``ACCELERATE_COMPILE_CACHE=0`` kills the whole feature (byte-identical
+behavior to an uncached build); ``ACCELERATE_COMPILE_CACHE_DIR`` names the
+(shareable) directory — **unset means disabled** (the cache never writes
+anywhere the operator didn't point it); ``ACCELERATE_COMPILE_CACHE_MAX_MB``
+caps the directory size.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional
+
+from ..logging import get_logger
+from ..telemetry import events as tel
+from .cache import CacheKey, CompileCache, LoadResult, key_from_lowered
+
+logger = get_logger(__name__)
+
+CACHE_ENV_VAR = "ACCELERATE_COMPILE_CACHE"
+CACHE_DIR_ENV_VAR = "ACCELERATE_COMPILE_CACHE_DIR"
+CACHE_MAX_MB_ENV_VAR = "ACCELERATE_COMPILE_CACHE_MAX_MB"
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def cache_enabled() -> bool:
+    """The kill switch: ``ACCELERATE_COMPILE_CACHE=0`` disables everything —
+    no directory access, no telemetry, no behavior change anywhere."""
+    return os.environ.get(CACHE_ENV_VAR, "").strip().lower() not in _FALSY
+
+
+def configured_cache_dir(env: Optional[dict] = None) -> Optional[str]:
+    """The cache directory from the environment, or ``None`` (= disabled:
+    the cache never invents a location the operator didn't configure)."""
+    source = os.environ if env is None else env
+    path = source.get(CACHE_DIR_ENV_VAR, "").strip()
+    return path or None
+
+
+def get_cache(directory: Optional[str] = None) -> Optional[CompileCache]:
+    """The :class:`CompileCache` for ``directory`` (default: the env dir), or
+    ``None`` when the feature is off, unconfigured, or the directory cannot
+    be created (logged — an unusable cache degrades to cold compiles, it
+    never breaks a restart)."""
+    if not cache_enabled():
+        return None
+    directory = directory or configured_cache_dir()
+    if not directory:
+        return None
+    try:
+        return CompileCache(directory)
+    except OSError as exc:
+        logger.warning(f"compile cache dir {directory} unusable ({exc}); cold-starting")
+        return None
+
+
+def _emit(event: str, fn: str, key: Optional[CacheKey] = None, **fields: Any) -> None:
+    if not tel.is_enabled():
+        return
+    tel.emit(
+        "compile_cache",
+        event=event,
+        fn=fn,
+        key=key.entry_id if key is not None else None,
+        **fields,
+    )
+
+
+def _emit_load(fn: str, key: CacheKey, res: LoadResult) -> None:
+    if res.outcome == "hit":
+        _emit("hit", fn, key, bytes=res.nbytes, load_s=res.seconds)
+    elif res.outcome == "corrupt":
+        _emit(
+            "corrupt", fn, key, reason=res.reason,
+            quarantined_to=res.quarantined_to,
+        )
+        _emit("fallback", fn, key, reason="corrupt entry — compiling fresh")
+    else:
+        _emit("miss", fn, key, reason=res.reason)
+
+
+# -------------------------------------------------------------- consumers ----
+def maybe_load_executable(
+    name: str,
+    fn: Any,
+    args: tuple,
+    kwargs: Optional[dict] = None,
+    *,
+    mesh: Optional[Any] = None,
+    directory: Optional[str] = None,
+) -> "tuple[Optional[Any], Optional[CacheKey]]":
+    """Load-only probe for a jitted ``fn`` at ``args``: trace (no XLA
+    compile), key, and return the cached executable on a hit — or ``None``
+    on miss/corrupt/disabled, in which case the caller's normal jit path
+    compiles exactly as today. Never raises."""
+    cache = get_cache(directory)
+    if cache is None or not hasattr(fn, "lower"):
+        return None, None
+    try:
+        lowered = fn.lower(*args, **(kwargs or {}))
+        key = key_from_lowered(name, lowered, mesh=mesh)
+    except Exception as exc:
+        logger.warning(f"compile cache probe for {name} failed to trace: {exc}")
+        return None, None
+    res = cache.load(key)
+    _emit_load(name, key, res)
+    return res.executable, key
+
+
+def aot_compile(
+    name: str,
+    fn: Any,
+    args: tuple,
+    kwargs: Optional[dict] = None,
+    *,
+    mesh: Optional[Any] = None,
+    directory: Optional[str] = None,
+    cache: Optional[CompileCache] = None,
+) -> "tuple[Optional[Any], str]":
+    """Load-or-compile one program point: returns ``(executable, outcome)``
+    where outcome is ``hit`` / ``miss`` (freshly compiled + exported) /
+    ``corrupt`` (quarantined, freshly compiled) / ``uncached`` (cache off —
+    freshly compiled, not exported) / ``error`` (could not even compile:
+    executable is ``None``; the caller falls back to its plain jit path)."""
+    if not hasattr(fn, "lower"):
+        return None, "error"
+    if cache is None:
+        cache = get_cache(directory)
+    try:
+        lowered = fn.lower(*args, **(kwargs or {}))
+    except Exception as exc:
+        logger.warning(f"AOT lowering of {name} failed: {exc}")
+        return None, "error"
+    key = None
+    if cache is not None:
+        try:
+            key = key_from_lowered(name, lowered, mesh=mesh)
+        except Exception:
+            key = None
+        if key is not None:
+            res = cache.load(key)
+            _emit_load(name, key, res)
+            if res.outcome == "hit":
+                return res.executable, "hit"
+            outcome = res.outcome  # miss or corrupt(→fallback compile)
+        else:
+            outcome = "miss"
+    else:
+        outcome = "uncached"
+    try:
+        compiled = lowered.compile()
+    except Exception as exc:
+        logger.warning(f"AOT compile of {name} failed: {exc}")
+        return None, "error"
+    if cache is not None and key is not None:
+        store = cache.store(key, compiled)
+        _emit(
+            f"store_{store.outcome}" if store.outcome != "stored" else "store",
+            name, key, bytes=store.nbytes, store_s=store.seconds,
+            reason=store.reason, evicted=len(store.evicted) or None,
+        )
+    return compiled, outcome
+
+
+def maybe_export(
+    name: str,
+    lowered: Any,
+    compiled: Any,
+    *,
+    mesh: Optional[Any] = None,
+    directory: Optional[str] = None,
+) -> Optional[str]:
+    """Export an already-compiled executable (the perf cost capture's AOT
+    compile — free to serialize since the compile is already paid). Returns
+    the store outcome or ``None`` when the cache is off. Never raises."""
+    cache = get_cache(directory)
+    if cache is None:
+        return None
+    try:
+        key = key_from_lowered(name, lowered, mesh=mesh)
+    except Exception as exc:
+        logger.warning(f"compile cache export of {name} failed to key: {exc}")
+        return None
+    res = cache.store(key, compiled)
+    _emit(
+        f"store_{res.outcome}" if res.outcome != "stored" else "store",
+        name, key, bytes=res.nbytes, store_s=res.seconds,
+        reason=res.reason, evicted=len(res.evicted) or None,
+    )
+    return res.outcome
+
+
+def pretouch(
+    directory: Optional[str] = None, env: Optional[dict] = None
+) -> "dict[str, Any]":
+    """Supervisor pre-spawn probe: is the cache there and writable for the
+    next generation? Returns ``{"status": "ok" | "disabled" | "unconfigured"
+    | "readonly" | "missing", "dir": ...}``; anything not ``ok``/
+    ``disabled``/``unconfigured`` means the respawn will cold-start — the
+    caller logs and emits so that shows up in the restart record instead of
+    silently doubling MTTR."""
+    if env is not None:
+        enabled = str(env.get(CACHE_ENV_VAR, "")).strip().lower() not in _FALSY
+    else:
+        enabled = cache_enabled()
+    if not enabled:
+        return {"status": "disabled", "dir": None}
+    directory = directory or configured_cache_dir(env)
+    if not directory:
+        return {"status": "unconfigured", "dir": None}
+    info: "dict[str, Any]" = {"dir": directory}
+    if not os.path.isdir(directory):
+        try:
+            os.makedirs(directory, exist_ok=True)
+        except OSError as exc:
+            info.update(status="missing", error=str(exc))
+            return info
+    probe = os.path.join(directory, f".pretouch-{os.getpid()}-{os.urandom(3).hex()}")
+    try:
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.unlink(probe)
+    except OSError as exc:
+        info.update(status="readonly", error=str(exc))
+        return info
+    try:
+        cache = CompileCache(directory)
+        info.update(status="ok", **{k: v for k, v in cache.stats().items() if k != "dir"})
+    except OSError as exc:
+        info.update(status="missing", error=str(exc))
+    return info
+
+
+def call_with_fallback(
+    name: str,
+    executable: Any,
+    fallback_fn: Any,
+    args: tuple,
+    key: Optional[CacheKey] = None,
+) -> "tuple[Any, bool]":
+    """Call a cache-loaded executable, falling back to the live jit path if
+    the call itself rejects (avals/shardings drifted since export — possible
+    when a restart changes an input dtype the key's HLO didn't see).
+
+    Returns ``(result, executable_still_usable)``. Only the PRE-execution
+    rejections AOT input checking raises (``TypeError``/``ValueError``) are
+    caught — at that point no donated buffer has been consumed, so re-running
+    the fallback on the same arrays is safe. A failure from inside execution
+    (backend runtime error, OOM) propagates: the inputs may already be
+    donated away, and silently re-running would mask the real failure."""
+    try:
+        return executable(*args), True
+    except (TypeError, ValueError) as exc:
+        logger.warning(
+            f"cached executable for {name} rejected its inputs "
+            f"({type(exc).__name__}: {exc}); falling back to fresh compile"
+        )
+        _emit("fallback", name, key, reason=f"call rejected: {type(exc).__name__}")
+        return fallback_fn(*args), False

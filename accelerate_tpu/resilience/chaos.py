@@ -60,8 +60,14 @@ CHAOS_ENV_VAR = "ACCELERATE_CHAOS_SCHEDULE"
 FAULT_KINDS = ("sigkill", "sigterm", "hang", "slow", "crash")
 # "serving_decode" fires inside ServingEngine.step (serving/engine.py): a
 # seeded replica kill/hang/slow lands mid-decode, which is what the router's
-# failover chaos tests and `make doctor` check 13 exercise
-POINTS = ("train_step", "collective", "prefetch", "serving_decode", "any")
+# failover chaos tests and `make doctor` check 13 exercise.
+# "compile_cache_store" fires inside CompileCache.store (compile_cache/),
+# BETWEEN the payload write and the manifest commit — a sigkill there is the
+# kill-9-mid-cache-write case the cache's crash protocol must survive.
+POINTS = (
+    "train_step", "collective", "prefetch", "serving_decode",
+    "compile_cache_store", "any",
+)
 
 
 class ChaosFaultError(RuntimeError):
@@ -397,11 +403,140 @@ def main(argv=None) -> int:
                 np.array_equal(ref_params[k], chaos_params[k]) for k in ref_params
             )
         verdict["final_params_bitwise_match"] = match
-        print(json.dumps(verdict))
         ok = rc == 0 and sup.restarts_used >= 1 and match
+
+        def _params_match(run_dir: str) -> bool:
+            ref_params = dict(np.load(os.path.join(ref_dir, "final_params.npz")))
+            got = dict(np.load(os.path.join(run_dir, "final_params.npz")))
+            return set(ref_params) == set(got) and all(
+                np.array_equal(ref_params[k], got[k]) for k in ref_params
+            )
+
+        def _cache_records(tel_root: str) -> "dict[str, int]":
+            counts: "dict[str, int]" = {}
+            try:
+                names = os.listdir(tel_root)
+            except OSError:
+                return counts
+            for n in names:
+                if not (n.startswith("events-rank") and n.endswith(".jsonl")):
+                    continue
+                with open(os.path.join(tel_root, n)) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if rec.get("kind") == "compile_cache":
+                            ev = rec.get("event")
+                            counts[ev] = counts.get(ev, 0) + 1
+            return counts
+
+        # 3. compile-cache leg A: kill -9 MID-CACHE-WRITE (a seeded SIGKILL at
+        # the compile_cache_store point — payload written, manifest not) →
+        # the restart must see only committed entries, resume, and finish
+        # with bitwise-identical params
+        from .. import compile_cache as _cc
+
+        midwrite_dir = os.path.join(root, "cache-midwrite")
+        midwrite_cache = os.path.join(midwrite_dir, "cache")
+        midwrite_tel = os.path.join(midwrite_dir, "telemetry")
+        os.makedirs(midwrite_tel, exist_ok=True)
+        env = dict(base_env)
+        env[CHAOS_ENV_VAR] = ChaosSchedule(
+            faults=[Fault(kind="sigkill", point="compile_cache_store", generation=0)]
+        ).to_json()
+        env["ACCELERATE_TELEMETRY"] = "1"
+        env["ACCELERATE_TELEMETRY_DIR"] = midwrite_tel
+        env[_cc.CACHE_DIR_ENV_VAR] = midwrite_cache
+        sup2 = Supervisor(
+            [toy_cmd(midwrite_dir)], env=env,
+            policy=RestartPolicy(max_restarts=args.max_restarts,
+                                 backoff_base_s=0.2, grace_period_s=2.0),
+            telemetry_dir=midwrite_tel,
+        )
+        rc2 = sup2.run()
+        committed = []
+        cache_obj = _cc.CompileCache(midwrite_cache) if os.path.isdir(midwrite_cache) else None
+        if cache_obj is not None:
+            committed = cache_obj.entries()
+        verdict["midwrite"] = {
+            "supervisor_rc": rc2,
+            "restarts": sup2.restarts_used,
+            "causes": [i.cause for i in sup2.incidents],
+            "committed_entries": len(committed),
+            "final_params_bitwise_match": rc2 == 0 and _params_match(midwrite_dir),
+            "cache_records": _cache_records(midwrite_tel),
+        }
+        ok = ok and rc2 == 0 and sup2.restarts_used >= 1 and verdict["midwrite"][
+            "final_params_bitwise_match"
+        ]
+
+        # 4. compile-cache leg B: POISONED entry. Populate the cache with one
+        # clean run, bit-flip every payload, then run supervised under the
+        # original SIGKILL schedule — the warm restart must detect the
+        # corruption, quarantine, fall back to a fresh compile (recorded in
+        # telemetry), and STILL finish with bitwise-identical params.
+        poison_dir = os.path.join(root, "cache-poison")
+        poison_cache = os.path.join(poison_dir, "cache")
+        poison_tel = os.path.join(poison_dir, "telemetry")
+        seed_dir = os.path.join(poison_dir, "seedrun")
+        os.makedirs(seed_dir, exist_ok=True)
+        os.makedirs(poison_tel, exist_ok=True)
+        env = dict(base_env)
+        env["ACCELERATE_TELEMETRY"] = "1"
+        env["ACCELERATE_TELEMETRY_DIR"] = os.path.join(poison_dir, "telemetry-seed")
+        env[_cc.CACHE_DIR_ENV_VAR] = poison_cache
+        seed_run = subprocess.run(toy_cmd(seed_dir), env=env, capture_output=True,
+                                  text=True, timeout=600)
+        poisoned = 0
+        if seed_run.returncode == 0 and os.path.isdir(poison_cache):
+            for entry in _cc.CompileCache(poison_cache).entries():
+                payload = os.path.join(entry, _cc.PAYLOAD_NAME)
+                try:
+                    blob = bytearray(open(payload, "rb").read())
+                    blob[len(blob) // 2] ^= 0xFF
+                    open(payload, "wb").write(bytes(blob))
+                    poisoned += 1
+                except OSError:
+                    pass
+        env = dict(env)
+        env[CHAOS_ENV_VAR] = schedule.to_json()
+        env["ACCELERATE_TELEMETRY_DIR"] = poison_tel
+        sup3 = Supervisor(
+            [toy_cmd(poison_dir)], env=env,
+            policy=RestartPolicy(max_restarts=args.max_restarts,
+                                 backoff_base_s=0.2, grace_period_s=2.0),
+            telemetry_dir=poison_tel,
+        )
+        rc3 = sup3.run()
+        cache_recs = _cache_records(poison_tel)
+        quarantined = 0
+        qdir = os.path.join(poison_cache, _cc.QUARANTINE_DIRNAME)
+        try:
+            quarantined = len(os.listdir(qdir))
+        except OSError:
+            pass
+        verdict["poisoned"] = {
+            "supervisor_rc": rc3,
+            "restarts": sup3.restarts_used,
+            "entries_poisoned": poisoned,
+            "quarantined": quarantined,
+            "cache_records": cache_recs,
+            "final_params_bitwise_match": rc3 == 0 and _params_match(poison_dir),
+        }
+        ok = ok and (
+            rc3 == 0 and sup3.restarts_used >= 1 and poisoned >= 1
+            and quarantined >= 1 and cache_recs.get("corrupt", 0) >= 1
+            and cache_recs.get("fallback", 0) >= 1
+            and verdict["poisoned"]["final_params_bitwise_match"]
+        )
+
+        print(json.dumps(verdict))
         print(
-            "chaos: PASS — run was SIGKILLed, auto-resumed, and finished with "
-            "bitwise-identical params" if ok
+            "chaos: PASS — SIGKILL auto-resume, kill-9-mid-cache-write restart, "
+            "and poisoned-cache restart all finished with bitwise-identical "
+            "params (corrupt entry quarantined, fallback compile recorded)" if ok
             else "chaos: FAIL — see verdict above",
             file=sys.stderr,
         )

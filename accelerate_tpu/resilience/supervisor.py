@@ -184,8 +184,28 @@ class Supervisor:
     def _heartbeat_file(self, new_rank: int) -> str:
         return os.path.join(self.telemetry_dir, f"heartbeat-rank{new_rank}")
 
+    def _pretouch_compile_cache(self, generation: int) -> None:
+        """Probe the persistent compile cache the children will use BEFORE
+        respawning them: a missing/readonly/unconfigured cache means the next
+        generation cold-starts — that must be a visible, attributed fact in
+        the supervisor record, not a silent MTTR doubling."""
+        from ..compile_cache import pretouch
+
+        try:
+            info = pretouch(env=self.env)
+        except Exception as exc:  # the probe must never block a respawn
+            info = {"status": "error", "error": f"{type(exc).__name__}: {exc}"}
+        self._emit("compile_cache", generation=generation, **info)
+        if info.get("status") in ("missing", "readonly", "error"):
+            logger.warning(
+                f"compile cache {info.get('dir') or '?'} is {info['status']} "
+                f"({info.get('error', '')}); generation {generation} will "
+                "cold-start (full XLA recompile)"
+            )
+
     def _spawn_cohort(self, spec: CohortSpec) -> None:
         publish_cohort_spec(self.roster_dir, spec)
+        self._pretouch_compile_cache(spec.generation)
         self._children = {}
         # The supervisor only owns the world-size env when it actually manages
         # a multi-process cohort; with ONE supervised child (single-host

@@ -154,6 +154,7 @@ class ServingEngine:
         admit_watermark_blocks: int = 0,
         lattice: Optional[BucketLattice] = None,
         heartbeat_name: str = "serving_decode",
+        compile_cache_dir: Optional[str] = None,
     ):
         self.params = params
         self.config = config
@@ -228,6 +229,15 @@ class ServingEngine:
 
         self.prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
         self.decode_fn = jax.jit(_decode, donate_argnums=(1,))
+        # Persistent-compile-cache warm boot: when a cache dir is configured
+        # (replacement replicas get it via ReplicaSpec.compile_cache_dir),
+        # warmup AOT-compiles every lattice point through the cache — hits
+        # load in milliseconds — and the step paths dispatch to these
+        # executables; with no dir this stays empty and behavior is
+        # byte-identical to the plain jit path.
+        self.compile_cache_dir = compile_cache_dir
+        self._aot: dict = {}  # ("prefill"|"decode", *bucket shape) -> executable
+        self.cache_stats = {"hit": 0, "miss": 0, "corrupt": 0, "uncached": 0, "error": 0}
 
         # stats for the telemetry records / bench payloads
         self.steps = 0
@@ -280,36 +290,74 @@ class ServingEngine:
         """Compile every lattice point up front (decode (slots, width) cross
         product + per-length prefill) so serving never pays a compile — and so
         the recompile detector's baseline is exact. Returns the per-function
-        compile counts; the jit caches must never grow past them."""
+        compile counts; the jit caches must never grow past them.
+
+        With ``compile_cache_dir`` configured (and the cache enabled), every
+        point goes through :func:`accelerate_tpu.compile_cache.aot_compile`
+        instead: a cached point LOADS in milliseconds (a replacement replica
+        boots warm), a missed point compiles once and is exported for the
+        next boot. ``cache_stats`` records the per-point outcomes."""
+        from .. import compile_cache as _ccache
+
+        cache = None
+        if self.compile_cache_dir is not None:
+            cache = _ccache.get_cache(self.compile_cache_dir)
         key = np.zeros((2,), np.uint32)
         for Sb, W in self.lattice.prefill_points():
             ids = np.zeros((1, Sb), np.int32)
             table = np.full((1, W), NULL_BLOCK, np.int32)
-            self.pool, tok = self.prefill_fn(
+            args = (
                 self.params, self.pool, ids, table, np.int32(0), np.int32(0),
                 key, np.int32(0),
             )
+            if cache is not None:
+                executable, outcome = _ccache.aot_compile(
+                    f"serving_prefill[{Sb}x{W}]", self.prefill_fn, args,
+                    mesh=self.mesh, cache=cache,
+                )
+                self.cache_stats[outcome] = self.cache_stats.get(outcome, 0) + 1
+                if executable is not None:
+                    self._aot[("prefill", Sb, W)] = executable
+                    continue
+            self.pool, tok = self.prefill_fn(*args)
         for Bb, W in self.lattice.decode_points():
             last = np.zeros((Bb,), np.int32)
             tables = np.full((Bb, W), NULL_BLOCK, np.int32)
             positions = np.zeros((Bb,), np.int32)
             keys = np.zeros((Bb, 2), np.uint32)
             token_idx = np.zeros((Bb,), np.int32)
-            self.pool, tok = self.decode_fn(
-                self.params, self.pool, last, tables, positions, keys, token_idx
-            )
-        jax.block_until_ready(tok)
+            args = (self.params, self.pool, last, tables, positions, keys, token_idx)
+            if cache is not None:
+                executable, outcome = _ccache.aot_compile(
+                    f"serving_decode[{Bb}x{W}]", self.decode_fn, args,
+                    mesh=self.mesh, cache=cache,
+                )
+                self.cache_stats[outcome] = self.cache_stats.get(outcome, 0) + 1
+                if executable is not None:
+                    self._aot[("decode", Bb, W)] = executable
+                    continue
+            self.pool, tok = self.decode_fn(*args)
+        jax.block_until_ready(self.pool)
         counts = self.jit_cache_sizes()
         if tel.is_enabled():
-            tel.emit("serving", phase="warmup", **counts)
+            tel.emit(
+                "serving", phase="warmup", **counts,
+                **(
+                    {"cache_" + k: v for k, v in self.cache_stats.items() if v}
+                    if cache is not None else {}
+                ),
+            )
         return counts
 
     def jit_cache_sizes(self) -> dict:
-        """Compiled-entry counts for the two step functions — after
-        :meth:`warmup` these must equal the lattice sizes forever."""
+        """Compiled-entry counts for the two step functions (live jit cache
+        plus cache-loaded AOT executables) — after :meth:`warmup` these must
+        equal the lattice sizes forever."""
+        aot_prefill = sum(1 for k in self._aot if k[0] == "prefill")
+        aot_decode = sum(1 for k in self._aot if k[0] == "decode")
         return {
-            "prefill_compiles": int(self.prefill_fn._cache_size()),
-            "decode_compiles": int(self.decode_fn._cache_size()),
+            "prefill_compiles": int(self.prefill_fn._cache_size()) + aot_prefill,
+            "decode_compiles": int(self.decode_fn._cache_size()) + aot_decode,
         }
 
     # -- the step loop -------------------------------------------------------
@@ -432,7 +480,8 @@ class ServingEngine:
             Sb = self.lattice.prefill_bucket(chunk.size)
             ids = np.zeros((1, Sb), np.int32)
             ids[0, : chunk.size] = chunk
-            self.pool, tok = self.prefill_fn(
+            fn = self._aot.get(("prefill", Sb, W), self.prefill_fn)
+            self.pool, tok = fn(
                 self.params, self.pool, ids, table, np.int32(start),
                 np.int32(chunk.size - 1), key, token_idx,
             )
@@ -459,7 +508,8 @@ class ServingEngine:
             positions[i] = req.prefix_len - 1
             keys[i] = self._request_key(req)
             token_idx[i] = len(req.generated)
-        self.pool, toks = self.decode_fn(
+        fn = self._aot.get(("decode", Bb, W), self.decode_fn)
+        self.pool, toks = fn(
             self.params, self.pool, last, tables, positions, keys, token_idx
         )
         toks = np.asarray(jax.device_get(toks))

@@ -87,6 +87,9 @@ class ReplicaSpec:
     top_k: int = 0
     top_p: float = 1.0
     param_dtype: str = "bfloat16"
+    # persistent compile cache (compile_cache/): a replacement/respawned
+    # replica warm-boots its whole lattice from here instead of recompiling
+    compile_cache_dir: Optional[str] = None
 
     def config(self):
         from ..models.transformer import LlamaConfig
@@ -131,6 +134,7 @@ class ReplicaSpec:
             top_k=self.top_k,
             top_p=self.top_p,
             heartbeat_name=heartbeat_name,
+            compile_cache_dir=self.compile_cache_dir,
         )
 
     def to_json(self) -> str:
@@ -252,6 +256,7 @@ class LocalReplica:
     def __init__(self, name: str, spec: ReplicaSpec, *, idle_beat_s: float = 0.05):
         self.name = name
         self.spec = spec
+        self._idle_beat_s = idle_beat_s
         self.state = ReplicaState.STARTING
         self._inbox: "queue.Queue[dict]" = queue.Queue()
         self._outbox: "queue.Queue[dict]" = queue.Queue()
@@ -310,6 +315,11 @@ class LocalReplica:
         self._killed.set()
         self._thread.join(timeout=timeout)
 
+    def respawn(self) -> "LocalReplica":
+        """A fresh incarnation from the stored spec (the router's self-heal
+        path) — warm-booted via ``spec.compile_cache_dir`` when set."""
+        return LocalReplica(self.name, self.spec, idle_beat_s=self._idle_beat_s)
+
 
 class ProcessReplica:
     """The worker loop in a child process, JSON lines over stdin/stdout.
@@ -334,6 +344,8 @@ class ProcessReplica:
 
         self.name = name
         self.spec = spec
+        self._idle_beat_s = idle_beat_s
+        self._base_env = None if env is None else dict(env)
         self.state = ReplicaState.STARTING
         self._outbox: "queue.Queue[dict]" = queue.Queue()
         # the child inherits the parent's environment verbatim (no platform
@@ -427,6 +439,16 @@ class ProcessReplica:
         except subprocess.TimeoutExpired:
             self.proc.kill()
             self.proc.wait(timeout=5.0)
+
+    def respawn(self) -> "ProcessReplica":
+        """A fresh child from the stored spec (the router's self-heal path),
+        warm-booted via ``spec.compile_cache_dir`` when set. The chaos
+        schedule is deliberately NOT re-armed: it is test instrumentation
+        aimed at the incarnation it already killed — a healed replica must
+        serve, not re-die deterministically."""
+        return ProcessReplica(
+            self.name, self.spec, env=self._base_env, idle_beat_s=self._idle_beat_s
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -140,6 +140,10 @@ class ServingRouter:
         max_retries: int = 3,
         max_outstanding_per_replica: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
+        self_heal: bool = False,
+        max_respawns_per_replica: int = 2,
+        respawn_backoff_base_s: float = 0.1,
+        respawn_backoff_max_s: float = 30.0,
     ):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -165,8 +169,23 @@ class ServingRouter:
         self.failovers = 0
         self.shed_by_reason: "dict[str, int]" = {}
         self._per_replica: "dict[str, dict]" = {
-            n: {"dispatched": 0, "completed": 0, "failovers": 0} for n in self.replicas
+            n: {"dispatched": 0, "completed": 0, "failovers": 0, "respawns": 0}
+            for n in self.replicas
         }
+        # Self-healing (supervisor semantics at router scope): a DEAD replica
+        # with a stored spec is respawned under a bounded per-replica budget
+        # with exponential backoff, so a chaos-killed fleet heals back to N
+        # instead of shrinking. Respawned engines warm-boot from the
+        # persistent compile cache when ReplicaSpec.compile_cache_dir is set.
+        self.self_heal = bool(self_heal)
+        self.max_respawns_per_replica = int(max_respawns_per_replica)
+        self.respawn_backoff_base_s = float(respawn_backoff_base_s)
+        self.respawn_backoff_max_s = float(respawn_backoff_max_s)
+        self.respawns = 0
+        self._respawn_not_before: "dict[str, float]" = {}
+        # replicas the operator put in DRAINING before they died: a requested
+        # scale-down must never be undone by a self-heal respawn
+        self._decommissioned: "set[str]" = set()
         for n in self.replicas:
             _watchdog.register(f"serving_replica:{n}")
 
@@ -217,6 +236,8 @@ class ServingRouter:
         self._terminal_this_poll: "list[RouterRequest]" = []
         activity = self._drain_events(now)
         activity |= self._check_health(now)
+        if self.self_heal:
+            activity |= self._heal(now)
         for req in self.admission.expire(now):
             self._finalize(
                 req, RouterRequestStatus.EXPIRED, now,
@@ -364,8 +385,74 @@ class ServingRouter:
                 activity = True
         return activity
 
+    def _heal_pending(self) -> bool:
+        """True while some DEAD replica can still be respawned — queued work
+        must WAIT for the heal instead of being failed loudly."""
+        if not self.self_heal:
+            return False
+        return any(
+            rep.state is ReplicaState.DEAD
+            and hasattr(rep, "respawn")
+            and name not in self._decommissioned
+            and self._per_replica[name]["respawns"] < self.max_respawns_per_replica
+            for name, rep in self.replicas.items()
+        )
+
+    def _heal(self, now: float) -> bool:
+        """Respawn DEAD replicas from their stored specs, bounded by
+        ``max_respawns_per_replica`` with exponential backoff (the
+        supervisor's restart semantics at router scope)."""
+        activity = False
+        for name, rep in list(self.replicas.items()):
+            if rep.state is not ReplicaState.DEAD or not hasattr(rep, "respawn"):
+                continue
+            if name in self._decommissioned:
+                continue  # the operator drained it: its death is a shutdown
+            used = self._per_replica[name]["respawns"]
+            if used >= self.max_respawns_per_replica:
+                continue
+            if now < self._respawn_not_before.get(name, 0.0):
+                continue
+            try:
+                fresh = rep.respawn()
+            except Exception as exc:
+                # an unspawnable replica burns budget too — otherwise a sick
+                # host would be retried forever with zero backpressure
+                self._per_replica[name]["respawns"] = used + 1
+                self._respawn_not_before[name] = now + self._respawn_backoff(used + 1)
+                if tel.is_enabled():
+                    tel.emit(
+                        "serving_replica", replica=name, state="respawn_failed",
+                        reason=f"{type(exc).__name__}: {exc}", respawns=used + 1,
+                    )
+                continue
+            self.replicas[name] = fresh
+            self._per_replica[name]["respawns"] = used + 1
+            self.respawns += 1
+            self._respawn_not_before[name] = now + self._respawn_backoff(used + 1)
+            self._last_event[name] = now  # STARTING: warmup counts as liveness
+            _watchdog.register(f"serving_replica:{name}")
+            if tel.is_enabled():
+                tel.emit(
+                    "serving_replica", replica=name, state="respawned",
+                    respawns=used + 1, budget=self.max_respawns_per_replica,
+                    prev_reason=getattr(rep, "reason", None),
+                )
+            activity = True
+        return activity
+
+    def _respawn_backoff(self, attempt: int) -> float:
+        return min(
+            self.respawn_backoff_max_s,
+            self.respawn_backoff_base_s * (2.0 ** max(0, attempt - 1)),
+        )
+
     def _fail_replica(self, rep, reason: str, now: float) -> None:
         """DEAD transition + failover of everything in flight there."""
+        if rep.state is ReplicaState.DRAINING:
+            # dying while drained is the tail end of a requested scale-down —
+            # remember that so self-heal never resurrects it
+            self._decommissioned.add(rep.name)
         rep.state = ReplicaState.DEAD
         rep.reason = reason
         # a declared-dead replica is diagnosed, not stalling: stop watching
@@ -410,6 +497,10 @@ class ServingRouter:
             if r.state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
         ]
         if not live:
+            if self._heal_pending():
+                # a respawn is coming (budget remains): queued work waits for
+                # the healed replica instead of failing
+                return False
             # every replica is DEAD or DRAINING — and DRAINING never returns
             # to HEALTHY, so queued work can never run. Fail it loudly (the
             # in-flight work on DRAINING replicas still finishes normally);
@@ -525,6 +616,7 @@ class ServingRouter:
             dispatched=per["dispatched"],
             completed=per["completed"],
             failovers=per["failovers"],
+            respawns=per["respawns"],
         )
 
     def _emit_poll(self, now: float) -> None:
@@ -557,5 +649,6 @@ class ServingRouter:
             "shed": self.shed,
             "shed_by_reason": dict(self.shed_by_reason),
             "failovers": self.failovers,
+            "respawns": self.respawns,
             "per_replica": {n: dict(v) for n, v in self._per_replica.items()},
         }

@@ -278,28 +278,62 @@ def cost_from_compiled(name: str, compiled: Any) -> Optional[CompiledCost]:
     )
 
 
-def capture_compiled(name: str, fn: Any, args: tuple, kwargs: Optional[dict] = None) -> Optional[CompiledCost]:
+def capture_compiled(
+    name: str,
+    fn: Any,
+    args: tuple,
+    kwargs: Optional[dict] = None,
+    mesh: Optional[Any] = None,
+) -> Optional[CompiledCost]:
     """AOT-lower ``fn`` with ``args`` and record its XLA cost + memory
     analysis; emits one ``perf`` event and a capacity check (see
     :func:`~accelerate_tpu.telemetry.memory.check_memory_fit`).
 
     The compile this triggers is excluded from the step profiler's
     compile-second accounting, so step records keep meaning "compiles the
-    *training* path paid". Never raises: an uncapturable backend returns
-    ``None`` and training proceeds untouched."""
+    *training* path paid". Since the compile is already paid, the executable
+    is also EXPORTED to the persistent compile cache (when configured —
+    :mod:`accelerate_tpu.compile_cache`), which is what lets the next
+    restart generation skip this function's compile entirely. Never raises:
+    an uncapturable backend returns ``None`` and training proceeds
+    untouched."""
     from . import step_profiler
 
     if not hasattr(fn, "lower"):
         return None  # eager (disable_jit) or already-AOT: nothing to lower
     c0, s0 = step_profiler.raw_compile_snapshot()
     try:
-        compiled = fn.lower(*args, **(kwargs or {})).compile()
+        lowered = fn.lower(*args, **(kwargs or {}))
+        compiled = lowered.compile()
         cost = cost_from_compiled(name, compiled)
     except Exception:
         cost = None
+    else:
+        try:
+            from ..compile_cache import maybe_export
+
+            maybe_export(name, lowered, compiled, mesh=mesh)
+        except Exception:
+            pass  # an unexportable backend must not cost the capture
     finally:
         c1, s1 = step_profiler.raw_compile_snapshot()
         step_profiler.exclude_compiles(c1 - c0, s1 - s0)
+    if cost is None:
+        return None
+    tel.emit("perf", **cost.record())
+    if cost.memory:
+        from .memory import check_memory_fit
+
+        check_memory_fit(name, cost.memory)
+    return cost
+
+
+def capture_from_executable(name: str, executable: Any) -> Optional[CompiledCost]:
+    """The zero-compile twin of :func:`capture_compiled`, for a step
+    executable LOADED from the persistent compile cache: the cost analysis is
+    read off the deserialized executable, so a warm restart's step records
+    still carry mfu/roofline without paying the capture's AOT compile."""
+    cost = cost_from_compiled(name, executable)
     if cost is None:
         return None
     tel.emit("perf", **cost.record())

@@ -477,6 +477,60 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
     return section
 
 
+def _compile_cache_section(events: "list[dict]") -> Optional[dict]:
+    """Aggregate the persistent compile cache's ``compile_cache`` records
+    (``compile_cache/runtime.py``): hit/miss/store/corrupt/fallback counts,
+    bytes loaded+stored, load seconds saved into milliseconds paid, and the
+    per-function outcome table. ``None`` when the streams carry no cache
+    records. A nonzero ``corrupt`` count names quarantined entries — the run
+    survived them by fallback compiles, but the operator should look."""
+    all_recs = [e for e in events if e.get("kind") == "compile_cache"]
+    # supervisor pre-touch probes carry `status` instead of `event`
+    pretouch = [str(r.get("status")) for r in all_recs if r.get("status")]
+    recs = [r for r in all_recs if r.get("event")]
+    degraded = any(s in ("missing", "readonly", "error") for s in pretouch)
+    if not recs and not degraded:
+        # an unconfigured/healthy pre-touch alone is not a cache story —
+        # don't grow every supervised run's report with an empty section
+        return None
+    by_event: dict = {}
+    by_fn: dict = {}
+    quarantined: "list[str]" = []
+    bytes_loaded = 0
+    bytes_stored = 0
+    load_s = 0.0
+    for r in recs:
+        ev = str(r.get("event", "?"))
+        by_event[ev] = by_event.get(ev, 0) + 1
+        fn = str(r.get("fn", "?"))
+        by_fn.setdefault(fn, {})[ev] = by_fn.setdefault(fn, {}).get(ev, 0) + 1
+        if ev == "hit":
+            bytes_loaded += int(r.get("bytes", 0) or 0)
+            load_s += float(r.get("load_s", 0.0) or 0.0)
+        elif ev.startswith("store"):
+            bytes_stored += int(r.get("bytes", 0) or 0)
+        if ev == "corrupt" and r.get("quarantined_to"):
+            quarantined.append(str(r["quarantined_to"]))
+    pretouch_counts: dict = {}
+    for s in pretouch:
+        pretouch_counts[s] = pretouch_counts.get(s, 0) + 1
+    return {
+        "events": len(all_recs),
+        "pretouch": dict(sorted(pretouch_counts.items())),
+        "hits": by_event.get("hit", 0),
+        "misses": by_event.get("miss", 0),
+        "stores": by_event.get("store", 0),
+        "corrupt": by_event.get("corrupt", 0),
+        "fallbacks": by_event.get("fallback", 0),
+        "by_event": dict(sorted(by_event.items())),
+        "by_fn": dict(sorted(by_fn.items())),
+        "bytes_loaded": bytes_loaded,
+        "bytes_stored": bytes_stored,
+        "load_s": round(load_s, 6),
+        "quarantined": quarantined,
+    }
+
+
 def _router_section(events: "list[dict]") -> Optional[dict]:
     """Aggregate the serving router's ``router`` records (``phase: "poll"``
     carries cumulative counters, ``phase: "request"`` one terminal outcome
@@ -672,6 +726,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "serving": _serving_section(events),
         "router": _router_section(events),
         "restarts": _restarts_section(events),
+        "compile_cache": _compile_cache_section(events),
     }
     if by_rank:
         report["ranks"] = _rank_section(events, file_rank, paths)
@@ -836,6 +891,9 @@ def format_report(report: dict) -> str:
     router = report.get("router")
     if router:
         lines.append(format_router_section(router))
+    ccache = report.get("compile_cache")
+    if ccache:
+        lines.append(format_compile_cache_section(ccache))
     m = report["memory"]
     lines.append(
         "memory peaks: device "
@@ -968,6 +1026,39 @@ def format_serving_section(serving: dict) -> str:
             f"({reqs.get('preempted', 0)} preempted-and-resumed, "
             f"{reqs.get('rejected', 0)} rejected), "
             f"{reqs['new_tokens']} token(s) generated{lat_s}{ttft_s}"
+        )
+    return "\n".join(lines)
+
+
+def format_compile_cache_section(ccache: dict) -> str:
+    """Human rendering of the persistent compile cache outcomes (see
+    ``docs/compile_cache.md`` for how to read it)."""
+    lines = ["compile cache:"]
+    lines.append(
+        f"  {ccache.get('hits', 0)} hit(s) ({_fmt_bytes(ccache.get('bytes_loaded', 0))} "
+        f"loaded in {ccache.get('load_s', 0.0) * 1e3:.1f}ms), "
+        f"{ccache.get('misses', 0)} miss(es), {ccache.get('stores', 0)} store(s) "
+        f"({_fmt_bytes(ccache.get('bytes_stored', 0))})"
+    )
+    for fn, evs in (ccache.get("by_fn") or {}).items():
+        parts = ", ".join(f"{ev} x{n}" for ev, n in sorted(evs.items()))
+        lines.append(f"    {fn}: {parts}")
+    if ccache.get("corrupt"):
+        lines.append(
+            f"  WARNING: {ccache['corrupt']} corrupt entr(ies) quarantined, "
+            f"{ccache.get('fallbacks', 0)} fallback compile(s) paid"
+        )
+        for q in (ccache.get("quarantined") or [])[-3:]:
+            lines.append(f"    quarantined: {q}")
+    degraded = {
+        s: n for s, n in (ccache.get("pretouch") or {}).items()
+        if s in ("missing", "readonly", "error")
+    }
+    if degraded:
+        parts = ", ".join(f"{s} x{n}" for s, n in degraded.items())
+        lines.append(
+            f"  WARNING: supervisor pre-touch found the cache {parts} — "
+            "those generations cold-started"
         )
     return "\n".join(lines)
 
@@ -1354,9 +1445,94 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("replicated serving router", False, f"{type(exc).__name__}: {exc}")
 
+        # 14. persistent compile cache (ISSUE 13): a subprocess compiles a
+        # jitted step into a temp cache and commits it; a SECOND subprocess
+        # ("the restart") must hit that entry with ZERO backend compiles and
+        # zero jit-cache growth; then the entry is bit-flipped and a third
+        # subprocess must fall back to a clean fresh compile with the poison
+        # quarantined — never a crash, never a wrong result
+        try:
+            _doctor_compile_cache(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("persistent compile cache", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
+
+
+def _doctor_compile_cache(tmp: str, _check) -> None:
+    """Doctor check 14 body: three subprocess generations against one temp
+    cache dir — gen A compiles a jitted step and commits it; gen B (the
+    restart) must load it with a cache HIT, zero backend compiles and zero
+    jit-cache growth (RecompileWatcher); after a bit-flip, gen C must
+    quarantine the poison and fall back to a clean fresh compile producing
+    the same result."""
+    import subprocess
+    import sys
+
+    from ..compile_cache import PAYLOAD_NAME, CompileCache
+
+    cache_dir = os.path.join(tmp, "compile-cache")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(p for p in (repo, env.get("PYTHONPATH")) if p)
+    child = (
+        "import json, os, sys\n"
+        "import jax, jax.numpy as jnp\n"
+        "from accelerate_tpu import compile_cache as cc\n"
+        "from accelerate_tpu.telemetry import step_profiler as sp\n"
+        "sp.install_compile_listener()\n"
+        "step = jax.jit(lambda p, x: {'w': p['w'] - 0.1 * (p['w'] @ x)[:, None] * x[None, :]})\n"
+        "params = {'w': jnp.ones((16, 16))}\n"
+        "x = jnp.ones((16,))\n"
+        "watcher = sp.RecompileWatcher()\n"
+        "watcher.register('doctor_step', step)\n"
+        "c0 = sp.raw_compile_snapshot()[0]\n"
+        f"ex, outcome = cc.aot_compile('doctor_step', step, (params, x), directory={cache_dir!r})\n"
+        "out = (ex if ex is not None else step)(params, x)\n"
+        "c1 = sp.raw_compile_snapshot()[0]\n"
+        "print(json.dumps({'outcome': outcome, 'backend_compiles': c1 - c0,\n"
+        "                  'jit_entries': int(step._cache_size()),\n"
+        "                  'recompiles': sum(watcher.poll(emit=False).values()),\n"
+        "                  'result': float(out['w'][0, 0])}))\n"
+    )
+
+    def _gen() -> dict:
+        res = subprocess.run(
+            [sys.executable, "-c", child], env=env, capture_output=True,
+            text=True, timeout=240,
+        )
+        if res.returncode != 0:
+            raise RuntimeError(f"child rc={res.returncode}: {res.stderr[-800:]}")
+        return json.loads(res.stdout.strip().splitlines()[-1])
+
+    a = _gen()  # cold: compile + commit
+    b = _gen()  # restart: must hit with zero compiles anywhere
+    cache = CompileCache(cache_dir)
+    entry = cache.entries()[0] if cache.entries() else None
+    if entry is not None:  # poison: flip one payload byte
+        payload = os.path.join(entry, PAYLOAD_NAME)
+        blob = bytearray(open(payload, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(payload, "wb").write(bytes(blob))
+    c = _gen()  # poisoned restart: quarantine + clean fallback compile
+    quarantined = cache.stats()["quarantined"]
+    ok = (
+        a["outcome"] == "miss" and a["backend_compiles"] >= 1
+        and b["outcome"] == "hit" and b["backend_compiles"] == 0
+        and b["jit_entries"] == 0 and b["recompiles"] == 0
+        and b["result"] == a["result"]
+        and entry is not None
+        and c["outcome"] == "corrupt" and c["backend_compiles"] >= 1
+        and c["result"] == a["result"]
+        and quarantined >= 1
+    )
+    _check(
+        "persistent compile cache",
+        ok,
+        f"cold={a} restart={b} poisoned={c} quarantined={quarantined}",
+    )
 
 
 def _doctor_elastic(tmp: str, _check) -> None:

@@ -154,6 +154,25 @@ def test_serving_benchmark_smoke():
     assert rep["replica_kill"]["p99_latency_ms"] >= rep["replica_kill"]["p50_latency_ms"]
 
 
+def test_compile_time_restart_benchmark_smoke():
+    """Fast tier-1 smoke for `make bench-compile` (ISSUE 13): the train leg
+    only (two subprocess generations against one cache) — the payload must
+    carry cold/warm seconds plus the cache-event counts, and the warm
+    generation must actually HIT (miss>0 there would be a silent recompile
+    masquerading as a warm start). Speedup-margin assertions live in the
+    chaos/compile-cache suites; wall-clock ratios here would flake on a
+    loaded CI box."""
+    out = run_script("benchmarks/compile_time/run.py", "--modes", "train", timeout=360)
+    assert out["bench"] == "compile_time_restart"
+    assert out["unit"].startswith("speedup")
+    leg = out["train"]
+    assert leg["metric"] == "restart_to_first_step_s"
+    assert leg["cold_s"] > 0 and leg["warm_s"] > 0 and leg["speedup"] > 0
+    assert leg["cold_cache_events"].get("store", 0) >= 1
+    assert leg["warm_cache_events"].get("hit", 0) >= 1
+    assert leg["warm_cache_events"].get("miss", 0) == 0
+
+
 def test_benchmark_dirs_are_documented():
     dirs = [p for p in (REPO / "benchmarks").iterdir() if p.is_dir() and p.name != "__pycache__"]
     assert len(dirs) >= 5

@@ -403,7 +403,8 @@ def test_doctor_self_checks(capsys):
     # + elastic auto-resume (ISSUE 10)
     # + serving engine (ISSUE 11)
     # + replicated serving router (ISSUE 12)
-    assert out.count("PASS") == 13 and "FAIL" not in out
+    # + persistent compile cache (ISSUE 13)
+    assert out.count("PASS") == 14 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out
@@ -411,6 +412,7 @@ def test_doctor_self_checks(capsys):
     assert "fused zero1 compiled collectives" in out
     assert "performance report section" in out
     assert "elastic auto-resume" in out
+    assert "persistent compile cache" in out
 
 
 # ------------------------------------------------------- integration hookups

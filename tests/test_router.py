@@ -614,3 +614,167 @@ def test_router_report_absent_without_records(tmp_path):
     report = build_report([str(tmp_path)])
     assert report["router"] is None
     assert "router:" not in format_report(report)
+
+
+# ---------------------------------------------------------------------------
+# router self-healing (ISSUE 13 satellite): a chaos-killed fleet heals back
+# to N via respawn-from-spec under a bounded budget with backoff
+
+
+class RespawnableFake(FakeReplica):
+    """FakeReplica that can be respawned from itself (generation counted)."""
+
+    def __init__(self, name, max_slots=4, generation=0):
+        super().__init__(name, max_slots=max_slots)
+        self.generation = generation
+
+    def respawn(self):
+        return RespawnableFake(
+            self.name, max_slots=self.spec.max_slots, generation=self.generation + 1
+        )
+
+
+def test_router_self_heal_respawns_within_budget_then_gives_up():
+    clock = FakeClock()
+    rep = RespawnableFake("r0")
+    router = ServingRouter(
+        [rep], clock=clock, self_heal=True, max_respawns_per_replica=1,
+        respawn_backoff_base_s=0.0,
+    )
+    rep.die()
+    router.poll()
+    healed = router.replicas["r0"]
+    assert healed is not rep and healed.generation == 1
+    assert healed.state is ReplicaState.HEALTHY
+    assert router.respawns == 1
+    assert router.stats()["per_replica"]["r0"]["respawns"] == 1
+    # budget exhausted: the second death stays dead, and queued work fails
+    # loudly instead of waiting for a heal that can never come
+    healed.die()
+    router.poll()
+    assert router.replicas["r0"].state is ReplicaState.DEAD
+    req = router.submit(np.arange(4, dtype=np.int32), 4)
+    router.poll()
+    assert req.status is RouterRequestStatus.FAILED
+    assert "no live replicas" in req.error
+
+
+def test_router_self_heal_backoff_defers_second_respawn():
+    clock = FakeClock()
+    rep = RespawnableFake("r0")
+    router = ServingRouter(
+        [rep], clock=clock, self_heal=True, max_respawns_per_replica=3,
+        respawn_backoff_base_s=10.0,
+    )
+    rep.die()
+    router.poll()  # first respawn is immediate
+    assert router.replicas["r0"].generation == 1
+    router.replicas["r0"].die()
+    router.poll()  # second respawn gated behind the backoff window
+    assert router.replicas["r0"].state is ReplicaState.DEAD
+    # queued work WAITS (budget remains) instead of failing
+    req = router.submit(np.arange(4, dtype=np.int32), 4)
+    router.poll()
+    assert req.status is RouterRequestStatus.QUEUED
+    clock.t += 10.1
+    router.poll()
+    assert router.replicas["r0"].generation == 2
+    assert router.replicas["r0"].state is ReplicaState.HEALTHY
+
+
+def test_router_self_heal_ignores_replicas_without_spec():
+    clock = FakeClock()
+    rep = FakeReplica("r0")  # no respawn()
+    router = ServingRouter([rep], clock=clock, self_heal=True)
+    rep.die()
+    router.poll()
+    assert router.replicas["r0"] is rep
+    assert router.replicas["r0"].state is ReplicaState.DEAD
+    assert router.respawns == 0
+
+
+def test_router_self_heals_killed_fleet_back_to_n_bitwise(tmp_path):
+    """The e2e: one of two thread-backed replicas is killed mid-decode. The
+    router must (a) fail the work over with bitwise parity, (b) respawn the
+    dead replica from its stored spec — warm-booted from the compile cache —
+    and (c) end with the fleet back at N serving bitwise-identical output
+    from the RESPAWNED replica."""
+    spec = _spec(compile_cache_dir=str(tmp_path / "cache"))
+    router = None
+    try:
+        router = ServingRouter(
+            [LocalReplica(f"r{i}", spec) for i in range(2)],
+            health_timeout_s=5.0, self_heal=True, max_respawns_per_replica=2,
+            respawn_backoff_base_s=0.05,
+        )
+        router.wait_ready(timeout_s=300)
+        prompts = _prompts(1, (5, 13, 9, 16, 7, 11))
+        reqs = [router.submit(p, 12, rng_seed=i) for i, p in enumerate(prompts)]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            router.poll()
+            if any(
+                r.replica == "r0" and len(r.generated) >= 2 and not r.status.terminal
+                for r in reqs
+            ):
+                break
+            time.sleep(0.002)
+        assert any(r.replica == "r0" and not r.status.terminal for r in reqs)
+        router.replicas["r0"].kill()
+        done = router.run(timeout_s=240)
+        assert sorted(r.rid for r in done) == sorted(r.rid for r in reqs)
+        params = spec.build_params()
+        for i, (p, req) in enumerate(zip(prompts, reqs)):
+            assert req.status is RouterRequestStatus.FINISHED, (i, req.status, req.error)
+            ref = greedy_generate(params, p[None], CONFIG, max_new_tokens=12)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids()), f"request {i}"
+        # the fleet heals back to N: the replacement boots (warm), goes ready
+        assert router.respawns >= 1
+        router.wait_ready(timeout_s=300)
+        assert all(
+            r.state is ReplicaState.HEALTHY for r in router.replicas.values()
+        ), {n: r.state for n, r in router.replicas.items()}
+        healed = router.replicas["r0"]
+        # warm boot: the respawned engine loaded its whole lattice from cache
+        assert healed._worker is not None
+        assert healed._worker.engine.cache_stats["hit"] == spec.lattice().size()
+        # drain the survivor so the next request MUST run on the respawned
+        # replica — and its output must still be bitwise-correct
+        router.drain("r1")
+        p_new = _prompts(9, (8,))[0]
+        req_new = router.submit(p_new, 8, rng_seed=42)
+        done = router.run(timeout_s=240)
+        assert req_new.status is RouterRequestStatus.FINISHED, req_new.error
+        assert req_new.replica == "r0"
+        ref = greedy_generate(params, p_new[None], CONFIG, max_new_tokens=8)
+        assert np.array_equal(np.asarray(ref[0]), req_new.output_ids())
+    finally:
+        if router is not None:
+            router.close()
+
+
+def test_router_self_heal_never_resurrects_drained_replica():
+    """drain() is a requested scale-down: a drained replica that then dies
+    must stay dead — self-heal respawning it would undo the operator's
+    decommission."""
+    clock = FakeClock()
+    reps = [RespawnableFake("r0"), RespawnableFake("r1")]
+    router = ServingRouter(
+        reps, clock=clock, self_heal=True, max_respawns_per_replica=3,
+        respawn_backoff_base_s=0.0,
+    )
+    router.drain("r0")
+    reps[0].die()
+    router.poll()
+    assert router.replicas["r0"] is reps[0]  # not replaced
+    assert router.replicas["r0"].state is ReplicaState.DEAD
+    assert router.respawns == 0
+    # a CRASHED (never drained) replica still heals
+    reps[1].die()
+    router.poll()
+    assert router.replicas["r1"].generation == 1
+    # and queued work does not wait on the decommissioned one once the
+    # healthy survivor exists
+    req = router.submit(np.arange(4, dtype=np.int32), 4)
+    router.poll()
+    assert req.status is RouterRequestStatus.DISPATCHED

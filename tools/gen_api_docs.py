@@ -223,6 +223,25 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
            "percentile", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
+    "compile_cache": (
+        "Compile cache",
+        "Zero-cold-start recovery (no reference counterpart): a crash-safe "
+        "persistent cache of serialized AOT executables, content-addressed on "
+        "(StableHLO fingerprint, mesh axes, device kind, jax/jaxlib/XLA "
+        "versions, compile flags), committed with the staged-fsync-CRC-"
+        "manifest-rename protocol and read defensively (corrupt/mismatched "
+        "entries are quarantined and fall back to a fresh compile). Probed by "
+        "the Accelerator on restart generations >= 1, loaded wholesale by the "
+        "serving engine's warmup, pre-touched by the elastic supervisor. See "
+        "`docs/compile_cache.md`.",
+        [("accelerate_tpu.compile_cache.cache",
+          ["CacheKey", "CompileCache", "LoadResult", "StoreResult",
+           "key_from_lowered", "environment_fingerprint", "compile_flags"]),
+         ("accelerate_tpu.compile_cache.runtime",
+          ["cache_enabled", "configured_cache_dir", "get_cache", "aot_compile",
+           "maybe_load_executable", "maybe_export", "call_with_fallback",
+           "pretouch"])],
+    ),
     "resilience": (
         "Resilience",
         "Elastic preemption-tolerant training (no reference counterpart): the "
