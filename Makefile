@@ -100,7 +100,9 @@ bench-zero1:
 # seeded Poisson open-loop load (aggregate tok/s ratio, batch occupancy,
 # p50/p99 per-request latency), plus the replicated-router leg: tok/s
 # scaling over N replicas and no-lost-requests + output parity under a
-# replica kill (benchmarks/serving)
+# replica kill, plus the shared-prefix leg: prefix cache on/off over one
+# seeded system-prompt workload (prefill-token reduction, hit rate,
+# bitwise output parity, zero recompiles) (benchmarks/serving)
 bench-serve:
 	python benchmarks/serving/run.py
 

@@ -15,6 +15,7 @@ bench-compile``).
 """
 
 from .cache import (
+    LAST_HIT_NAME,
     MANIFEST_NAME,
     PAYLOAD_NAME,
     QUARANTINE_DIRNAME,
@@ -30,6 +31,7 @@ from .cache import (
 from .runtime import (
     CACHE_DIR_ENV_VAR,
     CACHE_ENV_VAR,
+    CACHE_FN_QUOTA_MB_ENV_VAR,
     CACHE_MAX_MB_ENV_VAR,
     aot_compile,
     cache_enabled,
@@ -44,7 +46,9 @@ from .runtime import (
 __all__ = [
     "CACHE_ENV_VAR",
     "CACHE_DIR_ENV_VAR",
+    "CACHE_FN_QUOTA_MB_ENV_VAR",
     "CACHE_MAX_MB_ENV_VAR",
+    "LAST_HIT_NAME",
     "MANIFEST_NAME",
     "PAYLOAD_NAME",
     "QUARANTINE_DIRNAME",

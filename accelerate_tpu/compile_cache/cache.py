@@ -35,9 +35,15 @@ operator, so the next restart does not re-trip on it) and reports a corrupt
 outcome — the caller falls back to a fresh compile with a warning.
 
 **Eviction.** ``ACCELERATE_COMPILE_CACHE_MAX_MB`` bounds the directory;
-oldest entries go first, but an entry another process currently holds a
-shared ``flock`` on (it is mid-load) is skipped — eviction can never yank an
-executable out from under a reader.
+least-recently-HIT entries go first — every successful load touches the
+entry's ``LAST_HIT`` stamp, so the executables a fleet actually reloads stay
+resident while write-once-never-read entries age out (never-hit entries fall
+back to their write time). ``ACCELERATE_COMPILE_CACHE_FN_QUOTA_MB`` bounds
+each *function*'s share on top (the manifest ``fn`` field groups entries):
+one model's serving lattice filling the directory evicts its OWN stale
+points, not another fleet's step executables. Either way, an entry another
+process currently holds a shared ``flock`` on (it is mid-load) is skipped —
+eviction can never yank an executable out from under a reader.
 
 The payload is a pickle of :func:`jax.experimental.serialize_executable.
 serialize` output; like JAX's own persistent compilation cache, the
@@ -65,6 +71,7 @@ logger = get_logger(__name__)
 SCHEMA_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
 PAYLOAD_NAME = "executable.bin"
+LAST_HIT_NAME = "LAST_HIT"
 QUARANTINE_DIRNAME = "quarantine"
 
 #: Orphaned staging dirs (a writer killed mid-write) older than this are
@@ -239,9 +246,15 @@ class CompileCache:
     directory cannot be created, which :func:`~accelerate_tpu.compile_cache.
     runtime.pretouch` turns into a visible cold-start warning)."""
 
-    def __init__(self, directory: str, max_mb: Optional[float] = None):
+    def __init__(
+        self,
+        directory: str,
+        max_mb: Optional[float] = None,
+        fn_quota_mb: Optional[float] = None,
+    ):
         self.directory = os.path.abspath(directory)
         self.max_mb = max_mb
+        self.fn_quota_mb = fn_quota_mb
         os.makedirs(self.directory, exist_ok=True)
 
     # -- layout ---------------------------------------------------------------
@@ -252,7 +265,8 @@ class CompileCache:
         return os.path.join(self.directory, QUARANTINE_DIRNAME)
 
     def entries(self) -> "list[str]":
-        """Committed entry dirs (manifest present), oldest first."""
+        """Committed entry dirs (manifest present), least-recently-hit first
+        (a never-hit entry's recency is its write time)."""
         out = []
         try:
             names = os.listdir(self.directory)
@@ -264,7 +278,7 @@ class CompileCache:
                 continue
             if os.path.isfile(os.path.join(p, MANIFEST_NAME)):
                 out.append(p)
-        return sorted(out, key=lambda p: self._mtime(p))
+        return sorted(out, key=lambda p: self._last_hit(p))
 
     @staticmethod
     def _mtime(path: str) -> float:
@@ -272,6 +286,36 @@ class CompileCache:
             return os.path.getmtime(path)
         except OSError:
             return 0.0
+
+    def _last_hit(self, path: str) -> float:
+        """Eviction recency: the ``LAST_HIT`` stamp a load touches, falling
+        back to the entry's write time for entries never read back."""
+        try:
+            return os.path.getmtime(os.path.join(path, LAST_HIT_NAME))
+        except OSError:
+            return self._mtime(path)
+
+    @staticmethod
+    def _touch_last_hit(entry: str) -> None:
+        """Stamp read recency after a validated load (best effort, no fsync:
+        recency is advisory — losing a stamp to a crash just demotes the
+        entry to write-time order, it can never corrupt the entry)."""
+        try:
+            with open(os.path.join(entry, LAST_HIT_NAME), "w") as f:
+                f.write(f"{time.time():.3f}\n")
+        except OSError:
+            pass
+
+    def _entry_fn(self, path: str) -> str:
+        """The manifest's ``fn`` label (the per-function quota group);
+        unreadable manifests group under ``"?"`` — they still count against
+        SOME quota rather than escaping accounting."""
+        try:
+            with open(os.path.join(path, MANIFEST_NAME)) as f:
+                fn = json.load(f).get("fn")
+            return str(fn) if fn else "?"
+        except (OSError, ValueError):
+            return "?"
 
     @staticmethod
     def _dir_bytes(path: str) -> int:
@@ -414,6 +458,7 @@ class CompileCache:
                 )
         finally:
             f.close()  # releases the flock
+        self._touch_last_hit(entry)
         return LoadResult(
             "hit", executable=executable, nbytes=nbytes,
             seconds=round(time.monotonic() - t0, 6),
@@ -490,27 +535,51 @@ class CompileCache:
 
     # -- eviction -------------------------------------------------------------
     def evict(self, max_mb: Optional[float] = None, protect: "tuple[str, ...]" = ()) -> "list[str]":
-        """Delete oldest committed entries until the cache fits ``max_mb``
-        (default: the instance/env cap; no cap → no-op). Entries in
-        ``protect`` and entries another process holds a read lock on are
-        skipped."""
+        """Delete least-recently-HIT committed entries until every function's
+        share fits the per-fn quota (``fn_quota_mb`` /
+        ``ACCELERATE_COMPILE_CACHE_FN_QUOTA_MB``) and the whole directory
+        fits ``max_mb`` (default: the instance/env cap). No cap and no quota
+        → no-op. The quota pass runs FIRST, so under directory pressure the
+        function that overfilled the cache sheds its own stale entries before
+        the global pass can touch anyone else's. Entries in ``protect`` and
+        entries another process holds a read lock on are skipped."""
+        entries = self.entries()  # least-recently-hit first
+        sizes = {p: self._dir_bytes(p) for p in entries}
+        evicted: "list[str]" = []
+
+        def drop(p: str) -> bool:
+            if p in protect or not self._try_evict_one(p):
+                return False  # protected, or a reader holds it open
+            evicted.append(p)
+            return True
+
+        quota_mb = self._fn_quota_mb()
+        # no group can exceed the quota when the WHOLE directory fits it —
+        # skip the per-entry manifest parses (store() calls evict after every
+        # commit; a fleet-shared directory should not pay them every time)
+        if quota_mb is not None and sum(sizes.values()) > int(quota_mb * 1024 * 1024):
+            quota_bytes = int(quota_mb * 1024 * 1024)
+            groups: "dict[str, list[str]]" = {}
+            for p in entries:
+                groups.setdefault(self._entry_fn(p), []).append(p)
+            for group in groups.values():
+                total = sum(sizes[p] for p in group)
+                for p in group:  # this fn's least-recently-hit first
+                    if total <= quota_bytes:
+                        break
+                    if drop(p):
+                        total -= sizes[p]
         cap_mb = max_mb if max_mb is not None else self._cap_mb()
         if cap_mb is None:
-            return []
+            return evicted
         cap_bytes = int(cap_mb * 1024 * 1024)
-        entries = self.entries()
-        sizes = {p: self._dir_bytes(p) for p in entries}
-        total = sum(sizes.values())
-        evicted: "list[str]" = []
-        for p in entries:  # oldest first
+        remaining = [p for p in entries if p not in evicted]
+        total = sum(sizes[p] for p in remaining)
+        for p in remaining:
             if total <= cap_bytes:
                 break
-            if p in protect:
-                continue
-            if not self._try_evict_one(p):
-                continue  # a reader holds it open
-            total -= sizes[p]
-            evicted.append(p)
+            if drop(p):
+                total -= sizes[p]
         return evicted
 
     def _cap_mb(self) -> Optional[float]:
@@ -521,6 +590,15 @@ class CompileCache:
         from .runtime import CACHE_MAX_MB_ENV_VAR
 
         return parse_optional_float_from_env(CACHE_MAX_MB_ENV_VAR)
+
+    def _fn_quota_mb(self) -> Optional[float]:
+        if self.fn_quota_mb is not None:
+            return self.fn_quota_mb
+        from ..utils.environment import parse_optional_float_from_env
+
+        from .runtime import CACHE_FN_QUOTA_MB_ENV_VAR
+
+        return parse_optional_float_from_env(CACHE_FN_QUOTA_MB_ENV_VAR)
 
     def _try_evict_one(self, entry: str) -> bool:
         manifest_path = os.path.join(entry, MANIFEST_NAME)

@@ -25,7 +25,9 @@ Knobs: ``ACCELERATE_COMPILE_CACHE=0`` kills the whole feature (byte-identical
 behavior to an uncached build); ``ACCELERATE_COMPILE_CACHE_DIR`` names the
 (shareable) directory — **unset means disabled** (the cache never writes
 anywhere the operator didn't point it); ``ACCELERATE_COMPILE_CACHE_MAX_MB``
-caps the directory size.
+caps the directory size (least-recently-hit entries evicted first);
+``ACCELERATE_COMPILE_CACHE_FN_QUOTA_MB`` caps each function's share so one
+model's lattice cannot evict another fleet's entries.
 """
 
 from __future__ import annotations
@@ -43,6 +45,7 @@ logger = get_logger(__name__)
 CACHE_ENV_VAR = "ACCELERATE_COMPILE_CACHE"
 CACHE_DIR_ENV_VAR = "ACCELERATE_COMPILE_CACHE_DIR"
 CACHE_MAX_MB_ENV_VAR = "ACCELERATE_COMPILE_CACHE_MAX_MB"
+CACHE_FN_QUOTA_MB_ENV_VAR = "ACCELERATE_COMPILE_CACHE_FN_QUOTA_MB"
 
 _FALSY = ("0", "false", "no", "off")
 

@@ -12,6 +12,8 @@ conventions so models/CP kernels can swap implementations freely.
 from __future__ import annotations
 
 import math
+import os
+from functools import partial
 from typing import Optional
 
 import jax
@@ -81,18 +83,188 @@ def flash_attention(
     return out.transpose(0, 2, 1, 3).astype(orig_dtype)
 
 
+def paged_kernel_mode() -> str:
+    """Dispatch mode for :func:`paged_attention`, read once per trace (the
+    engine's step functions bake it in at compile time — flipping the env var
+    mid-run does not retrace warm jit entries):
+
+    - ``"on"`` (default): Pallas decode kernel when the backend is TPU,
+      gather reference everywhere else;
+    - ``"off"`` (``ACCELERATE_PAGED_KERNEL=0``): gather reference always —
+      the kill switch, byte-identical to the pre-kernel engine;
+    - ``"interpret"`` (``ACCELERATE_PAGED_KERNEL=interpret``): the Pallas
+      kernel in interpreter mode on ANY backend — how CPU CI drives the
+      kernel's exact dataflow through the full engine."""
+    raw = os.environ.get("ACCELERATE_PAGED_KERNEL", "1").strip().lower()
+    if raw in ("0", "off", "false"):
+        return "off"
+    if raw == "interpret":
+        return "interpret"
+    return "on"
+
+
+def _paged_decode_kernel(
+    tables_ref,  # [B, W] int32 scalar-prefetch (drives the k/v index maps)
+    lens_ref,    # [B]    int32 scalar-prefetch: per-row live kv length
+    q_ref,       # [1, H, D]            this row's query
+    k_ref,       # [1, block_size, Hkv, D]  the block the index map selected
+    v_ref,       # [1, block_size, Hkv, D]
+    o_ref,       # [1, H, D]
+    acc_ref,     # VMEM [H, D] f32      online-softmax accumulators,
+    m_ref,       # VMEM [H, 1] f32      carried across the W grid steps
+    l_ref,       # VMEM [H, 1] f32
+    *,
+    block_size: int,
+    groups: int,
+    scale: float,
+):
+    """One (row, logical-block) grid step of paged flash decode.
+
+    The grid is ``(B, W)`` with the block axis innermost; the BlockSpec index
+    maps already DMA'd physical block ``tables[b, w]`` of each pool into VMEM
+    — the kernel never sees the pool, only one streamed block — so the body
+    is plain online softmax: rescale the running (max, sum, acc) by the new
+    block's contribution and normalize on the last block. Padded table
+    entries point at the null block and their positions exceed the row's
+    live length, so the same position mask that makes the gather reference
+    exact silences them here. All math is f32 on the VPU: decode attention
+    is bandwidth-bound (one query row per block), so streaming, not the MXU,
+    is what this kernel buys."""
+    from jax.experimental import pallas as pl  # deferred with pallas_call's
+
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale           # [H, D]
+    k = k_ref[0].astype(jnp.float32)                   # [bs, Hkv, D]
+    v = v_ref[0].astype(jnp.float32)
+    if groups > 1:  # GQA: every q head in a group reads its kv head's block
+        bs, hkv, d = k.shape
+        k = jnp.broadcast_to(k[:, :, None, :], (bs, hkv, groups, d)).reshape(bs, -1, d)
+        v = jnp.broadcast_to(v[:, :, None, :], (bs, hkv, groups, d)).reshape(bs, -1, d)
+    # s[h, j] = q[h] . k[j, h] — broadcast-multiply-reduce on the VPU (one
+    # query row per head: an MXU matmul would be all padding)
+    s = jnp.sum(q[:, None, :] * k.transpose(1, 0, 2), axis=-1)  # [H, bs]
+    pos = w * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < lens_ref[pl.program_id(0)], s, -jnp.inf)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))  # [H, 1]
+    # a fully-masked prefix of blocks keeps m at -inf: exp(-inf - -inf) would
+    # be NaN, so clamp the shift (everything is 0-weighted anyway)
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(m_prev - shift)                    # [H, 1]
+    p = jnp.exp(s - shift)                             # [H, bs], masked -> 0
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.sum(
+        p[:, :, None] * v.transpose(1, 0, 2), axis=1
+    )
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(w == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attention_decode(
+    q, k_pool, v_pool, block_tables, kv_lens, scale=None, *, interpret=False
+):
+    """Pallas paged flash-attention decode: q ``[B, 1, H, D]`` against
+    per-layer pools ``[num_blocks, block_size, Hkv, D]`` through
+    ``block_tables [B, W]``, with ragged per-row live lengths ``kv_lens
+    [B]``. Walks each row's block table and streams the referenced KV blocks
+    through VMEM with online softmax — the gathered ``[B, W*block_size]``
+    cache the XLA reference materializes per layer never exists.
+    ``interpret=True`` runs the identical kernel through the Pallas
+    interpreter (the CPU parity path in tier-1 CI)."""
+    from jax.experimental import pallas as pl_  # deferred: CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    if S != 1:
+        raise ValueError(f"decode kernel wants S=1 queries, got S={S}")
+    num_blocks, block_size, Hkv, _ = k_pool.shape
+    W = block_tables.shape[1]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    sm_scale = (1.0 / math.sqrt(D)) if scale is None else float(scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block tables + lengths
+        grid=(B, W),
+        in_specs=[
+            pl_.BlockSpec((1, H, D), lambda b, w, tables, lens: (b, 0, 0)),
+            pl_.BlockSpec(
+                (1, block_size, Hkv, D),
+                lambda b, w, tables, lens: (tables[b, w], 0, 0, 0),
+            ),
+            pl_.BlockSpec(
+                (1, block_size, Hkv, D),
+                lambda b, w, tables, lens: (tables[b, w], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl_.BlockSpec((1, H, D), lambda b, w, tables, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _paged_decode_kernel,
+        block_size=block_size,
+        groups=H // Hkv,
+        scale=sm_scale,
+    )
+    out = pl_.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        jnp.asarray(kv_lens, jnp.int32).reshape(B),
+        q[:, 0],
+        k_pool,
+        v_pool,
+    )
+    return out[:, None]  # [B, 1, H, D], the caller's BSHD contract
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, q_positions, scale=None):
     """Paged decode attention for the serving engine (kernel dispatch point).
 
     q ``[B, S, H, D]``; per-layer pools ``[num_blocks, block_size, Hkv, D]``;
     ``block_tables [B, W]`` (physical block ids, null-padded); ``q_positions
-    [B, S]``. Today every backend runs the XLA reference path
-    (``serving.kv_pager.paged_attention``: gather blocks by table, shared
-    masked-attention core — bitwise-identical to contiguous decode); a
-    Pallas paged-attention kernel that streams blocks through VMEM without
-    materializing the gathered cache (vLLM-style PagedAttention) is the TPU
-    upgrade and slots in HERE without touching engine callers, exactly like
-    :func:`flash_attention`'s pallas-vs-xla split."""
+    [B, S]``. Single-token decode (``S == 1``) dispatches to the Pallas
+    paged-attention kernel (:func:`paged_attention_decode`) on the TPU
+    backend — block-table walk + VMEM block streaming + online softmax, no
+    materialized gathered KV per layer. Everywhere else — prefill chunks
+    (``S > 1``), non-TPU backends, and the ``ACCELERATE_PAGED_KERNEL=0``
+    kill switch — runs the XLA reference path (``serving.kv_pager.
+    paged_attention``: gather blocks by table, shared masked-attention core
+    — bitwise-identical to contiguous decode), exactly like
+    :func:`flash_attention`'s pallas-vs-xla split.
+    ``ACCELERATE_PAGED_KERNEL=interpret`` forces the kernel (interpreter
+    mode) on any backend so CPU CI can drive the kernel dataflow through
+    the full engine."""
+    mode = paged_kernel_mode()
+    if q.shape[1] == 1 and mode != "off":
+        if mode == "interpret":
+            return paged_attention_decode(
+                q, k_pool, v_pool, block_tables, q_positions[:, 0] + 1,
+                scale, interpret=True,
+            )
+        if jax.default_backend() == "tpu":
+            return paged_attention_decode(
+                q, k_pool, v_pool, block_tables, q_positions[:, 0] + 1, scale
+            )
     from ..serving.kv_pager import paged_attention as _xla_paged
 
     return _xla_paged(q, k_pool, v_pool, block_tables, q_positions, scale)

@@ -39,6 +39,8 @@ from .kv_pager import (
     BlockAllocator,
     BlockAllocatorError,
     BlockPoolExhausted,
+    PrefixAllocation,
+    PrefixPlan,
     init_block_pool,
     paged_attention,
 )
@@ -56,6 +58,8 @@ __all__ = [
     "BlockPoolExhausted",
     "init_block_pool",
     "paged_attention",
+    "PrefixPlan",
+    "PrefixAllocation",
     "Request",
     "RequestStatus",
     "Scheduler",

@@ -114,3 +114,12 @@ class BucketLattice:
     def size(self) -> int:
         """Total compile points (the warmed jit-cache budget)."""
         return len(self.decode_points()) + len(self.prefill_points())
+
+    def warmup_points(self, prefix_cache: bool = False) -> int:
+        """Total shapes :meth:`~accelerate_tpu.serving.engine.ServingEngine.
+        warmup` visits: the lattice, plus the single copy-on-write block-copy
+        shape when prefix caching is enabled (the COW copy is one fixed-shape
+        program — ``(pool, src, dst)`` scalars — so it adds exactly one point
+        and no churn-driven shapes). This is the number the compile-cache
+        hit/miss counters and the frozen-jit-cache oracle compare against."""
+        return self.size() + (1 if prefix_cache else 0)

@@ -155,6 +155,7 @@ class ServingEngine:
         lattice: Optional[BucketLattice] = None,
         heartbeat_name: str = "serving_decode",
         compile_cache_dir: Optional[str] = None,
+        prefix_cache: bool = True,
     ):
         self.params = params
         self.config = config
@@ -165,7 +166,10 @@ class ServingEngine:
         # batched decode produces a stall dump naming this engine (replicas
         # suffix their name so a stuck replica is attributable)
         self.heartbeat_name = heartbeat_name
-        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.prefix_cache = prefix_cache
+        self.allocator = BlockAllocator(
+            num_blocks, block_size, prefix_caching=prefix_cache
+        )
         if max_blocks_per_seq is None:
             max_blocks_per_seq = self.allocator.usable_blocks
         max_prefill_len = max_prefill_len or min(
@@ -227,8 +231,18 @@ class ServingEngine:
             tok = jax.vmap(select_one)(logits[:, -1], folded)
             return pool, tok.astype(jnp.int32)
 
+        def _cow(pool, src, dst):
+            # copy-on-write for the aligned prefix-cache edge case: duplicate
+            # one physical block (all layers, K and V) into a private block
+            # before the new sequence's first write can touch shared content
+            return {
+                "k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+                "v": pool["v"].at[:, dst].set(pool["v"][:, src]),
+            }
+
         self.prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
         self.decode_fn = jax.jit(_decode, donate_argnums=(1,))
+        self.cow_fn = jax.jit(_cow, donate_argnums=(0,))
         # Persistent-compile-cache warm boot: when a cache dir is configured
         # (replacement replicas get it via ReplicaSpec.compile_cache_dir),
         # warmup AOT-compiles every lattice point through the cache — hits
@@ -244,6 +258,9 @@ class ServingEngine:
         self.decode_tokens = 0
         self.prefill_tokens = 0
         self.prefill_calls = 0
+        #: prompt tokens whose KV came straight from the prefix cache — i.e.
+        #: prefill work NOT done (the bench's ``prefill_tokens_saved``)
+        self.prefix_cached_tokens = 0
         self.max_running = 0
         self._occupancy_sum = 0.0
         self._occupancy_steps = 0
@@ -337,6 +354,21 @@ class ServingEngine:
                     self._aot[("decode", Bb, W)] = executable
                     continue
             self.pool, tok = self.decode_fn(*args)
+        if self.prefix_cache:
+            # the COW copy is one more lattice point (a single shape): warm it
+            # here — copying the null block onto itself writes nothing live
+            args = (self.pool, np.int32(NULL_BLOCK), np.int32(NULL_BLOCK))
+            done = False
+            if cache is not None:
+                executable, outcome = _ccache.aot_compile(
+                    "serving_cow", self.cow_fn, args, mesh=self.mesh, cache=cache,
+                )
+                self.cache_stats[outcome] = self.cache_stats.get(outcome, 0) + 1
+                if executable is not None:
+                    self._aot[("cow",)] = executable
+                    done = True
+            if not done:
+                self.pool = self.cow_fn(*args)
         jax.block_until_ready(self.pool)
         counts = self.jit_cache_sizes()
         if tel.is_enabled():
@@ -355,10 +387,15 @@ class ServingEngine:
         equal the lattice sizes forever."""
         aot_prefill = sum(1 for k in self._aot if k[0] == "prefill")
         aot_decode = sum(1 for k in self._aot if k[0] == "decode")
-        return {
+        out = {
             "prefill_compiles": int(self.prefill_fn._cache_size()) + aot_prefill,
             "decode_compiles": int(self.decode_fn._cache_size()) + aot_decode,
         }
+        if self.prefix_cache:
+            out["cow_compiles"] = int(self.cow_fn._cache_size()) + (
+                1 if ("cow",) in self._aot else 0
+            )
+        return out
 
     # -- the step loop -------------------------------------------------------
 
@@ -377,6 +414,7 @@ class ServingEngine:
 
         prefills = 0
         prefill_tokens_before = self.prefill_tokens
+        prefix_cached_before = self.prefix_cached_tokens
         admitted = self.scheduler.admissions()
         while self.scheduler.rejected:
             req = self.scheduler.rejected.pop()
@@ -434,6 +472,7 @@ class ServingEngine:
                 occupancy=round(occupancy, 6),
                 prefills=prefills,
                 prefill_tokens=self.prefill_tokens - prefill_tokens_before,
+                prefix_hit_tokens=self.prefix_cached_tokens - prefix_cached_before,
                 decode_tokens=len(running),
                 preemptions=self.scheduler.preemption_count,
                 free_blocks=alloc["free_blocks"],
@@ -463,18 +502,36 @@ class ServingEngine:
         return req._key
 
     def _prefill_request(self, req: Request, now: float) -> None:
-        """Prefill the request's full prefix in length-bucketed CHUNKS: each
-        chunk runs at the smallest covering prefill bucket (the largest
-        bucket for all but the tail), so arbitrarily long prefixes — e.g. a
-        resumed request's prompt + generated — stay inside the compiled
-        lattice. Only the final chunk's sampled token is kept."""
+        """Prefill the request's UNCACHED prefix tail in length-bucketed
+        CHUNKS: each chunk runs at the smallest covering prefill bucket (the
+        largest bucket for all but the tail), so arbitrarily long prefixes —
+        e.g. a resumed request's prompt + generated — stay inside the
+        compiled lattice. Only the final chunk's sampled token is kept.
+
+        Prefix-cache admission already mapped the cached blocks into the
+        table: ``req.cached_tokens`` leading positions hold valid KV and are
+        skipped (the attention inside each chunk reads them through the block
+        table, so the math is position-exact and bitwise-identical to an
+        unshared run). A pending copy-on-write pair is applied to the pool
+        FIRST — the one write this request aims below its uncached tail goes
+        into its private copy, never a shared block."""
         prefix = req.output_ids()
+        if req.cow_block is not None:
+            src, dst = req.cow_block
+            fn = self._aot.get(("cow",), self.cow_fn)
+            self.pool = fn(self.pool, np.int32(src), np.int32(dst))
+            # the copy is issued (ordered before any later pool op): release
+            # the allocator's pin so src can park in the reclaimable pool
+            self.allocator.cow_done(src)
+            req.cow_block = None
         W = self.lattice.prefill_points()[0][1]
         table = self.allocator.block_table(req.rid, pad_to=W)[None]
         chunk_cap = self.lattice.prefill_buckets[-1]
         key = self._request_key(req)
         token_idx = np.int32(len(req.generated))
-        start = 0
+        start = int(req.cached_tokens)
+        self.prefix_cached_tokens += start
+        self.prefill_tokens += int(prefix.size) - start
         while start < prefix.size:
             chunk = prefix[start : start + chunk_cap]
             Sb = self.lattice.prefill_bucket(chunk.size)
@@ -489,7 +546,6 @@ class ServingEngine:
         req.generated.append(int(tok))
         if req.first_token_t is None:
             req.first_token_t = now
-        self.prefill_tokens += int(prefix.size)
         self.prefill_calls += 1
 
     def _decode_batch(self, running: "list[Request]") -> None:
@@ -515,6 +571,16 @@ class ServingEngine:
         toks = np.asarray(jax.device_get(toks))
         for i, req in enumerate(running):
             req.generated.append(int(toks[i]))
+            if self.prefix_cache:
+                # this decode wrote position prefix_len-2's token (the last
+                # PREVIOUS token) — when the written count crosses a block
+                # boundary, the just-filled block becomes immutable and
+                # content-indexable for future prefix matches
+                written = req.prefix_len - 1
+                if written > 0 and written % self.block_size == 0:
+                    self.allocator.register_full_blocks(
+                        req.rid, req.output_ids()[:-1]
+                    )
         self.decode_tokens += len(running)
 
     def _emit_completion(self, req: Request) -> None:
@@ -533,7 +599,7 @@ class ServingEngine:
         )
 
     def stats(self) -> dict:
-        return {
+        out = {
             "steps": self.steps,
             "decode_tokens": self.decode_tokens,
             "prefill_tokens": self.prefill_tokens,
@@ -546,3 +612,16 @@ class ServingEngine:
             **self.jit_cache_sizes(),
             **self.allocator.stats(),
         }
+        if self.prefix_cache:
+            # hit rate over PROMPT tokens: cached / (cached + actually
+            # prefilled) — the fraction of prefill work the cache deleted
+            total = self.prefix_cached_tokens + self.prefill_tokens
+            # cow_copies rides in from allocator.stats() above — the
+            # allocator's count is the single source (every allocated COW
+            # pair is applied in the same step's prefill phase)
+            out.update(
+                prefill_tokens_saved=self.prefix_cached_tokens,
+                prefix_hit_rate=round(self.prefix_cached_tokens / total, 6)
+                if total else 0.0,
+            )
+        return out

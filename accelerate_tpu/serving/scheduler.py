@@ -77,6 +77,12 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     error: Optional[str] = None  # set when REJECTED
+    # prefix-cache admission state, overwritten on EVERY admission (a resume
+    # re-plans against the index as it stands then): how many leading prefix
+    # tokens are already cached (the engine prefills only past them), and the
+    # pending copy-on-write pair the engine must apply before any write
+    cached_tokens: int = 0
+    cow_block: "Optional[tuple[int, int]]" = None
     # engine-side PRNGKey cache (pure function of rng_seed)
     _key: Optional[np.ndarray] = field(default=None, repr=False, init=False)
 
@@ -184,11 +190,24 @@ class Scheduler:
             if slot is None:
                 break
             req = self.queue[0]
-            need = self.allocator.blocks_for(req.prefix_len)
+            prefix_tokens = req.output_ids()
+            # admission charges only UNCACHED blocks: the plan maps the
+            # longest cached block-aligned prefix for free, and the watermark
+            # compares the fresh-tail cost against free + reclaimable blocks
+            # (with caching off the plan degenerates to blocks_for(prefix))
+            plan = self.allocator.plan_prefix(prefix_tokens)
+            # fresh blocks the tail takes, plus LRU-parked matched blocks this
+            # mapping will pin (they count as available today but can't also
+            # serve as fresh blocks — without the charge the allocation below
+            # could throw on a plan admission just green-lit)
+            need = plan.fresh_blocks + plan.lru_pinned
             # worst case the sequence can reach: its current prefix plus every
             # remaining token it may generate
             remaining = max(0, req.max_new_tokens - len(req.generated))
             worst_tokens = req.prefix_len + remaining
+            # the block-WIDTH cap charges the full table (shared blocks widen
+            # the gather exactly like private ones); only the pool check is
+            # prefix-aware
             worst = self.allocator.blocks_for(worst_tokens)
             reason = None
             if self.max_seq_tokens is not None and worst_tokens > self.max_seq_tokens:
@@ -212,10 +231,14 @@ class Scheduler:
                 req.error = "rejected: " + reason
                 self.rejected.append(req)
                 continue
-            if need + self.admit_watermark_blocks > self.allocator.free_blocks:
+            if need + self.admit_watermark_blocks > self.allocator.available_blocks:
                 break  # pool pressure: let running sequences drain first
             self.queue.popleft()
-            self.allocator.allocate(req.rid, req.prefix_len)
+            alloc = self.allocator.allocate_with_prefix(
+                req.rid, prefix_tokens, plan=plan
+            )
+            req.cached_tokens = alloc.cached_tokens
+            req.cow_block = alloc.cow
             req.status = RequestStatus.RUNNING
             req.slot = slot
             self.slots[slot] = req

@@ -448,6 +448,10 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
         return None
     decode_tokens = sum(int(s.get("decode_tokens", 0)) for s in steps)
     prefill_tokens = sum(int(s.get("prefill_tokens", 0)) for s in steps)
+    # prompt tokens served straight from the prefix cache (prefill skipped);
+    # hit rate is over ALL prompt tokens = saved / (saved + prefilled)
+    prefix_hit_tokens = sum(int(s.get("prefix_hit_tokens", 0)) for s in steps)
+    prompt_tokens = prefix_hit_tokens + prefill_tokens
     ts = sorted(float(s.get("t", 0.0)) for s in steps)
     span = ts[-1] - ts[0] if len(ts) >= 2 else 0.0
     completed = [r for r in reqs if not r.get("error")]
@@ -459,6 +463,10 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
         "fragmentation": _dist([float(s.get("fragmentation", 0.0)) for s in steps]),
         "decode_tokens": decode_tokens,
         "prefill_tokens": prefill_tokens,
+        "prefill_tokens_saved": prefix_hit_tokens,
+        "prefix_hit_rate": (
+            round(prefix_hit_tokens / prompt_tokens, 6) if prompt_tokens else 0.0
+        ),
         "tokens_per_s": round(decode_tokens / span, 2) if span > 0 else None,
         "preemptions": max((int(s.get("preemptions", 0)) for s in steps), default=0),
         "requests": {
@@ -1010,6 +1018,11 @@ def format_serving_section(serving: dict) -> str:
             f"queue depth p50={qd['p50']:.1f} max={qd['max']:.0f}  "
             f"block occupancy p50={blk['p50']:.2f} max={blk['max']:.2f}"
         )
+    if serving.get("prefill_tokens_saved"):
+        lines.append(
+            f"  prefix cache: {serving['prefill_tokens_saved']} prefill token(s) "
+            f"saved (hit rate {serving['prefix_hit_rate']:.1%})"
+        )
     if serving.get("preemptions"):
         lines.append(f"  preemptions: {serving['preemptions']} (pool pressure evictions)")
     reqs = serving.get("requests") or {}
@@ -1456,6 +1469,16 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("persistent compile cache", False, f"{type(exc).__name__}: {exc}")
 
+        # 15. prefix-cached paged KV + copy-on-write (ISSUE 14): two requests
+        # sharing a long prefix then diverging must produce outputs
+        # bitwise-equal to unshared single-stream runs, shared blocks must
+        # never be freed while referenced (pool-churn use-after-free probe),
+        # and the jit caches must stay frozen post-warmup with the cache on
+        try:
+            _doctor_prefix_cache(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("prefix cache + COW", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -1638,6 +1661,93 @@ def _doctor_serving(tmp: str, _check) -> None:
         ok,
         f"mismatched={mismatched} max_running={stats['max_running']} "
         f"caches={engine.jit_cache_sizes()} warmed={warmed}",
+    )
+
+
+def _doctor_prefix_cache(tmp: str, _check) -> None:
+    """Doctor check 15 body: automatic prefix caching with copy-on-write must
+    be INVISIBLE in every output. Two requests share a long block-aligned
+    prefix then diverge; the first finishes and frees while the second still
+    decodes, and fresh requests are submitted immediately after so any
+    erroneously-freed shared block would be reclaimed and overwritten under
+    the survivor (the use-after-free probe — corruption would break its
+    bitwise parity). Requires (a) every completion bitwise-equal to its
+    unshared single-stream ``greedy_generate`` reference, (b) the shared
+    prefix actually shared (shared block count and hit tokens > 0 mid-flight),
+    (c) jit caches frozen at the warmed counts with the cache enabled, and
+    (d) the serving report section renders the prefix-cache savings line."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..generation import greedy_generate
+    from ..models import LlamaConfig, init_llama
+    from ..serving import BucketLattice, RequestStatus, ServingEngine
+    from . import events as tel_events
+
+    config = LlamaConfig.tiny()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    serve_dir = os.path.join(tmp, "prefix_cache")
+    tel_events.enable(out_dir=serve_dir, run_id="doctor-prefix-cache")
+    try:
+        engine = ServingEngine(
+            params, config, num_blocks=33, block_size=8, max_slots=4,
+            lattice=BucketLattice(
+                slot_buckets=(2, 4), block_buckets=(8,), prefill_buckets=(32,)
+            ),
+            prefix_cache=True,
+        )
+        warmed = engine.warmup()
+        rng = np.random.default_rng(15)
+        shared = rng.integers(0, config.vocab_size, (24,)).astype(np.int32)  # 3 full blocks
+        tails = [rng.integers(0, config.vocab_size, (n,)).astype(np.int32)
+                 for n in (6, 10)]
+        prompts = [np.concatenate([shared, t]) for t in tails]
+        a = engine.submit(prompts[0], 6, rng_seed=0)
+        engine.step()  # a prefilled: its full blocks are content-indexed
+        b = engine.submit(prompts[1], 14, rng_seed=1)
+        engine.step()  # b admitted: maps a's 3 shared blocks (refcount 2)
+        shared_mid = engine.allocator.shared_blocks()
+        reqs = [a, b]
+        churned = False
+        while not engine.scheduler.idle():
+            engine.step()
+            if not churned and a.status is RequestStatus.FINISHED:
+                # a freed its references while b still decodes: flood the pool
+                # with fresh requests so a wrongly-freed shared block would be
+                # reclaimed and OVERWRITTEN under b before it finishes
+                churned = True
+                for i in (2, 3):
+                    p = rng.integers(0, config.vocab_size, (20,)).astype(np.int32)
+                    prompts.append(p)
+                    reqs.append(engine.submit(p, 8, rng_seed=i))
+    finally:
+        tel_events.disable()
+    mismatched = []
+    for i, req in enumerate(reqs):
+        ref = greedy_generate(
+            params, prompts[i][None], config, max_new_tokens=req.max_new_tokens
+        )
+        if not np.array_equal(np.asarray(ref[0]), req.output_ids()):
+            mismatched.append(i)
+    hit_tokens = engine.allocator.prefix_hit_tokens
+    text = format_report(build_report([serve_dir]))
+    ok = (
+        not mismatched
+        and churned
+        and shared_mid >= 3
+        and hit_tokens >= 24
+        and engine.jit_cache_sizes() == warmed
+        and "prefix cache:" in text
+    )
+    _check(
+        "prefix cache + COW",
+        ok,
+        f"mismatched={mismatched} churned={churned} shared_mid={shared_mid} "
+        f"hit_tokens={hit_tokens} caches={engine.jit_cache_sizes()} warmed={warmed}",
     )
 
 

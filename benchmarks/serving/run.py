@@ -35,32 +35,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from _common import detect_backend, emit, percentile as _percentile
 
 
-def build_workload(n_requests, seed, prompt_lens, new_tokens, rate, vocab_size):
+def build_workload(n_requests, seed, prompt_lens, new_tokens, rate, vocab_size,
+                   shared_len=0):
     """Seeded open-loop arrival schedule: [(arrival_step, prompt, max_new)].
-    ``rate`` is mean arrivals per engine step (Poisson: exponential gaps)."""
+    ``rate`` is mean arrivals per engine step (Poisson: exponential gaps).
+    ``shared_len > 0`` prepends one shared head of that many tokens to every
+    prompt (``prompt_lens`` then sizes the private suffix) — the system-prompt
+    workload shape automatic prefix caching exists to exploit."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
+    shared = rng.integers(0, vocab_size, (shared_len,)).astype(np.int32)
     t = 0.0
     workload = []
     for _ in range(n_requests):
         t += rng.exponential(1.0 / rate)
-        prompt = rng.integers(0, vocab_size, (int(rng.integers(*prompt_lens)),))
-        workload.append((int(t), prompt.astype(np.int32), int(rng.integers(*new_tokens))))
+        suffix = rng.integers(0, vocab_size, (int(rng.integers(*prompt_lens)),))
+        prompt = np.concatenate([shared, suffix.astype(np.int32)])
+        workload.append((int(t), prompt, int(rng.integers(*new_tokens))))
     return workload
 
 
-def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
-            block_size, lattice):
-    """One scheduling policy over the shared workload; returns its metrics."""
-    from accelerate_tpu.serving import RequestStatus, ServingEngine
+def _drive(engine, workload):
+    """Open-loop drive: submit each request at its arrival step, step the
+    engine while work is live, idle-tick otherwise. Returns (terminal
+    requests partitioned FINISHED/other, wall seconds)."""
+    from accelerate_tpu.serving import RequestStatus
 
-    engine = ServingEngine(
-        params, config, num_blocks=num_blocks, block_size=block_size,
-        max_slots=max_slots, lattice=lattice, continuous=continuous,
-    )
-    engine.warmup()  # all buckets compiled before the clock starts
-    completed = []
+    terminal = []
     next_req = 0
     step = 0
     t0 = time.monotonic()
@@ -72,15 +74,29 @@ def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
         if engine.scheduler.idle():
             step += 1  # idle tick: nothing due yet, no device work
             continue
-        completed.extend(engine.step())
+        terminal.extend(engine.step())
         step += 1
     wall = time.monotonic() - t0
+    finished = [r for r in terminal if r.status is RequestStatus.FINISHED]
+    other = [r for r in terminal if r.status is not RequestStatus.FINISHED]
+    return finished, other, wall
+
+
+def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
+            block_size, lattice):
+    """One scheduling policy over the shared workload; returns its metrics."""
+    from accelerate_tpu.serving import ServingEngine
+
+    engine = ServingEngine(
+        params, config, num_blocks=num_blocks, block_size=block_size,
+        max_slots=max_slots, lattice=lattice, continuous=continuous,
+    )
+    engine.warmup()  # all buckets compiled before the clock starts
     # step() also returns REJECTED requests (pool/lattice misconfiguration):
     # keep them out of the throughput/latency aggregates — and out of the
     # continuous/static comparison — but report them (a silently shrunken
     # workload would fake the ratio)
-    rejected = [r for r in completed if r.status is not RequestStatus.FINISHED]
-    completed = [r for r in completed if r.status is RequestStatus.FINISHED]
+    completed, rejected, wall = _drive(engine, workload)
     tokens = sum(len(r.generated) for r in completed)
     latencies = [r.finish_t - r.arrival_t for r in completed]
     ttfts = [r.first_token_t - r.arrival_t for r in completed if r.first_token_t]
@@ -227,6 +243,125 @@ def run_bench_replicated(
     }
 
 
+def run_prefix_leg(params, config, workload, *, prefix_cache, max_slots,
+                   num_blocks, block_size, lattice):
+    """One prefix-cache setting over the shared-prefix workload; returns the
+    leg metrics, every request's output tokens (for the cross-leg bitwise
+    parity check) and the post-warmup recompile count (must be 0 — the cache
+    introduces no new shapes). Rejected requests are reported, not silently
+    dropped (a shrunken workload would fake the prefill-token reduction)."""
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry.step_profiler import RecompileWatcher
+
+    engine = ServingEngine(
+        params, config, num_blocks=num_blocks, block_size=block_size,
+        max_slots=max_slots, lattice=lattice, prefix_cache=prefix_cache,
+    )
+    engine.warmup()
+    watcher = RecompileWatcher()
+    watcher.register("prefill", engine.prefill_fn)
+    watcher.register("decode", engine.decode_fn)
+    if prefix_cache:
+        # the COW block copy is the one jit fn the cache adds: the
+        # zero-recompile signal must watch it too
+        watcher.register("cow", engine.cow_fn)
+    completed, rejected, wall = _drive(engine, workload)
+    tokens = sum(len(r.generated) for r in completed)
+    ttfts = [r.first_token_t - r.arrival_t for r in completed if r.first_token_t]
+    stats = engine.stats()
+    outputs = {r.rid: [int(t) for t in r.output_ids()] for r in completed}
+    return {
+        "prefix_cache": prefix_cache,
+        "completed": len(completed),
+        "rejected": len(rejected),
+        "tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+        "p50_ttft_ms": round(_percentile(ttfts, 50) * 1e3, 2),
+        "prefill_tokens": stats["prefill_tokens"],
+        "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+        "prefill_tokens_saved": stats.get("prefill_tokens_saved", 0),
+        "cow_copies": stats.get("cow_copies", 0),
+        "recompiles": sum(watcher.poll(emit=False).values()),
+    }, [outputs[k] for k in sorted(outputs)]
+
+
+def run_bench_prefix_cache(
+    on_tpu: bool,
+    requests: int = 24,
+    rate: float = 2.0,
+    seed: int = 0,
+    max_slots: int = 4,
+    num_blocks: int = 97,
+    block_size: int = 8,
+) -> dict:
+    """The shared-prefix leg (ISSUE 14): ONE seeded Poisson workload whose
+    prompts share a long system prompt, replayed with the prefix cache on
+    and off. The cache-on leg must cut prefill tokens (the `value` is the
+    measured reduction), improve tok/s and TTFT p50, produce bitwise
+    -identical outputs per request, and stay recompile-free — the
+    acceptance line `make bench-serve` holds."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig, init_llama
+    from accelerate_tpu.serving import BucketLattice
+
+    if on_tpu:
+        config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+                             n_kv_heads=8, max_seq_len=512)
+        shared_len, suffix_lens, new_tokens = 128, (8, 48), (8, 32)
+        max_slots, num_blocks, block_size = max(max_slots, 8), 320, 16
+    else:
+        config = LlamaConfig.tiny()
+        # a long shared system prompt vs short private suffixes: the
+        # workload shape where prefix caching pays (most prompt tokens are
+        # the shared head, so the cached leg's prefill runs a small bucket
+        # instead of the largest)
+        shared_len, suffix_lens, new_tokens = 64, (2, 14), (2, 20)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    max_len = shared_len + suffix_lens[1] + new_tokens[1]
+    lattice = BucketLattice.from_limits(
+        max_slots, -(-max_len // block_size) + 1, shared_len + suffix_lens[1]
+    )
+    workload = build_workload(
+        requests, seed, suffix_lens, new_tokens, rate, config.vocab_size,
+        shared_len=shared_len,
+    )
+    kw = dict(max_slots=max_slots, num_blocks=num_blocks,
+              block_size=block_size, lattice=lattice)
+    cached, cached_out = run_prefix_leg(params, config, workload,
+                                        prefix_cache=True, **kw)
+    plain, plain_out = run_prefix_leg(params, config, workload,
+                                      prefix_cache=False, **kw)
+    reduction = (
+        1.0 - cached["prefill_tokens"] / plain["prefill_tokens"]
+        if plain["prefill_tokens"] else 0.0
+    )
+    return {
+        "bench": "serving_prefix_cache",
+        "unit": "prefill_token_reduction(cached vs off)",
+        "value": round(reduction, 4),
+        "cached": cached,
+        "uncached": plain,
+        "prefix_hit_rate": cached["prefix_hit_rate"],
+        "prefill_tokens_saved": cached["prefill_tokens_saved"],
+        "tokens_per_s_ratio": round(
+            cached["tokens_per_s"] / max(plain["tokens_per_s"], 1e-9), 3
+        ),
+        "ttft_p50_ratio": round(
+            cached["p50_ttft_ms"] / max(plain["p50_ttft_ms"], 1e-9), 3
+        ),
+        "outputs_match": cached_out == plain_out,
+        "zero_recompiles": cached["recompiles"] == 0 and plain["recompiles"] == 0,
+        "requests": requests,
+        "shared_prefix_len": shared_len,
+        "on_tpu": on_tpu,
+    }
+
+
 def run_bench_serving(
     on_tpu: bool,
     requests: int = 32,
@@ -299,6 +434,8 @@ if __name__ == "__main__":
     ap.add_argument("--replicated-requests", type=int, default=16,
                     help="workload size for the router leg (0 skips it)")
     ap.add_argument("--n-replicas", type=int, default=2)
+    ap.add_argument("--prefix-requests", type=int, default=24,
+                    help="workload size for the shared-prefix leg (0 skips it)")
     args = ap.parse_args()
     on_tpu = detect_backend()
     out = run_bench_serving(
@@ -319,5 +456,12 @@ if __name__ == "__main__":
             max_slots=args.max_slots,
             num_blocks=args.num_blocks,
             block_size=args.block_size,
+        )
+    if args.prefix_requests > 0:
+        out["prefix_cache"] = run_bench_prefix_cache(
+            on_tpu=on_tpu,
+            requests=args.prefix_requests,
+            rate=args.rate,
+            seed=args.seed,
         )
     emit(out)

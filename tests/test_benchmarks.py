@@ -125,8 +125,8 @@ def test_serving_benchmark_smoke():
     out = run_script(
         "benchmarks/serving/run.py",
         "--requests", "12", "--rate", "2.0", "--max-slots", "4",
-        "--replicated-requests", "8",
-        timeout=420,
+        "--replicated-requests", "8", "--prefix-requests", "10",
+        timeout=600,
     )
     assert out["bench"] == "serving"
     assert out["unit"] == "throughput_ratio(continuous/static)"
@@ -152,6 +152,21 @@ def test_serving_benchmark_smoke():
     assert rep["replica_kill"]["failovers"] >= 1
     assert rep["kill_outputs_match_unkilled"] is True
     assert rep["replica_kill"]["p99_latency_ms"] >= rep["replica_kill"]["p50_latency_ms"]
+    # shared-prefix leg (ISSUE 14): the deterministic invariants hold even at
+    # reduced scale — prefill-token reduction is a token COUNT, not a wall
+    # clock, so the ≥40% acceptance bar is assertable here; the wall-clock
+    # tok/s and ttft improvements are asserted by `make bench-serve` at full
+    # scale and only sanity-checked (> 0) under CI load
+    pc = out["prefix_cache"]
+    assert pc["bench"] == "serving_prefix_cache"
+    assert pc["value"] >= 0.4  # prefill tokens cut by at least 40%
+    assert pc["prefix_hit_rate"] > 0
+    assert pc["prefill_tokens_saved"] > 0
+    assert pc["outputs_match"] is True  # bitwise parity between the legs
+    assert pc["zero_recompiles"] is True
+    assert pc["cached"]["completed"] == pc["uncached"]["completed"] == 10
+    assert pc["cached"]["rejected"] == pc["uncached"]["rejected"] == 0
+    assert pc["tokens_per_s_ratio"] > 0 and pc["ttft_p50_ratio"] > 0
 
 
 def test_compile_time_restart_benchmark_smoke():

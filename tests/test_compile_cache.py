@@ -308,8 +308,9 @@ def test_true_rename_race_loser_discards(tmp_path, step_fn, step_args, monkeypat
 # eviction
 
 
-def _fake_entry(cache_dir, key_id, nbytes=1024, mtime=None):
-    """Hand-built committed entry (content is irrelevant to eviction)."""
+def _fake_entry(cache_dir, key_id, nbytes=1024, mtime=None, fn=None):
+    """Hand-built committed entry (content is irrelevant to eviction);
+    ``fn`` labels the manifest for the per-function quota grouping."""
     import zlib
 
     entry = os.path.join(str(cache_dir), key_id)
@@ -317,7 +318,7 @@ def _fake_entry(cache_dir, key_id, nbytes=1024, mtime=None):
     payload = os.urandom(nbytes)
     open(os.path.join(entry, cc.PAYLOAD_NAME), "wb").write(payload)
     json.dump(
-        {"schema": cc.SCHEMA_VERSION, "key": {}, "fn": key_id,
+        {"schema": cc.SCHEMA_VERSION, "key": {}, "fn": fn or key_id,
          "payload": {"file": cc.PAYLOAD_NAME, "bytes": nbytes,
                      "crc32": zlib.crc32(payload) & 0xFFFFFFFF}},
         open(os.path.join(entry, cc.MANIFEST_NAME), "w"),
@@ -353,6 +354,57 @@ def test_eviction_skips_entry_open_for_read(tmp_path):
     finally:
         reader.close()
     assert cache.evict(max_mb=0.0) == [victim]  # released: now evictable
+
+
+def test_eviction_hit_refreshes_recency(tmp_path, step_fn, step_args):
+    """GC is LRU-by-last-HIT, not oldest-write: a load stamps the entry's
+    recency, so the executable a fleet actually reloads outlives a
+    never-read entry written later (ISSUE 14 compile-cache GC upgrade)."""
+    assert _populate(tmp_path, step_fn, step_args) == "miss"
+    cache = CompileCache(str(tmp_path))
+    (hot,) = cache.entries()
+    os.utime(hot, (1_000, 1_000))  # backdate: oldest-write would evict it
+    stale = _fake_entry(tmp_path, "b" * 24, nbytes=600 * 1024, mtime=2_000)
+    key = cc.key_from_lowered("step", step_fn.lower(*step_args))
+    assert cache.load(key).outcome == "hit"  # stamps LAST_HIT on `hot`
+    assert os.path.isfile(os.path.join(hot, cc.LAST_HIT_NAME))
+    assert cache.entries() == [stale, hot]  # recency order flipped
+    evicted = cache.evict(max_mb=0.55)
+    assert stale in evicted and hot not in evicted and os.path.isdir(hot)
+    # and the hot entry still loads after the GC pass
+    assert cache.load(key).outcome == "hit"
+
+
+def test_eviction_fn_quota_spares_other_fns(tmp_path):
+    """Per-fn quota: a function over its share sheds its OWN least-recently
+    -hit entries; another function's globally-older entry is untouched."""
+    a1 = _fake_entry(tmp_path, "a" * 24, nbytes=500 * 1024, mtime=1_000, fn="lattice")
+    a2 = _fake_entry(tmp_path, "b" * 24, nbytes=500 * 1024, mtime=2_000, fn="lattice")
+    a3 = _fake_entry(tmp_path, "c" * 24, nbytes=500 * 1024, mtime=3_000, fn="lattice")
+    b1 = _fake_entry(tmp_path, "d" * 24, nbytes=500 * 1024, mtime=1_500, fn="train_step")
+    cache = CompileCache(str(tmp_path), fn_quota_mb=1.0)
+    evicted = cache.evict()  # quota enforcement needs no global cap
+    # lattice holds 1.5MB against a 1MB share: its LRU entry goes; train_step
+    # is under quota, so its OLDER entry survives a pass that oldest-write
+    # eviction would have taken it in
+    assert evicted == [a1]
+    assert os.path.isdir(b1) and os.path.isdir(a2) and os.path.isdir(a3)
+    assert not os.path.isdir(a1)
+
+
+def test_eviction_fn_quota_env_knob_then_global_cap(tmp_path, monkeypatch):
+    """The env knob wires the quota, and the global cap still applies after
+    the quota pass — across functions, least-recently-hit first."""
+    a1 = _fake_entry(tmp_path, "a" * 24, nbytes=400 * 1024, mtime=1_000, fn="lattice")
+    a2 = _fake_entry(tmp_path, "b" * 24, nbytes=400 * 1024, mtime=3_000, fn="lattice")
+    b1 = _fake_entry(tmp_path, "c" * 24, nbytes=400 * 1024, mtime=2_000, fn="train_step")
+    monkeypatch.setenv(cc.CACHE_FN_QUOTA_MB_ENV_VAR, "0.5")
+    cache = CompileCache(str(tmp_path))
+    evicted = cache.evict(max_mb=0.5)
+    # quota pass: lattice (800KB > 512KB) drops a1; cap pass: 800KB total
+    # still > 512KB, so the globally least-recently-hit survivor (b1) goes
+    assert evicted == [a1, b1]
+    assert os.path.isdir(a2)
 
 
 def test_store_applies_env_cap_but_protects_fresh_entry(tmp_path, step_fn, step_args, monkeypatch):
@@ -534,13 +586,16 @@ def test_serving_warmup_loads_full_lattice_from_cache(tmp_path, tiny_engine_part
         return engine, counts
 
     cold, counts_cold = boot()
-    assert cold.cache_stats["miss"] == lattice.size() and cold.cache_stats["hit"] == 0
+    # the prefix-cache COW copy is one more warmed point (ISSUE 14)
+    points = lattice.warmup_points(prefix_cache=True)
+    assert cold.cache_stats["miss"] == points and cold.cache_stats["hit"] == 0
     warm, counts_warm = boot()
     # the FULL lattice loaded: every point a hit, zero compiles
-    assert warm.cache_stats["hit"] == lattice.size() and warm.cache_stats["miss"] == 0
+    assert warm.cache_stats["hit"] == points and warm.cache_stats["miss"] == 0
     assert counts_cold == counts_warm == {
         "prefill_compiles": len(lattice.prefill_points()),
         "decode_compiles": len(lattice.decode_points()),
+        "cow_compiles": 1,
     }
     # bitwise: the warm replica serves exactly what the cold one does, and
     # exactly what an uncached engine does
@@ -584,7 +639,7 @@ def test_serving_warmup_with_poisoned_cache_falls_back(tmp_path, tiny_engine_par
         blob[len(blob) // 2] ^= 0xFF
         open(payload, "wb").write(bytes(blob))
     engine = boot()  # must not crash; compiles fresh
-    assert engine.cache_stats["corrupt"] == lattice.size()
+    assert engine.cache_stats["corrupt"] == lattice.warmup_points(prefix_cache=True)
     assert cache.stats()["quarantined"] >= lattice.size()
     prompt = (np.arange(1, 9) % 63).astype(np.int32)
     req = engine.submit(prompt, 4, rng_seed=1)

@@ -1,0 +1,229 @@
+"""Pallas paged-attention decode kernel (ISSUE 14): interpret-mode parity.
+
+The kernel (``ops.flash_attention.paged_attention_decode``) walks block
+tables and streams KV blocks through VMEM with online softmax; the XLA
+gather path (``serving.kv_pager.paged_attention``) is the reference
+semantics. These tests drive the SAME kernel through the Pallas interpreter
+on CPU — identical dataflow, no TPU required — and hold the line the
+acceptance criteria name: parity across scrambled non-contiguous block
+tables, GQA head ratios, ragged per-slot lengths, null-block rows, and
+tables aliased at a copy-on-write divergence point; plus the
+``ACCELERATE_PAGED_KERNEL`` dispatch/kill-switch contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.generation import greedy_generate
+from accelerate_tpu.models import LlamaConfig, init_llama
+from accelerate_tpu.ops.flash_attention import (
+    paged_attention as dispatch_paged,
+    paged_attention_decode,
+    paged_kernel_mode,
+)
+from accelerate_tpu.serving import BucketLattice, ServingEngine
+from accelerate_tpu.serving.kv_pager import NULL_BLOCK, paged_attention as gather_ref
+
+CONFIG = LlamaConfig.tiny()
+
+
+def _random_paged_case(seed, *, B, H, Hkv, D, bs, nb, W, lens):
+    """A pool full of garbage with each row's live tokens scattered over a
+    scrambled block table; returns (q, k_pool, v_pool, tables, lens)."""
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((B, 1, H, D)).astype(np.float32)
+    k_pool = rng.standard_normal((nb, bs, Hkv, D)).astype(np.float32)
+    v_pool = rng.standard_normal((nb, bs, Hkv, D)).astype(np.float32)
+    # hand out distinct non-null physical blocks in a scrambled order
+    perm = rng.permutation(np.arange(1, nb))
+    tables = np.full((B, W), NULL_BLOCK, np.int32)
+    used = 0
+    for b, n in enumerate(lens):
+        need = -(-int(n) // bs)
+        tables[b, :need] = perm[used : used + need]
+        used += need
+    return q, k_pool, v_pool, tables, np.asarray(lens, np.int32)
+
+
+def _assert_parity(q, k_pool, v_pool, tables, lens, tol=1e-6):
+    qj = jnp.asarray(q)
+    kj, vj = jnp.asarray(k_pool), jnp.asarray(v_pool)
+    tj = jnp.asarray(tables)
+    ref = gather_ref(qj, kj, vj, tj, jnp.asarray(lens - 1)[:, None])
+    out = paged_attention_decode(qj, kj, vj, tj, jnp.asarray(lens), interpret=True)
+    err = float(jnp.max(jnp.abs(ref.astype(jnp.float32) - out.astype(jnp.float32))))
+    assert err <= tol, f"kernel diverged from gather reference by {err}"
+
+
+@pytest.mark.smoke
+def test_kernel_parity_scrambled_tables_ragged_lengths():
+    case = _random_paged_case(
+        0, B=4, H=8, Hkv=2, D=32, bs=8, nb=24, W=5, lens=[37, 10, 40, 1]
+    )
+    _assert_parity(*case)
+
+
+@pytest.mark.parametrize("H,Hkv", [(4, 4), (8, 4), (8, 2), (8, 1)])
+def test_kernel_parity_across_gqa_ratios(H, Hkv):
+    case = _random_paged_case(
+        1, B=2, H=H, Hkv=Hkv, D=16, bs=4, nb=16, W=4, lens=[13, 7]
+    )
+    _assert_parity(*case)
+
+
+def test_kernel_parity_null_block_rows():
+    """Inactive batch slots point every table entry at the null block with a
+    1-token length — the kernel must produce exactly what the gather
+    reference produces for them (the engine discards these rows, but a NaN
+    would poison the batched output buffer)."""
+    q, k_pool, v_pool, tables, lens = _random_paged_case(
+        2, B=3, H=4, Hkv=2, D=16, bs=4, nb=9, W=3, lens=[9, 5, 11]
+    )
+    tables[1, :] = NULL_BLOCK  # dead slot
+    lens[1] = 1
+    out = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True,
+    )
+    assert bool(jnp.all(jnp.isfinite(out)))
+    _assert_parity(q, k_pool, v_pool, tables, lens)
+
+
+def test_kernel_parity_at_cow_divergence_point():
+    """Two rows share every block except the last (the post-COW layout: a
+    common cached prefix, then private diverged tails) — aliased physical
+    blocks across tables must read identically for the shared part and
+    independently past the divergence."""
+    rng = np.random.default_rng(3)
+    B, H, Hkv, D, bs, nb = 2, 4, 2, 16, 4, 10
+    q = rng.standard_normal((B, 1, H, D)).astype(np.float32)
+    k_pool = rng.standard_normal((nb, bs, Hkv, D)).astype(np.float32)
+    v_pool = rng.standard_normal((nb, bs, Hkv, D)).astype(np.float32)
+    tables = np.asarray([[3, 5, 7], [3, 5, 8]], np.int32)  # diverge at block 2
+    lens = np.asarray([11, 12], np.int32)
+    _assert_parity(q, k_pool, v_pool, tables, lens)
+
+
+def test_kernel_parity_bf16_pools_within_one_ulp():
+    """bf16 pools (the engine's cache dtype): the kernel computes the whole
+    softmax in f32 while the reference rounds probabilities through bf16, so
+    agreement is to bf16 resolution, not bitwise."""
+    case = _random_paged_case(
+        4, B=2, H=4, Hkv=2, D=32, bs=8, nb=12, W=3, lens=[20, 9]
+    )
+    q, k_pool, v_pool, tables, lens = case
+    _assert_parity(
+        q.astype(jnp.bfloat16), k_pool.astype(jnp.bfloat16),
+        v_pool.astype(jnp.bfloat16), tables, lens, tol=2e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# dispatch + kill switch
+
+
+def test_paged_kernel_mode_parsing(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_PAGED_KERNEL", raising=False)
+    assert paged_kernel_mode() == "on"
+    for raw, want in [("0", "off"), ("off", "off"), ("FALSE", "off"),
+                      ("1", "on"), ("interpret", "interpret")]:
+        monkeypatch.setenv("ACCELERATE_PAGED_KERNEL", raw)
+        assert paged_kernel_mode() == want
+
+
+def test_kill_switch_path_is_byte_identical_to_reference(monkeypatch):
+    """``ACCELERATE_PAGED_KERNEL=0`` must route straight to the gather
+    reference — byte-identical output, the pre-kernel engine exactly."""
+    q, k_pool, v_pool, tables, lens = _random_paged_case(
+        5, B=2, H=4, Hkv=2, D=16, bs=4, nb=8, W=3, lens=[9, 6]
+    )
+    args = (jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(lens - 1)[:, None])
+    monkeypatch.setenv("ACCELERATE_PAGED_KERNEL", "0")
+    out = dispatch_paged(*args)
+    ref = gather_ref(*args)
+    assert np.array_equal(np.asarray(out, np.float32), np.asarray(ref, np.float32))
+
+
+def test_prefill_shapes_always_take_the_gather_path(monkeypatch):
+    """S > 1 (chunked prefill) is outside the decode kernel's contract: even
+    with the kernel forced on, multi-token queries run the reference."""
+    monkeypatch.setenv("ACCELERATE_PAGED_KERNEL", "interpret")
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((1, 3, 4, 16)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((8, 4, 2, 16)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((8, 4, 2, 16)), jnp.float32)
+    tables = jnp.asarray([[3, 5, 1]], jnp.int32)
+    qpos = jnp.asarray([[8, 9, 10]], jnp.int32)
+    out = dispatch_paged(q, k_pool, v_pool, tables, qpos)
+    ref = gather_ref(q, k_pool, v_pool, tables, qpos)
+    assert np.array_equal(np.asarray(out, np.float32), np.asarray(ref, np.float32))
+
+
+def test_tpu_backend_dispatches_the_kernel(monkeypatch):
+    """On a TPU backend with the default mode, S=1 decode must route to the
+    Pallas kernel (compiled, not interpreted) — asserted by stubbing the
+    kernel entry point, since CI has no TPU to compile for."""
+    import importlib
+
+    # `ops.__init__` re-exports the `flash_attention` FUNCTION under the
+    # submodule's name, so attribute-style import resolves to the function —
+    # fetch the module itself
+    fa = importlib.import_module("accelerate_tpu.ops.flash_attention")
+    calls = []
+
+    def fake_decode(q, k_pool, v_pool, tables, lens, scale=None, *, interpret=False):
+        calls.append(interpret)
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(fa, "paged_attention_decode", fake_decode)
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("ACCELERATE_PAGED_KERNEL", raising=False)
+    q = jnp.zeros((1, 1, 4, 16))
+    fa.paged_attention(
+        q, jnp.zeros((4, 4, 2, 16)), jnp.zeros((4, 4, 2, 16)),
+        jnp.zeros((1, 2), jnp.int32), jnp.asarray([[3]], jnp.int32),
+    )
+    assert calls == [False]  # kernel path, compiled (not interpret) mode
+
+
+def test_engine_through_interpreted_kernel_matches_reference(monkeypatch):
+    """The whole serving engine with decode dispatched through the Pallas
+    kernel (interpreter mode) must still match the single-stream greedy
+    reference token-for-token — the CPU stand-in for the TPU dispatch
+    acceptance line. f32 end to end: the kernel keeps softmax probabilities
+    in f32 where the reference rounds them through the cache dtype, so at
+    bf16 a near-tie argmax can legitimately flip (the bf16 tolerance test
+    above owns that envelope) — at f32 the paths agree to ~1e-7 and greedy
+    token streams are identical."""
+    monkeypatch.setenv("ACCELERATE_PAGED_KERNEL", "interpret")
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=4,
+        cache_dtype=jnp.float32,
+        lattice=BucketLattice(slot_buckets=(2, 4), block_buckets=(4,),
+                              prefill_buckets=(32,)),
+    )
+    engine.warmup()
+    rng = np.random.default_rng(7)
+    specs = [(5, 7), (13, 11), (21, 5)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+    reqs = [engine.submit(p, n, rng_seed=i)
+            for i, (p, (_, n)) in enumerate(zip(prompts, specs))]
+    engine.run()
+    for i, ((_, n), req) in enumerate(zip(specs, reqs)):
+        ref = greedy_generate(params, prompts[i][None], CONFIG, max_new_tokens=n)
+        assert np.array_equal(np.asarray(ref[0]), req.output_ids()), f"request {i}"
+
+
+def test_kernel_rejects_multi_token_queries():
+    with pytest.raises(ValueError, match="S=1"):
+        paged_attention_decode(
+            jnp.zeros((1, 2, 4, 16)), jnp.zeros((4, 4, 2, 16)),
+            jnp.zeros((4, 4, 2, 16)), jnp.zeros((1, 2), jnp.int32),
+            jnp.asarray([5]), interpret=True,
+        )

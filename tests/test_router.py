@@ -736,8 +736,11 @@ def test_router_self_heals_killed_fleet_back_to_n_bitwise(tmp_path):
         ), {n: r.state for n, r in router.replicas.items()}
         healed = router.replicas["r0"]
         # warm boot: the respawned engine loaded its whole lattice from cache
+        # (incl. the prefix-cache COW point, one extra warmed shape)
         assert healed._worker is not None
-        assert healed._worker.engine.cache_stats["hit"] == spec.lattice().size()
+        assert healed._worker.engine.cache_stats["hit"] == spec.lattice().warmup_points(
+            prefix_cache=True
+        )
         # drain the survivor so the next request MUST run on the respawned
         # replica — and its output must still be bitwise-correct
         router.drain("r1")

@@ -228,7 +228,7 @@ def test_engine_chunked_prefill_parity_beyond_largest_bucket(params):
     assert np.array_equal(np.asarray(ref[0]), req.output_ids())
     # chunking stayed inside the warmed lattice: no new compiles
     assert engine.jit_cache_sizes() == {
-        "prefill_compiles": 2, "decode_compiles": 1
+        "prefill_compiles": 2, "decode_compiles": 1, "cow_compiles": 1
     }
 
 
@@ -450,6 +450,10 @@ def test_serving_telemetry_and_report_section(params, tmp_path):
     assert serving["requests"]["new_tokens"] == 6 + 4 + 8
     assert serving["decode_tokens"] == engine.decode_tokens
     assert serving["prefill_tokens"] == engine.prefill_tokens
+    # prefix-cache schema fields are always present (zero for this
+    # unshared workload) and mirror the engine's own counters
+    assert serving["prefill_tokens_saved"] == engine.prefix_cached_tokens
+    assert 0.0 <= serving["prefix_hit_rate"] <= 1.0
     assert serving["occupancy"]["max"] > 0.5  # batched, not serialized
     assert serving["requests"]["latency_s"]["count"] == 3
     text = format_report(report)
@@ -466,6 +470,217 @@ def test_report_without_serving_records_omits_section(tmp_path):
     report = build_report([str(tmp_path)])
     assert report["serving"] is None
     assert "serving:" not in format_report(report)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: refcounted block sharing + copy-on-write (ISSUE 14)
+
+
+def test_prefix_allocator_shares_blocks_and_refcounts():
+    alloc = BlockAllocator(num_blocks=17, block_size=4, prefix_caching=True)
+    toks = np.arange(10, dtype=np.int32)  # 2 full blocks + a 2-token tail
+    t_a = alloc.allocate_with_prefix("a", toks)
+    assert t_a.cached_tokens == 0 and t_a.cow is None
+    # same prefix, longer tail: the two full blocks are MAPPED, not copied
+    t_b = alloc.allocate_with_prefix("b", np.concatenate([toks, toks[:3]]))
+    assert t_b.cached_tokens == 8
+    assert t_b.table[:2] == t_a.table[:2]
+    assert t_b.table[2:] != t_a.table[2:]  # private tails
+    assert alloc.shared_blocks() == 2
+    # free one sharer: shared blocks stay live for the other (no
+    # use-after-free); a's PARTIAL tail block is not content-indexed so it
+    # goes straight back to the free list, while the full blocks stay
+    # referenced by b (nothing parks in the LRU pool yet)
+    free_before = alloc.free_blocks
+    alloc.free("a")
+    assert alloc.block_table("b")[0] == t_b.table[0]
+    assert alloc.shared_blocks() == 0 and alloc.reclaimable_blocks == 0
+    assert alloc.free_blocks == free_before + 1
+    # a third request still matches the chain through b's references
+    t_c = alloc.allocate_with_prefix("c", toks.copy())
+    assert t_c.cached_tokens == 8 and t_c.table[:2] == t_b.table[:2]
+    # freeing the LAST referents parks the registered blocks, matchable until
+    # reclaimed
+    alloc.free("b")
+    alloc.free("c")
+    assert alloc.reclaimable_blocks >= 2
+    t_d = alloc.allocate_with_prefix("d", toks.copy())
+    assert t_d.cached_tokens == 8
+
+
+def test_prefix_allocator_full_match_is_copy_on_write():
+    alloc = BlockAllocator(num_blocks=17, block_size=4, prefix_caching=True)
+    toks = np.arange(8, dtype=np.int32)  # exactly 2 blocks: the aligned case
+    t_a = alloc.allocate_with_prefix("a", toks)
+    t_b = alloc.allocate_with_prefix("b", toks.copy())
+    # all but the last position come from the cache; the last matched block
+    # is replaced by a private copy target so no shared block is ever written
+    assert t_b.cached_tokens == 7
+    assert t_b.cow is not None
+    src, dst = t_b.cow
+    assert src == t_a.table[-1] and dst == t_b.table[-1] and dst != src
+    assert t_b.table[0] == t_a.table[0]
+    # the src pin: until the engine confirms the device copy, src must not be
+    # reclaimable even though no live table holds it beyond a's
+    alloc.free("a")
+    free_before = alloc.free_blocks
+    while alloc.free_blocks:  # drain the free list completely
+        alloc.allocate(f"f{alloc.free_blocks}", alloc.block_size)
+    with pytest.raises(BlockPoolExhausted):
+        # the only reclaimable candidates are pinned/referenced: must refuse,
+        # never hand out the COW source
+        alloc.allocate("overflow", 10 * alloc.block_size)
+    alloc.cow_done(src)
+    assert alloc.reclaimable_blocks >= 1  # pin released: src parks in LRU
+    assert free_before >= 0
+
+
+def test_prefix_allocator_reclaims_lru_before_rejecting():
+    alloc = BlockAllocator(num_blocks=9, block_size=4, prefix_caching=True)
+    toks = np.arange(32, dtype=np.int32)  # all 8 usable blocks
+    alloc.allocate_with_prefix("a", toks)
+    alloc.free("a")  # every block cached + unreferenced (LRU pool)
+    assert alloc.free_blocks == 0 and alloc.reclaimable_blocks == 8
+    assert alloc.available_blocks == 8  # caching never shrinks capacity
+    # a new unrelated allocation must reclaim from the LRU pool, not reject
+    table = alloc.allocate_with_prefix("b", 100 + np.arange(12, dtype=np.int32))
+    assert len(table.table) == 3 and alloc.reclaimed_blocks == 3
+    # 3 reclaimed entries left the content index; b's 3 full blocks joined it
+    assert alloc.stats()["cached_blocks"] == 8 - 3 + 3
+
+
+def test_prefix_allocator_off_keeps_legacy_behavior():
+    on = BlockAllocator(num_blocks=9, block_size=4, prefix_caching=False)
+    toks = np.arange(8, dtype=np.int32)
+    t1 = on.allocate_with_prefix("a", toks)
+    assert t1.cached_tokens == 0 and t1.cow is None
+    t2 = on.allocate_with_prefix("b", toks.copy())  # no index: no sharing
+    assert set(t1.table).isdisjoint(t2.table)
+    on.free("a")
+    assert on.reclaimable_blocks == 0  # nothing parks: straight to free list
+    plan = on.plan_prefix(toks)
+    assert plan.fresh_blocks == 2 and not plan.matched
+
+
+def test_prefix_plan_charges_lru_pinned_blocks():
+    """A plan whose matched blocks sit in the LRU pool must charge them to
+    admission (they count as available but this mapping pins them) — without
+    the charge, admission green-lights an allocation that then throws."""
+    alloc = BlockAllocator(num_blocks=5, block_size=4, prefix_caching=True)
+    toks = np.arange(8, dtype=np.int32)
+    alloc.allocate_with_prefix("a", toks)
+    alloc.free("a")  # 2 cached blocks in LRU, 2 free
+    plan = alloc.plan_prefix(np.concatenate([toks, np.arange(100, 112, dtype=np.int32)]))
+    assert len(plan.matched) == 2 and plan.lru_pinned == 2
+    # total charge = 3 fresh + 2 pinned = 5 > 4 available: inadmissible
+    assert plan.fresh_blocks == 3
+    assert plan.fresh_blocks + plan.lru_pinned > alloc.available_blocks
+    with pytest.raises(BlockPoolExhausted):
+        alloc.allocate_with_prefix("b", np.concatenate(
+            [toks, np.arange(100, 112, dtype=np.int32)]
+        ))
+
+
+def test_engine_prefix_cache_bitwise_parity_and_savings(params):
+    """Staggered requests sharing a long system prompt: the cached engine
+    must produce BITWISE-identical outputs to the cache-off engine while
+    skipping a large share of prefill work, with the jit caches frozen at
+    the warmup counts (the zero-recompile oracle keeps holding)."""
+    from accelerate_tpu.telemetry.step_profiler import RecompileWatcher
+
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, CONFIG.vocab_size, (24,)).astype(np.int32)
+    suffixes = [rng.integers(0, CONFIG.vocab_size, (n,)).astype(np.int32)
+                for n in (5, 9, 3, 7)]
+    prompts = [np.concatenate([shared, s]) for s in suffixes]
+
+    def run(prefix_cache):
+        engine = ServingEngine(
+            params, CONFIG, num_blocks=65, block_size=8, max_slots=4,
+            lattice=BucketLattice(slot_buckets=(2, 4), block_buckets=(8,),
+                                  prefill_buckets=(32,)),
+            prefix_cache=prefix_cache,
+        )
+        warmed = engine.warmup()
+        watcher = RecompileWatcher()
+        watcher.register("prefill", engine.prefill_fn)
+        watcher.register("decode", engine.decode_fn)
+        reqs = [engine.submit(prompts[0], 8, rng_seed=0),
+                engine.submit(prompts[1], 6, rng_seed=1)]
+        for i in (2, 3):  # staggered: arrive after the first prefills landed
+            engine.step()
+            reqs.append(engine.submit(prompts[i], 5 + i, rng_seed=i))
+        engine.run()
+        assert engine.jit_cache_sizes() == warmed
+        assert watcher.poll(emit=False) == {}
+        return engine, [r.output_ids() for r in reqs]
+
+    cached_engine, cached_out = run(True)
+    plain_engine, plain_out = run(False)
+    for i, (a, b) in enumerate(zip(cached_out, plain_out)):
+        assert np.array_equal(a, b), f"request {i} diverged under prefix caching"
+    stats = cached_engine.stats()
+    assert stats["prefix_hit_rate"] > 0.3
+    assert stats["prefill_tokens_saved"] >= 3 * 24 - 24  # later reqs skip the shared part
+    assert "prefix_hit_rate" not in plain_engine.stats()
+
+
+def test_engine_prefix_cache_cow_divergence_parity(params):
+    """Block-aligned duplicate prompts hit the full-match COW path: each
+    request recomputes its final position in a PRIVATE copy and decodes its
+    own continuation — outputs bitwise-equal to unshared runs, shared blocks
+    never written (proven by request 0 finishing first and request 1 still
+    matching its reference afterwards)."""
+    rng = np.random.default_rng(12)
+    p32 = rng.integers(0, CONFIG.vocab_size, (32,)).astype(np.int32)  # 4 blocks
+
+    def run(prefix_cache):
+        engine = ServingEngine(
+            params, CONFIG, num_blocks=65, block_size=8, max_slots=4,
+            lattice=BucketLattice(slot_buckets=(2, 4), block_buckets=(8,),
+                                  prefill_buckets=(32,)),
+            prefix_cache=prefix_cache,
+        )
+        engine.warmup()
+        a = engine.submit(p32, 4, rng_seed=0)
+        engine.step()  # a prefilled + indexed before b arrives
+        b = engine.submit(p32.copy(), 12, rng_seed=0)
+        engine.run()
+        return engine, a.output_ids(), b.output_ids()
+
+    engine, a_cached, b_cached = run(True)
+    _, a_plain, b_plain = run(False)
+    assert np.array_equal(a_cached, a_plain)
+    assert np.array_equal(b_cached, b_plain)
+    assert engine.allocator.cow_copies == 1
+    assert engine.stats()["cow_copies"] == 1
+    # same seed + same prompt -> identical streams; the divergence point is
+    # covered by kernel-level aliased-table tests (different seeds would
+    # sample different tokens into the two PRIVATE last blocks)
+    assert np.array_equal(a_cached, b_cached[: a_cached.size])
+
+
+def test_engine_preemption_resume_rides_the_prefix_cache(params):
+    """A preempted request's blocks park in the LRU pool; its resume re-plans
+    and maps them back instead of re-prefilling — with output identical to
+    the uninterrupted single-stream reference (the PR-13 failover waste the
+    motivation names)."""
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=10, block_size=8, max_slots=4,
+        max_blocks_per_seq=8,
+        lattice=BucketLattice(slot_buckets=(1, 2, 4), block_buckets=(4, 8),
+                              prefill_buckets=(32,)),
+    )
+    engine.warmup()
+    prompts = _prompts(2, (16, 14, 15))
+    reqs = [engine.submit(p, 16, rng_seed=i) for i, p in enumerate(prompts)]
+    engine.run()
+    assert engine.scheduler.preemption_count >= 1
+    for i, p in enumerate(prompts):
+        ref = greedy_generate(params, p[None], CONFIG, max_new_tokens=16)
+        assert np.array_equal(np.asarray(ref[0]), reqs[i].output_ids()), f"request {i}"
+    # at least one resume found its own KV still cached
+    assert engine.allocator.prefix_hit_tokens > 0
 
 
 # ---------------------------------------------------------------------------

@@ -100,15 +100,20 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         "fixed-size KV blocks in one preallocated pool with a host-side "
         "allocator, watermark/LIFO preemption with persisted resume, and a "
         "static bucket lattice so admission churn never recompiles — "
-        "replicated behind a health-checked router with token-exact "
-        "failover, deadlines, and graceful overload shedding. See "
-        "`docs/serving.md` for the guide and `benchmarks/serving/` "
-        "(`make bench-serve`) for the continuous-vs-static and replicated "
-        "benchmarks.",
+        "with automatic prefix caching (content-addressed refcounted block "
+        "sharing + copy-on-write) and a Pallas paged-attention decode "
+        "kernel on TPU — replicated behind a health-checked router with "
+        "token-exact failover, deadlines, and graceful overload shedding. "
+        "See `docs/serving.md` for the guide and `benchmarks/serving/` "
+        "(`make bench-serve`) for the continuous-vs-static, replicated and "
+        "shared-prefix benchmarks.",
         [("accelerate_tpu.serving.engine", ["ServingEngine", "paged_forward"]),
          ("accelerate_tpu.serving.kv_pager",
           ["BlockAllocator", "BlockAllocatorError", "BlockPoolExhausted",
-           "init_block_pool", "paged_attention"]),
+           "PrefixPlan", "PrefixAllocation", "init_block_pool",
+           "paged_attention"]),
+         ("accelerate_tpu.ops.flash_attention",
+          ["paged_attention", "paged_attention_decode", "paged_kernel_mode"]),
          ("accelerate_tpu.serving.scheduler",
           ["Request", "RequestStatus", "Scheduler", "SchedulingError"]),
          ("accelerate_tpu.serving.buckets", ["BucketLattice"]),
