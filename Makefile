@@ -100,9 +100,11 @@ bench-zero1:
 # seeded Poisson open-loop load (aggregate tok/s ratio, batch occupancy,
 # p50/p99 per-request latency), plus the replicated-router leg: tok/s
 # scaling over N replicas and no-lost-requests + output parity under a
-# replica kill, plus the shared-prefix leg: prefix cache on/off over one
-# seeded system-prompt workload (prefill-token reduction, hit rate,
-# bitwise output parity, zero recompiles) (benchmarks/serving)
+# replica kill — re-run once with tracing armed (gap-free span trees for
+# every completion incl. failover hops, tracing tok/s tax reported) —
+# plus the shared-prefix leg: prefix cache on/off over one seeded
+# system-prompt workload (prefill-token reduction, hit rate, bitwise
+# output parity, zero recompiles) (benchmarks/serving)
 bench-serve:
 	python benchmarks/serving/run.py
 
@@ -118,8 +120,11 @@ bench-compile:
 # capture, xplane trace parsing, the performance report section, fused
 # ZeRO-1, elastic auto-resume, the serving engine, the replicated
 # serving router (2 replicas, one chaos-killed mid-load, exactly-once +
-# bitwise parity), and the persistent compile cache (subprocess restart
-# hits with zero recompiles; poisoned entry quarantined + clean fallback)
+# bitwise parity), the persistent compile cache (subprocess restart
+# hits with zero recompiles; poisoned entry quarantined + clean fallback),
+# the prefix cache + COW, and the observability plane (traced 2-replica
+# router under an injected kill: gap-free span trees, /metrics scrape
+# matching the report, slo_violation under a tight objective)
 # against synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor

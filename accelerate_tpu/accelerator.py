@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
@@ -496,6 +497,18 @@ class Accelerator:
         from .resilience import chaos as _chaos
 
         _chaos.maybe_arm_from_env()
+        # Training-side step-latency SLO (telemetry/slo.py):
+        # ACCELERATE_SLO_STEP_LATENCY_S arms a burn-rate monitor over step
+        # wall times — a sustained regression past the threshold emits one
+        # ``slo_violation`` record per episode. Unset: one env lookup ever
+        # and a None-check per step.
+        from .telemetry import slo as _slo
+
+        step_slo = _slo.step_latency_slo_from_env()
+        self._step_slo_monitor = (
+            _slo.SLOMonitor([step_slo]) if step_slo is not None else None
+        )
+        self._step_slo_last_eval = 0.0
         # Elastic cohort membership: under a supervised run (restart
         # generation set, or a roster dir published) announce ourselves so the
         # supervisor's roster reflects who actually came up.
@@ -1107,11 +1120,14 @@ class Accelerator:
         cached_exec: list = [None, False, None]
         restart_generation = self.restart_generation
 
+        slo_monitor = self._step_slo_monitor
+
         def step_and_track(params, opt_state, batch):
             # forensics: the flight ring always knows the current step, and an
             # active watchdog hears one beat per step (a rank whose beats stop
             # is stalled; its open phases name what it is blocked in)
             step_index = step_telemetry.step_index
+            slo_t0 = time.monotonic() if slo_monitor is not None else 0.0
             flight.step = step_index
             _watchdog.beat("train_step", step=step_index)
             _chaos.maybe_inject("train_step", step=step_index)
@@ -1172,6 +1188,15 @@ class Accelerator:
                 if compiled_comms:
                     for op, nbytes in compiled_comms.items():
                         ops.record_compiled_collective(op, nbytes)
+            if slo_monitor is not None:
+                # step-latency SLO: observe every step, evaluate throttled
+                # (evaluation walks the burn windows — once a second is the
+                # right cadence, not once a step)
+                wall = time.monotonic()
+                slo_monitor.observe("step_latency", value=wall - slo_t0)
+                if wall - self._step_slo_last_eval >= 1.0:
+                    self._step_slo_last_eval = wall
+                    slo_monitor.evaluate()
             optimizer.opt_state = new_opt_state
             if model_slot is not None:
                 self._models[model_slot] = new_params

@@ -82,6 +82,11 @@ def get_cache(directory: Optional[str] = None) -> Optional[CompileCache]:
 
 
 def _emit(event: str, fn: str, key: Optional[CacheKey] = None, **fields: Any) -> None:
+    from ..telemetry import metrics as _metrics
+
+    # the streaming-metrics plane counts every cache outcome too (scrapable
+    # hit/miss/corrupt rates per fn); one None-check when metrics are off
+    _metrics.inc("accelerate_compile_cache_events_total", event=event, fn=fn)
     if not tel.is_enabled():
         return
     tel.emit(

@@ -102,13 +102,23 @@ def serving_shardings(mesh, config: LlamaConfig):
     the block axis stays replicated because block tables address the WHOLE
     pool (any sequence may hold any block, so there is no batch axis to
     shard — batch parallelism for serving is a scheduler concern: run one
-    engine per data-parallel replica)."""
+    engine per data-parallel replica).
+
+    The spec is CANONICALIZED (PR 9's ``canonicalize_spec``: trailing
+    ``None`` dims trimmed) so the placed pool's sharding compares equal to
+    the canonical form GSPMD hands back on every step OUTPUT. The
+    non-canonical ``P(None, None, None, tp, None)`` made the first warmed
+    prefill bucket — the only one compiled against the freshly
+    ``device_put`` pool — re-specialize on its first steady-state call on a
+    multi-device mesh (the "4x2 recompile" noted in PR 14)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    from .parallel.sharding import canonicalize_spec
+
     axes = dict(mesh.shape)
     tp = "tp" if axes.get("tp", 1) > 1 and config.n_kv_heads % axes["tp"] == 0 else None
-    return NamedSharding(mesh, P(None, None, None, tp, None))
+    return NamedSharding(mesh, canonicalize_spec(P(None, None, None, tp, None), axes))
 
 
 def _place_for_mesh(mesh, prompt_ids, cache, config):

@@ -152,6 +152,17 @@ class Supervisor:
         self._events_path = os.path.join(self.telemetry_dir, "events-supervisor.jsonl")
         self._events_opened = False
         self._seen_dumps: "dict[str, float]" = {}  # path -> mtime (ranks reuse names)
+        # Training-side SLO (telemetry/slo.py): ACCELERATE_SLO_RESTART_DOWNTIME_S
+        # arms a restart-downtime objective — every restart's downtime_s is one
+        # event, and a burn-episode entry writes an ``slo_violation`` record
+        # into events-supervisor.jsonl next to the restart records. Restarts
+        # are rare, so min_events=1: a single over-budget restart is a signal.
+        from ..telemetry.slo import SLOMonitor, restart_downtime_slo_from_env
+
+        downtime_slo = restart_downtime_slo_from_env()
+        self._slo_monitor = (
+            SLOMonitor([downtime_slo], min_events=1) if downtime_slo is not None else None
+        )
 
     # -------------------------------------------------------------- telemetry --
     def _emit(self, kind: str, **fields: Any) -> None:
@@ -430,6 +441,7 @@ class Supervisor:
             time.sleep(delay)
             self.generation = spec.generation
             self._spawn_cohort(spec)
+            downtime_s = round(time.monotonic() - failed_at, 3)
             self._emit(
                 "restart",
                 generation=spec.generation,
@@ -439,8 +451,16 @@ class Supervisor:
                 step=incident.step,
                 dump=incident.dump,
                 processes=spec.num_processes,
-                downtime_s=round(time.monotonic() - failed_at, 3),
+                downtime_s=downtime_s,
             )
+            if self._slo_monitor is not None:
+                self._slo_monitor.observe("restart_downtime", value=downtime_s)
+                for rec in self._slo_monitor.evaluate(emit=False):
+                    if rec.get("entered"):
+                        # the supervisor writes its own stream (no EventLog
+                        # in this process) — same record schema
+                        self._emit("slo_violation", generation=spec.generation,
+                                   **{k: v for k, v in rec.items() if k != "entered"})
 
     def _watch(self) -> "Optional[_Incident]":
         """Block until the cohort finishes (returns None) or something dies /

@@ -38,6 +38,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..telemetry import metrics as _metrics
+
 __all__ = [
     "PRIORITY_INTERACTIVE",
     "PRIORITY_BATCH",
@@ -146,17 +148,23 @@ class AdmissionController:
         # full queue must not also drain rate budget other traffic could use
         if self.bucket is not None and self.bucket.available(now) < cost:
             self.shed_count += 1
+            _metrics.inc("accelerate_admission_shed_total", reason="rate-limited")
             return AdmissionVerdict(False, reason="rate-limited")
         evicted = []
         if self.depth >= self.max_queue:
             victim = self._evict_below(request.priority)
             if victim is None:
                 self.shed_count += 1
+                _metrics.inc("accelerate_admission_shed_total", reason="queue-full")
                 return AdmissionVerdict(False, reason="queue-full")
             evicted.append(victim)
+            _metrics.inc("accelerate_admission_shed_total", reason="displaced")
         if self.bucket is not None:
             self.bucket.take(cost, now)  # same `now` as the probe: cannot fail
         self._queues.setdefault(request.priority, deque()).append(request)
+        if _metrics.is_enabled():
+            _metrics.observe("accelerate_admission_queue_depth", self.depth,
+                             buckets=_metrics.DEPTH_BUCKETS)
         return AdmissionVerdict(True, evicted=evicted)
 
     def _evict_below(self, priority: int):

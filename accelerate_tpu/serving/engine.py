@@ -43,6 +43,8 @@ from ..generation import _project_qkv, sample_token_logits, serving_shardings
 from ..models.transformer import LlamaConfig, rms_norm, rope_frequencies
 from ..ops.flash_attention import paged_attention
 from ..telemetry import events as tel
+from ..telemetry import metrics as _metrics
+from ..telemetry import tracing as _tracing
 from ..telemetry import watchdog as _watchdog
 from .buckets import BucketLattice
 from .kv_pager import NULL_BLOCK, BlockAllocator, init_block_pool
@@ -253,6 +255,11 @@ class ServingEngine:
         self._aot: dict = {}  # ("prefill"|"decode", *bucket shape) -> executable
         self.cache_stats = {"hit": 0, "miss": 0, "corrupt": 0, "uncached": 0, "error": 0}
 
+        # live observability (PR 15): arm tracing/metrics from the env once
+        # per engine — both stay None-branch no-ops when unconfigured
+        _tracing.maybe_arm_from_env()
+        _metrics.maybe_enable_from_env()
+
         # stats for the telemetry records / bench payloads
         self.steps = 0
         self.decode_tokens = 0
@@ -276,6 +283,7 @@ class ServingEngine:
         rng_seed: int = 0,
         arrival_t: Optional[float] = None,
         generated: Optional["list[int]"] = None,
+        trace: Optional[dict] = None,
     ) -> Request:
         """Enqueue one request; returns its :class:`Request` handle (live —
         ``generated``/``status`` update as the engine steps).
@@ -285,7 +293,13 @@ class ServingEngine:
         prefill covers ``prompt + generated`` and sampling continues at fold
         index ``len(generated)`` — exactly the scheduler's preempt/resume
         state, so the continuation is bitwise-identical to an unfailed run.
-        ``max_new_tokens`` stays the request's TOTAL new-token budget."""
+        ``max_new_tokens`` stays the request's TOTAL new-token budget.
+
+        ``trace`` is a propagated :class:`~accelerate_tpu.telemetry.tracing.
+        TraceContext` dict (the router's dispatch span): engine spans parent
+        under it and accumulate on ``Request.trace_spans`` for the owner to
+        emit. With no ``trace`` and tracing armed, the engine roots its own
+        trace and emits it at completion."""
         req = Request(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -300,6 +314,22 @@ class ServingEngine:
                     f"max_new_tokens={max_new_tokens}: nothing left to decode"
                 )
             req.generated = [int(t) for t in generated]
+        ctx = _tracing.TraceContext.from_wire(trace)
+        if ctx is None and _tracing.is_armed():
+            ctx = _tracing.new_trace()
+            req._trace_owner = True
+        if ctx is not None:
+            req.trace = ctx
+            req._span_root = _tracing.span_open(
+                ctx, "engine_request", component="engine", rid=int(req.rid),
+                prompt_tokens=int(req.prompt.size),
+                resumed_tokens=len(req.generated),
+            )
+            req._span_queue = _tracing.span_open(
+                ctx, "queue_wait", parent_id=req._span_root["span_id"],
+                component="engine",
+            )
+            req.trace_spans += [req._span_root, req._span_queue]
         self.scheduler.submit(req)
         return req
 
@@ -406,6 +436,7 @@ class ServingEngine:
         ``Request.error`` set) for requests whose worst case can never fit
         this engine's pool/lattice."""
         now = time.monotonic() if now is None else now
+        step_t0 = time.monotonic()
         # chaos fault point: a seeded replica kill/hang/slow lands HERE, mid
         # decode loop (resilience/chaos.py, point "serving_decode") — one
         # ``is None`` check when disarmed
@@ -419,7 +450,10 @@ class ServingEngine:
         while self.scheduler.rejected:
             req = self.scheduler.rejected.pop()
             req.finish_t = now
+            self._close_trace(req, "rejected")
             finished.append(req)  # returned to the caller, status REJECTED
+            if _metrics.is_enabled():
+                _metrics.inc("accelerate_engine_requests_total", outcome="rejected")
             if tel.is_enabled():
                 tel.emit(
                     "serving_request", rid=req.rid, error=req.error,
@@ -430,7 +464,7 @@ class ServingEngine:
             prefills += 1
             if req.done:
                 self.scheduler.complete(req, now)
-                self._emit_completion(req)
+                self._finish_request(req, now)
                 finished.append(req)
 
         running = [r for r in self.scheduler.running()]
@@ -447,7 +481,7 @@ class ServingEngine:
             for req in running:
                 if req.done:
                     self.scheduler.complete(req, now)
-                    self._emit_completion(req)
+                    self._finish_request(req, now)
                     finished.append(req)
 
         self.steps += 1
@@ -462,6 +496,33 @@ class ServingEngine:
         self.max_running = max(self.max_running, len(running))
         self._occupancy_sum += occupancy
         self._occupancy_steps += 1
+        if _metrics.is_enabled():
+            alloc_occ = self.allocator.occupancy()
+            # gauges are last-write-wins: label them per engine so N
+            # LocalReplica engines in one process (one shared registry)
+            # don't clobber each other's depth (histograms/counters below
+            # aggregate across engines by design — fleet-level percentiles)
+            _metrics.set_gauge("accelerate_engine_queue_depth",
+                               self.scheduler.queue_depth, engine=self.heartbeat_name)
+            _metrics.set_gauge("accelerate_engine_running", len(running),
+                               engine=self.heartbeat_name)
+            _metrics.observe("accelerate_engine_queue_depth_hist", self.scheduler.queue_depth,
+                             buckets=_metrics.DEPTH_BUCKETS)
+            _metrics.observe("accelerate_batch_occupancy", occupancy,
+                             buckets=_metrics.OCCUPANCY_BUCKETS)
+            _metrics.observe("accelerate_block_pool_occupancy", alloc_occ,
+                             buckets=_metrics.OCCUPANCY_BUCKETS)
+            _metrics.inc("accelerate_decode_tokens_total", len(running))
+            _metrics.inc("accelerate_prefill_tokens_total",
+                         self.prefill_tokens - prefill_tokens_before)
+            _metrics.inc("accelerate_prefix_hit_tokens_total",
+                         self.prefix_cached_tokens - prefix_cached_before)
+            if running:
+                # per-token latency: every live request earned exactly one
+                # token this step, so the step wall IS its token interval
+                _metrics.observe("accelerate_per_token_latency_seconds",
+                                 time.monotonic() - step_t0)
+            _metrics.maybe_snapshot()
         if tel.is_enabled():
             alloc = self.allocator.stats()
             tel.emit(
@@ -516,14 +577,33 @@ class ServingEngine:
         FIRST — the one write this request aims below its uncached tail goes
         into its private copy, never a shared block."""
         prefix = req.output_ids()
+        span_prefill = None
+        if req.trace is not None:
+            if req._span_queue is not None and "t1_ns" not in req._span_queue:
+                _tracing.span_close(req._span_queue)
+            span_prefill = _tracing.span_open(
+                req.trace, "prefill", parent_id=req._span_root["span_id"],
+                component="engine", prefix_tokens=int(prefix.size),
+                cached_tokens=int(req.cached_tokens),
+                cow=req.cow_block is not None,
+                resume=req.preemptions > 0,
+            )
+            req.trace_spans.append(span_prefill)
         if req.cow_block is not None:
             src, dst = req.cow_block
+            cow_t0 = _tracing.now_ns() if span_prefill is not None else 0
             fn = self._aot.get(("cow",), self.cow_fn)
             self.pool = fn(self.pool, np.int32(src), np.int32(dst))
             # the copy is issued (ordered before any later pool op): release
             # the allocator's pin so src can park in the reclaimable pool
             self.allocator.cow_done(src)
             req.cow_block = None
+            if span_prefill is not None:
+                req.trace_spans.append(_tracing.make_span(
+                    req.trace, "cow_copy", cow_t0, _tracing.now_ns(),
+                    parent_id=span_prefill["span_id"], component="engine",
+                    src_block=int(src), dst_block=int(dst),
+                ))
         W = self.lattice.prefill_points()[0][1]
         table = self.allocator.block_table(req.rid, pad_to=W)[None]
         chunk_cap = self.lattice.prefill_buckets[-1]
@@ -537,12 +617,21 @@ class ServingEngine:
             Sb = self.lattice.prefill_bucket(chunk.size)
             ids = np.zeros((1, Sb), np.int32)
             ids[0, : chunk.size] = chunk
+            chunk_t0 = _tracing.now_ns() if span_prefill is not None else 0
             fn = self._aot.get(("prefill", Sb, W), self.prefill_fn)
             self.pool, tok = fn(
                 self.params, self.pool, ids, table, np.int32(start),
                 np.int32(chunk.size - 1), key, token_idx,
             )
+            if span_prefill is not None:
+                req.trace_spans.append(_tracing.make_span(
+                    req.trace, "prefill_chunk", chunk_t0, _tracing.now_ns(),
+                    parent_id=span_prefill["span_id"], component="engine",
+                    start=int(start), tokens=int(chunk.size), bucket=int(Sb),
+                ))
             start += chunk.size
+        if span_prefill is not None:
+            _tracing.span_close(span_prefill)
         req.generated.append(int(tok))
         if req.first_token_t is None:
             req.first_token_t = now
@@ -564,11 +653,32 @@ class ServingEngine:
             positions[i] = req.prefix_len - 1
             keys[i] = self._request_key(req)
             token_idx[i] = len(req.generated)
+        # gate on the requests' own contexts, not the local arming state (a
+        # ProcessReplica child traces whenever the router propagated a ctx) —
+        # and only for SAMPLED traces: per-token decode spans are the bulk of
+        # a trace's cost, and an unsampled trace keeps only its cheap
+        # structural spans (the router flips sampled on for failover
+        # redispatches, whose forced emission needs the detail)
+        decode_t0 = (
+            _tracing.now_ns()
+            if any(r.trace is not None and r.trace.get("sampled") for r in running)
+            else 0
+        )
         fn = self._aot.get(("decode", Bb, W), self.decode_fn)
         self.pool, toks = fn(
             self.params, self.pool, last, tables, positions, keys, token_idx
         )
         toks = np.asarray(jax.device_get(toks))
+        if decode_t0:
+            decode_t1 = _tracing.now_ns()
+            for req in running:
+                if req.trace is not None and req.trace.get("sampled"):
+                    req.trace_spans.append(_tracing.make_span(
+                        req.trace, "decode_step", decode_t0, decode_t1,
+                        parent_id=req._span_root["span_id"], component="engine",
+                        step=int(self.steps), batch=len(running),
+                        token_idx=len(req.generated),
+                    ))
         for i, req in enumerate(running):
             req.generated.append(int(toks[i]))
             if self.prefix_cache:
@@ -582,6 +692,36 @@ class ServingEngine:
                         req.rid, req.output_ids()[:-1]
                     )
         self.decode_tokens += len(running)
+
+    def _close_trace(self, req: Request, outcome: str) -> None:
+        """Close the request's open spans with the terminal ``outcome``; the
+        trace's OWNER emits — this engine when it rooted the trace, the
+        router (via the replica event stream) when the context was
+        propagated in."""
+        if req.trace is None:
+            return
+        if req._span_queue is not None and "t1_ns" not in req._span_queue:
+            _tracing.span_close(req._span_queue)
+        if req._span_root is not None and "t1_ns" not in req._span_root:
+            _tracing.span_close(
+                req._span_root, outcome=outcome, tokens=len(req.generated),
+                preemptions=int(req.preemptions),
+            )
+        if req._trace_owner:
+            _tracing.finish_trace(
+                req.trace, req.trace_spans, forced=outcome != "finished"
+            )
+
+    def _finish_request(self, req: Request, now: float) -> None:
+        self._close_trace(req, "finished")
+        if _metrics.is_enabled():
+            _metrics.inc("accelerate_engine_requests_total", outcome="finished")
+            if req.first_token_t is not None:
+                _metrics.observe("accelerate_engine_ttft_seconds",
+                                 req.first_token_t - req.arrival_t)
+            _metrics.observe("accelerate_engine_request_latency_seconds",
+                             (req.finish_t or now) - req.arrival_t)
+        self._emit_completion(req)
 
     def _emit_completion(self, req: Request) -> None:
         if not tel.is_enabled():

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 
 from ..generation import _masked_attention
 from ..models.transformer import LlamaConfig
+from ..telemetry import metrics as _metrics
 
 __all__ = [
     "NULL_BLOCK",
@@ -236,6 +237,7 @@ class BlockAllocator:
         h = self._block_hash.pop(blk)
         del self._cached[h]
         self.reclaimed_blocks += 1
+        _metrics.inc("accelerate_blocks_reclaimed_total")
         return blk
 
     def _unref(self, blk: int) -> None:
@@ -377,6 +379,7 @@ class BlockAllocator:
             self.prefix_hit_tokens += plan.cached_tokens
         if cow is not None:
             self.cow_copies += 1
+            _metrics.inc("accelerate_cow_copies_total")
         return PrefixAllocation(list(table), plan.cached_tokens, cow)
 
     def cow_done(self, blk: int) -> None:

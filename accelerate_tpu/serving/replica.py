@@ -195,6 +195,10 @@ class _EngineWorker:
                             eos_token_id=cmd.get("eos"),
                             rng_seed=int(cmd.get("rng_seed", 0)),
                             generated=cmd.get("generated") or None,
+                            # propagated trace context: engine spans parent
+                            # under the router's dispatch span and ship back
+                            # inside the done event (the router owns emission)
+                            trace=cmd.get("trace"),
                         )
                         handles[cmd["rid"]] = req
                         sent[cmd["rid"]] = len(req.generated)
@@ -223,18 +227,33 @@ class _EngineWorker:
                 )
                 for req in finished:
                     rid = next(k for k, v in handles.items() if v is req)
-                    self.send(
-                        {
-                            "event": "done",
-                            "rid": rid,
-                            "status": "finished"
-                            if req.status is RequestStatus.FINISHED
-                            else "rejected",
-                            "tokens": [int(t) for t in req.generated],
-                            "error": req.error,
-                            "preemptions": req.preemptions,
-                        }
-                    )
+                    done_event = {
+                        "event": "done",
+                        "rid": rid,
+                        "status": "finished"
+                        if req.status is RequestStatus.FINISHED
+                        else "rejected",
+                        "tokens": [int(t) for t in req.generated],
+                        "error": req.error,
+                        "preemptions": req.preemptions,
+                    }
+                    if (
+                        req.trace_spans
+                        and not req._trace_owner
+                        and (
+                            req.trace.get("sampled")
+                            or req.status is not RequestStatus.FINISHED
+                        )
+                    ):
+                        # span dicts are JSON-able by construction; they ride
+                        # the event stream so the ROUTER (one writer per
+                        # trace) assembles and emits the whole trace. An
+                        # UNSAMPLED finished request ships nothing — the only
+                        # way the router would emit it is a failover, and
+                        # failover redispatches arrive with sampled flipped
+                        # on (the previous hop's spans died with the replica)
+                        done_event["spans"] = req.trace_spans
+                    self.send(done_event)
                     handles.pop(rid)
                     sent.pop(rid)
         except BaseException as exc:  # the router must hear about ANY death
@@ -363,6 +382,13 @@ class ProcessReplica:
         else:
             child_env.pop(CHAOS_ENV_VAR, None)  # a parent-armed schedule must
             # not leak into every replica — chaos targets are explicit
+        # the ROUTER host owns the /metrics endpoint: a child inheriting the
+        # parent's fixed port would fail the bind (degrading to a warning,
+        # but N warning-spewing children serve nobody — the child's registry
+        # still arms via telemetry and its spans ship over the event stream)
+        from ..telemetry.metrics import METRICS_PORT_ENV_VAR
+
+        child_env.pop(METRICS_PORT_ENV_VAR, None)
         # -c instead of -m: runpy would re-execute a module the package
         # __init__ already imported and warn about it
         worker = (

@@ -49,6 +49,8 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..telemetry import events as tel
+from ..telemetry import metrics as _metrics
+from ..telemetry import tracing as _tracing
 from ..telemetry import watchdog as _watchdog
 from .admission import PRIORITY_BATCH, AdmissionController
 from .replica import ReplicaState
@@ -99,6 +101,14 @@ class RouterRequest:
     error: Optional[str] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    # distributed tracing (telemetry/tracing.py): the root context and this
+    # request's assembled spans — router-side admission/dispatch spans plus
+    # the engine spans shipped back in the replica's ``done`` event. None /
+    # empty while tracing is disarmed.
+    trace: Optional[dict] = field(default=None, repr=False)
+    trace_spans: "list[dict]" = field(default_factory=list, repr=False)
+    _span_root: Optional[dict] = field(default=None, repr=False)
+    _span_dispatch: Optional[dict] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -144,6 +154,8 @@ class ServingRouter:
         max_respawns_per_replica: int = 2,
         respawn_backoff_base_s: float = 0.1,
         respawn_backoff_max_s: float = 30.0,
+        slo_monitor: Optional[Any] = None,
+        slo_eval_interval_s: float = 1.0,
     ):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -186,6 +198,17 @@ class ServingRouter:
         # replicas the operator put in DRAINING before they died: a requested
         # scale-down must never be undone by a self-heal respawn
         self._decommissioned: "set[str]" = set()
+        # live observability (PR 15): tracing/metrics arm from the env (both
+        # None-branch no-ops when unconfigured); an optional
+        # telemetry.slo.SLOMonitor turns per-request outcomes into burn-rate
+        # evaluation, and a replica BURNING its fast ttft window counts
+        # toward DRAINING pressure in _dispatch.
+        _tracing.maybe_arm_from_env()
+        _metrics.maybe_enable_from_env()
+        self.slo_monitor = slo_monitor
+        self.slo_eval_interval_s = float(slo_eval_interval_s)
+        self._last_slo_eval = float("-inf")
+        self._burning_replicas: "set[str]" = set()
         for n in self.replicas:
             _watchdog.register(f"serving_replica:{n}")
 
@@ -216,7 +239,24 @@ class ServingRouter:
             deadline_t=(now + deadline_s) if deadline_s is not None else None,
             arrival_t=now,
         )
+        admission_t0 = 0
+        if _tracing.is_armed():
+            req.trace = _tracing.new_trace()
+            req._span_root = _tracing.span_open(
+                req.trace, "request", component="router", rid=req.rid,
+                prompt_tokens=int(req.prompt.size),
+                max_new_tokens=int(req.max_new_tokens),
+                priority=int(req.priority),
+            )
+            req.trace_spans.append(req._span_root)
+            admission_t0 = _tracing.now_ns()
         verdict = self.admission.try_admit(req, cost=req.cost_tokens, now=now)
+        if admission_t0:
+            req.trace_spans.append(_tracing.make_span(
+                req.trace, "admission", admission_t0, _tracing.now_ns(),
+                parent_id=req._span_root["span_id"], component="router",
+                admitted=bool(verdict.admitted), reason=verdict.reason,
+            ))
         for victim in verdict.evicted:
             self._finalize(
                 victim, RouterRequestStatus.SHED, now,
@@ -244,7 +284,26 @@ class ServingRouter:
                 error="expired: deadline passed before dispatch",
             )
             activity = True
+        if (
+            self.slo_monitor is not None
+            and now - self._last_slo_eval >= self.slo_eval_interval_s
+        ):
+            # burn-rate evaluation (throttled): emits slo_violation records
+            # on episode entry, and refreshes the burning-replica set the
+            # dispatch loop treats as DRAINING pressure
+            self._last_slo_eval = now
+            self.slo_monitor.evaluate(now=now)
+            if "ttft" in getattr(self.slo_monitor, "objectives", {}):
+                self._burning_replicas = set(
+                    self.slo_monitor.burning_sources("ttft", now=now)
+                )
         activity |= self._dispatch(now)
+        if activity and _metrics.is_enabled():
+            _metrics.set_gauge("accelerate_router_queue_depth", self.admission.depth)
+            _metrics.set_gauge("accelerate_router_inflight", len(self._inflight))
+            _metrics.observe("accelerate_router_queue_depth_hist", self.admission.depth,
+                             buckets=_metrics.DEPTH_BUCKETS)
+            _metrics.maybe_snapshot()
         if activity and tel.is_enabled():
             self._emit_poll(now)
         return self._terminal_this_poll
@@ -295,6 +354,7 @@ class ServingRouter:
             self._emit_replica(rep, self.clock())
 
     def close(self) -> None:
+        _metrics.snapshot_now()  # persist the final counters for the report
         for n, rep in self.replicas.items():
             _watchdog.unregister(f"serving_replica:{n}")
             try:
@@ -349,6 +409,15 @@ class ServingRouter:
                     if req is None or req.replica != name:
                         continue  # stale: this request was failed over already
                     del self._inflight[req.rid]
+                    if req.trace is not None:
+                        # the engine's spans ride home in the done event; the
+                        # router is the trace's single writer
+                        req.trace_spans.extend(ev.get("spans") or [])
+                        if req._span_dispatch is not None:
+                            _tracing.span_close(
+                                req._span_dispatch, outcome=str(ev.get("status"))
+                            )
+                            req._span_dispatch = None
                     if ev.get("status") == "finished":
                         req.generated = [int(t) for t in ev.get("tokens", [])]
                         req.preemptions = int(ev.get("preemptions", 0))
@@ -465,12 +534,22 @@ class ServingRouter:
         if tel.is_enabled():
             tel.emit("serving_replica", replica=rep.name, state="dead", reason=reason)
         self._emit_replica(rep, now)
+        _metrics.inc("accelerate_replica_deaths_total", replica=rep.name)
         for req in self._outstanding(rep.name):
             del self._inflight[req.rid]
             req.replica = None
             req.retries += 1
             self.failovers += 1
             self._per_replica[rep.name]["failovers"] += 1
+            _metrics.inc("accelerate_failovers_total")
+            if req._span_dispatch is not None:
+                # the hop that died: closed with the failover verdict so the
+                # retry lineage (this span + the next dispatch's) is explicit
+                _tracing.span_close(
+                    req._span_dispatch, outcome="failover", reason=reason,
+                    streamed_tokens=len(req.generated),
+                )
+                req._span_dispatch = None
             if req.done_decoding:
                 # every token was already streamed back before the death —
                 # the work is done, only the done event was lost
@@ -538,23 +617,53 @@ class ServingRouter:
                 )
                 activity = True
                 continue
-            target = min(ready, key=lambda r: self.outstanding_tokens(r.name))
+            # a replica burning its fast SLO window (self._burning_replicas)
+            # counts toward DRAINING pressure: it loses ties and is only
+            # chosen when every ready replica is burning — never a deadlock,
+            # always a lean away from the replica missing its objective
+            target = min(
+                ready,
+                key=lambda r: (
+                    r.name in self._burning_replicas,
+                    self.outstanding_tokens(r.name),
+                ),
+            )
             req.replica = target.name
             req._resume_from = len(req.generated)
             req.status = RouterRequestStatus.DISPATCHED
             self._inflight[req.rid] = req
             self.dispatched += 1
             self._per_replica[target.name]["dispatched"] += 1
-            target.submit(
-                {
-                    "rid": req.rid,
-                    "prompt": [int(t) for t in req.prompt],
-                    "max_new": req.max_new_tokens,
-                    "eos": req.eos_token_id,
-                    "rng_seed": req.rng_seed,
-                    "generated": list(req.generated),
-                }
-            )
+            payload = {
+                "rid": req.rid,
+                "prompt": [int(t) for t in req.prompt],
+                "max_new": req.max_new_tokens,
+                "eos": req.eos_token_id,
+                "rng_seed": req.rng_seed,
+                "generated": list(req.generated),
+            }
+            if req.trace is not None:
+                # one dispatch span per attempt: a failed-over request shows
+                # its full retry lineage (attempt numbers, replicas) as
+                # sibling dispatch spans under one trace_id
+                req._span_dispatch = _tracing.span_open(
+                    req.trace, "dispatch", parent_id=req._span_root["span_id"],
+                    component="router", replica=target.name,
+                    attempt=int(req.retries),
+                    resume_tokens=len(req.generated),
+                )
+                req.trace_spans.append(req._span_dispatch)
+                wire_ctx = _tracing.TraceContext(req.trace).child(
+                    req._span_dispatch["span_id"]
+                )
+                if req.retries > 0:
+                    # a failover survivor's trace is FORCE-emitted at finalize
+                    # — flip sampled on for this hop so the engine records
+                    # full decode detail instead of the unsampled skeleton
+                    wire_ctx = _tracing.TraceContext(wire_ctx, sampled=True)
+                payload["trace"] = dict(wire_ctx)  # plain dict on the wire:
+                # both transports JSON it verbatim
+            target.submit(payload)
             activity = True
 
     def _finalize(
@@ -578,6 +687,32 @@ class ServingRouter:
                 self.expired += 1
             elif status is RouterRequestStatus.FAILED:
                 self.failed += 1
+        if req.trace is not None:
+            # close any dangling dispatch span (e.g. FAILED with the replica
+            # gone) and the root, then emit: sampled traces always, and
+            # FORCED for the traces an operator will ask about — shed,
+            # expired, failed, or failover survivors
+            if req._span_dispatch is not None:
+                _tracing.span_close(req._span_dispatch, outcome=status.value)
+                req._span_dispatch = None
+            _tracing.span_close(
+                req._span_root, outcome=status.value, retries=int(req.retries),
+                tokens=len(req.generated), error=req.error,
+            )
+            _tracing.finish_trace(
+                req.trace, req.trace_spans,
+                forced=status is not RouterRequestStatus.FINISHED or req.retries > 0,
+            )
+        if _metrics.is_enabled():
+            _metrics.inc("accelerate_router_requests_total", outcome=status.value)
+            if status is RouterRequestStatus.FINISHED:
+                _metrics.observe("accelerate_router_request_latency_seconds",
+                                 now - req.arrival_t)
+                if req.first_token_t is not None:
+                    _metrics.observe("accelerate_router_ttft_seconds",
+                                     req.first_token_t - req.arrival_t)
+        if self.slo_monitor is not None:
+            self._observe_slo(req, status, now)
         terminal = getattr(self, "_terminal_this_poll", None)
         if terminal is not None and status is not RouterRequestStatus.SHED:
             terminal.append(req)
@@ -598,6 +733,39 @@ class ServingRouter:
                 else None,
                 error=req.error,
             )
+
+    def _observe_slo(self, req: RouterRequest, status: RouterRequestStatus,
+                     now: float) -> None:
+        """Feed one terminal outcome into the SLO monitor (only objectives
+        the monitor actually declares): ``shed_rate`` sees every submission,
+        ``availability`` and ``ttft`` see admitted work (a request that died
+        without a first token is an over-threshold ttft by definition)."""
+        objectives = getattr(self.slo_monitor, "objectives", {})
+        shed = status is RouterRequestStatus.SHED
+        if "shed_rate" in objectives:
+            self.slo_monitor.observe("shed_rate", good=not shed, now=now)
+        if shed:
+            return
+        # per-replica attribution only for requests that lived on ONE
+        # replica: a failover survivor's ttft/latency was inflated by the
+        # DEAD replica (death detection + re-prefill), and blaming the
+        # healthy survivor would drain exactly the replica that absorbed
+        # the work — retried requests count toward the GLOBAL burn only
+        source = req.replica if req.retries == 0 else None
+        if "availability" in objectives:
+            self.slo_monitor.observe(
+                "availability",
+                good=status is RouterRequestStatus.FINISHED,
+                source=source,
+                now=now,
+            )
+        if "ttft" in objectives:
+            ttft = (
+                req.first_token_t - req.arrival_t
+                if req.first_token_t is not None
+                else float("inf")
+            )
+            self.slo_monitor.observe("ttft", value=ttft, source=source, now=now)
 
     # -- telemetry -----------------------------------------------------------
 

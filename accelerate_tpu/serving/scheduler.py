@@ -33,6 +33,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..telemetry import metrics as _metrics
 from .kv_pager import BlockAllocator, BlockPoolExhausted
 
 __all__ = ["RequestStatus", "Request", "Scheduler", "SchedulingError"]
@@ -83,8 +84,19 @@ class Request:
     # pending copy-on-write pair the engine must apply before any write
     cached_tokens: int = 0
     cow_block: "Optional[tuple[int, int]]" = None
+    # distributed-tracing state (telemetry/tracing.py): the propagated
+    # context (None while tracing is disarmed — every check stays one
+    # branch) and this request's accumulated span dicts. The engine fills
+    # them; router-owned requests ship the spans back over the replica
+    # event stream instead of emitting locally.
+    trace: Optional[dict] = None
+    trace_spans: "list[dict]" = field(default_factory=list)
     # engine-side PRNGKey cache (pure function of rng_seed)
     _key: Optional[np.ndarray] = field(default=None, repr=False, init=False)
+    # open trace spans (closed as the request moves through the engine)
+    _span_root: Optional[dict] = field(default=None, repr=False, init=False)
+    _span_queue: Optional[dict] = field(default=None, repr=False, init=False)
+    _trace_owner: bool = field(default=False, repr=False, init=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -276,6 +288,7 @@ class Scheduler:
             req.status = RequestStatus.PREEMPTED
             req.preemptions += 1
             self.preemption_count += 1
+            _metrics.inc("accelerate_preemptions_total")
             self.queue.appendleft(req)
             return True
         return False

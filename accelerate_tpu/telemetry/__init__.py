@@ -29,10 +29,26 @@ to a loss-curve tracker. Layout:
 - :mod:`.watchdog` — heartbeat thread (``ACCELERATE_WATCHDOG_TIMEOUT``) that
   detects stalled heartbeat sources and blocked phases (e.g. a rank stuck in
   ``collective:gather``), dumps the flight record and optionally aborts.
+- :mod:`.tracing` — request-scoped distributed tracing for the serving
+  path: a dependency-free span model with context propagation across the
+  replica transports (``ACCELERATE_TRACE_SAMPLE`` arms it; SHED/FAILED/
+  failover traces are always kept), Chrome ``trace.json`` export and the
+  gap-free span-tree validator.
+- :mod:`.metrics` — the streaming metrics plane: typed
+  counter/gauge/histogram registry fed by the serving stack, Prometheus
+  text exposition from a stdlib HTTP thread (``ACCELERATE_METRICS_PORT``),
+  periodic ``metrics`` snapshot records, and THE shared
+  histogram/percentile implementation the report CLI and benches use.
+- :mod:`.slo` — SLO burn-rate monitoring: declarative objectives (ttft,
+  availability, shed rate, step latency, restart downtime) evaluated over
+  fast/slow windows, ``slo_violation`` records, and the burning-replica
+  signal the serving router folds into dispatch.
 - :mod:`.report` — ``python -m accelerate_tpu.telemetry report <dir>``
   aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes;
-  ``--by-rank`` adds cross-rank straggler/heartbeat/flight forensics) and the
-  ``doctor`` self-check subcommand.
+  ``--request <id>`` renders one request's span timeline, ``--trace-out``
+  exports it as a Chrome trace; ``--by-rank`` adds cross-rank
+  straggler/heartbeat/flight forensics) and the ``doctor`` self-check
+  subcommand.
 - :mod:`.tracker_bridge` — mirrors report summaries into ``tracking.py``
   trackers so the metrics land wherever users already log.
 
@@ -40,7 +56,7 @@ Comms counters live in :mod:`accelerate_tpu.utils.operations` (the ops being
 counted) and write through :mod:`.events`.
 """
 
-from . import flight_recorder, perf, watchdog, xplane
+from . import flight_recorder, metrics, perf, slo, tracing, watchdog, xplane
 from .events import (
     TELEMETRY_DIR_ENV_VAR,
     TELEMETRY_ENV_VAR,
@@ -61,8 +77,11 @@ from .events import (
 )
 from .flight_recorder import FlightRecorder
 from .memory import MemoryMonitor, device_memory_stats, host_memory_bytes, live_array_bytes
+from .metrics import Histogram, MetricsRegistry
 from .perf import CompiledCost, HardwarePeaks, capture_compiled, lm_train_mfu, peaks_for_device
+from .slo import SLObjective, SLOMonitor
 from .step_profiler import RecompileWatcher, StepTelemetry, record_data_wait
+from .tracing import TraceContext
 from .tracker_bridge import mirror_to_trackers, summary_metrics
 from .watchdog import Watchdog
 from .xplane import TraceWindows, summarize_trace
@@ -75,9 +94,14 @@ __all__ = [
     "EventLog",
     "FlightRecorder",
     "HardwarePeaks",
+    "Histogram",
     "MemoryMonitor",
+    "MetricsRegistry",
     "RecompileWatcher",
+    "SLOMonitor",
+    "SLObjective",
     "StepTelemetry",
+    "TraceContext",
     "TraceWindows",
     "Watchdog",
     "capture_compiled",
@@ -96,14 +120,17 @@ __all__ = [
     "live_array_bytes",
     "lm_train_mfu",
     "maybe_enable_from_env",
+    "metrics",
     "mirror_to_trackers",
     "peaks_for_device",
     "perf",
     "record_data_wait",
     "set_step",
+    "slo",
     "span",
     "summarize_trace",
     "summary_metrics",
+    "tracing",
     "watchdog",
     "xplane",
 ]

@@ -36,11 +36,15 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import re
 import sys
 from typing import Iterable, Optional
+
+# THE percentile/histogram implementations live in telemetry.metrics — the
+# report re-exports `percentile` for its callers but owns no private math
+# (tests/test_observability.py ratchets that across the repo)
+from .metrics import hist_dist, percentile
 
 PERCENTILES = (50, 90, 99)
 
@@ -82,14 +86,6 @@ def load_events(paths: Iterable[str]) -> "list[dict]":
     return events
 
 
-def percentile(values: "list[float]", p: int) -> float:
-    """Nearest-rank percentile (ceil rank) of an already-sorted list."""
-    if not values:
-        return 0.0
-    idx = min(len(values) - 1, max(0, math.ceil(p / 100.0 * len(values)) - 1))
-    return values[idx]
-
-
 def _dist(values: "list[float]") -> dict:
     values = sorted(values)
     if not values:
@@ -98,7 +94,8 @@ def _dist(values: "list[float]") -> dict:
         "count": len(values),
         "mean": round(sum(values) / len(values), 6),
         "max": round(values[-1], 6),
-        **{f"p{p}": round(percentile(values, p), 6) for p in PERCENTILES},
+        # presorted: one sort per distribution, not four
+        **{f"p{p}": round(percentile(values, p, presorted=True), 6) for p in PERCENTILES},
     }
 
 
@@ -474,15 +471,69 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
             "rejected": sum(1 for r in reqs if r.get("error")),
             "preempted": sum(1 for r in completed if r.get("preemptions")),
             "new_tokens": sum(int(r.get("new_tokens", 0)) for r in completed),
-            "latency_s": _dist(
+            # latency/ttft go through the SHARED fixed-bucket histogram
+            # (telemetry.metrics), so these percentiles are bit-identical to
+            # what a live /metrics scrape of the same run computes
+            "latency_s": hist_dist(
                 [float(r["latency_s"]) for r in completed if r.get("latency_s") is not None]
             ),
-            "ttft_s": _dist(
+            "ttft_s": hist_dist(
                 [float(r["ttft_s"]) for r in completed if r.get("ttft_s") is not None]
             ),
         },
     }
     return section
+
+
+def _slo_section(events: "list[dict]") -> Optional[dict]:
+    """Aggregate ``slo_violation`` records (``telemetry/slo.py``): one per
+    burn-episode ENTRY, so the count is "how many times did we start burning
+    through the budget", with the worst observed burn rates per objective.
+    ``None`` when the streams carry no SLO records — runs without a monitor
+    armed don't grow an empty section."""
+    violations = [e for e in events if e.get("kind") == "slo_violation"]
+    if not violations:
+        return None
+    by_slo: dict = {}
+    for v in violations:
+        name = str(v.get("slo", "?"))
+        rec = by_slo.setdefault(
+            name,
+            {
+                "violations": 0,
+                "kind": v.get("slo_kind"),
+                "target": v.get("target"),
+                "threshold_s": v.get("threshold_s"),
+                "burn_threshold": v.get("burn_threshold"),
+                "worst_fast_burn": 0.0,
+                "worst_slow_burn": 0.0,
+                "fast_window_s": v.get("fast_window_s"),
+                "slow_window_s": v.get("slow_window_s"),
+            },
+        )
+        rec["violations"] += 1
+        rec["worst_fast_burn"] = max(rec["worst_fast_burn"], float(v.get("fast_burn", 0.0)))
+        rec["worst_slow_burn"] = max(rec["worst_slow_burn"], float(v.get("slow_burn", 0.0)))
+    return {"violations": len(violations), "by_slo": dict(sorted(by_slo.items()))}
+
+
+def format_slo_section(slo: dict) -> str:
+    """Human rendering of the SLO burn-rate violations (see
+    ``docs/observability.md`` for how to write an objective)."""
+    lines = [f"SLO: {slo['violations']} violation episode(s)"]
+    for name, rec in (slo.get("by_slo") or {}).items():
+        target = rec.get("target")
+        thr = rec.get("threshold_s")
+        obj = f"{target:.2%} good" if target is not None else "?"
+        if thr is not None:
+            obj += f" @ {thr * 1e3:.0f}ms"
+        lines.append(
+            f"  {name}: {rec['violations']} episode(s) — objective {obj}, worst "
+            f"burn fast={rec['worst_fast_burn']:.1f}x slow={rec['worst_slow_burn']:.1f}x "
+            f"(threshold {rec.get('burn_threshold')}x over "
+            f"{rec.get('fast_window_s', 0) / 60:.0f}m/{rec.get('slow_window_s', 0) / 60:.0f}m)"
+        )
+    return "\n".join(lines)
 
 
 def _compile_cache_section(events: "list[dict]") -> Optional[dict]:
@@ -598,10 +649,12 @@ def _router_section(events: "list[dict]") -> Optional[dict]:
         "requests": {
             "finished": len(finished),
             "retried": sum(1 for r in finished if int(r.get("retries", 0)) > 0),
-            "latency_s": _dist(
+            # the shared fixed-bucket histogram (telemetry.metrics): report
+            # percentiles == live-scrape percentiles over the same events
+            "latency_s": hist_dist(
                 [float(r["latency_s"]) for r in finished if r.get("latency_s") is not None]
             ),
-            "ttft_s": _dist(
+            "ttft_s": hist_dist(
                 [float(r["ttft_s"]) for r in finished if r.get("ttft_s") is not None]
             ),
         },
@@ -733,6 +786,13 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "performance": _performance_section(events, steps),
         "serving": _serving_section(events),
         "router": _router_section(events),
+        "slo": _slo_section(events),
+        # trace roots only: legacy EventLog.span timing records share the
+        # kind but carry no trace_id
+        "traces": sum(
+            1 for e in events
+            if e.get("kind") == "span" and e.get("trace_id") and not e.get("parent_id")
+        ),
         "restarts": _restarts_section(events),
         "compile_cache": _compile_cache_section(events),
     }
@@ -899,6 +959,14 @@ def format_report(report: dict) -> str:
     router = report.get("router")
     if router:
         lines.append(format_router_section(router))
+    slo = report.get("slo")
+    if slo:
+        lines.append(format_slo_section(slo))
+    if report.get("traces"):
+        lines.append(
+            f"traces: {report['traces']} request trace(s) recorded — "
+            "`report --request <id>` renders one, `--trace-out` exports Chrome JSON"
+        )
     ccache = report.get("compile_cache")
     if ccache:
         lines.append(format_compile_cache_section(ccache))
@@ -1225,6 +1293,73 @@ def format_rank_section(ranks: dict) -> str:
     return "\n".join(lines)
 
 
+def find_request_trace(events: "list[dict]", rid: str) -> "tuple[Optional[str], list[dict]]":
+    """Locate one request's trace among merged ``span`` records: by the root
+    span's ``rid`` attribute (the router's ``q<n>`` / the engine's integer
+    rid) or by a raw trace id. Returns ``(trace_id, spans)``."""
+    from . import tracing as _tracing
+
+    traces = _tracing.spans_by_trace(events)
+    if rid in traces:
+        return rid, traces[rid]
+    for tid, spans in traces.items():
+        for s in spans:
+            if not s.get("parent_id") and str((s.get("attrs") or {}).get("rid")) == str(rid):
+                return tid, spans
+    return None, []
+
+
+def render_request(paths: Iterable[str], rid: str,
+                   trace_out: Optional[str] = None) -> "tuple[int, str]":
+    """The ``report --request <id>`` body: one request's span timeline
+    (queue → dispatch → prefill chunks → decode steps → failover hops) from
+    the trace records, optionally exported as Chrome ``trace.json``."""
+    from . import tracing as _tracing
+
+    events = load_events(paths)
+    trace_id, spans = find_request_trace(events, rid)
+    if not spans:
+        available = sorted(
+            str((s.get("attrs") or {}).get("rid"))
+            for t in _tracing.spans_by_trace(events).values()
+            for s in t
+            if not s.get("parent_id")
+        )
+        hint = f" (traced requests: {', '.join(available[:10])})" if available else (
+            " (no span records — was ACCELERATE_TRACE_SAMPLE set on the serving run?)"
+        )
+        return 1, f"no trace found for request {rid!r}{hint}"
+    problems = _tracing.validate_span_tree(spans)
+    root = next((s for s in spans if not s.get("parent_id")), spans[0])
+    attrs = root.get("attrs") or {}
+    header = (
+        f"request {rid} — trace {trace_id}, {len(spans)} span(s), "
+        f"outcome {attrs.get('outcome', '?')}"
+        + (f", {attrs.get('retries')} failover retr(ies)" if attrs.get("retries") else "")
+    )
+    lines = [header, _tracing.format_timeline(spans)]
+    if problems:
+        lines.append("  WARNING: span tree has gaps: " + "; ".join(problems))
+    if trace_out:
+        with open(trace_out, "w") as f:
+            json.dump(_tracing.chrome_trace(spans), f)
+        lines.append(f"  chrome trace written to {trace_out}")
+    return 0, "\n".join(lines)
+
+
+def export_traces(paths: Iterable[str], trace_out: str) -> "tuple[int, str]":
+    """``report --trace-out`` without ``--request``: every recorded span as
+    one Chrome trace file (all requests side by side)."""
+    from . import tracing as _tracing
+
+    events = load_events(paths)
+    # trace spans only (legacy EventLog.span timing records have no trace_id)
+    spans = [e for e in events if e.get("kind") == "span" and e.get("trace_id")]
+    with open(trace_out, "w") as f:
+        json.dump(_tracing.chrome_trace(spans), f)
+    return 0, f"{len(spans)} span(s) written to {trace_out}"
+
+
 def run_doctor() -> int:
     """Self-check the forensics pipeline: flight dump → watchdog stall
     detection → straggler report. Exercises the real code paths against
@@ -1478,6 +1613,19 @@ def run_doctor() -> int:
             _doctor_prefix_cache(tmp, _check)
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("prefix cache + COW", False, f"{type(exc).__name__}: {exc}")
+
+        # 16. observability plane (ISSUE 15): a 2-replica CPU router with
+        # tracing + metrics ON under a seeded workload with one injected
+        # kill — every completed request must carry a GAP-FREE span tree
+        # (admission→dispatch→prefill→decode, failover hops included), the
+        # live /metrics scrape's ttft histogram count must equal the
+        # completions (and its quantiles match the report's serving
+        # section), and one slo_violation must fire under an artificially
+        # tight ttft objective
+        try:
+            _doctor_observability(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("observability plane", False, f"{type(exc).__name__}: {exc}")
 
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
@@ -1873,6 +2021,136 @@ def _doctor_router(tmp: str, _check) -> None:
     )
 
 
+def _doctor_observability(tmp: str, _check) -> None:
+    """Doctor check 16 body: two thread-backed CPU replicas behind the
+    router with tracing + metrics + SLO monitoring armed, a seeded chaos
+    ``crash`` killing one replica mid-decode. Requires (a) every FINISHED
+    request carries a gap-free span tree and the failover survivor shows
+    its retry lineage (two dispatch spans, one trace_id), (b) the live
+    ``/metrics`` scrape's router-ttft histogram count equals the
+    completions and its quantiles match the report CLI's router section
+    (same shared histogram math), and (c) at least one ``slo_violation``
+    fires under an artificially tight ttft objective."""
+    import dataclasses
+    import urllib.request
+
+    import numpy as np
+
+    from ..models import LlamaConfig
+    from ..resilience import chaos
+    from ..resilience.chaos import ChaosSchedule, Fault
+    from ..serving import (
+        AdmissionController,
+        LocalReplica,
+        ReplicaSpec,
+        ReplicaState,
+        RouterRequestStatus,
+        ServingRouter,
+    )
+    from . import events as tel_events
+    from . import metrics as _metrics
+    from . import tracing as _tracing
+    from .slo import SLOMonitor, serving_slos
+
+    config = LlamaConfig.tiny()
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config), num_blocks=33, block_size=8,
+        max_slots=2, slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(16,),
+    )
+    obs_dir = os.path.join(tmp, "observability")
+    tel_events.enable(out_dir=obs_dir, run_id="doctor-observability")
+    router = None
+    try:
+        _tracing.arm(1.0)
+        # earlier checks ran serving engines with telemetry on, which arms
+        # the process-wide registry — this check compares scrape counts
+        # against ITS run, so it starts from a fresh one
+        _metrics.disable()
+        _metrics.enable()
+        _metrics.serve(0)  # a real HTTP scrape, not a registry shortcut
+        port = _metrics.server_port()
+        chaos.arm(ChaosSchedule(
+            faults=[Fault(kind="crash", point="serving_decode", step=4)]
+        ))
+        monitor = SLOMonitor(
+            # ttft threshold of 1µs: every request is "bad", the burn rate
+            # saturates, and the violation machinery must fire
+            serving_slos(ttft_threshold_s=1e-6), min_events=4,
+        )
+        replicas = [LocalReplica(f"r{i}", spec) for i in range(2)]
+        router = ServingRouter(
+            replicas,
+            admission=AdmissionController(max_queue=16),
+            health_timeout_s=10.0,
+            slo_monitor=monitor,
+            slo_eval_interval_s=0.0,
+        )
+        router.wait_ready(timeout_s=300)
+        rng = np.random.default_rng(16)
+        reqs = []
+        for i in range(8):
+            prompt = rng.integers(0, config.vocab_size, (int(rng.integers(4, 12)),))
+            reqs.append(router.submit(prompt.astype(np.int32), 8, rng_seed=i))
+        router.run(timeout_s=300)
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+    finally:
+        chaos.arm(None)
+        if router is not None:
+            router.close()
+        _tracing.disarm()
+        _metrics.disable()
+        tel_events.disable()
+
+    finished = [r for r in reqs if r.status is RouterRequestStatus.FINISHED]
+    tree_problems = {
+        r.rid: _tracing.validate_span_tree(r.trace_spans)
+        for r in finished
+        if _tracing.validate_span_tree(r.trace_spans)
+    }
+    retried = [r for r in reqs if r.retries > 0]
+    lineage_ok = bool(retried) and all(
+        sum(1 for s in r.trace_spans if s["name"] == "dispatch") >= 2
+        and len({s["trace_id"] for s in r.trace_spans}) == 1
+        for r in retried
+    )
+    dead = [n for n, r in router.replicas.items() if r.state is ReplicaState.DEAD]
+
+    hist = _metrics.histogram_from_scrape(
+        _metrics.parse_prometheus_text(scrape), "accelerate_router_ttft_seconds"
+    )
+    report = build_report([obs_dir])
+    router_section = report.get("router") or {}
+    report_ttft = (router_section.get("requests") or {}).get("ttft_s") or {}
+    scrape_matches = (
+        hist is not None
+        and hist.count == len(finished)
+        # identical bucket math: the record values round at 1e-6, so agree
+        # to that precision
+        and abs(hist.quantile(0.50) - report_ttft.get("p50", -1)) < 2e-6
+        and abs(hist.quantile(0.99) - report_ttft.get("p99", -1)) < 2e-6
+    )
+    slo_section = report.get("slo") or {}
+    text = format_report(report)
+    ok = (
+        len(finished) == len(reqs)
+        and not tree_problems
+        and len(dead) == 1
+        and lineage_ok
+        and scrape_matches
+        and (slo_section.get("by_slo") or {}).get("ttft", {}).get("violations", 0) >= 1
+        and "SLO:" in text
+    )
+    _check(
+        "observability plane",
+        ok,
+        f"finished={len(finished)}/{len(reqs)} tree_problems={tree_problems} "
+        f"dead={dead} lineage_ok={lineage_ok} hist_count={getattr(hist, 'count', None)} "
+        f"report_ttft={report_ttft} slo={slo_section}",
+    )
+
+
 def _doctor_fused_zero1(_check) -> None:
     """Doctor check 9 body: jaxlint R3/R4 over the fused-update module +
     accelerator, then a subprocess self_check compiling the fused step and
@@ -1984,6 +2262,18 @@ def main(argv: Optional["list[str]"] = None) -> int:
         help="cross-rank straggler section: per-step rank skew, heartbeat gaps, "
         "flight records",
     )
+    rep.add_argument(
+        "--request",
+        metavar="ID",
+        help="render one request's distributed-trace span timeline (router rid "
+        "like q3, an engine rid, or a raw trace id) instead of the aggregate report",
+    )
+    rep.add_argument(
+        "--trace-out",
+        metavar="FILE",
+        help="write the span records as a Chrome trace.json (with --request: "
+        "that request only; alone: every recorded trace)",
+    )
     sub.add_parser("doctor", help="self-check the watchdog/flight-recorder/report pipeline")
     args = parser.parse_args(argv)
     if args.command == "doctor":
@@ -1991,6 +2281,14 @@ def main(argv: Optional["list[str]"] = None) -> int:
     if args.command != "report":
         parser.print_help()
         return 2
+    if args.request is not None:
+        rc, text = render_request(args.paths, args.request, trace_out=args.trace_out)
+        print(text)
+        return rc
+    if args.trace_out is not None:
+        rc, text = export_traces(args.paths, args.trace_out)
+        print(text)
+        return rc
     report = build_report(args.paths, by_rank=args.by_rank)
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))

@@ -34,11 +34,8 @@ def emit(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
 
 
-def percentile(values, p):
-    """Round-half-rank percentile of an unsorted list (the benches' shared
-    definition; telemetry.report.percentile is the ceil-rank variant)."""
-    values = sorted(values)
-    if not values:
-        return 0.0
-    idx = min(len(values) - 1, max(0, int(round(p / 100 * (len(values) - 1)))))
-    return values[idx]
+# THE percentile implementation lives in telemetry.metrics (nearest-rank,
+# shared with the report CLI and the /metrics histogram plane) — the benches
+# re-export it instead of carrying a private variant, so a bench's p99 and
+# the report's p99 of the same numbers can never disagree.
+from accelerate_tpu.telemetry.metrics import percentile  # noqa: E402,F401

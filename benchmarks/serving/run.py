@@ -118,13 +118,19 @@ def run_leg(params, config, workload, *, continuous, max_slots, num_blocks,
 
 
 def _drain_through_router(spec, workload, *, n_replicas, kill_after=None,
-                          health_timeout_s=10.0):
+                          health_timeout_s=10.0, traced=False):
     """Drain the whole workload as a backlog through a router over
     ``n_replicas`` thread-backed replicas; optionally SIGKILL-equivalent one
     replica after ``kill_after`` completions (abrupt: in-flight work is
     failed over with token-exact resume). Returns the leg metrics plus every
     request's output tokens so the kill leg can be parity-checked against
-    the unkilled one."""
+    the unkilled one.
+
+    ``traced=True`` arms request-scoped tracing (telemetry/tracing.py) for
+    the leg and reports ``span_trees_complete``: every FINISHED request must
+    carry a gap-free admission→dispatch→prefill→decode span tree, failover
+    hops included — the ISSUE 15 acceptance invariant, measured on the same
+    workload the untraced legs time."""
     import time as _time
 
     from accelerate_tpu.serving import (
@@ -133,7 +139,10 @@ def _drain_through_router(spec, workload, *, n_replicas, kill_after=None,
         RouterRequestStatus,
         ServingRouter,
     )
+    from accelerate_tpu.telemetry import tracing as _tracing
 
+    if traced:
+        _tracing.arm(1.0)
     replicas = [LocalReplica(f"r{i}", spec) for i in range(n_replicas)]
     router = ServingRouter(
         replicas,
@@ -168,7 +177,7 @@ def _drain_through_router(spec, workload, *, n_replicas, kill_after=None,
         completed = [r for r in reqs if r.status is RouterRequestStatus.FINISHED]
         tokens = sum(len(r.generated) for r in completed)
         latencies = [r.finish_t - r.arrival_t for r in completed]
-        return {
+        leg = {
             "replicas": n_replicas,
             "completed": len(completed),
             "lost": len(reqs) - len(completed),
@@ -180,8 +189,24 @@ def _drain_through_router(spec, workload, *, n_replicas, kill_after=None,
             "p99_latency_ms": round(_percentile(latencies, 99) * 1e3, 2),
             "outputs": [[int(t) for t in r.generated] for r in reqs],
         }
+        if traced:
+            broken = [
+                r.rid for r in completed
+                if _tracing.validate_span_tree(r.trace_spans)
+            ]
+            retried = [r for r in completed if r.retries > 0]
+            lineage = all(
+                sum(1 for s in r.trace_spans if s["name"] == "dispatch") >= 2
+                for r in retried
+            )
+            leg["traced"] = True
+            leg["span_trees_complete"] = not broken and lineage
+            leg["broken_span_trees"] = len(broken)
+        return leg
     finally:
         router.close()
+        if traced:
+            _tracing.disarm()
 
 
 def run_bench_replicated(
@@ -226,8 +251,17 @@ def run_bench_replicated(
     kill = _drain_through_router(
         spec, workload, n_replicas=n_replicas, kill_after=max(1, requests // 4)
     )
+    # the ISSUE 15 leg: the SAME kill workload with tracing armed — outputs
+    # must stay bitwise-identical, every completion must carry a gap-free
+    # span tree (failover hops included), and the tok/s ratio against the
+    # untraced kill leg reports the tracing tax honestly
+    traced = _drain_through_router(
+        spec, workload, n_replicas=n_replicas,
+        kill_after=max(1, requests // 4), traced=True,
+    )
     parity = kill["outputs"] == many["outputs"]
-    for leg in (one, many, kill):
+    traced_parity = traced["outputs"] == many["outputs"]
+    for leg in (one, many, kill, traced):
         leg.pop("outputs")
     return {
         "bench": "serving_replicated",
@@ -236,7 +270,12 @@ def run_bench_replicated(
         "one_replica": one,
         "replicated": many,
         "replica_kill": kill,
+        "replica_kill_traced": traced,
         "kill_outputs_match_unkilled": parity,
+        "traced_outputs_match_unkilled": traced_parity,
+        "tracing_tokens_per_s_ratio": round(
+            traced["tokens_per_s"] / max(kill["tokens_per_s"], 1e-9), 3
+        ),
         "requests": requests,
         "n_replicas": n_replicas,
         "on_tpu": on_tpu,

@@ -152,6 +152,15 @@ def test_serving_benchmark_smoke():
     assert rep["replica_kill"]["failovers"] >= 1
     assert rep["kill_outputs_match_unkilled"] is True
     assert rep["replica_kill"]["p99_latency_ms"] >= rep["replica_kill"]["p50_latency_ms"]
+    # observability leg (ISSUE 15): tracing ON over the same kill workload —
+    # outputs still bitwise-identical, and 100% of completions carry a
+    # gap-free span tree (failover hops included)
+    traced = rep["replica_kill_traced"]
+    assert traced["completed"] == 8 and traced["lost"] == 0
+    assert traced["span_trees_complete"] is True
+    assert traced["broken_span_trees"] == 0
+    assert rep["traced_outputs_match_unkilled"] is True
+    assert rep["tracing_tokens_per_s_ratio"] > 0
     # shared-prefix leg (ISSUE 14): the deterministic invariants hold even at
     # reduced scale — prefill-token reduction is a token COUNT, not a wall
     # clock, so the ≥40% acceptance bar is assertable here; the wall-clock

@@ -404,7 +404,10 @@ def test_doctor_self_checks(capsys):
     # + serving engine (ISSUE 11)
     # + replicated serving router (ISSUE 12)
     # + persistent compile cache (ISSUE 13)
-    assert out.count("PASS") == 14 and "FAIL" not in out
+    # + prefix cache + COW (ISSUE 14 — the count was left at 14 when that
+    #   check landed; fixed here)
+    # + observability plane (ISSUE 15)
+    assert out.count("PASS") == 16 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out
@@ -413,6 +416,8 @@ def test_doctor_self_checks(capsys):
     assert "performance report section" in out
     assert "elastic auto-resume" in out
     assert "persistent compile cache" in out
+    assert "prefix cache + COW" in out
+    assert "observability plane" in out
 
 
 # ------------------------------------------------------- integration hookups

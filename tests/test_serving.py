@@ -695,9 +695,56 @@ def test_serving_shardings_places_kv_heads_on_tp():
     devices = np.array(jax.devices()[:8]).reshape(4, 2)
     mesh = Mesh(devices, ("dp", "tp"))
     sharding = serving_shardings(mesh, CONFIG)  # tiny config: 2 kv heads % tp=2 == 0
-    assert sharding.spec == P(None, None, None, "tp", None)
+    # CANONICAL form (trailing Nones trimmed): anything else re-specializes
+    # the first warmed prefill bucket on its first steady-state call (the
+    # PR 14 "4x2 recompile" — the dispatch cache compares specs, and GSPMD
+    # hands back the canonical form on every step output)
+    assert sharding.spec == P(None, None, None, "tp")
     # indivisible kv heads stay replicated
     import dataclasses
 
     odd = dataclasses.replace(CONFIG, n_heads=3, n_kv_heads=3)
-    assert serving_shardings(mesh, odd).spec == P(None, None, None, None, None)
+    assert serving_shardings(mesh, odd).spec == P()
+
+
+def test_zero_recompiles_through_churn_on_multidevice_mesh(params):
+    """The 4x2-mesh churn regression (ISSUE 15 satellite): with the pool
+    placed by ``serving_shardings`` on a multi-device mesh, post-warmup
+    churn — including a prompt that CHUNKS past the largest prefill bucket
+    and a small-bucket prefill against a steady-state pool (the exact shape
+    that re-specialized before the canonicalization fix) — must keep every
+    jit cache frozen at the warmed counts, with outputs bitwise-equal to
+    the single-device single-stream reference."""
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.telemetry.step_profiler import RecompileWatcher
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("dp", "tp"))
+    engine = ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=4,
+        lattice=BucketLattice(slot_buckets=(2, 4), block_buckets=(8,),
+                              prefill_buckets=(16, 32)),
+        mesh=mesh,
+    )
+    warmed = engine.warmup()
+    watcher = RecompileWatcher()
+    watcher.register("mesh_prefill", engine.prefill_fn)
+    watcher.register("mesh_decode", engine.decode_fn)
+    rng = np.random.default_rng(21)
+    reqs = []
+    # (9, _) hits the SMALL prefill bucket against a steady-state pool;
+    # (45, _) chunks past the largest (32) bucket; staggered arrivals churn
+    # slot and width buckets
+    for i, (n, new) in enumerate([(9, 4), (45, 6), (30, 4), (5, 8)]):
+        prompt = rng.integers(0, CONFIG.vocab_size, (n,)).astype(np.int32)
+        reqs.append(engine.submit(prompt, new, rng_seed=i))
+        engine.step()
+    engine.run()
+    assert all(r.done for r in reqs)
+    assert engine.jit_cache_sizes() == warmed
+    assert watcher.poll(emit=False) == {}
+    for i, r in enumerate(reqs):
+        ref = greedy_generate(params, r.prompt[None], CONFIG,
+                              max_new_tokens=r.max_new_tokens)
+        assert np.array_equal(np.asarray(ref[0]), r.output_ids()), f"request {i}"

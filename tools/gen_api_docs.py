@@ -222,10 +222,25 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
          ("accelerate_tpu.telemetry.watchdog",
           ["Watchdog", "start", "stop", "maybe_start_from_env", "get_watchdog",
            "beat", "register", "unregister", "env_timeout"]),
+         ("accelerate_tpu.telemetry.tracing",
+          ["TraceContext", "arm", "disarm", "maybe_arm_from_env", "is_armed",
+           "new_trace", "span_open", "span_close", "make_span", "emit_spans",
+           "finish_trace", "spans_by_trace", "validate_span_tree",
+           "chrome_trace", "format_timeline"]),
+         ("accelerate_tpu.telemetry.metrics",
+          ["Counter", "Gauge", "Histogram", "MetricsRegistry", "percentile",
+           "quantile_from_buckets", "hist_dist", "enable", "disable",
+           "maybe_enable_from_env", "inc", "set_gauge", "observe",
+           "snapshot_now", "maybe_snapshot", "serve", "server_port",
+           "stop_server", "parse_prometheus_text", "histogram_from_scrape"]),
+         ("accelerate_tpu.telemetry.slo",
+          ["SLObjective", "SLOMonitor", "serving_slos",
+           "step_latency_slo_from_env", "restart_downtime_slo_from_env"]),
          ("accelerate_tpu.telemetry.report",
           ["build_report", "format_report", "format_rank_section",
-           "format_serving_section", "format_router_section", "load_events",
-           "percentile", "run_doctor", "main"]),
+           "format_serving_section", "format_router_section",
+           "format_slo_section", "render_request", "find_request_trace",
+           "load_events", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "compile_cache": (
