@@ -122,9 +122,11 @@ bench-compile:
 # serving router (2 replicas, one chaos-killed mid-load, exactly-once +
 # bitwise parity), the persistent compile cache (subprocess restart
 # hits with zero recompiles; poisoned entry quarantined + clean fallback),
-# the prefix cache + COW, and the observability plane (traced 2-replica
+# the prefix cache + COW, the observability plane (traced 2-replica
 # router under an injected kill: gap-free span trees, /metrics scrape
-# matching the report, slo_violation under a tight objective)
+# matching the report, slo_violation under a tight objective), and the
+# disaggregated prefill/decode tier (2+2 fleet with a corrupted and a
+# dropped KV handoff: exactly-once + bitwise parity across the handoff)
 # against synthetic inputs (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor

@@ -40,6 +40,7 @@ from .runtime import (
     get_cache,
     maybe_export,
     maybe_load_executable,
+    preship,
     pretouch,
 )
 
@@ -67,5 +68,6 @@ __all__ = [
     "key_from_lowered",
     "maybe_export",
     "maybe_load_executable",
+    "preship",
     "pretouch",
 ]

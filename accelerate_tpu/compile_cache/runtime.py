@@ -38,7 +38,7 @@ from typing import Any, Optional
 
 from ..logging import get_logger
 from ..telemetry import events as tel
-from .cache import CacheKey, CompileCache, LoadResult, key_from_lowered
+from .cache import MANIFEST_NAME, CacheKey, CompileCache, LoadResult, key_from_lowered
 
 logger = get_logger(__name__)
 
@@ -261,6 +261,56 @@ def pretouch(
     except OSError as exc:
         info.update(status="missing", error=str(exc))
     return info
+
+
+def preship(
+    src_dir: str,
+    dst_dir: str,
+    *,
+    fns: Optional["set[str]"] = None,
+    fn_prefixes: "tuple[str, ...]" = ("serving_",),
+) -> "dict[str, Any]":
+    """Warm a JOINER's cache before it boots (the autoscaler's scale-up
+    path): copy committed entries from ``src_dir`` into ``dst_dir`` so the
+    joining replica's warmup is all hits — zero compiles on join.
+
+    Only entries whose manifest ``fn`` matches ship: the exact names in
+    ``fns`` when given (the joiner's warmup lattice), else any
+    ``fn_prefixes`` match — a training fleet's entries never ride along.
+    Each entry is staged (``.tmp-``, invisible to :meth:`CompileCache.
+    entries`) and atomically renamed, so a concurrently booting reader
+    never sees a half-copied entry; entries already present are left
+    alone. Returns ``{"shipped", "skipped", "already", "bytes"}`` and
+    emits one ``compile_cache`` ``preship`` telemetry record."""
+    import shutil
+
+    out: "dict[str, Any]" = {"shipped": 0, "skipped": 0, "already": 0, "bytes": 0}
+    src = CompileCache(src_dir)
+    os.makedirs(dst_dir, exist_ok=True)
+    for path in src.entries():
+        fn = src._entry_fn(path)
+        wanted = (fn in fns) if fns is not None else fn.startswith(tuple(fn_prefixes))
+        if not wanted:
+            out["skipped"] += 1
+            continue
+        dst_entry = os.path.join(dst_dir, os.path.basename(path))
+        if os.path.isfile(os.path.join(dst_entry, MANIFEST_NAME)):
+            out["already"] += 1
+            continue
+        staging = dst_entry + f".tmp-preship-{os.getpid()}-{os.urandom(3).hex()}"
+        try:
+            shutil.copytree(path, staging)
+            os.rename(staging, dst_entry)
+        except OSError:
+            # a concurrent shipper won the rename, or the filesystem is sick:
+            # either way the boot degrades to a compile, never to a crash
+            shutil.rmtree(staging, ignore_errors=True)
+            out["skipped"] += 1
+            continue
+        out["shipped"] += 1
+        out["bytes"] += CompileCache._dir_bytes(dst_entry)
+    _emit("preship", "*", src_dir=src_dir, dst_dir=dst_dir, **out)
+    return out
 
 
 def call_with_fallback(

@@ -57,22 +57,34 @@ from typing import Any, Optional
 
 CHAOS_ENV_VAR = "ACCELERATE_CHAOS_SCHEDULE"
 
-FAULT_KINDS = ("sigkill", "sigterm", "hang", "slow", "crash")
+FAULT_KINDS = ("sigkill", "sigterm", "hang", "slow", "crash", "corrupt")
 # "serving_decode" fires inside ServingEngine.step (serving/engine.py): a
 # seeded replica kill/hang/slow lands mid-decode, which is what the router's
 # failover chaos tests and `make doctor` check 13 exercise.
 # "compile_cache_store" fires inside CompileCache.store (compile_cache/),
 # BETWEEN the payload write and the manifest commit — a sigkill there is the
 # kill-9-mid-cache-write case the cache's crash protocol must survive.
+# "kv_handoff" fires inside PrefillEngine.step (serving/disagg.py), between
+# the chunked prefill and the KV handoff pack: a "crash" drops the handoff
+# with the prefill replica (the router must re-run prefill exactly-once), a
+# "corrupt" lets the pack complete but flips payload bytes (the router's
+# checksum verify must catch it), "slow"/"hang" delay/wedge the handoff.
 POINTS = (
     "train_step", "collective", "prefetch", "serving_decode",
-    "compile_cache_store", "any",
+    "compile_cache_store", "kv_handoff", "any",
 )
 
 
 class ChaosFaultError(RuntimeError):
     """Raised by a ``crash`` fault — the injected stand-in for arbitrary
     training-code failure."""
+
+
+class ChaosCorruptionError(ChaosFaultError):
+    """Raised by a ``corrupt`` fault. Sites that model in-transit payload
+    corruption (the ``kv_handoff`` point) catch THIS subclass and deliver a
+    deliberately damaged payload instead of dying; anywhere else it behaves
+    exactly like ``crash`` (a ChaosFaultError the worker reports as fatal)."""
 
 
 @dataclass(frozen=True)
@@ -279,6 +291,8 @@ def _execute(fault: Fault, point: str, step: Optional[int]) -> None:
         time.sleep(fault.duration_s or 0.05)
     elif fault.kind == "crash":
         raise ChaosFaultError(desc)
+    elif fault.kind == "corrupt":
+        raise ChaosCorruptionError(desc)
 
 
 # ---------------------------------------------------------------------------

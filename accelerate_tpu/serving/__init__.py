@@ -18,7 +18,16 @@ single-stream decode (ROADMAP item 1). Five pillars:
   :mod:`~accelerate_tpu.serving.admission` — health-checked
   least-outstanding-tokens dispatch over N replicas with deadlines,
   exactly-once token-exact failover, token-bucket admission, priority
-  shedding (distinct ``SHED`` status) and bounded-queue backpressure.
+  shedding (distinct ``SHED`` status) and bounded-queue backpressure;
+- :mod:`~accelerate_tpu.serving.disagg` +
+  :mod:`~accelerate_tpu.serving.autoscaler` — disaggregated prefill/decode:
+  role-split engines joined by a content-addressed KV handoff
+  (:class:`~accelerate_tpu.serving.disagg.KVHandoff` behind a
+  :class:`~accelerate_tpu.serving.disagg.KVTransport`), two-tier dispatch
+  (:class:`~accelerate_tpu.serving.disagg.DisaggRouter`), and an
+  SLO-burn-driven :class:`~accelerate_tpu.serving.autoscaler.
+  AutoscalerPolicy` whose scale-ups join warm via compile-cache
+  pre-shipping.
 
 See ``docs/serving.md`` for the guide and ``benchmarks/serving/`` for the
 continuous-vs-static and replicated Poisson-load benchmarks
@@ -43,6 +52,15 @@ from .kv_pager import (
     PrefixPlan,
     init_block_pool,
     paged_attention,
+)
+from .autoscaler import AutoscalerPolicy, lattice_fns
+from .disagg import (
+    DecodeEngine,
+    DisaggRouter,
+    KVHandoff,
+    KVTransport,
+    LocalBlockCopyTransport,
+    PrefillEngine,
 )
 from .replica import LocalReplica, ProcessReplica, ReplicaSpec, ReplicaState
 from .router import RouterRequest, RouterRequestStatus, ServingRouter
@@ -76,4 +94,12 @@ __all__ = [
     "RouterRequest",
     "RouterRequestStatus",
     "ServingRouter",
+    "KVHandoff",
+    "KVTransport",
+    "LocalBlockCopyTransport",
+    "PrefillEngine",
+    "DecodeEngine",
+    "DisaggRouter",
+    "AutoscalerPolicy",
+    "lattice_fns",
 ]

@@ -1,0 +1,290 @@
+"""SLO-driven autoscaling for the decode tier, warm by construction.
+
+The PR 15 burn-rate monitor turned per-request outcomes into an error-budget
+signal; this module closes the loop: an :class:`AutoscalerPolicy` plugged
+into the router (``ServingRouter(autoscaler=...)``, consulted once per poll
+right after the SLO evaluation it keys off) GROWS the decode tier when the
+``ttft`` objective is burning and SHRINKS it after sustained idleness —
+through the exact replica machinery the PR 13 self-heal path uses
+(spawn-from-spec, ``router.add_replica``, drain-to-decommission).
+
+Scale-up is **warm by construction**: before the joiner boots, the policy
+pre-ships the relevant compile-cache entries
+(:func:`~accelerate_tpu.compile_cache.preship` — exactly the joiner's
+warmup lattice, :func:`lattice_fns`) into the joiner's cache directory, so
+its warmup is all cache hits and ``join_compiles == 0``. The joiner's ready
+event carries its cache outcomes (``router.replicas[name].ready_info``),
+which is how :meth:`AutoscalerPolicy.maybe_act` asserts the warm join and
+how the bench payload reports it.
+
+Hysteresis, all on an injectable clock (tested on a synthetic one):
+
+- grow only while the ``ttft`` objective is VIOLATING (both burn windows
+  over threshold — the monitor's own episode hysteresis), at most one
+  pending join at a time, never past ``max_decode``;
+- shrink only after ``idle_shrink_after_s`` of continuous empty
+  queue + zero in-flight, never below ``min_decode``;
+- every action arms ``cooldown_s`` before the next one, so a burn episode
+  that outlives one scale-up cannot flap the fleet.
+
+Every decision is one ``autoscale`` telemetry record (schema in
+``docs/telemetry.md``); the report CLI renders them as the ``autoscaler``
+section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+from ..telemetry import events as tel
+from ..telemetry import metrics as _metrics
+from .replica import ReplicaState, ReplicaSpec
+
+__all__ = ["AutoscalerPolicy", "lattice_fns"]
+
+
+def lattice_fns(spec: ReplicaSpec) -> "set[str]":
+    """The compile-cache ``fn`` names a replica built from ``spec`` warms —
+    the exact pre-ship set (shipping anything else wastes joiner disk;
+    shipping less makes the join cold). Mirrors the engine's lattice
+    derivation, including the default power-of-two lattice when the spec
+    pins no buckets."""
+    lat = spec.lattice()
+    if lat is None:
+        from .buckets import BucketLattice
+
+        config = spec.config()
+        mbps = spec.max_blocks_per_seq
+        if mbps is None:
+            mbps = spec.num_blocks - 1  # allocator.usable_blocks
+        max_prefill = min(config.max_seq_len, mbps * spec.block_size)
+        lat = BucketLattice.from_limits(spec.max_slots, mbps, max_prefill)
+    fns = {f"serving_prefill[{S}x{W}]" for S, W in lat.prefill_points()}
+    fns |= {f"serving_decode[{B}x{W}]" for B, W in lat.decode_points()}
+    fns |= {"serving_cow", "serving_land"}
+    return fns
+
+
+class AutoscalerPolicy:
+    """Grow/shrink the decode tier off the router's burn-rate signal.
+
+    ``template_spec`` is the recipe for joiners (its ``role`` is forced to
+    ``"decode"`` and its ``compile_cache_dir`` pointed at the joiner's own
+    pre-shipped directory); ``spawn(name, spec)`` builds the replica
+    (defaults to :class:`~accelerate_tpu.serving.replica.LocalReplica`).
+    ``source_cache_dir`` names the warm cache to pre-ship from — typically
+    the founding decode replicas' directory; ``joiner_cache_dir(name)``
+    maps a joiner to its cache directory (default: share the source
+    directory, which is already warm by definition)."""
+
+    def __init__(
+        self,
+        template_spec: ReplicaSpec,
+        *,
+        spawn: Optional[Callable[[str, ReplicaSpec], Any]] = None,
+        min_decode: int = 1,
+        max_decode: int = 4,
+        cooldown_s: float = 30.0,
+        idle_shrink_after_s: float = 60.0,
+        source_cache_dir: Optional[str] = None,
+        joiner_cache_dir: Optional[Callable[[str], str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        name_prefix: str = "scale",
+    ):
+        if min_decode < 1:
+            raise ValueError(f"min_decode must be >= 1, got {min_decode}")
+        if max_decode < min_decode:
+            raise ValueError(f"max_decode={max_decode} < min_decode={min_decode}")
+        self.template_spec = template_spec
+        self.spawn = spawn or self._default_spawn
+        self.min_decode = int(min_decode)
+        self.max_decode = int(max_decode)
+        self.cooldown_s = float(cooldown_s)
+        self.idle_shrink_after_s = float(idle_shrink_after_s)
+        self.source_cache_dir = source_cache_dir
+        self.joiner_cache_dir = joiner_cache_dir
+        self.clock = clock
+        self.name_prefix = name_prefix
+        #: every decision, in order — the bench payload and tests read this
+        self.events: "list[dict]" = []
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._counter = 0
+        self._cooldown_until = float("-inf")
+        self._idle_since: Optional[float] = None
+        #: joiner name -> spawn time, while its warmup is still running
+        self._pending: "dict[str, float]" = {}
+
+    @staticmethod
+    def _default_spawn(name: str, spec: ReplicaSpec):
+        from .replica import LocalReplica
+
+        return LocalReplica(name, spec)
+
+    # -- the per-poll hook ---------------------------------------------------
+
+    def maybe_act(self, router, now: Optional[float] = None) -> bool:
+        """One autoscaling decision against ``router``'s current state.
+        Called by the router's poll loop; safe to call every poll — all the
+        hysteresis lives here. Returns True when anything happened."""
+        now = self.clock() if now is None else now
+        acted = self._note_joins(router, now)
+        decode_live = [
+            r for r in router.replicas.values()
+            if getattr(r, "role", "serving") != "prefill"
+            and r.state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
+        ]
+        # -- grow: the ttft objective is in a burn episode -------------------
+        burn = next(
+            (
+                rec for rec in getattr(router, "last_slo_results", [])
+                if rec.get("slo") == "ttft" and rec.get("violating")
+            ),
+            None,
+        )
+        if (
+            burn is not None
+            and now >= self._cooldown_until
+            and not self._pending
+            and len(decode_live) < self.max_decode
+        ):
+            self._scale_up(router, now, burn)
+            return True
+        # -- shrink: sustained idleness --------------------------------------
+        idle = router.admission.depth == 0 and not router._inflight
+        if not idle:
+            self._idle_since = None
+            return acted
+        if self._idle_since is None:
+            self._idle_since = now
+            return acted
+        if (
+            now - self._idle_since >= self.idle_shrink_after_s
+            and now >= self._cooldown_until
+            and not self._pending
+            and len(decode_live) > self.min_decode
+        ):
+            self._scale_down(router, now, decode_live)
+            return True
+        return acted
+
+    # -- internals -----------------------------------------------------------
+
+    def _record(self, router, now: float, **fields) -> dict:
+        rec = {"t": now, **fields}
+        self.events.append(rec)
+        _metrics.inc("accelerate_autoscale_actions_total",
+                     action=fields.get("action", "?"))
+        if tel.is_enabled():
+            tel.emit("autoscale", **{k: v for k, v in rec.items() if k != "t"},
+                     decode_replicas=len([
+                         r for r in router.replicas.values()
+                         if getattr(r, "role", "serving") != "prefill"
+                         and r.state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
+                     ]))
+        return rec
+
+    def _note_joins(self, router, now: float) -> bool:
+        """Resolve pending joins: a joiner that reached HEALTHY reports its
+        time-to-ready and whether the join was warm (zero compiles — every
+        warmup point was a cache hit); one that died reports the failure
+        and releases the pending slot so the next burn can retry."""
+        acted = False
+        for name in list(self._pending):
+            rep = router.replicas.get(name)
+            if rep is None or rep.state is ReplicaState.DEAD:
+                self._pending.pop(name)
+                self._record(router, now, action="join_failed", replica=name,
+                             reason=getattr(rep, "reason", "replica missing"))
+                acted = True
+                continue
+            if rep.state is not ReplicaState.HEALTHY:
+                continue  # still warming
+            spawned = self._pending.pop(name)
+            info = getattr(rep, "ready_info", None) or {}
+            join_compiles = sum(
+                int(info.get(k, 0))
+                for k in ("cache_miss", "cache_uncached", "cache_error")
+            )
+            self._record(
+                router, now,
+                action="join_ready",
+                replica=name,
+                time_to_ready_s=round(now - spawned, 6),
+                join_compiles=join_compiles,
+                warm=join_compiles == 0,
+            )
+            acted = True
+        return acted
+
+    def _scale_up(self, router, now: float, burn: dict) -> None:
+        from .. import compile_cache as _ccache
+
+        self._counter += 1
+        name = f"{self.name_prefix}{self._counter}"
+        spec = dataclasses.replace(self.template_spec, role="decode")
+        preshipped = None
+        if self.source_cache_dir is not None:
+            dst = (
+                self.joiner_cache_dir(name)
+                if self.joiner_cache_dir is not None
+                else self.source_cache_dir
+            )
+            spec = dataclasses.replace(spec, compile_cache_dir=dst)
+            if dst != self.source_cache_dir:
+                # push exactly the joiner's warmup lattice into its cache dir
+                # BEFORE boot — the warmup then hits on every point
+                preshipped = _ccache.preship(
+                    self.source_cache_dir, dst, fns=lattice_fns(spec)
+                )
+        replica = self.spawn(name, spec)
+        router.add_replica(replica)
+        self._pending[name] = now
+        self._cooldown_until = now + self.cooldown_s
+        self.scale_ups += 1
+        self._record(
+            router, now,
+            action="scale_up",
+            replica=name,
+            trigger="ttft_burn",
+            fast_burn=burn.get("fast_burn"),
+            burn_threshold=burn.get("burn_threshold"),
+            preshipped=preshipped,
+        )
+
+    def _scale_down(self, router, now: float, decode_live: "list") -> None:
+        """Retire one decode replica: newest joiner first (founding members
+        are the steady-state fleet), least-loaded as the tiebreak. Drain +
+        stop — the worker exits once told, the router's health check books
+        the death as a decommission (DRAINING death never self-heals)."""
+        victim = max(
+            decode_live,
+            key=lambda r: (
+                r.name.startswith(self.name_prefix),
+                -len(router._outstanding(r.name)),
+                r.name,
+            ),
+        )
+        idle_s = now - (self._idle_since if self._idle_since is not None else now)
+        router.drain(victim.name)
+        victim.stop()
+        self._idle_since = None
+        self._cooldown_until = now + self.cooldown_s
+        self.scale_downs += 1
+        self._record(
+            router, now,
+            action="scale_down",
+            replica=victim.name,
+            trigger="sustained_idle",
+            idle_s=round(idle_s, 6),
+        )
+
+    def stats(self) -> dict:
+        return {
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "pending_joins": sorted(self._pending),
+            "events": list(self.events),
+        }

@@ -425,6 +425,39 @@ class BlockAllocator:
                 new += 1
         return new
 
+    def chain_hashes(self, seq_id) -> "list[bytes]":
+        """The sequence's registered full-block chain hashes, oldest first —
+        the content addresses a KV handoff ships (``serving/disagg.py``).
+        Raises on an unknown/freed sequence like every other lookup."""
+        if seq_id not in self._tables:
+            raise BlockAllocatorError(
+                f"chain_hashes of unknown/freed sequence {seq_id!r} (use-after-free?)"
+            )
+        return list(self._chain.get(seq_id, []))
+
+    def adopt_block(self, chain_hash: bytes) -> Optional[int]:
+        """Content-index one externally produced full block (the decode side
+        of a prefill→decode KV handoff): take a block, register it under
+        ``chain_hash``, and park it UNREFERENCED in the LRU pool — matchable
+        by the next admission's :meth:`plan_prefix`, reclaimable under
+        pressure like any cached block, so a landing can never strand pool
+        capacity. The caller writes the block's device content at the
+        returned physical index. Returns ``None`` when the hash is already
+        cached (content-addressed dedup: nothing to copy)."""
+        if not self.prefix_caching:
+            raise BlockAllocatorError("adopt_block requires prefix_caching=True")
+        if chain_hash in self._cached:
+            return None
+        if self.available_blocks < 1:
+            raise BlockPoolExhausted(
+                "no block available to adopt a handed-off KV block"
+            )
+        blk = self._take_block()
+        self._cached[chain_hash] = blk
+        self._block_hash[blk] = chain_hash
+        self._lru[blk] = None
+        return blk
+
     def append(self, seq_id, n_tokens: int = 1) -> "list[int]":
         """Grow a sequence by ``n_tokens``; allocates new block(s) only when
         the count crosses a block boundary. Returns the block ids newly

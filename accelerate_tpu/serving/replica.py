@@ -90,6 +90,13 @@ class ReplicaSpec:
     # persistent compile cache (compile_cache/): a replacement/respawned
     # replica warm-boots its whole lattice from here instead of recompiling
     compile_cache_dir: Optional[str] = None
+    # disaggregated serving (serving/disagg.py): "serving" builds the
+    # monolithic prefill+decode engine (the default — every pre-disagg spec
+    # round-trips unchanged); "prefill" builds a PrefillEngine (chunked
+    # prefill only, emits KV handoffs); "decode" builds a DecodeEngine
+    # (lands handoffs, gates admission on them). The router dispatches by
+    # this role: "prefill" replicas never see decode work and vice versa.
+    role: str = "serving"
 
     def config(self):
         from ..models.transformer import LlamaConfig
@@ -120,9 +127,14 @@ class ReplicaSpec:
         )
 
     def build_engine(self, heartbeat_name: str = "serving_decode"):
-        from .engine import ServingEngine
+        if self.role == "prefill":
+            from .disagg import PrefillEngine as engine_cls
+        elif self.role == "decode":
+            from .disagg import DecodeEngine as engine_cls
+        else:
+            from .engine import ServingEngine as engine_cls
 
-        return ServingEngine(
+        return engine_cls(
             self.build_params(),
             self.config(),
             num_blocks=self.num_blocks,
@@ -179,7 +191,14 @@ class _EngineWorker:
         from .scheduler import RequestStatus
 
         try:
-            self.send({"event": "ready", **self.engine.warmup()})
+            ready = {"event": "ready", **self.engine.warmup()}
+            # AOT cache outcomes ride the ready event so the router (and the
+            # autoscaler's warm-join assertion) can tell a zero-compile warm
+            # boot from a cold one without reaching into the worker
+            for k, v in getattr(self.engine, "cache_stats", {}).items():
+                if v:
+                    ready[f"cache_{k}"] = v
+            self.send(ready)
             handles: "dict[str, Any]" = {}  # router rid -> engine Request
             sent: "dict[str, int]" = {}  # router rid -> tokens already reported
             last_beat = 0.0
@@ -189,6 +208,12 @@ class _EngineWorker:
                     if cmd.get("cmd") == "stop":
                         return
                     if cmd.get("cmd") == "submit":
+                        extra = {}
+                        if cmd.get("handoff") is not None:
+                            # disaggregated decode dispatch: the wire-form KV
+                            # handoff rides the submit, and DecodeEngine.submit
+                            # gates the request's admission on landing it
+                            extra["handoff"] = cmd["handoff"]
                         req = self.engine.submit(
                             np.asarray(cmd["prompt"], np.int32),
                             int(cmd["max_new"]),
@@ -199,6 +224,7 @@ class _EngineWorker:
                             # under the router's dispatch span and ship back
                             # inside the done event (the router owns emission)
                             trace=cmd.get("trace"),
+                            **extra,
                         )
                         handles[cmd["rid"]] = req
                         sent[cmd["rid"]] = len(req.generated)
@@ -256,6 +282,26 @@ class _EngineWorker:
                     self.send(done_event)
                     handles.pop(rid)
                     sent.pop(rid)
+                pop = getattr(self.engine, "pop_handoffs", None)
+                if pop is not None:
+                    # PrefillEngine: each prefilled request leaves as a KV
+                    # handoff event (wire dict), not a done event — the router
+                    # requeues it toward the decode tier. The step event above
+                    # already reported tok0 as progress, and FIFO transports
+                    # deliver it first, so the router's generated-so-far view
+                    # is consistent by the time the handoff lands.
+                    for req, wire in pop():
+                        rid = next(k for k, v in handles.items() if v is req)
+                        ho_event = {"event": "handoff", "rid": rid, "handoff": wire}
+                        if (
+                            req.trace_spans
+                            and not req._trace_owner
+                            and req.trace.get("sampled")
+                        ):
+                            ho_event["spans"] = req.trace_spans
+                        self.send(ho_event)
+                        handles.pop(rid, None)
+                        sent.pop(rid, None)
         except BaseException as exc:  # the router must hear about ANY death
             try:
                 self.send({"event": "fatal", "error": f"{type(exc).__name__}: {exc}"})
@@ -303,6 +349,10 @@ class LocalReplica:
             return self._inbox.get(timeout=timeout) if timeout > 0 else self._inbox.get_nowait()
         except queue.Empty:
             return None
+
+    @property
+    def role(self) -> str:
+        return getattr(self.spec, "role", "serving")
 
     # -- router surface ------------------------------------------------------
 
@@ -426,6 +476,10 @@ class ProcessReplica:
                 self._outbox.put(json.loads(line))
             except ValueError:
                 pass  # stray non-protocol output (jax logs) — never fatal
+
+    @property
+    def role(self) -> str:
+        return getattr(self.spec, "role", "serving")
 
     # -- router surface ------------------------------------------------------
 

@@ -109,6 +109,16 @@ class RouterRequest:
     trace_spans: "list[dict]" = field(default_factory=list, repr=False)
     _span_root: Optional[dict] = field(default=None, repr=False)
     _span_dispatch: Optional[dict] = field(default=None, repr=False)
+    # disaggregated serving (serving/disagg.py): which prefill replica ran
+    # the prefill hop, how long that hop took, when its KV handoff landed at
+    # the router, and the verified wire-form handoff awaiting (re-)dispatch
+    # to the decode tier (kept until FINISHED so a decode-replica death can
+    # re-deliver it — adopt_block dedup makes re-delivery idempotent)
+    prefill_replica: Optional[str] = None
+    prefill_s: Optional[float] = None
+    handoff_t: Optional[float] = None
+    _handoff: Optional[dict] = field(default=None, repr=False)
+    _dispatch_t: float = field(default=0.0, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -156,6 +166,7 @@ class ServingRouter:
         respawn_backoff_max_s: float = 30.0,
         slo_monitor: Optional[Any] = None,
         slo_eval_interval_s: float = 1.0,
+        autoscaler: Optional[Any] = None,
     ):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -209,6 +220,12 @@ class ServingRouter:
         self.slo_eval_interval_s = float(slo_eval_interval_s)
         self._last_slo_eval = float("-inf")
         self._burning_replicas: "set[str]" = set()
+        #: the most recent burn-rate evaluation (list of per-objective
+        #: records) — the autoscaler's trigger input
+        self.last_slo_results: "list[dict]" = []
+        # optional serving/autoscaler.py policy, consulted once per poll
+        # right after the burn-rate evaluation it keys off
+        self.autoscaler = autoscaler
         for n in self.replicas:
             _watchdog.register(f"serving_replica:{n}")
 
@@ -292,11 +309,13 @@ class ServingRouter:
             # on episode entry, and refreshes the burning-replica set the
             # dispatch loop treats as DRAINING pressure
             self._last_slo_eval = now
-            self.slo_monitor.evaluate(now=now)
+            self.last_slo_results = self.slo_monitor.evaluate(now=now)
             if "ttft" in getattr(self.slo_monitor, "objectives", {}):
                 self._burning_replicas = set(
                     self.slo_monitor.burning_sources("ttft", now=now)
                 )
+        if self.autoscaler is not None:
+            activity |= bool(self.autoscaler.maybe_act(self, now))
         activity |= self._dispatch(now)
         if activity and _metrics.is_enabled():
             _metrics.set_gauge("accelerate_router_queue_depth", self.admission.depth)
@@ -353,6 +372,23 @@ class ServingRouter:
             rep.state = ReplicaState.DRAINING
             self._emit_replica(rep, self.clock())
 
+    def add_replica(self, replica) -> None:
+        """Register a freshly spawned replica mid-flight (the autoscaler's
+        scale-up path): it joins STARTING, becomes dispatchable at its ready
+        event, and participates in health/heal/telemetry like a founding
+        member. Re-adding a name revives a decommissioned slot."""
+        name = replica.name
+        if name in self.replicas and self.replicas[name].state is not ReplicaState.DEAD:
+            raise ValueError(f"replica {name!r} is already registered and live")
+        self.replicas[name] = replica
+        self._last_event[name] = self.clock()  # warmup counts as liveness
+        self._per_replica.setdefault(
+            name, {"dispatched": 0, "completed": 0, "failovers": 0, "respawns": 0}
+        )
+        self._decommissioned.discard(name)
+        _watchdog.register(f"serving_replica:{name}")
+        self._emit_replica(replica, self.clock())
+
     def close(self) -> None:
         _metrics.snapshot_now()  # persist the final counters for the report
         for n, rep in self.replicas.items():
@@ -394,6 +430,9 @@ class ServingRouter:
                 kind = ev.get("event")
                 if kind == "ready" and rep.state is ReplicaState.STARTING:
                     rep.state = ReplicaState.HEALTHY
+                    # warmup compile/cache counts: the autoscaler's warm-join
+                    # assertion (join_compiles == 0) reads these
+                    rep.ready_info = {k: v for k, v in ev.items() if k != "event"}
                     self._emit_replica(rep, now)
                     activity = True
                 elif kind == "step":
@@ -431,11 +470,20 @@ class ServingRouter:
                             error=ev.get("error") or "rejected by engine",
                         )
                     activity = True
+                elif kind == "handoff":
+                    activity |= self._on_handoff(name, rep, ev, now)
                 elif kind == "fatal":
                     self._fail_replica(rep, f"worker died: {ev.get('error')}", now)
                     activity = True
                     break  # remaining events are from a dead worker
         return activity
+
+    def _on_handoff(self, name: str, rep, ev: dict, now: float) -> bool:
+        """A prefill-tier worker finished a request's prefill hop and shipped
+        its KV. The base router runs no prefill tier — DisaggRouter
+        (serving/disagg.py) overrides this with verify + requeue-to-decode;
+        here a stray handoff event is dropped like any stale event."""
+        return False
 
     def _check_health(self, now: float) -> bool:
         activity = False
@@ -571,9 +619,13 @@ class ServingRouter:
         return 2 * max_slots  # slots busy + one queued wave behind them
 
     def _dispatch(self, now: float) -> bool:
+        # prefill-role replicas never take plain dispatches: they belong to
+        # DisaggRouter's two-tier _dispatch override — the filter keeps a
+        # mixed fleet safe even if someone hands one to the base router
         live = [
             r for r in self.replicas.values()
             if r.state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
+            and getattr(r, "role", "serving") != "prefill"
         ]
         if not live:
             if self._heal_pending():
@@ -630,6 +682,7 @@ class ServingRouter:
             )
             req.replica = target.name
             req._resume_from = len(req.generated)
+            req._dispatch_t = now
             req.status = RouterRequestStatus.DISPATCHED
             self._inflight[req.rid] = req
             self.dispatched += 1
@@ -717,8 +770,7 @@ class ServingRouter:
         if terminal is not None and status is not RouterRequestStatus.SHED:
             terminal.append(req)
         if tel.is_enabled():
-            tel.emit(
-                "router",
+            record = dict(
                 phase="request",
                 rid=req.rid,
                 outcome=status.value,
@@ -733,6 +785,14 @@ class ServingRouter:
                 else None,
                 error=req.error,
             )
+            if req.prefill_replica is not None:
+                # disaggregated request: which prefill replica ran the prefill
+                # hop and how long it took — the report's per-tier breakdown
+                record["prefill_replica"] = req.prefill_replica
+                record["prefill_s"] = (
+                    round(req.prefill_s, 6) if req.prefill_s is not None else None
+                )
+            tel.emit("router", **record)
 
     def _observe_slo(self, req: RouterRequest, status: RouterRequestStatus,
                      now: float) -> None:
@@ -777,6 +837,7 @@ class ServingRouter:
             "serving_replica",
             replica=rep.name,
             state=rep.state.value,
+            role=getattr(rep, "role", "serving"),
             transport=getattr(rep, "transport", "?"),
             outstanding_requests=len(self._outstanding(rep.name)),
             outstanding_tokens=self.outstanding_tokens(rep.name),

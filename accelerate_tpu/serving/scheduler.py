@@ -137,10 +137,16 @@ class Scheduler:
         admit_watermark_blocks: int = 0,
         max_seq_blocks: Optional[int] = None,
         max_seq_tokens: Optional[int] = None,
+        admission_gate=None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.allocator = allocator
+        # optional predicate over the queue head: False holds the request
+        # (and everything behind it — admission stays FIFO) without popping
+        # it. The disaggregated DecodeEngine gates on "its handed-off KV
+        # blocks have landed"; None keeps the legacy path branch-free.
+        self.admission_gate = admission_gate
         self.max_slots = max_slots
         self.continuous = continuous
         # hard per-sequence caps, both enforced at ADMISSION on the worst
@@ -202,6 +208,8 @@ class Scheduler:
             if slot is None:
                 break
             req = self.queue[0]
+            if self.admission_gate is not None and not self.admission_gate(req):
+                break  # gated (e.g. KV handoff not landed): FIFO order holds
             prefix_tokens = req.output_ids()
             # admission charges only UNCACHED blocks: the plan maps the
             # longest cached block-aligned prefix for free, and the watermark

@@ -600,6 +600,7 @@ def _router_section(events: "list[dict]") -> Optional[dict]:
     polls = [e for e in events if e.get("kind") == "router" and e.get("phase") == "poll"]
     reqs = [e for e in events if e.get("kind") == "router" and e.get("phase") == "request"]
     reps = [e for e in events if e.get("kind") == "serving_replica"]
+    handoffs = [e for e in events if e.get("kind") == "kv_handoff"]
     if not polls and not reqs and not reps:
         return None
     outcomes: dict = {}
@@ -629,12 +630,48 @@ def _router_section(events: "list[dict]") -> Optional[dict]:
     for r in reps:
         name = str(r.get("replica", "?"))
         rec = replicas.setdefault(
-            name, {"state": "?", "dispatched": 0, "completed": 0, "failovers": 0}
+            name,
+            {"state": "?", "role": "serving", "dispatched": 0, "completed": 0,
+             "failovers": 0},
         )
         rec["state"] = str(r.get("state", rec["state"]))  # records are in order
+        if r.get("role"):
+            rec["role"] = str(r["role"])
         for key in ("dispatched", "completed", "failovers"):
             if r.get(key) is not None:
                 rec[key] = max(rec[key], int(r[key]))
+
+    # -- disaggregated tiers: only when any record carries the role/handoff
+    # markers (monolithic streams keep the old shape + a None tiers key) ------
+    disagg_reqs = [r for r in reqs if r.get("prefill_replica")]
+    tiers = None
+    if handoffs or disagg_reqs or any(
+        rec["role"] in ("prefill", "decode") for rec in replicas.values()
+    ):
+        ho_outcomes: dict = {}
+        for h in handoffs:
+            o = str(h.get("outcome", "?"))
+            ho_outcomes[o] = ho_outcomes.get(o, 0) + 1
+        disagg_finished = [r for r in disagg_reqs if r.get("outcome") == "finished"]
+        tiers = {
+            "prefill_replicas": sorted(
+                n for n, rec in replicas.items() if rec["role"] == "prefill"
+            ),
+            "decode_replicas": sorted(
+                n for n, rec in replicas.items() if rec["role"] != "prefill"
+            ),
+            "handoffs": len(handoffs),
+            "handoff_outcomes": dict(sorted(ho_outcomes.items())),
+            "handoff_blocks": sum(int(h.get("blocks", 0)) for h in handoffs),
+            "handoff_bytes": sum(int(h.get("bytes", 0)) for h in handoffs),
+            # the prefill hop's dispatch->handoff wall time, per finished
+            # request — the decode hop is latency_s minus this
+            "prefill_s": hist_dist(
+                [float(r["prefill_s"]) for r in disagg_finished
+                 if r.get("prefill_s") is not None]
+            ),
+            "disagg_finished": len(disagg_finished),
+        }
     return {
         "polls": len(polls),
         "queue_depth": _dist([float(p.get("queued", 0)) for p in polls]),
@@ -659,6 +696,49 @@ def _router_section(events: "list[dict]") -> Optional[dict]:
             ),
         },
         "replicas": dict(sorted(replicas.items())),
+        "tiers": tiers,
+    }
+
+
+def _autoscaler_section(events: "list[dict]") -> Optional[dict]:
+    """Aggregate the :class:`~accelerate_tpu.serving.autoscaler.
+    AutoscalerPolicy`'s ``autoscale`` records: every scale decision with its
+    trigger objective, and for each join whether it was warm (zero compiles,
+    thanks to pre-shipping) plus its time-to-ready. ``None`` when the stream
+    carries no autoscale records."""
+    recs = [e for e in events if e.get("kind") == "autoscale"]
+    if not recs:
+        return None
+    actions: dict = {}
+    for r in recs:
+        a = str(r.get("action", "?"))
+        actions[a] = actions.get(a, 0) + 1
+    joins = [r for r in recs if r.get("action") == "join_ready"]
+    warm = sum(1 for j in joins if j.get("warm"))
+    return {
+        "actions": dict(sorted(actions.items())),
+        "scale_ups": actions.get("scale_up", 0),
+        "scale_downs": actions.get("scale_down", 0),
+        "joins": {
+            "ready": len(joins),
+            "failed": actions.get("join_failed", 0),
+            "warm": warm,
+            "cold": len(joins) - warm,
+            "compiles": sum(int(j.get("join_compiles", 0)) for j in joins),
+            "time_to_ready_s": _dist(
+                [float(j["time_to_ready_s"]) for j in joins
+                 if j.get("time_to_ready_s") is not None]
+            ),
+        },
+        "events": [
+            {
+                k: r.get(k)
+                for k in ("action", "replica", "trigger", "fast_burn", "warm",
+                          "join_compiles", "time_to_ready_s", "idle_s", "reason")
+                if r.get(k) is not None
+            }
+            for r in recs
+        ],
     }
 
 
@@ -786,6 +866,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         "performance": _performance_section(events, steps),
         "serving": _serving_section(events),
         "router": _router_section(events),
+        "autoscaler": _autoscaler_section(events),
         "slo": _slo_section(events),
         # trace roots only: legacy EventLog.span timing records share the
         # kind but carry no trace_id
@@ -959,6 +1040,9 @@ def format_report(report: dict) -> str:
     router = report.get("router")
     if router:
         lines.append(format_router_section(router))
+    autoscaler = report.get("autoscaler")
+    if autoscaler:
+        lines.append(format_autoscaler_section(autoscaler))
     slo = report.get("slo")
     if slo:
         lines.append(format_slo_section(slo))
@@ -1157,9 +1241,36 @@ def format_router_section(router: dict) -> str:
         lines.append(f"  replicas: {len(replicas)} ({states})")
         for name, rec in replicas.items():
             fo = f", {rec['failovers']} failover(s)" if rec.get("failovers") else ""
+            role = rec.get("role", "serving")
+            role_s = f" [{role}]" if role in ("prefill", "decode") else ""
             lines.append(
-                f"    {name}: {rec['state']} — dispatched {rec['dispatched']}, "
+                f"    {name}{role_s}: {rec['state']} — dispatched {rec['dispatched']}, "
                 f"completed {rec['completed']}{fo}"
+            )
+    tiers = router.get("tiers")
+    if tiers:
+        lines.append(
+            f"  tiers: {len(tiers.get('prefill_replicas') or [])} prefill / "
+            f"{len(tiers.get('decode_replicas') or [])} decode — "
+            f"{tiers.get('handoffs', 0)} KV handoff(s), "
+            f"{tiers.get('handoff_blocks', 0)} block(s), "
+            f"{_fmt_bytes(tiers.get('handoff_bytes', 0))}"
+        )
+        bad = {
+            o: n for o, n in (tiers.get("handoff_outcomes") or {}).items()
+            if o != "ok" and n
+        }
+        if bad:
+            lines.append(
+                "    handoff outcomes: "
+                + ", ".join(f"{o} {n}" for o, n in sorted(bad.items()))
+            )
+        pf = tiers.get("prefill_s") or {}
+        if pf.get("count"):
+            lines.append(
+                f"    prefill hop p50={pf['p50'] * 1e3:.1f}ms "
+                f"p99={pf['p99'] * 1e3:.1f}ms over "
+                f"{tiers.get('disagg_finished', 0)} disaggregated request(s)"
             )
     lines.append(
         f"  dispatched {router.get('dispatched', 0)}, completed "
@@ -1192,6 +1303,46 @@ def format_router_section(router: dict) -> str:
             f"  requests: {reqs['finished']} finished "
             f"({reqs.get('retried', 0)} resumed across replicas){lat_s}{ttft_s}"
         )
+    return "\n".join(lines)
+
+
+def format_autoscaler_section(autoscaler: dict) -> str:
+    """Human rendering of the SLO-driven autoscaler's decision log (see
+    ``docs/observability.md`` "Autoscaler signal")."""
+    joins = autoscaler.get("joins") or {}
+    lines = [
+        "autoscaler: "
+        f"{autoscaler.get('scale_ups', 0)} scale-up(s), "
+        f"{autoscaler.get('scale_downs', 0)} scale-down(s), "
+        f"{joins.get('ready', 0)} join(s) "
+        f"({joins.get('warm', 0)} warm, {joins.get('cold', 0)} cold, "
+        f"{joins.get('failed', 0)} failed)"
+    ]
+    ttr = joins.get("time_to_ready_s") or {}
+    if ttr.get("count"):
+        lines.append(
+            f"  time-to-ready p50={ttr['p50']:.2f}s max={ttr['max']:.2f}s, "
+            f"join compiles {joins.get('compiles', 0)} "
+            f"(0 == every warmup point pre-shipped)"
+        )
+    for ev in autoscaler.get("events") or []:
+        action = ev.get("action", "?")
+        if action == "scale_up":
+            detail = f"+{ev.get('replica')} (trigger {ev.get('trigger')})"
+        elif action == "scale_down":
+            detail = (
+                f"-{ev.get('replica')} (trigger {ev.get('trigger')}, "
+                f"idle {ev.get('idle_s', 0):.1f}s)"
+            )
+        elif action == "join_ready":
+            detail = (
+                f"{ev.get('replica')} ready in {ev.get('time_to_ready_s', 0):.2f}s, "
+                f"{ev.get('join_compiles', 0)} compile(s) "
+                f"({'warm' if ev.get('warm') else 'COLD'})"
+            )
+        else:
+            detail = f"{ev.get('replica')} ({ev.get('reason', '?')})"
+        lines.append(f"  {action}: {detail}")
     return "\n".join(lines)
 
 
@@ -1626,6 +1777,18 @@ def run_doctor() -> int:
             _doctor_observability(tmp, _check)
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("observability plane", False, f"{type(exc).__name__}: {exc}")
+
+        # 17. disaggregated prefill/decode (ISSUE 16): a 2-tier fleet (2
+        # prefill + 2 decode) under a seeded chaos kill at the kv_handoff
+        # point (prefill dies after prefilling, before the handoff lands)
+        # plus one seeded handoff corruption — the router must re-run
+        # prefill exactly-once in both cases and every request must finish
+        # bitwise-equal to its single-stream greedy reference, with the
+        # report rendering the per-tier breakdown
+        try:
+            _doctor_disagg(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("disaggregated serving", False, f"{type(exc).__name__}: {exc}")
 
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
@@ -2148,6 +2311,109 @@ def _doctor_observability(tmp: str, _check) -> None:
         f"finished={len(finished)}/{len(reqs)} tree_problems={tree_problems} "
         f"dead={dead} lineage_ok={lineage_ok} hist_count={getattr(hist, 'count', None)} "
         f"report_ttft={report_ttft} slo={slo_section}",
+    )
+
+
+def _doctor_disagg(tmp: str, _check) -> None:
+    """Doctor check 17 body: 2 prefill + 2 decode thread-backed CPU replicas
+    behind the DisaggRouter. A seeded chaos ``crash`` at the ``kv_handoff``
+    point kills one prefill replica after it prefilled but before the
+    handoff shipped (the handoff is DROPPED), and a seeded ``corrupt``
+    fault damages one handoff payload in flight. Requires (a) every request
+    FINISHED exactly once with output bitwise-equal to its single-stream
+    ``greedy_generate`` reference (the router re-ran prefill from scratch
+    in both fault cases), (b) exactly one prefill replica DEAD and at least
+    one corrupt handoff detected by the wire verify, and (c) the router
+    report section renders the per-tier breakdown with handoff counts."""
+    import dataclasses
+
+    import numpy as np
+
+    from ..models import LlamaConfig
+    from ..resilience import chaos
+    from ..resilience.chaos import ChaosSchedule, Fault
+    from ..serving import (
+        DisaggRouter,
+        LocalReplica,
+        ReplicaSpec,
+        ReplicaState,
+        RouterRequestStatus,
+    )
+    from . import events as tel_events
+
+    config = LlamaConfig.tiny()
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config), num_blocks=33, block_size=8,
+        max_slots=2, slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(16,),
+    )
+    pspec = dataclasses.replace(spec, role="prefill")
+    dspec = dataclasses.replace(spec, role="decode")
+    disagg_dir = os.path.join(tmp, "disagg")
+    tel_events.enable(out_dir=disagg_dir, run_id="doctor-disagg")
+    router = None
+    try:
+        # once-matched under a lock: exactly one prefill thread dies
+        # mid-handoff (crash) and exactly one handoff arrives damaged
+        # (corrupt) — the router must recover both without duplicating or
+        # losing a single token
+        chaos.arm(ChaosSchedule(faults=[
+            Fault(kind="corrupt", point="kv_handoff", step=1),
+            Fault(kind="crash", point="kv_handoff", step=2),
+        ]))
+        router = DisaggRouter(
+            [LocalReplica(f"p{i}", pspec) for i in range(2)],
+            [LocalReplica(f"d{i}", dspec) for i in range(2)],
+            health_timeout_s=10.0,
+        )
+        router.wait_ready(timeout_s=300)
+        rng = np.random.default_rng(17)
+        reqs = []
+        for i in range(6):
+            prompt = rng.integers(0, config.vocab_size, (int(rng.integers(4, 14)),))
+            reqs.append((prompt.astype(np.int32), 7,
+                         router.submit(prompt.astype(np.int32), 7, rng_seed=i)))
+        router.run(timeout_s=300)
+    finally:
+        chaos.arm(None)
+        if router is not None:
+            router.close()
+        tel_events.disable()
+
+    from ..generation import greedy_generate
+
+    params = spec.build_params()
+    mismatched = []
+    not_finished = []
+    for i, (prompt, max_new, req) in enumerate(reqs):
+        if req.status is not RouterRequestStatus.FINISHED:
+            not_finished.append((i, req.status.value, req.error))
+            continue
+        ref = greedy_generate(params, prompt[None], config, max_new_tokens=max_new)
+        if not np.array_equal(np.asarray(ref[0]), req.output_ids()):
+            mismatched.append(i)
+    dead = [n for n, r in router.replicas.items() if r.state is ReplicaState.DEAD]
+    report = build_report([disagg_dir])
+    text = format_report(report)
+    tiers = (report.get("router") or {}).get("tiers") or {}
+    ok = (
+        not not_finished
+        and not mismatched
+        and len(dead) == 1
+        and dead[0] in ("p0", "p1")
+        and router.completed == len(reqs)
+        and router.handoffs >= len(reqs)
+        and router.handoff_corrupt >= 1
+        and tiers.get("handoffs", 0) >= len(reqs)
+        and (tiers.get("handoff_outcomes") or {}).get("corrupt", 0) >= 1
+        and "  tiers: " in text
+        and "KV handoff" in text
+    )
+    _check(
+        "disaggregated serving",
+        ok,
+        f"not_finished={not_finished} mismatched={mismatched} dead={dead} "
+        f"completed={router.completed} handoffs={router.handoffs} "
+        f"corrupt={router.handoff_corrupt} tiers={tiers}",
     )
 
 

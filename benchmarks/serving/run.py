@@ -24,6 +24,14 @@ scaling), then once more with one replica killed mid-load: zero requests
 may be lost, the kill run's outputs must be bitwise-identical to the
 unkilled run (token-exact failover resume), and the p99 shows the failover
 latency tax.
+
+The **disaggregated leg** (ISSUE 16) replays a long-prompt-heavy Poisson
+ramp through a monolithic 1-replica router and through the 1-prefill +
+1-decode ``DisaggRouter`` with the SLO autoscaler armed: ≥1 decode
+scale-up must fire mid-load, the joiner must boot warm off the pre-shipped
+compile cache (``join_compiles == 0``), outputs must stay bitwise-identical
+to the monolith, and the payload carries the ttft/latency p99 across the
+scale transition.
 """
 
 import argparse
@@ -401,6 +409,197 @@ def run_bench_prefix_cache(
     }
 
 
+def _drain_through_disagg(pspec, dspec, workload, *, arrival_dt_s,
+                          cache_root=None, timeout_s=600.0):
+    """Drain the seeded Poisson workload through a 1-prefill + 1-decode
+    DisaggRouter with the SLO autoscaler armed under an artificially tight
+    ttft objective (threshold 1µs: the open-loop ramp is violating by
+    construction, so ≥1 decode scale-up MUST fire mid-load). Arrival steps
+    are replayed open-loop at ``arrival_dt_s`` wall seconds per step.
+    Returns the leg metrics — per-request outputs for the monolith parity
+    check, pre/post-transition ttft+latency percentiles, and the joiner's
+    compile count (0 == the pre-ship made the join warm)."""
+    import time as _time
+
+    from accelerate_tpu.serving import (
+        AdmissionController,
+        AutoscalerPolicy,
+        DisaggRouter,
+        LocalReplica,
+        RouterRequestStatus,
+    )
+    from accelerate_tpu.telemetry.slo import SLOMonitor, serving_slos
+
+    autoscaler = AutoscalerPolicy(
+        dspec,
+        min_decode=1,
+        max_decode=2,
+        cooldown_s=30.0,
+        idle_shrink_after_s=3600.0,  # this leg measures the scale-UP path
+        source_cache_dir=(
+            os.path.join(cache_root, "warm") if cache_root else None
+        ),
+        joiner_cache_dir=(
+            (lambda name: os.path.join(cache_root, name)) if cache_root else None
+        ),
+    )
+    router = DisaggRouter(
+        [LocalReplica("p0", pspec)],
+        [LocalReplica("d0", dspec)],
+        admission=AdmissionController(max_queue=len(workload) + 1),
+        health_timeout_s=30.0,
+        # a 1µs ttft threshold saturates the burn windows as soon as
+        # min_events completions land — the deterministic scale trigger
+        slo_monitor=SLOMonitor(serving_slos(ttft_threshold_s=1e-6), min_events=4),
+        slo_eval_interval_s=0.0,
+        autoscaler=autoscaler,
+    )
+    try:
+        router.wait_ready(timeout_s=300)
+        t0 = _time.monotonic()
+        reqs = []
+        next_req = 0
+        while next_req < len(workload) or not all(r.status.terminal for r in reqs):
+            now = _time.monotonic()
+            while (next_req < len(workload)
+                   and workload[next_req][0] * arrival_dt_s <= now - t0):
+                _, prompt, max_new = workload[next_req]
+                reqs.append(router.submit(prompt, max_new, rng_seed=next_req))
+                next_req += 1
+            router.poll()
+            _time.sleep(0.001)
+            if now - t0 > timeout_s:
+                raise RuntimeError(f"disagg leg wedged (>{timeout_s}s)")
+        # let an in-flight join finish warming so its compile count lands
+        while autoscaler.stats()["pending_joins"]:
+            router.poll()
+            _time.sleep(0.01)
+            if _time.monotonic() - t0 > timeout_s:
+                break
+        wall = _time.monotonic() - t0
+        completed = [r for r in reqs if r.status is RouterRequestStatus.FINISHED]
+        tokens = sum(len(r.generated) for r in completed)
+        scale_ups = [e for e in autoscaler.events if e["action"] == "scale_up"]
+        joins = [e for e in autoscaler.events if e["action"] == "join_ready"]
+
+        def _phase(rs):
+            lat = [r.finish_t - r.arrival_t for r in rs]
+            ttft = [r.first_token_t - r.arrival_t for r in rs if r.first_token_t]
+            return {
+                "completed": len(rs),
+                "p50_latency_ms": round(_percentile(lat, 50) * 1e3, 2),
+                "p99_latency_ms": round(_percentile(lat, 99) * 1e3, 2),
+                "p50_ttft_ms": round(_percentile(ttft, 50) * 1e3, 2),
+                "p99_ttft_ms": round(_percentile(ttft, 99) * 1e3, 2),
+            }
+
+        # the transition cut: requests finishing before the first scale-up
+        # ran on the founding fleet; everything after shares the joiner
+        t_scale = scale_ups[0]["t"] if scale_ups else None
+        leg = {
+            "completed": len(completed),
+            "lost": len(reqs) - len(completed),
+            "tokens": tokens,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+            "handoffs": router.handoffs,
+            "handoff_corrupt": router.handoff_corrupt,
+            "scale_events": len(autoscaler.events),
+            "scale_ups": len(scale_ups),
+            "first_scale_after_s": (
+                round(t_scale - t0, 4) if t_scale is not None else None
+            ),
+            "join_compiles": sum(int(j.get("join_compiles", 0)) for j in joins),
+            "warm_joins": sum(1 for j in joins if j.get("warm")),
+            "joins": len(joins),
+            "time_to_ready_s": [j.get("time_to_ready_s") for j in joins],
+            "outputs": [[int(t) for t in r.generated] for r in reqs],
+        }
+        if t_scale is not None:
+            pre = [r for r in completed if r.finish_t < t_scale]
+            post = [r for r in completed if r.finish_t >= t_scale]
+            leg["transition"] = {"pre_scale": _phase(pre), "post_scale": _phase(post)}
+        return leg
+    finally:
+        router.close()
+
+
+def run_bench_disagg(
+    on_tpu: bool,
+    requests: int = 16,
+    seed: int = 0,
+    max_slots: int = 2,
+    num_blocks: int = 49,
+    block_size: int = 8,
+) -> dict:
+    """The disaggregated leg (ISSUE 16): ONE seeded long-prompt-heavy Poisson
+    ramp drained through a monolithic 1-replica router and through the
+    1-prefill + 1-decode DisaggRouter with the SLO autoscaler armed. The
+    tight ttft objective forces ≥1 decode scale-up mid-load; the payload
+    reports the ttft/latency p99 across that transition, the monolith-vs
+    -disagg comparison, bitwise output parity, zero lost requests, and the
+    joiner's compile count (the pre-shipped join must be warm:
+    ``join_compiles == 0``)."""
+    import dataclasses
+    import tempfile
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.serving import ReplicaSpec
+
+    config = LlamaConfig.tiny()
+    # long-prompt-heavy: most work is prefill, the mix disaggregation exists
+    # to isolate from decode interference
+    prompt_lens, new_tokens = (16, 48), (2, 12)
+    max_len = prompt_lens[1] + new_tokens[1]
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config),
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_slots=max_slots,
+        slot_buckets=(max_slots,),
+        block_buckets=(-(-max_len // block_size) + 1,),
+        prefill_buckets=(prompt_lens[1] + new_tokens[1],),
+    )
+    workload = build_workload(
+        requests, seed, prompt_lens, new_tokens, 2.0, config.vocab_size
+    )
+    mono = _drain_through_router(spec, workload, n_replicas=1)
+    with tempfile.TemporaryDirectory(prefix="bench-disagg-cache-") as cache_root:
+        # founding replicas warm into (and the joiner pre-ships from) a
+        # shared source cache dir; each joiner gets its OWN dir so the
+        # pre-ship is real file movement, not a shared-directory freebie
+        warm_dir = os.path.join(cache_root, "warm")
+        pspec = dataclasses.replace(spec, role="prefill",
+                                    compile_cache_dir=warm_dir)
+        dspec = dataclasses.replace(spec, role="decode",
+                                    compile_cache_dir=warm_dir)
+        disagg = _drain_through_disagg(
+            pspec, dspec, workload, arrival_dt_s=0.02, cache_root=cache_root,
+        )
+    parity = disagg["outputs"] == mono["outputs"]
+    for leg in (mono, disagg):
+        leg.pop("outputs")
+    return {
+        "bench": "serving_disagg",
+        "unit": "tokens_per_s_ratio(disagg/monolith)",
+        "value": round(
+            disagg["tokens_per_s"] / max(mono["tokens_per_s"], 1e-9), 3
+        ),
+        "monolith": mono,
+        "disagg": disagg,
+        "outputs_match_monolith": parity,
+        "zero_lost": disagg["lost"] == 0,
+        "scale_up_fired": disagg["scale_ups"] >= 1,
+        "join_compiles": disagg["join_compiles"],
+        "warm_join": disagg["joins"] > 0
+        and disagg["warm_joins"] == disagg["joins"],
+        "requests": requests,
+        "prompt_lens": list(prompt_lens),
+        "new_tokens": list(new_tokens),
+        "on_tpu": on_tpu,
+    }
+
+
 def run_bench_serving(
     on_tpu: bool,
     requests: int = 32,
@@ -475,6 +674,8 @@ if __name__ == "__main__":
     ap.add_argument("--n-replicas", type=int, default=2)
     ap.add_argument("--prefix-requests", type=int, default=24,
                     help="workload size for the shared-prefix leg (0 skips it)")
+    ap.add_argument("--disagg-requests", type=int, default=16,
+                    help="workload size for the disaggregated leg (0 skips it)")
     args = ap.parse_args()
     on_tpu = detect_backend()
     out = run_bench_serving(
@@ -501,6 +702,12 @@ if __name__ == "__main__":
             on_tpu=on_tpu,
             requests=args.prefix_requests,
             rate=args.rate,
+            seed=args.seed,
+        )
+    if args.disagg_requests > 0:
+        out["disagg"] = run_bench_disagg(
+            on_tpu=on_tpu,
+            requests=args.disagg_requests,
             seed=args.seed,
         )
     emit(out)

@@ -1,0 +1,707 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 16).
+
+The acceptance lines these tests hold:
+
+- **handoff integrity**: the content-addressed KV handoff (paged block
+  content + the prefix-hash chain as the transfer unit) round-trips its
+  wire form losslessly, and the verify step catches payload corruption,
+  hash tampering and prompt mismatch — a damaged handoff is NEVER landed;
+- **decode admission gating**: a decode engine admits a request only once
+  its KV blocks have landed; a handoff that can never land (pool
+  exhausted, nothing running) is dropped and the request falls back to a
+  full re-prefill — correct either way, bitwise;
+- **bitwise parity**: the two-tier path (prefill hop → handoff → decode
+  hop) produces output identical to the monolithic engine for greedy AND
+  sampled decoding, including preempt/resume under pool pressure,
+  prefill/decode replica death mid-handoff, and corrupt-handoff re-runs —
+  each request finishing EXACTLY once;
+- **autoscaler hysteresis**: on a synthetic clock the policy scales up
+  only while the ttft objective is violating, holds one pending join at a
+  time, shrinks only after sustained idleness, and never flaps inside the
+  cooldown window; pre-shipping pushes exactly the joiner's warmup
+  lattice and nothing else.
+
+Host-side policy logic runs against fakes (microseconds); the parity and
+failover lines run against real thread-backed engines in tier-1 and real
+subprocess replicas with real SIGKILL in the slow-marked e2e.
+"""
+
+import dataclasses
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import greedy_generate
+from accelerate_tpu.models import LlamaConfig
+from accelerate_tpu.resilience import chaos
+from accelerate_tpu.resilience.chaos import ChaosSchedule, Fault
+from accelerate_tpu.serving import (
+    AutoscalerPolicy,
+    BlockPoolExhausted,
+    BucketLattice,
+    DecodeEngine,
+    DisaggRouter,
+    KVHandoff,
+    LocalReplica,
+    PrefillEngine,
+    ProcessReplica,
+    ReplicaSpec,
+    ReplicaState,
+    RouterRequestStatus,
+    ServingRouter,
+    lattice_fns,
+)
+from accelerate_tpu.serving.disagg import corrupt_wire
+
+CONFIG = LlamaConfig.tiny()
+
+
+def _spec(**kw) -> ReplicaSpec:
+    base = dict(
+        model=dataclasses.asdict(CONFIG), num_blocks=33, block_size=8,
+        max_slots=2, slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(32,),
+    )
+    base.update(kw)
+    return ReplicaSpec(**base)
+
+
+def _params():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import init_llama
+
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16),
+        init_llama(CONFIG, jax.random.PRNGKey(0)),
+    )
+
+
+def _lattice():
+    return BucketLattice(
+        slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(32,)
+    )
+
+
+def _prompts(seed, lengths):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CONFIG.vocab_size, (n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _pack_one(params, prompt, max_new, rng_seed=0):
+    """One request through a PrefillEngine; returns its handoff wire dict."""
+    eng = PrefillEngine(params, CONFIG, num_blocks=33, block_size=8,
+                        max_slots=2, lattice=_lattice())
+    eng.warmup()
+    req = eng.submit(prompt, max_new, rng_seed=rng_seed)
+    eng.step()
+    handoffs = eng.pop_handoffs()
+    assert len(handoffs) == 1 and handoffs[0][0] is req
+    assert eng.handoffs_packed == 1
+    return handoffs[0][1]
+
+
+# ---------------------------------------------------------------------------
+# handoff integrity
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_wire_roundtrip_and_verify():
+    params = _params()
+    (prompt,) = _prompts(0, [20])  # 2 full blocks + a 4-token tail
+    wire = _pack_one(params, prompt, 4)
+    ho, problems = KVHandoff.verify_wire(wire, prompt=prompt)
+    assert problems == [] and ho is not None
+    assert ho.n_blocks == 2 and len(ho.hashes) == 2
+    assert ho.block_size == 8
+    assert np.array_equal(ho.prompt, prompt)
+    # the chain hashes are recomputable from the prompt alone — content
+    # addressing, not positional bookkeeping
+    re_wire = ho.to_wire()
+    ho2, problems2 = KVHandoff.verify_wire(re_wire, prompt=prompt)
+    assert problems2 == [] and ho2.crc == ho.crc
+
+    # payload corruption: one flipped byte in the k content must be caught
+    bad = corrupt_wire({**wire})
+    _, problems = KVHandoff.verify_wire(bad, prompt=prompt)
+    assert problems, "corrupted payload passed verification"
+
+    # hash tampering: a forged chain hash must fail the prompt recompute
+    forged = dict(wire)
+    forged["hashes"] = ["00" * 16] + list(wire["hashes"][1:])
+    _, problems = KVHandoff.verify_wire(forged, prompt=prompt)
+    assert problems
+
+    # prompt mismatch: a handoff delivered against the wrong request
+    other = np.roll(prompt, 1)
+    _, problems = KVHandoff.verify_wire(wire, prompt=other)
+    assert problems
+
+
+def test_handoff_empty_prompt_shorter_than_block():
+    """Prompts under one block ship zero KV blocks — the handoff still
+    carries tok0 and verifies; decode re-prefills the whole (tiny) prompt."""
+    params = _params()
+    (prompt,) = _prompts(1, [5])
+    wire = _pack_one(params, prompt, 3)
+    ho, problems = KVHandoff.verify_wire(wire, prompt=prompt)
+    assert problems == [] and ho.n_blocks == 0
+    # empty-payload corruption flips the crc instead
+    bad = corrupt_wire(dict(wire))
+    _, problems = KVHandoff.verify_wire(bad, prompt=prompt)
+    assert problems
+
+
+# ---------------------------------------------------------------------------
+# decode admission gating
+# ---------------------------------------------------------------------------
+
+
+def test_decode_gates_until_handoff_lands_then_reuses_blocks():
+    params = _params()
+    (prompt,) = _prompts(2, [20])
+    max_new = 6
+    wire = _pack_one(params, prompt, max_new)
+    dec = DecodeEngine(params, CONFIG, num_blocks=33, block_size=8,
+                       max_slots=2, lattice=_lattice())
+    dec.warmup()
+    req = dec.submit(prompt, max_new, rng_seed=0,
+                     generated=[int(wire["first_token"])], handoff=wire)
+    # gated: the admission gate holds the request while its KV is in flight
+    assert req.rid in dec._awaiting
+    while not dec.scheduler.idle():
+        dec.step()
+    assert dec.handoffs_landed == 1 and dec.handoff_blocks == 2
+    assert not dec._awaiting
+    # the landed blocks were REUSED (prefix hit), not re-prefilled
+    assert req.cached_tokens >= 8
+    ref = greedy_generate(params, prompt[None], CONFIG, max_new_tokens=max_new)
+    assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+
+
+def test_decode_drops_unlandable_handoff_and_reprefills():
+    """A handoff that can never land (pool exhausted with nothing running)
+    is dropped: the gate opens and the request full-re-prefills — slower,
+    still bitwise-correct. The deadlock-escape path."""
+    params = _params()
+    (prompt,) = _prompts(3, [20])
+    max_new = 5
+    wire = _pack_one(params, prompt, max_new)
+    dec = DecodeEngine(params, CONFIG, num_blocks=33, block_size=8,
+                       max_slots=2, lattice=_lattice())
+    dec.warmup()
+
+    class _NeverLands:
+        def pack(self, engine, req):  # pragma: no cover - decode side only
+            raise AssertionError("decode engines do not pack")
+
+        def deliver(self, handoff, engine):
+            raise BlockPoolExhausted("no room, ever")
+
+    dec.transport = _NeverLands()
+    req = dec.submit(prompt, max_new, rng_seed=0,
+                     generated=[int(wire["first_token"])], handoff=wire)
+    while not dec.scheduler.idle():
+        dec.step()
+    assert dec.handoffs_landed == 0
+    assert not dec._awaiting  # dropped, not wedged
+    ref = greedy_generate(params, prompt[None], CONFIG, max_new_tokens=max_new)
+    assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+
+
+def test_delivery_is_idempotent_per_hash():
+    """Re-delivering the same handoff dedups on the content hash — the
+    at-least-once transport retry cannot strand or duplicate blocks."""
+    params = _params()
+    (prompt,) = _prompts(4, [24])
+    wire = _pack_one(params, prompt, 4)
+    dec = DecodeEngine(params, CONFIG, num_blocks=33, block_size=8,
+                       max_slots=2, lattice=_lattice())
+    dec.warmup()
+    ho, problems = KVHandoff.verify_wire(wire, prompt=prompt)
+    assert problems == []
+    first = dec.transport.deliver(ho, dec)
+    again = dec.transport.deliver(ho, dec)
+    assert first["landed"] == 3 and first["dedup"] == 0
+    assert again["landed"] == 0 and again["dedup"] == 3
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity vs the monolith (router level)
+# ---------------------------------------------------------------------------
+
+
+def _run_router(router, workload, *, seeds=None, timeout_s=300):
+    router.wait_ready(timeout_s=timeout_s)
+    reqs = [
+        router.submit(prompt, max_new,
+                      rng_seed=(seeds[i] if seeds else i))
+        for i, (prompt, max_new) in enumerate(workload)
+    ]
+    router.run(timeout_s=timeout_s)
+    return reqs
+
+
+def _disagg_fleet(spec, n_prefill=1, n_decode=1, **kw):
+    pspec = dataclasses.replace(spec, role="prefill")
+    dspec = dataclasses.replace(spec, role="decode")
+    return DisaggRouter(
+        [LocalReplica(f"p{i}", pspec) for i in range(n_prefill)],
+        [LocalReplica(f"d{i}", dspec) for i in range(n_decode)],
+        **kw,
+    )
+
+
+def test_disagg_bitwise_parity_greedy():
+    spec = _spec()
+    prompts = _prompts(5, [4, 11, 20, 24, 9, 17])
+    workload = [(p, 3 + (i % 5)) for i, p in enumerate(prompts)]
+    router = _disagg_fleet(spec, n_prefill=1, n_decode=2)
+    try:
+        reqs = _run_router(router, workload)
+        params = spec.build_params()
+        for (prompt, max_new), req in zip(workload, reqs):
+            assert req.status is RouterRequestStatus.FINISHED, req.error
+            ref = greedy_generate(params, prompt[None], CONFIG,
+                                  max_new_tokens=max_new)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+        assert router.handoffs == len(workload)
+        assert router.completed == len(workload)
+    finally:
+        router.close()
+
+
+def test_disagg_bitwise_parity_sampled_vs_monolith():
+    """Sampled decoding (temperature + top-k) through the two-tier path vs
+    the SAME spec monolith: tok0 sampled at fold 0 on the prefill engine,
+    every later token at its fold on the decode engine — identical streams,
+    or the handoff broke the fold-index bookkeeping."""
+    spec = _spec(temperature=0.8, top_k=4)
+    prompts = _prompts(6, [6, 14, 22, 10])
+    workload = [(p, 4 + i) for i, p in enumerate(prompts)]
+    mono = ServingRouter([LocalReplica("m0", spec)])
+    try:
+        mono_reqs = _run_router(mono, workload)
+    finally:
+        mono.close()
+    router = _disagg_fleet(spec, n_prefill=1, n_decode=1)
+    try:
+        reqs = _run_router(router, workload)
+        for m, d in zip(mono_reqs, reqs):
+            assert m.status is RouterRequestStatus.FINISHED
+            assert d.status is RouterRequestStatus.FINISHED, d.error
+            assert m.generated == d.generated
+    finally:
+        router.close()
+
+
+def test_disagg_parity_under_pool_pressure_preempt_resume():
+    """A tight decode pool forces preemption/resume mid-decode; the two-tier
+    path must stay bitwise-identical to the SAME-spec monolith under the
+    same pressure (the monolith is the reference the ISSUE names — under
+    this much pool churn its preempt/resume schedule differs from the
+    unconstrained single-stream decode, identically on both paths)."""
+    spec = _spec(num_blocks=17)  # 16 usable blocks across 2 slots
+    prompts = _prompts(7, [18, 22, 20, 16])
+    workload = [(p, 10) for p in prompts]
+    mono = ServingRouter([LocalReplica("m0", spec)])
+    try:
+        mono_reqs = _run_router(mono, workload)
+    finally:
+        mono.close()
+    router = _disagg_fleet(spec, n_prefill=1, n_decode=1)
+    try:
+        reqs = _run_router(router, workload)
+        for m, d in zip(mono_reqs, reqs):
+            assert m.status is RouterRequestStatus.FINISHED
+            assert d.status is RouterRequestStatus.FINISHED, d.error
+            assert m.generated == d.generated
+    finally:
+        router.close()
+
+
+def test_disagg_prefill_death_reruns_exactly_once():
+    """A chaos crash at the kv_handoff point kills one prefill replica after
+    prefilling but before its handoff ships — the router must wipe the
+    sampled tok0 (fold 0 re-runs on the survivor) and finish every request
+    exactly once, bitwise."""
+    spec = _spec()
+    prompts = _prompts(8, [9, 16, 21, 12, 24])
+    workload = [(p, 6) for p in prompts]
+    chaos.arm(ChaosSchedule(
+        faults=[Fault(kind="crash", point="kv_handoff", step=1)]
+    ))
+    router = _disagg_fleet(spec, n_prefill=2, n_decode=1,
+                           health_timeout_s=10.0)
+    try:
+        reqs = _run_router(router, workload)
+        params = spec.build_params()
+        for (prompt, max_new), req in zip(workload, reqs):
+            assert req.status is RouterRequestStatus.FINISHED, req.error
+            ref = greedy_generate(params, prompt[None], CONFIG,
+                                  max_new_tokens=max_new)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+        dead = [n for n, r in router.replicas.items()
+                if r.state is ReplicaState.DEAD]
+        assert len(dead) == 1 and dead[0].startswith("p")
+        assert router.completed == len(workload)
+    finally:
+        router.close()
+        chaos.arm(None)
+
+
+def test_disagg_decode_death_fails_over_across_handoff():
+    """Killing a decode replica mid-decode fails its requests over to the
+    surviving decode replica with the streamed progress intact — the resume
+    crosses the handoff boundary (the survivor re-prefills prompt +
+    generated-so-far; the original handoff blocks are gone with the dead
+    engine) and stays token-exact."""
+    spec = _spec()
+    prompts = _prompts(9, [8, 15, 19, 23, 11, 14])
+    workload = [(p, 9) for p in prompts]
+    router = _disagg_fleet(spec, n_prefill=1, n_decode=2,
+                           health_timeout_s=10.0)
+    try:
+        router.wait_ready(timeout_s=300)
+        reqs = [router.submit(p, m, rng_seed=i)
+                for i, (p, m) in enumerate(workload)]
+        t0 = time.monotonic()
+        killed = False
+        while not all(r.status.terminal for r in reqs):
+            router.poll()
+            if not killed and any(
+                r.status is RouterRequestStatus.FINISHED for r in reqs
+            ):
+                router.replicas["d0"].kill()
+                killed = True
+            time.sleep(0.001)
+            assert time.monotonic() - t0 < 300, "wedged"
+        assert killed
+        params = spec.build_params()
+        for (prompt, max_new), req in zip(workload, reqs):
+            assert req.status is RouterRequestStatus.FINISHED, req.error
+            ref = greedy_generate(params, prompt[None], CONFIG,
+                                  max_new_tokens=max_new)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+        assert router.completed == len(workload)
+    finally:
+        router.close()
+
+
+def test_disagg_corrupt_handoff_detected_and_rerun():
+    """A chaos 'corrupt' fault damages one handoff in flight: the router's
+    wire verify must catch it (never landing damaged KV), re-run the
+    prefill, and still finish bitwise-exact."""
+    spec = _spec()
+    prompts = _prompts(10, [13, 18, 25, 10])
+    workload = [(p, 5) for p in prompts]
+    chaos.arm(ChaosSchedule(
+        faults=[Fault(kind="corrupt", point="kv_handoff", step=1)]
+    ))
+    router = _disagg_fleet(spec, n_prefill=1, n_decode=1)
+    try:
+        reqs = _run_router(router, workload)
+        params = spec.build_params()
+        for (prompt, max_new), req in zip(workload, reqs):
+            assert req.status is RouterRequestStatus.FINISHED, req.error
+            ref = greedy_generate(params, prompt[None], CONFIG,
+                                  max_new_tokens=max_new)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+        assert router.handoff_corrupt >= 1
+        assert router.completed == len(workload)
+    finally:
+        router.close()
+        chaos.arm(None)
+
+
+@pytest.mark.slow  # 4 subprocess replicas each paying jax import + warmup,
+# plus a real SIGKILL on the prefill tier mid-load
+def test_process_replica_disagg_sigkill_parity():
+    spec = _spec()
+    pspec = dataclasses.replace(spec, role="prefill")
+    dspec = dataclasses.replace(spec, role="decode")
+    prompts = _prompts(11, [9, 17, 22, 13, 20, 15])
+    workload = [(p, 8) for p in prompts]
+    router = DisaggRouter(
+        [ProcessReplica(f"p{i}", pspec) for i in range(2)],
+        [ProcessReplica(f"d{i}", dspec) for i in range(2)],
+        health_timeout_s=30.0,
+    )
+    try:
+        router.wait_ready(timeout_s=600)
+        reqs = [router.submit(p, m, rng_seed=i)
+                for i, (p, m) in enumerate(workload)]
+        t0 = time.monotonic()
+        killed = False
+        while not all(r.status.terminal for r in reqs):
+            router.poll()
+            if not killed and router.handoffs >= 2:
+                router.replicas["p0"].kill()  # real SIGKILL mid-handoff
+                killed = True
+            time.sleep(0.001)
+            assert time.monotonic() - t0 < 600, "wedged"
+        assert killed
+        params = spec.build_params()
+        for (prompt, max_new), req in zip(workload, reqs):
+            assert req.status is RouterRequestStatus.FINISHED, req.error
+            ref = greedy_generate(params, prompt[None], CONFIG,
+                                  max_new_tokens=max_new)
+            assert np.array_equal(np.asarray(ref[0]), req.output_ids())
+        assert router.completed == len(workload)
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler hysteresis (synthetic clock, fake router)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, name, role="decode", state=ReplicaState.HEALTHY):
+        self.name = name
+        self.role = role
+        self.state = state
+        self.ready_info = {}
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+
+
+class _FakeRouter:
+    def __init__(self, replicas):
+        self.replicas = {r.name: r for r in replicas}
+        self.last_slo_results = []
+        self.admission = SimpleNamespace(depth=0)
+        self._inflight = {}
+        self.added = []
+        self.drained = []
+
+    def add_replica(self, rep):
+        self.replicas[rep.name] = rep
+        self.added.append(rep.name)
+
+    def drain(self, name):
+        self.replicas[name].state = ReplicaState.DRAINING
+        self.drained.append(name)
+
+    def _outstanding(self, name):
+        return []
+
+
+_BURN = {"slo": "ttft", "violating": True, "fast_burn": 20.0,
+         "burn_threshold": 14.4}
+
+
+def _policy(**kw):
+    base = dict(
+        spawn=lambda name, spec: _FakeReplica(name,
+                                              state=ReplicaState.STARTING),
+        min_decode=1, max_decode=3, cooldown_s=30.0, idle_shrink_after_s=10.0,
+    )
+    base.update(kw)
+    return AutoscalerPolicy(_spec(), **base)
+
+
+def test_autoscaler_grows_on_burn_once_then_cools_down():
+    router = _FakeRouter([_FakeReplica("p0", role="prefill"),
+                          _FakeReplica("d0")])
+    pol = _policy()
+    router.last_slo_results = [_BURN]
+    assert pol.maybe_act(router, now=0.0) is True
+    assert router.added == ["scale1"]
+    assert pol.scale_ups == 1
+    # still burning: the pending join vetoes a second spawn
+    assert pol.maybe_act(router, now=1.0) is False
+    assert router.added == ["scale1"]
+    # the joiner warms up: join_ready books the warm join off ready_info
+    joiner = router.replicas["scale1"]
+    joiner.state = ReplicaState.HEALTHY
+    joiner.ready_info = {"cache_hit": 6}
+    assert pol.maybe_act(router, now=5.0) is True
+    join = [e for e in pol.events if e["action"] == "join_ready"]
+    assert len(join) == 1
+    assert join[0]["warm"] is True and join[0]["join_compiles"] == 0
+    assert join[0]["time_to_ready_s"] == 5.0
+    # join resolved but the cooldown window still vetoes a second spawn
+    assert pol.maybe_act(router, now=6.0) is False
+    assert pol.maybe_act(router, now=31.0) is True  # cooldown over: grow again
+    assert router.added == ["scale1", "scale2"]
+
+
+def test_autoscaler_respects_max_decode():
+    router = _FakeRouter([_FakeReplica("d0"), _FakeReplica("d1"),
+                          _FakeReplica("d2")])
+    pol = _policy(max_decode=3)
+    router.last_slo_results = [_BURN]
+    assert pol.maybe_act(router, now=0.0) is False
+    assert pol.scale_ups == 0 and router.added == []
+
+
+def test_autoscaler_shrinks_after_sustained_idle_no_flapping():
+    router = _FakeRouter([_FakeReplica("p0", role="prefill"),
+                          _FakeReplica("d0"), _FakeReplica("scale9")])
+    pol = _policy()
+    # idle but not yet sustained: nothing happens
+    assert pol.maybe_act(router, now=0.0) is False
+    assert pol.maybe_act(router, now=9.0) is False
+    # a burst of activity resets the idle clock
+    router._inflight = {1: object()}
+    assert pol.maybe_act(router, now=9.5) is False
+    router._inflight = {}
+    assert pol.maybe_act(router, now=10.0) is False
+    # sustained idle: retire the NEWEST joiner (name_prefix match), once
+    assert pol.maybe_act(router, now=20.5) is True
+    assert router.drained == ["scale9"]
+    assert router.replicas["scale9"].stopped
+    assert pol.scale_downs == 1
+    # cooldown + min_decode: continued idleness cannot flap the fleet
+    assert pol.maybe_act(router, now=25.0) is False
+    assert pol.maybe_act(router, now=200.0) is False  # d0 is the floor
+    assert pol.scale_downs == 1 and router.drained == ["scale9"]
+
+
+def test_autoscaler_burn_beats_shrink_and_alternation_respects_cooldown():
+    router = _FakeRouter([_FakeReplica("d0")])
+    pol = _policy(idle_shrink_after_s=5.0)
+    router.last_slo_results = [_BURN]
+    assert pol.maybe_act(router, now=0.0) is True  # scale_up
+    router.replicas["scale1"].state = ReplicaState.HEALTHY
+    assert pol.maybe_act(router, now=1.0) is True  # join_ready
+    # burn clears, idleness starts — but the cooldown window holds
+    router.last_slo_results = []
+    assert pol.maybe_act(router, now=2.0) is False
+    assert pol.maybe_act(router, now=8.0) is False  # idle 6s > 5s, cooldown
+    assert pol.maybe_act(router, now=31.0) is True  # cooldown over: shrink
+    assert pol.scale_ups == 1 and pol.scale_downs == 1
+    actions = [e["action"] for e in pol.events]
+    assert actions == ["scale_up", "join_ready", "scale_down"]
+
+
+def test_autoscaler_join_failure_releases_pending_slot():
+    router = _FakeRouter([_FakeReplica("d0")])
+    pol = _policy()
+    router.last_slo_results = [_BURN]
+    assert pol.maybe_act(router, now=0.0) is True
+    router.replicas["scale1"].state = ReplicaState.DEAD
+    assert pol.maybe_act(router, now=1.0) is True  # join_failed booked
+    assert [e["action"] for e in pol.events][-1] == "join_failed"
+    assert not pol.stats()["pending_joins"]
+    # after cooldown the next burn may retry with a fresh joiner
+    assert pol.maybe_act(router, now=31.0) is True
+    assert router.added == ["scale1", "scale2"]
+
+
+def test_autoscaler_validates_bounds():
+    with pytest.raises(ValueError):
+        _policy(min_decode=0)
+    with pytest.raises(ValueError):
+        _policy(min_decode=3, max_decode=2)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache pre-shipping
+# ---------------------------------------------------------------------------
+
+
+def _fake_entry(cache_dir, name, fn, payload=b"x" * 64):
+    d = os.path.join(cache_dir, name)
+    os.makedirs(d)
+    with open(os.path.join(d, "exec.bin"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+        json.dump({"fn": fn}, f)
+
+
+def test_lattice_fns_is_the_warmup_set():
+    spec = _spec()
+    fns = lattice_fns(spec)
+    lat = spec.lattice()
+    assert fns == (
+        {f"serving_prefill[{S}x{W}]" for S, W in lat.prefill_points()}
+        | {f"serving_decode[{B}x{W}]" for B, W in lat.decode_points()}
+        | {"serving_cow", "serving_land"}
+    )
+    # the default power-of-two lattice path (no pinned buckets) also resolves
+    fns_default = lattice_fns(_spec(slot_buckets=None, block_buckets=None,
+                                    prefill_buckets=None))
+    assert {"serving_cow", "serving_land"} <= fns_default
+
+
+def test_preship_ships_only_lattice_relevant_entries(tmp_path):
+    from accelerate_tpu.compile_cache import preship
+
+    spec = _spec()
+    fns = sorted(lattice_fns(spec))
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    os.makedirs(src)
+    for i, fn in enumerate(fns):
+        _fake_entry(str(src), f"rel{i}", fn)
+    # irrelevant: another model's lattice point and a training fn
+    _fake_entry(str(src), "other0", "serving_prefill[999x99]")
+    _fake_entry(str(src), "other1", "train_step")
+    out = preship(str(src), str(dst), fns=set(fns))
+    assert out["shipped"] == len(fns)
+    assert out["skipped"] == 2
+    assert out["already"] == 0
+    assert out["bytes"] > 0
+    shipped = sorted(os.listdir(dst))
+    assert shipped == [f"rel{i}" for i in range(len(fns))]
+    # idempotent: a second push copies nothing
+    again = preship(str(src), str(dst), fns=set(fns))
+    assert again["shipped"] == 0 and again["already"] == len(fns)
+
+
+def test_preship_default_prefix_filter(tmp_path):
+    from accelerate_tpu.compile_cache import preship
+
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    os.makedirs(src)
+    _fake_entry(str(src), "a", "serving_prefill[16x2]")
+    _fake_entry(str(src), "b", "serving_land")
+    _fake_entry(str(src), "c", "train_step")
+    out = preship(str(src), str(dst))
+    assert out["shipped"] == 2 and out["skipped"] == 1
+    assert sorted(os.listdir(dst)) == ["a", "b"]
+
+
+def test_warm_join_end_to_end_zero_compiles(tmp_path):
+    """The acceptance invariant wired through real engines: a decode joiner
+    whose cache dir was pre-shipped from a warm source boots with ZERO
+    compiles — every warmup point (prefill/decode lattice, COW, land) is a
+    cache hit, visible in its ready event."""
+    from accelerate_tpu.compile_cache import preship
+
+    warm_dir = str(tmp_path / "warm")
+    join_dir = str(tmp_path / "joiner")
+    spec = _spec(role="decode", compile_cache_dir=warm_dir)
+    # a founding decode replica warms the source cache
+    founder = LocalReplica("d0", spec)
+    router = ServingRouter([founder])
+    try:
+        router.wait_ready(timeout_s=300)
+    finally:
+        router.close()
+    shipped = preship(warm_dir, join_dir, fns=lattice_fns(spec))
+    assert shipped["shipped"] > 0
+    joiner = LocalReplica(
+        "scale1", dataclasses.replace(spec, compile_cache_dir=join_dir)
+    )
+    router2 = ServingRouter([joiner])
+    try:
+        router2.wait_ready(timeout_s=300)
+        info = joiner.ready_info or {}
+        compiles = sum(int(info.get(k, 0)) for k in
+                       ("cache_miss", "cache_uncached", "cache_error"))
+        assert compiles == 0, info
+        assert int(info.get("cache_hit", 0)) > 0
+    finally:
+        router2.close()

@@ -407,7 +407,8 @@ def test_doctor_self_checks(capsys):
     # + prefix cache + COW (ISSUE 14 — the count was left at 14 when that
     #   check landed; fixed here)
     # + observability plane (ISSUE 15)
-    assert out.count("PASS") == 16 and "FAIL" not in out
+    # + disaggregated serving (ISSUE 16)
+    assert out.count("PASS") == 17 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out

@@ -104,9 +104,12 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         "sharing + copy-on-write) and a Pallas paged-attention decode "
         "kernel on TPU — replicated behind a health-checked router with "
         "token-exact failover, deadlines, and graceful overload shedding. "
+        "The fleet can be split into disaggregated prefill/decode tiers "
+        "(content-addressed KV handoff, bitwise parity with the monolith) "
+        "with SLO-burn-driven autoscaling and warm pre-shipped scale-up. "
         "See `docs/serving.md` for the guide and `benchmarks/serving/` "
-        "(`make bench-serve`) for the continuous-vs-static, replicated and "
-        "shared-prefix benchmarks.",
+        "(`make bench-serve`) for the continuous-vs-static, replicated, "
+        "shared-prefix and disaggregated benchmarks.",
         [("accelerate_tpu.serving.engine", ["ServingEngine", "paged_forward"]),
          ("accelerate_tpu.serving.kv_pager",
           ["BlockAllocator", "BlockAllocatorError", "BlockPoolExhausted",
@@ -122,7 +125,12 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
          ("accelerate_tpu.serving.replica",
           ["ReplicaSpec", "ReplicaState", "LocalReplica", "ProcessReplica"]),
          ("accelerate_tpu.serving.admission",
-          ["AdmissionController", "AdmissionVerdict", "TokenBucket"])],
+          ["AdmissionController", "AdmissionVerdict", "TokenBucket"]),
+         ("accelerate_tpu.serving.disagg",
+          ["PrefillEngine", "DecodeEngine", "DisaggRouter", "KVHandoff",
+           "KVTransport", "LocalBlockCopyTransport"]),
+         ("accelerate_tpu.serving.autoscaler",
+          ["AutoscalerPolicy", "lattice_fns"])],
     ),
     "analysis": (
         "Static analysis (jaxlint)",
@@ -252,15 +260,16 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         "manifest-rename protocol and read defensively (corrupt/mismatched "
         "entries are quarantined and fall back to a fresh compile). Probed by "
         "the Accelerator on restart generations >= 1, loaded wholesale by the "
-        "serving engine's warmup, pre-touched by the elastic supervisor. See "
-        "`docs/compile_cache.md`.",
+        "serving engine's warmup, pre-touched by the elastic supervisor, and "
+        "pre-shipped to autoscaler joiners for warm (zero-compile) scale-up. "
+        "See `docs/compile_cache.md`.",
         [("accelerate_tpu.compile_cache.cache",
           ["CacheKey", "CompileCache", "LoadResult", "StoreResult",
            "key_from_lowered", "environment_fingerprint", "compile_flags"]),
          ("accelerate_tpu.compile_cache.runtime",
           ["cache_enabled", "configured_cache_dir", "get_cache", "aot_compile",
            "maybe_load_executable", "maybe_export", "call_with_fallback",
-           "pretouch"])],
+           "pretouch", "preship"])],
     ),
     "resilience": (
         "Resilience",
