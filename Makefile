@@ -18,8 +18,8 @@
 
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
-        bench-input bench-ckpt bench-zero1 bench-serve bench-compile doctor \
-        lint profile chaos
+        bench-input bench-ckpt bench-zero1 bench-serve bench-compile \
+        bench-check doctor lint profile chaos
 
 PYTEST := python -m pytest -q
 
@@ -115,6 +115,14 @@ bench-serve:
 bench-compile:
 	python benchmarks/compile_time/run.py
 
+# perf-regression sentinel (telemetry/regress.py): compare the two newest
+# comparable BENCH_*.json payloads in BENCH_DIR (default: repo root) against
+# the per-metric tolerance registry. Exit 1 on regression, 2 when the
+# environments' fingerprints differ (refusal, not a verdict).
+BENCH_DIR ?= .
+bench-check:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry regress --scan $(BENCH_DIR)
+
 # self-check: flight-recorder dump, watchdog stall detection, straggler
 # report, collective-divergence detection, the jaxlint engine, perf cost
 # capture, xplane trace parsing, the performance report section, fused
@@ -126,8 +134,11 @@ bench-compile:
 # router under an injected kill: gap-free span trees, /metrics scrape
 # matching the report, slo_violation under a tight objective), and the
 # disaggregated prefill/decode tier (2+2 fleet with a corrupted and a
-# dropped KV handoff: exactly-once + bitwise parity across the handoff)
-# against synthetic inputs (telemetry/report.py run_doctor)
+# dropped KV handoff: exactly-once + bitwise parity across the handoff),
+# and the goodput ledger (a supervised chaos run whose injected SIGKILL
+# and slow-data badput the ledger must attribute to cause, <5% of
+# wall-clock unattributed) against synthetic inputs
+# (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor
 

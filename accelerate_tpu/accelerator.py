@@ -1909,6 +1909,12 @@ class Accelerator:
             self._trace_windows.close()
         if _tel.is_enabled() and self.trackers:
             self.log_telemetry_summary()
+        # final goodput snapshot: whatever the live meter accumulated since
+        # its last throttled emit must land in the event stream before exit
+        if _tel.is_enabled():
+            from .telemetry import goodput as _goodput
+
+            _goodput.emit_now(final=True)
         # forensics teardown: training no longer beats, so the train-step
         # source must stop being watched (a finished run is not a stall) and a
         # watchdog we started is stopped with it

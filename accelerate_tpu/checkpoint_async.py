@@ -91,6 +91,9 @@ class CheckpointManager:
             _tel.emit(
                 "checkpoint", phase="backpressure", dur_s=round(waited, 6), hidden=False
             )
+            from .telemetry import goodput as _goodput
+
+            _goodput.note("checkpoint_stall", waited)
             return waited
         return 0.0
 

@@ -526,6 +526,9 @@ def snapshot_accelerator_state(
         blocking=blocking,
         sharded=snap.sharded,
     )
+    from .telemetry import goodput as _goodput
+
+    _goodput.note("checkpoint_stall", snap.snapshot_s)
     return snap
 
 
@@ -725,16 +728,26 @@ def write_and_commit(
     )
     t0 = time.monotonic()
     final = commit_snapshot(snap, files, heartbeat=heartbeat)
+    commit_s = time.monotonic() - t0
     _tel.emit(
         "checkpoint",
         phase="commit",
-        dur_s=round(time.monotonic() - t0, 6),
+        dur_s=round(commit_s, 6),
         dir=final,
         hidden=hidden,
         committed=snap.is_committer,
     )
     if snap.is_committer and snap.rotation is not None:
         rotate_checkpoints(snap.rotation[0], snap.rotation[1], final)
+    if not hidden:
+        # blocking saves stall the training loop for the full pipeline; async
+        # writer time is hidden and only surfaces via backpressure/drain
+        from .telemetry import goodput as _goodput
+
+        _goodput.note(
+            "checkpoint_stall",
+            timings["serialize_s"] + timings["write_s"] + commit_s,
+        )
     logger.info(f"saved state to {final}")
     return final
 

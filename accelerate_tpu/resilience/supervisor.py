@@ -191,6 +191,31 @@ class Supervisor:
         except OSError:
             pass  # supervision must not die of a full disk
 
+    def _goodput_verdict(self) -> None:
+        """On clean finish, fold the whole run's event streams into the
+        goodput ledger and log the one-line verdict (also emitted as a
+        supervisor ``goodput`` record so the report CLI can find it without
+        re-deriving). Best-effort: a verdict failure must not fail the run."""
+        try:
+            from ..telemetry import goodput as _goodput
+            from ..telemetry import report as _report
+
+            events = _report.load_events([self.telemetry_dir])
+            ledger = _goodput.build_ledger(events)
+            if ledger is None:
+                return
+            logger.info(_goodput.verdict_line(ledger))
+            self._emit(
+                "goodput",
+                final=True,
+                goodput_fraction=ledger["goodput_fraction"],
+                wall_s=ledger["wall_s"],
+                unattributed_fraction=ledger["unattributed_fraction"],
+                top_badput=ledger.get("top_badput"),
+            )
+        except Exception:
+            logger.warning("goodput verdict failed", exc_info=True)
+
     # ----------------------------------------------------------------- spawn ----
     def _heartbeat_file(self, new_rank: int) -> str:
         return os.path.join(self.telemetry_dir, f"heartbeat-rank{new_rank}")
@@ -379,6 +404,7 @@ class Supervisor:
             if incident is None:  # clean finish
                 self._emit("elastic", phase="done", generation=self.generation,
                            restarts=self.restarts_used)
+                self._goodput_verdict()
                 return 0
             failed_at = time.monotonic()
             self._teardown()

@@ -39,6 +39,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..telemetry import events as tel
+from ..telemetry import goodput as _goodput
 from ..telemetry import metrics as _metrics
 from .replica import ReplicaState, ReplicaSpec
 
@@ -216,6 +217,9 @@ class AutoscalerPolicy:
                 join_compiles=join_compiles,
                 warm=join_compiles == 0,
             )
+            # the joiner's warm-up window is capacity the fleet paid for but
+            # could not serve with — scaleup_wait in the goodput taxonomy
+            _goodput.note("scaleup_wait", now - spawned)
             acted = True
         return acted
 

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import events as tel
+from ..telemetry import goodput as _goodput
 from ..telemetry import metrics as _metrics
 from ..telemetry import tracing as _tracing
 from ..telemetry import watchdog as _watchdog
@@ -344,6 +345,7 @@ class PrefillEngine(ServingEngine):
 
     def step(self, now: Optional[float] = None) -> "list[Request]":
         now = time.monotonic() if now is None else now
+        step_t0 = time.monotonic()
         finished: "list[Request]" = []
         prefills = 0
         prefill_tokens_before = self.prefill_tokens
@@ -404,9 +406,11 @@ class PrefillEngine(ServingEngine):
             _metrics.maybe_snapshot()
         if tel.is_enabled() and (prefills or finished):
             alloc = self.allocator.stats()
+            step_dur = time.monotonic() - step_t0
             tel.emit(
                 "serving",
                 phase="step",
+                dur_s=round(step_dur, 6),
                 queue_depth=self.scheduler.queue_depth,
                 running=0,
                 occupancy=0.0,
@@ -420,6 +424,12 @@ class PrefillEngine(ServingEngine):
                 block_occupancy=alloc["occupancy"],
                 fragmentation=alloc["fragmentation"],
             )
+            _goodput.note_serving_step(
+                step_dur,
+                computed_tokens=self.prefill_tokens - prefill_tokens_before,
+                wasted_tokens=0,
+            )
+            _goodput.maybe_emit()
         return finished
 
     def stats(self) -> dict:

@@ -43,6 +43,7 @@ from ..generation import _project_qkv, sample_token_logits, serving_shardings
 from ..models.transformer import LlamaConfig, rms_norm, rope_frequencies
 from ..ops.flash_attention import paged_attention
 from ..telemetry import events as tel
+from ..telemetry import goodput as _goodput
 from ..telemetry import metrics as _metrics
 from ..telemetry import tracing as _tracing
 from ..telemetry import watchdog as _watchdog
@@ -268,6 +269,11 @@ class ServingEngine:
         #: prompt tokens whose KV came straight from the prefix cache — i.e.
         #: prefill work NOT done (the bench's ``prefill_tokens_saved``)
         self.prefix_cached_tokens = 0
+        #: re-prefilled tokens: KV this engine computed a second time. The
+        #: goodput ledger's token-waste attribution — preempt/resume
+        #: re-prefills vs failover/handoff resumes seeded via ``generated``
+        self.preempt_prefill_tokens = 0
+        self.resume_prefill_tokens = 0
         self.max_running = 0
         self._occupancy_sum = 0.0
         self._occupancy_steps = 0
@@ -346,6 +352,7 @@ class ServingEngine:
         next boot. ``cache_stats`` records the per-point outcomes."""
         from .. import compile_cache as _ccache
 
+        warmup_t0 = time.monotonic()
         cache = None
         if self.compile_cache_dir is not None:
             cache = _ccache.get_cache(self.compile_cache_dir)
@@ -402,13 +409,15 @@ class ServingEngine:
         jax.block_until_ready(self.pool)
         counts = self.jit_cache_sizes()
         if tel.is_enabled():
+            warmup_dur = time.monotonic() - warmup_t0
             tel.emit(
-                "serving", phase="warmup", **counts,
+                "serving", phase="warmup", dur_s=round(warmup_dur, 6), **counts,
                 **(
                     {"cache_" + k: v for k, v in self.cache_stats.items() if v}
                     if cache is not None else {}
                 ),
             )
+            _goodput.note("warmup", warmup_dur)
         return counts
 
     def jit_cache_sizes(self) -> dict:
@@ -446,6 +455,8 @@ class ServingEngine:
         prefills = 0
         prefill_tokens_before = self.prefill_tokens
         prefix_cached_before = self.prefix_cached_tokens
+        preempt_before = self.preempt_prefill_tokens
+        resume_before = self.resume_prefill_tokens
         admitted = self.scheduler.admissions()
         while self.scheduler.rejected:
             req = self.scheduler.rejected.pop()
@@ -525,15 +536,22 @@ class ServingEngine:
             _metrics.maybe_snapshot()
         if tel.is_enabled():
             alloc = self.allocator.stats()
+            step_dur = time.monotonic() - step_t0
+            prefill_delta = self.prefill_tokens - prefill_tokens_before
+            preempt_delta = self.preempt_prefill_tokens - preempt_before
+            resume_delta = self.resume_prefill_tokens - resume_before
             tel.emit(
                 "serving",
                 phase="step",
+                dur_s=round(step_dur, 6),
                 queue_depth=self.scheduler.queue_depth,
                 running=len(running),
                 occupancy=round(occupancy, 6),
                 prefills=prefills,
-                prefill_tokens=self.prefill_tokens - prefill_tokens_before,
+                prefill_tokens=prefill_delta,
                 prefix_hit_tokens=self.prefix_cached_tokens - prefix_cached_before,
+                preempt_reprefill_tokens=preempt_delta,
+                resume_reprefill_tokens=resume_delta,
                 decode_tokens=len(running),
                 preemptions=self.scheduler.preemption_count,
                 free_blocks=alloc["free_blocks"],
@@ -541,6 +559,12 @@ class ServingEngine:
                 block_occupancy=alloc["occupancy"],
                 fragmentation=alloc["fragmentation"],
             )
+            _goodput.note_serving_step(
+                step_dur,
+                computed_tokens=prefill_delta + len(running),
+                wasted_tokens=preempt_delta + resume_delta,
+            )
+            _goodput.maybe_emit()
         return finished
 
     def run(self, max_steps: int = 100_000) -> "list[Request]":
@@ -612,6 +636,13 @@ class ServingEngine:
         start = int(req.cached_tokens)
         self.prefix_cached_tokens += start
         self.prefill_tokens += int(prefix.size) - start
+        # token-goodput waste attribution: a prefill covering already-produced
+        # work is recomputation. Preempt/resume re-runs carry preemptions>0;
+        # a failover/handoff resume arrives with ``generated`` pre-seeded.
+        if req.preemptions > 0:
+            self.preempt_prefill_tokens += int(prefix.size) - start
+        elif req.generated:
+            self.resume_prefill_tokens += int(prefix.size) - start
         while start < prefix.size:
             chunk = prefix[start : start + chunk_cap]
             Sb = self.lattice.prefill_bucket(chunk.size)
@@ -744,6 +775,8 @@ class ServingEngine:
             "decode_tokens": self.decode_tokens,
             "prefill_tokens": self.prefill_tokens,
             "prefill_calls": self.prefill_calls,
+            "preempt_prefill_tokens": self.preempt_prefill_tokens,
+            "resume_prefill_tokens": self.resume_prefill_tokens,
             "preemptions": self.scheduler.preemption_count,
             "max_running": self.max_running,
             "mean_occupancy": round(

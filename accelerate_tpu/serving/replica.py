@@ -47,6 +47,9 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from ..telemetry import events as tel
+from ..telemetry import goodput as _goodput
+
 __all__ = [
     "REPLICA_SPEC_ENV_VAR",
     "ReplicaState",
@@ -202,6 +205,7 @@ class _EngineWorker:
             handles: "dict[str, Any]" = {}  # router rid -> engine Request
             sent: "dict[str, int]" = {}  # router rid -> tokens already reported
             last_beat = 0.0
+            idle_since = None  # start of the current no-work spell, if any
             while not self.killed.is_set():
                 cmd = self.recv(self.idle_beat_s if self.engine.scheduler.idle() else 0.0)
                 while cmd is not None:
@@ -231,10 +235,20 @@ class _EngineWorker:
                     cmd = self.recv(0.0)
                 if self.engine.scheduler.idle():
                     now = time.monotonic()
+                    if idle_since is None:
+                        idle_since = now
                     if now - last_beat >= self.idle_beat_s:
                         last_beat = now
                         self.send({"event": "beat"})
                     continue
+                if idle_since is not None:
+                    # evidenced idle capacity: the goodput ledger attributes
+                    # this gap to `idle` instead of leaving it unattributed
+                    idle_dur = time.monotonic() - idle_since
+                    idle_since = None
+                    if idle_dur > 1e-3 and tel.is_enabled():
+                        tel.emit("serving", phase="idle", dur_s=round(idle_dur, 6))
+                        _goodput.note("idle", idle_dur)
                 finished = self.engine.step()
                 progress = {}
                 for rid, req in handles.items():

@@ -49,6 +49,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..telemetry import events as tel
+from ..telemetry import goodput as _goodput
 from ..telemetry import metrics as _metrics
 from ..telemetry import tracing as _tracing
 from ..telemetry import watchdog as _watchdog
@@ -793,6 +794,14 @@ class ServingRouter:
                     round(req.prefill_s, 6) if req.prefill_s is not None else None
                 )
             tel.emit("router", **record)
+            if status in (RouterRequestStatus.FAILED, RouterRequestStatus.EXPIRED) \
+                    and (req.replica is not None or req.generated):
+                # abandoned after compute was spent on it: everything prefilled
+                # or decoded for this request is badput in the token ledger
+                _goodput.note_serving_step(
+                    0.0,
+                    wasted_tokens=int(req.prompt.size) + len(req.generated),
+                )
 
     def _observe_slo(self, req: RouterRequest, status: RouterRequestStatus,
                      now: float) -> None:

@@ -43,6 +43,18 @@ to a loss-curve tracker. Layout:
   availability, shed rate, step latency, restart downtime) evaluated over
   fast/slow windows, ``slo_violation`` records, and the burning-replica
   signal the serving router folds into dispatch.
+- :mod:`.goodput` — the fleet goodput/badput ledger: every wall-clock
+  second attributed into a fixed taxonomy (productive execute vs compile,
+  data-wait, exposed checkpoint stalls, restart downtime, cold compiles,
+  scale-up waits, serving idle) from the existing event streams, plus the
+  serving-side token ledger (useful vs re-computed tokens). Renders as the
+  report CLI's ``goodput`` section, periodic ``goodput`` snapshot records,
+  and Prometheus gauges; every run ends in a one-line verdict.
+- :mod:`.regress` — the continuous perf-regression sentinel:
+  ``python -m accelerate_tpu.telemetry regress`` compares bench payloads
+  grouped by environment fingerprint against a per-metric registry
+  (direction, noise tolerance, hard bars) and exits nonzero on regression
+  (``make bench-check``).
 - :mod:`.report` — ``python -m accelerate_tpu.telemetry report <dir>``
   aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes;
   ``--request <id>`` renders one request's span timeline, ``--trace-out``
@@ -56,7 +68,7 @@ Comms counters live in :mod:`accelerate_tpu.utils.operations` (the ops being
 counted) and write through :mod:`.events`.
 """
 
-from . import flight_recorder, metrics, perf, slo, tracing, watchdog, xplane
+from . import flight_recorder, goodput, metrics, perf, regress, slo, tracing, watchdog, xplane
 from .events import (
     TELEMETRY_DIR_ENV_VAR,
     TELEMETRY_ENV_VAR,
@@ -114,6 +126,7 @@ __all__ = [
     "flight_recorder",
     "gauge",
     "get_event_log",
+    "goodput",
     "hard_flush",
     "host_memory_bytes",
     "is_enabled",
@@ -125,6 +138,7 @@ __all__ = [
     "peaks_for_device",
     "perf",
     "record_data_wait",
+    "regress",
     "set_step",
     "slo",
     "span",

@@ -1,4 +1,6 @@
-"""``python -m accelerate_tpu.telemetry report <dir>`` entry point."""
+"""``python -m accelerate_tpu.telemetry <command>`` entry point: ``report``
+(event-stream aggregation), ``doctor`` (self-check), and ``regress`` (the
+perf-regression sentinel over bench payloads — ``make bench-check``)."""
 
 import sys
 

@@ -1,0 +1,532 @@
+"""Fleet goodput/badput ledger: attribute every wall-clock second of a run.
+
+Large fleets lose throughput not in the step function but *between* steps —
+compiles, input stalls, checkpoint stalls, restart downtime, cold scale-ups.
+The ledger turns the event streams every subsystem already writes into a
+fixed taxonomy, per rank, per restart generation and fleet-aggregated, so
+"how much of the fleet's wall-clock bought training/serving work?" is one
+number (``goodput_fraction``) with an attributed remainder.
+
+Two halves:
+
+- **Post-hoc ledger** (:func:`build_ledger`): pure function of the merged
+  event list the report CLI already loads. Wall-clock is segmented per rank
+  stream at each ``meta`` record (every process incarnation writes a fresh
+  meta line, so metas are the generation boundaries) and attributed from the
+  records inside the segment: ``step`` execute/compile/data-wait splits,
+  exposed ``checkpoint`` phases, ``serving`` step/warmup durations, and the
+  supervisor's ``restart``/``autoscale`` records for cross-incarnation
+  downtime. The serving side additionally carries a **token goodput**:
+  useful emitted tokens vs total computed, with re-prefill/abandoned/handoff
+  waste attribution.
+- **Live meter** (:func:`note_step` & friends): cumulative in-process
+  counters fed from the same call sites that emit the records, flushed as
+  periodic ``goodput`` snapshot records and Prometheus gauges
+  (:data:`~accelerate_tpu.telemetry.metrics.GOODPUT_GAUGES`). Disabled cost
+  is one ``is_enabled`` check per call — no files, no threads of its own.
+
+The restart-downtime computation lives HERE (:func:`restart_stats`) and is
+the single implementation both the report CLI's restarts section and the
+ledger consume — the two can never disagree.
+
+Taxonomy (seconds buckets; ``good`` vs ``badput`` vs the honest remainder):
+
+===================  =====  ====================================================
+category             kind   evidence
+===================  =====  ====================================================
+productive           good   ``step`` ``execute_s`` minus critical data wait
+serving_execute      good   ``serving`` step ``dur_s`` (engine busy)
+compile              bad    ``step`` ``compile_s`` (segment saw cache hits/no cache)
+compile_cold         bad    ``step`` ``compile_s`` in a segment with a compile-cache
+                            miss/fallback (PR 13 records)
+warmup               bad    ``serving`` warmup ``dur_s`` (lattice compile/load)
+data_wait            bad    critical input-pipeline wait inside steps (PR 3)
+checkpoint_stall     bad    non-hidden ``checkpoint`` phase durations (PR 5)
+restart_downtime     bad    supervisor ``restart`` records x cohort size (PR 10)
+scaleup_wait         bad    ``autoscale`` scale-up ``time_to_ready_s`` (PR 16)
+init                 bad    segment head before the first step/warmup starts
+idle                 bad    evidenced idle serving gaps (empty engine on both ends)
+unattributed         --     wall minus everything above (must stay < 5%)
+===================  =====  ====================================================
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from . import events as tel
+from . import metrics as _metrics
+
+GOOD_CATEGORIES = ("productive", "serving_execute")
+BADPUT_CATEGORIES = (
+    "compile",
+    "compile_cold",
+    "warmup",
+    "data_wait",
+    "checkpoint_stall",
+    "restart_downtime",
+    "scaleup_wait",
+    "init",
+    "idle",
+)
+#: token-waste causes in the serving token ledger
+TOKEN_WASTE_CAUSES = (
+    "preemption_reprefill",  # LIFO preempt/resume re-prefills (PR 11)
+    "failover_reprefill",    # replica-death resume re-prefills (PR 12)
+    "handoff_rerun",         # corrupt/dropped KV handoff -> prefill re-run (PR 16)
+    "abandoned",             # dispatched but failed/expired: all its tokens
+)
+
+
+# ---------------------------------------------------------------------------
+# THE shared restart-downtime computation (report restarts section + ledger)
+
+def restart_stats(events: "list[dict]") -> dict:
+    """Aggregate supervisor ``restart`` records into the downtime facts both
+    the report CLI's restarts section and the goodput ledger consume.
+
+    ``downtime_s`` sums the supervisor-measured failure-detection→respawn
+    gaps; ``chip_downtime_s`` weights each gap by the cohort size it idled
+    (``processes`` on the record — a 8-process cohort down 3s lost 24
+    chip-seconds); ``by_generation`` attributes each gap to the generation it
+    *spawned* (the downtime paid to reach it)."""
+    restarts = [e for e in events if e.get("kind") == "restart"]
+    causes: dict = {}
+    by_generation: dict = {}
+    downtime = 0.0
+    chip_downtime = 0.0
+    for r in restarts:
+        cause = str(r.get("cause", "?"))
+        causes[cause] = causes.get(cause, 0) + 1
+        d = float(r.get("downtime_s", 0.0))
+        downtime += d
+        chip = d * max(1, int(r.get("processes") or 1))
+        chip_downtime += chip
+        gen = int(r.get("generation", 0))
+        by_generation[gen] = round(by_generation.get(gen, 0.0) + chip, 6)
+    return {
+        "count": sum(1 for r in restarts if not r.get("gave_up")),
+        "downtime_s": round(downtime, 3),
+        "chip_downtime_s": round(chip_downtime, 3),
+        "causes": dict(sorted(causes.items())),
+        "by_generation": by_generation,
+    }
+
+
+# ---------------------------------------------------------------------------
+# post-hoc ledger
+
+def _segments(events: "list[dict]") -> "list[dict]":
+    """Split the merged event list into per-incarnation segments: one per
+    ``meta`` record in each rank stream (every respawn opens its stream with
+    a fresh meta line, so the k-th meta in a file IS local generation k).
+    Supervisor streams (``role: supervisor``, no ``process_index``) carry no
+    rank wall-clock and are excluded."""
+    by_file: dict = {}
+    for e in events:
+        by_file.setdefault(e.get("_file") or "?", []).append(e)
+    segments: "list[dict]" = []
+    for file, evs in sorted(by_file.items()):
+        current: Optional[dict] = None
+        gen = -1
+        for e in evs:
+            if e.get("kind") == "meta":
+                if e.get("process_index") is None:
+                    current = None  # supervisor/unknown stream: skip until next rank meta
+                    continue
+                gen += 1
+                current = {
+                    "file": file,
+                    "rank": int(e["process_index"]),
+                    "generation": gen,
+                    "t0": float(e.get("t", 0.0)),
+                    "events": [],
+                }
+                segments.append(current)
+            elif current is not None:
+                current["events"].append(e)
+    return segments
+
+
+def _attribute_segment(seg: dict) -> dict:
+    """One incarnation's wall-clock, attributed. Sum-based with clamps: the
+    buckets are built from disjoint evidence (step internals never overlap
+    checkpoint/warmup records, which are emitted between steps), and the
+    remainder is reported honestly as ``unattributed``."""
+    evs = seg["events"]
+    t0 = seg["t0"]
+    # the meta line is stamped when the stream file is first written, which
+    # can be AFTER early records were stamped (records carry their END time,
+    # so work like a serving warmup may straddle the lazy meta write) —
+    # anchor at the earliest evidence so the wall doesn't collapse to zero
+    starts = [float(e.get("t", t0)) - float(e.get("dur_s", 0.0)) for e in evs]
+    t0 = min([t0] + starts)
+    t_last = max([float(e.get("t", t0)) for e in evs] + [t0])
+    wall = max(0.0, t_last - t0)
+    buckets = {c: 0.0 for c in GOOD_CATEGORIES + BADPUT_CATEGORIES}
+
+    steps = [e for e in evs if e.get("kind") == "step"]
+    cold = any(
+        e.get("kind") == "compile_cache"
+        and e.get("event") in ("miss", "fallback", "corrupt")
+        for e in evs
+    )
+    compile_key = "compile_cold" if cold else "compile"
+    # a step's drained data_wait_s covers waits since the PREVIOUS step's
+    # drain — the loader fetch usually stalls in the gap BETWEEN step windows
+    # (``for batch in loader: step(batch)``), so charge the wait against the
+    # inter-step gap first and only the remainder against execute time
+    prev_end: Optional[float] = None
+    for s in steps:
+        t = float(s.get("t", t0))
+        dur = float(s.get("dur_s", 0.0))
+        gap = max(0.0, (t - dur) - prev_end) if prev_end is not None else 0.0
+        execute = float(s.get("execute_s", 0.0))
+        wait = max(0.0, float(s.get("data_wait_s", 0.0)))
+        gap_wait = min(wait, gap)
+        in_step_wait = min(wait - gap_wait, execute)
+        buckets["data_wait"] += gap_wait + in_step_wait
+        buckets["productive"] += max(0.0, execute - in_step_wait)
+        buckets[compile_key] += float(s.get("compile_s", 0.0))
+        prev_end = t
+
+    for c in evs:
+        if c.get("kind") == "checkpoint" and not c.get("hidden", False):
+            buckets["checkpoint_stall"] += float(c.get("dur_s", 0.0))
+
+    serving_steps = [
+        e for e in evs if e.get("kind") == "serving" and e.get("phase") == "step"
+    ]
+    for e in evs:
+        if e.get("kind") == "serving" and e.get("phase") == "warmup":
+            buckets["warmup"] += float(e.get("dur_s", 0.0))
+        if e.get("kind") == "serving" and e.get("phase") == "idle":
+            buckets["idle"] += float(e.get("dur_s", 0.0))
+    for s in serving_steps:
+        buckets["serving_execute"] += float(s.get("dur_s", 0.0))
+
+    # segment head/tail: framework time outside any recorded unit of work —
+    # imports, device init and loader spin-up before the first step, and
+    # teardown (final saves, summary emits, log close) after the last one.
+    # Records carry their END time; subtract dur_s to recover the start.
+    work = steps + serving_steps + [
+        e
+        for e in evs
+        if (e.get("kind") == "serving" and e.get("phase") in ("warmup", "idle"))
+        or (e.get("kind") == "checkpoint" and not e.get("hidden", False))
+    ]
+    if work:
+        work_starts = [
+            float(e.get("t", t0)) - float(e.get("dur_s", 0.0)) for e in work
+        ]
+        work_ends = [float(e.get("t", t0)) for e in work]
+        buckets["init"] = max(0.0, min(work_starts) - t0)
+        buckets["init"] += max(0.0, t_last - max(work_ends))
+
+    attributed = sum(buckets.values())
+    unattributed = max(0.0, wall - attributed)
+    return {
+        "rank": seg["rank"],
+        "generation": seg["generation"],
+        "wall_s": round(wall, 6),
+        "buckets": {k: round(v, 6) for k, v in buckets.items()},
+        "unattributed_s": round(unattributed, 6),
+        "overattributed": attributed > wall * 1.05 + 1e-6,
+    }
+
+
+def _token_ledger(events: "list[dict]") -> Optional[dict]:
+    """Serving token goodput: useful emitted tokens vs total computed."""
+    serving_steps = [
+        e for e in events if e.get("kind") == "serving" and e.get("phase") == "step"
+    ]
+    if not serving_steps:
+        return None
+    computed = sum(
+        int(s.get("prefill_tokens", 0)) + int(s.get("decode_tokens", 0))
+        for s in serving_steps
+    )
+    waste = {c: 0 for c in TOKEN_WASTE_CAUSES}
+    waste["preemption_reprefill"] = sum(
+        int(s.get("preempt_reprefill_tokens", 0)) for s in serving_steps
+    )
+    waste["failover_reprefill"] = sum(
+        int(s.get("resume_reprefill_tokens", 0)) for s in serving_steps
+    )
+    routed = [
+        e for e in events if e.get("kind") == "router" and e.get("phase") == "request"
+    ]
+    prompt_by_rid = {str(r.get("rid")): int(r.get("prompt_tokens") or 0) for r in routed}
+    shed = 0
+    for r in routed:
+        outcome = str(r.get("outcome", ""))
+        if outcome == "shed":
+            shed += 1  # never dispatched: zero compute wasted, counted anyway
+        elif outcome in ("failed", "expired") and (
+            r.get("replica") is not None or int(r.get("new_tokens") or 0) > 0
+        ):
+            waste["abandoned"] += int(r.get("prompt_tokens") or 0) + int(
+                r.get("new_tokens") or 0
+            )
+    reruns = 0
+    for h in events:
+        if h.get("kind") == "kv_handoff" and h.get("outcome") not in (None, "ok"):
+            reruns += 1
+            waste["handoff_rerun"] += prompt_by_rid.get(str(h.get("rid")), 0)
+    wasted = min(computed, sum(waste.values()))
+    useful = computed - wasted
+    return {
+        "computed_tokens": computed,
+        "useful_tokens": useful,
+        "wasted_tokens": wasted,
+        "waste_by_cause": waste,
+        "shed_requests": shed,
+        "handoff_reruns": reruns,
+        "token_goodput_fraction": (
+            round(useful / computed, 6) if computed else None
+        ),
+    }
+
+
+def build_ledger(events: "list[dict]", by_rank: bool = False) -> Optional[dict]:
+    """The fleet goodput ledger over a merged event list (the report CLI's
+    ``load_events`` output). Returns None when there is no wall-clock
+    evidence at all (no rank stream ever opened)."""
+    segments = [_attribute_segment(s) for s in _segments(events)]
+    restarts = restart_stats(events)
+    scaleup = sum(
+        float(a.get("time_to_ready_s", 0.0))
+        for a in events
+        if a.get("kind") == "autoscale" and a.get("action") == "scale_up"
+    )
+    if not segments and not restarts["count"]:
+        return None
+
+    total = {c: 0.0 for c in GOOD_CATEGORIES + BADPUT_CATEGORIES}
+    wall = 0.0
+    unattributed = 0.0
+    by_generation: dict = {}
+    by_rank_out: dict = {}
+    for seg in segments:
+        wall += seg["wall_s"]
+        unattributed += seg["unattributed_s"]
+        for c, v in seg["buckets"].items():
+            total[c] += v
+        g = by_generation.setdefault(
+            seg["generation"], {"wall_s": 0.0, "good_s": 0.0, "badput_s": 0.0,
+                               "unattributed_s": 0.0, "restart_downtime_s": 0.0}
+        )
+        g["wall_s"] += seg["wall_s"]
+        g["good_s"] += sum(seg["buckets"][c] for c in GOOD_CATEGORIES)
+        g["badput_s"] += sum(seg["buckets"][c] for c in BADPUT_CATEGORIES)
+        g["unattributed_s"] += seg["unattributed_s"]
+        if by_rank:
+            r = by_rank_out.setdefault(
+                seg["rank"], {"wall_s": 0.0, "good_s": 0.0, "unattributed_s": 0.0}
+            )
+            r["wall_s"] += seg["wall_s"]
+            r["good_s"] += sum(seg["buckets"][c] for c in GOOD_CATEGORIES)
+            r["unattributed_s"] += seg["unattributed_s"]
+
+    # cross-incarnation costs the rank streams cannot see: supervisor-measured
+    # restart downtime (chip-seconds) and autoscaler cold scale-up waits
+    total["restart_downtime"] = restarts["chip_downtime_s"]
+    total["scaleup_wait"] += scaleup
+    for gen, d in restarts["by_generation"].items():
+        g = by_generation.setdefault(
+            gen, {"wall_s": 0.0, "good_s": 0.0, "badput_s": 0.0,
+                  "unattributed_s": 0.0, "restart_downtime_s": 0.0}
+        )
+        g["restart_downtime_s"] += d
+        g["badput_s"] += d
+        g["wall_s"] += d
+    wall += restarts["chip_downtime_s"] + scaleup
+
+    good = sum(total[c] for c in GOOD_CATEGORIES)
+    badput = {c: round(total[c], 6) for c in BADPUT_CATEGORIES if total[c] > 0}
+    top = max(
+        list(badput.items()) + [("unattributed", unattributed)],
+        key=lambda kv: kv[1],
+        default=None,
+    )
+    ledger = {
+        "wall_s": round(wall, 6),
+        "good_s": round(good, 6),
+        "goodput_fraction": round(good / wall, 6) if wall > 0 else None,
+        "good_by_category": {
+            c: round(total[c], 6) for c in GOOD_CATEGORIES if total[c] > 0
+        },
+        "badput_s": badput,
+        "unattributed_s": round(unattributed, 6),
+        "unattributed_fraction": round(unattributed / wall, 6) if wall > 0 else None,
+        "top_badput": (
+            {"cause": top[0], "seconds": round(top[1], 6),
+             "fraction": round(top[1] / wall, 6) if wall > 0 else None}
+            if top and top[1] > 0 else None
+        ),
+        "segments": len(segments),
+        "by_generation": {
+            str(k): {kk: round(vv, 6) for kk, vv in v.items()}
+            for k, v in sorted(by_generation.items())
+        },
+        "restarts": restarts,
+        "overattributed": any(s["overattributed"] for s in segments),
+    }
+    if by_rank and by_rank_out:
+        fractions = {
+            r: (v["good_s"] / v["wall_s"] if v["wall_s"] > 0 else 0.0)
+            for r, v in by_rank_out.items()
+        }
+        ledger["by_rank"] = {
+            str(r): {
+                "wall_s": round(v["wall_s"], 6),
+                "good_s": round(v["good_s"], 6),
+                "goodput_fraction": round(fractions[r], 6),
+                "unattributed_s": round(v["unattributed_s"], 6),
+            }
+            for r, v in sorted(by_rank_out.items())
+        }
+        if len(fractions) > 1:
+            ledger["rank_skew"] = round(
+                max(fractions.values()) - min(fractions.values()), 6
+            )
+    tokens = _token_ledger(events)
+    if tokens is not None:
+        ledger["tokens"] = tokens
+    ledger["verdict"] = verdict_line(ledger)
+    return ledger
+
+
+def verdict_line(ledger: dict) -> str:
+    """The per-run one-liner: goodput fraction + the top badput cause."""
+    frac = ledger.get("goodput_fraction")
+    frac_s = f"{frac * 100:.1f}%" if frac is not None else "n/a"
+    top = ledger.get("top_badput")
+    top_s = (
+        f" — top badput: {top['cause']} ({top['fraction'] * 100:.1f}%)"
+        if top and top.get("fraction") is not None
+        else ""
+    )
+    tok = ledger.get("tokens") or {}
+    tok_frac = tok.get("token_goodput_fraction")
+    tok_s = f", token goodput {tok_frac * 100:.1f}%" if tok_frac is not None else ""
+    return (
+        f"goodput {frac_s} of {ledger['wall_s']:.1f}s fleet wall-clock"
+        f"{top_s}{tok_s}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# live meter: cumulative counters -> periodic `goodput` records + gauges
+
+_LOCK = threading.Lock()
+_SECONDS: "dict[str, float]" = {}
+_TOKENS = {"computed": 0, "wasted": 0}
+_LAST_EMIT = 0.0
+_EMIT_INTERVAL_S = 30.0
+
+
+def note(category: str, seconds: float) -> None:
+    """Charge ``seconds`` to a taxonomy category. One ``is_enabled`` check
+    when telemetry is off — no state is touched, no files or threads exist."""
+    if not tel.is_enabled() or seconds <= 0:
+        return
+    with _LOCK:
+        _SECONDS[category] = _SECONDS.get(category, 0.0) + float(seconds)
+
+
+def note_step(execute_s: float, compile_s: float, data_wait_s: float) -> None:
+    """Per-train-step feed (step_profiler exit): the execute/compile/wait
+    split, charged to productive/compile/data_wait."""
+    if not tel.is_enabled():
+        return
+    wait = min(max(0.0, data_wait_s), max(0.0, execute_s))
+    with _LOCK:
+        _SECONDS["productive"] = _SECONDS.get("productive", 0.0) + max(
+            0.0, execute_s - wait
+        )
+        _SECONDS["data_wait"] = _SECONDS.get("data_wait", 0.0) + wait
+        if compile_s > 0:
+            _SECONDS["compile"] = _SECONDS.get("compile", 0.0) + compile_s
+
+
+def note_serving_step(dur_s: float, computed_tokens: int = 0,
+                      wasted_tokens: int = 0) -> None:
+    """Per-engine-step feed: busy seconds + the step's token accounting."""
+    if not tel.is_enabled():
+        return
+    with _LOCK:
+        if dur_s > 0:
+            _SECONDS["serving_execute"] = (
+                _SECONDS.get("serving_execute", 0.0) + dur_s
+            )
+        _TOKENS["computed"] += int(computed_tokens)
+        _TOKENS["wasted"] += int(wasted_tokens)
+
+
+def maybe_emit(now: Optional[float] = None) -> bool:
+    """Throttled snapshot: at most one ``goodput`` record (+ gauge refresh)
+    per interval, emitted from whatever hot path calls this. Cheap when off."""
+    global _LAST_EMIT
+    if not tel.is_enabled():
+        return False
+    now = time.monotonic() if now is None else now
+    if now - _LAST_EMIT < _EMIT_INTERVAL_S:
+        return False
+    _LAST_EMIT = now
+    emit_now()
+    return True
+
+
+def emit_now(final: bool = False) -> Optional[dict]:
+    """Flush the meter: one cumulative ``goodput`` record and the Prometheus
+    gauges (when the PR 15 registry is armed). Returns the record fields."""
+    if not tel.is_enabled():
+        return None
+    with _LOCK:
+        seconds = dict(_SECONDS)
+        tokens = dict(_TOKENS)
+    good = sum(seconds.get(c, 0.0) for c in GOOD_CATEGORIES)
+    bad = sum(v for c, v in seconds.items() if c not in GOOD_CATEGORIES)
+    accounted = good + bad
+    frac = good / accounted if accounted > 0 else None
+    useful = max(0, tokens["computed"] - tokens["wasted"])
+    tok_frac = useful / tokens["computed"] if tokens["computed"] else None
+    fields: "dict[str, Any]" = {
+        "good_s": round(good, 6),
+        "badput_s": round(bad, 6),
+        "by_category": {k: round(v, 6) for k, v in sorted(seconds.items())},
+        "goodput_fraction": round(frac, 6) if frac is not None else None,
+        "computed_tokens": tokens["computed"],
+        "wasted_tokens": tokens["wasted"],
+        "token_goodput_fraction": (
+            round(tok_frac, 6) if tok_frac is not None else None
+        ),
+    }
+    if final:
+        fields["final"] = True
+    tel.emit("goodput", **fields)
+    if _metrics.is_enabled():
+        if frac is not None:
+            _metrics.set_gauge(_metrics.GOODPUT_FRACTION_GAUGE, round(frac, 6))
+        if tok_frac is not None:
+            _metrics.set_gauge(
+                _metrics.TOKEN_GOODPUT_FRACTION_GAUGE, round(tok_frac, 6)
+            )
+        for cause, v in seconds.items():
+            if cause not in GOOD_CATEGORIES:
+                _metrics.set_gauge(
+                    _metrics.BADPUT_SECONDS_GAUGE, round(v, 6), cause=cause
+                )
+    return fields
+
+
+def _reset_for_tests() -> None:
+    global _LAST_EMIT
+    with _LOCK:
+        _SECONDS.clear()
+        _TOKENS["computed"] = 0
+        _TOKENS["wasted"] = 0
+        _LAST_EMIT = 0.0

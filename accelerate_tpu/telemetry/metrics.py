@@ -58,6 +58,14 @@ LATENCY_BUCKETS_S = (
 )
 #: queue depth / outstanding counts
 DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+#: goodput-plane gauge names, set by the :mod:`.goodput` live meter on each
+#: periodic flush: the run's goodput fraction, the serving token goodput
+#: fraction, and per-cause badput seconds (labelled ``cause=<taxonomy key>``).
+#: Declared here so dashboards and tests share one spelling with the meter.
+GOODPUT_FRACTION_GAUGE = "accelerate_goodput_fraction"
+TOKEN_GOODPUT_FRACTION_GAUGE = "accelerate_token_goodput_fraction"
+BADPUT_SECONDS_GAUGE = "accelerate_badput_seconds"
 #: occupancies are fractions in [0, 1]
 OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 

@@ -1,0 +1,353 @@
+"""Continuous perf-regression sentinel over bench payloads.
+
+``python -m accelerate_tpu.telemetry regress BASELINE CANDIDATE [...]``
+compares bench payloads (driver ``BENCH_*.json`` wrappers, raw ``bench.py``
+final-line dicts, or JSONL logs whose last line is the payload) and emits a
+NOISE / IMPROVED / REGRESSION verdict per metric, with exit codes a CI gate
+can consume (``make bench-check``):
+
+- ``0`` — clean: every compared metric is NOISE or IMPROVED,
+- ``1`` — at least one REGRESSION (the output names the metric),
+- ``2`` — refusal or error: cross-environment comparison, unusable payloads,
+  or fewer than two usable payloads.
+
+Two numbers are only comparable when their **environment fingerprints**
+match (device kind/count — stamped into every payload by
+``benchmarks/_common.env_fingerprint``; older payloads fall back to their
+``device_kind``/``n_chips`` fields). A TPU v5e number vs a CPU number is a
+hardware change, not a perf change, and the sentinel refuses it rather than
+reporting a 25x "regression".
+
+The **metric registry** (:data:`DEFAULT_SPECS`) gives each metric family a
+direction (higher/lower is better), a relative noise tolerance (doubled on
+CPU fingerprints — CI boxes are loud), and an optional hard bar that flags a
+candidate regardless of the baseline (a 0.0 headline is a dead run, not a
+slow one). :func:`register` prepends project-specific specs."""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import glob
+import json
+import os
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+NOISE = "NOISE"
+IMPROVED = "IMPROVED"
+REGRESSION = "REGRESSION"
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """Comparison policy for metric names matching ``pattern`` (fnmatch,
+    case-insensitive, first match wins)."""
+
+    pattern: str
+    direction: str = "higher"  # "higher" | "lower" is better
+    tolerance: float = 0.05    # relative noise band
+    hard_min: Optional[float] = None  # candidate below this: REGRESSION outright
+    hard_max: Optional[float] = None  # candidate above this: REGRESSION outright
+
+
+#: first match wins; the trailing catch-all makes every numeric comparable
+DEFAULT_SPECS: "list[MetricSpec]" = [
+    MetricSpec("*latency*", "lower", 0.10),
+    MetricSpec("*ttft*", "lower", 0.10),
+    MetricSpec("*stall*", "lower", 0.15),
+    MetricSpec("*compile*", "lower", 0.15),
+    MetricSpec("*seconds*", "lower", 0.10),
+    MetricSpec("*_s", "lower", 0.10),
+    MetricSpec("*_ms", "lower", 0.10),
+    MetricSpec("mfu", "higher", 0.05),
+    # a zero/absent headline is a dead run — flag it even vs a dead baseline
+    MetricSpec("headline", "higher", 0.10, hard_min=1e-9),
+    MetricSpec("*", "higher", 0.05),
+]
+
+_EXTRA_SPECS: "list[MetricSpec]" = []
+
+
+def register(spec: MetricSpec) -> None:
+    """Prepend a project-specific spec (takes precedence over defaults)."""
+    _EXTRA_SPECS.insert(0, spec)
+
+
+def spec_for(name: str) -> MetricSpec:
+    low = name.lower()
+    for spec in _EXTRA_SPECS + DEFAULT_SPECS:
+        if fnmatch.fnmatch(low, spec.pattern):
+            return spec
+    return MetricSpec("*")  # unreachable: the catch-all matches
+
+
+# ---------------------------------------------------------------------------
+# payload loading + environment fingerprints
+
+def load_payload(path: str) -> Optional[dict]:
+    """A bench payload from ``path``: a driver ``BENCH_*.json`` wrapper (its
+    ``parsed`` field), a raw payload dict, or a JSONL log (last parseable
+    object line). None when nothing usable is inside."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    payload: Optional[dict] = None
+    try:
+        obj = json.loads(text)
+        payload = obj if isinstance(obj, dict) else None
+    except json.JSONDecodeError:
+        for line in reversed(text.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict):
+                payload = obj
+                break
+    if payload is not None and "parsed" in payload and "rc" in payload:
+        payload = payload["parsed"] if isinstance(payload["parsed"], dict) else None
+    return payload
+
+
+def fingerprint(payload: dict) -> dict:
+    """The environment identity a comparison must hold fixed. Prefers the
+    stamped ``env`` block; falls back to the payload's own device fields for
+    pre-stamp payloads."""
+    env = payload.get("env") if isinstance(payload.get("env"), dict) else {}
+    kind = env.get("device_kind") or payload.get("device_kind")
+    count = env.get("device_count") or payload.get("n_chips")
+    return {
+        "device_kind": str(kind) if kind else None,
+        "device_count": int(count) if count else None,
+        "jaxlib": env.get("jaxlib"),
+    }
+
+
+def fingerprint_label(fp: dict) -> str:
+    kind = fp.get("device_kind") or "unknown"
+    count = fp.get("device_count")
+    return f"{kind} x{count}" if count else str(kind)
+
+
+def comparable(a: dict, b: dict) -> bool:
+    """Same device kind (known on both sides) and, when both report one, the
+    same device count."""
+    if not a.get("device_kind") or not b.get("device_kind"):
+        return False
+    if a["device_kind"] != b["device_kind"]:
+        return False
+    ca, cb = a.get("device_count"), b.get("device_count")
+    return ca is None or cb is None or ca == cb
+
+
+def extract_metrics(payload: dict) -> "dict[str, float]":
+    """Flatten a payload into comparable named numbers: the headline value
+    (named by its ``metric`` string when that is a bare identifier, else
+    ``headline``), ``mfu``, and every ``configs.<name>`` sub-benchmark
+    value."""
+    out: "dict[str, float]" = {}
+
+    def _num(v) -> Optional[float]:
+        return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+    headline = _num(payload.get("value"))
+    if headline is not None:
+        metric = str(payload.get("metric", ""))
+        name = metric if metric and metric.isidentifier() else "headline"
+        out[name] = headline
+    mfu = _num(payload.get("mfu"))
+    if mfu is not None:
+        out["mfu"] = mfu
+    configs = payload.get("configs")
+    if isinstance(configs, dict):
+        for cfg, entry in sorted(configs.items()):
+            if isinstance(entry, dict):
+                v = _num(entry.get("value"))
+                if v is not None:
+                    out[f"configs.{cfg}"] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# comparison
+
+def compare_metrics(
+    baseline: dict,
+    candidate: dict,
+    tolerance: Optional[float] = None,
+    cpu_noise_factor: float = 2.0,
+) -> "list[dict]":
+    """Per-metric verdicts over the metric names both payloads carry."""
+    base = extract_metrics(baseline)
+    cand = extract_metrics(candidate)
+    is_cpu = (fingerprint(candidate).get("device_kind") or "").lower() == "cpu"
+    verdicts: "list[dict]" = []
+    for name in sorted(set(base) & set(cand)):
+        spec = spec_for(name)
+        tol = tolerance if tolerance is not None else spec.tolerance
+        if is_cpu:
+            tol *= cpu_noise_factor
+        b, c = base[name], cand[name]
+        verdict = NOISE
+        reason = ""
+        if spec.hard_min is not None and c < spec.hard_min:
+            verdict, reason = REGRESSION, f"hard bar: {c:g} < {spec.hard_min:g}"
+        elif spec.hard_max is not None and c > spec.hard_max:
+            verdict, reason = REGRESSION, f"hard bar: {c:g} > {spec.hard_max:g}"
+        elif b != 0:
+            delta = (c - b) / abs(b)
+            gain = delta if spec.direction == "higher" else -delta
+            if gain > tol:
+                verdict = IMPROVED
+            elif gain < -tol:
+                verdict = REGRESSION
+        elif c != 0:
+            verdict = IMPROVED if spec.direction == "higher" else REGRESSION
+        delta_pct = ((c - b) / abs(b) * 100.0) if b else None
+        verdicts.append({
+            "metric": name,
+            "baseline": b,
+            "candidate": c,
+            "delta_pct": round(delta_pct, 3) if delta_pct is not None else None,
+            "tolerance_pct": round(tol * 100.0, 3),
+            "direction": spec.direction,
+            "verdict": verdict,
+            **({"reason": reason} if reason else {}),
+        })
+    return verdicts
+
+
+def _format_comparison(base_name: str, cand_name: str, fp: dict,
+                       verdicts: "list[dict]") -> "list[str]":
+    lines = [
+        f"regress: baseline={base_name} candidate={cand_name} "
+        f"env={fingerprint_label(fp)}"
+    ]
+    if not verdicts:
+        lines.append("  (no common metrics)")
+    width = max((len(v["metric"]) for v in verdicts), default=0)
+    for v in verdicts:
+        delta = (
+            f"{v['delta_pct']:+.1f}%" if v["delta_pct"] is not None else "n/a"
+        )
+        extra = f", {v['reason']}" if v.get("reason") else ""
+        lines.append(
+            f"  {v['verdict']:<10} {v['metric']:<{width}}  "
+            f"{v['baseline']:g} -> {v['candidate']:g}  "
+            f"({delta}, tol {v['tolerance_pct']:g}%, "
+            f"{v['direction']} is better{extra})"
+        )
+    return lines
+
+
+def scan_dir(directory: str) -> "list[str]":
+    """The ``BENCH_*.json`` payload files under ``directory``, oldest first
+    (lexicographic — the driver numbers them r01, r02, ...)."""
+    return sorted(glob.glob(os.path.join(directory, "BENCH_*.json")))
+
+
+def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
+                as_json: bool = False, scan: Optional[str] = None) -> int:
+    """CLI body. With ``scan``, compares the two newest usable payloads in
+    the directory; with explicit paths, the first is the baseline and every
+    later payload is compared against it."""
+    out_lines: "list[str]" = []
+    result: dict = {"comparisons": [], "refusals": []}
+
+    if scan:
+        paths = scan_dir(scan)
+    loaded = []
+    for p in paths:
+        payload = load_payload(p)
+        if payload is None:
+            out_lines.append(f"regress: skipping {os.path.basename(p)} (no parseable payload)")
+            continue
+        loaded.append((os.path.basename(p), payload))
+    if scan:
+        loaded = loaded[-2:]
+    if len(loaded) < 2:
+        out_lines.append("regress: need at least two usable payloads to compare")
+        print("\n".join(out_lines))
+        return 2
+
+    base_name, baseline = loaded[0]
+    base_fp = fingerprint(baseline)
+    regressions: "list[str]" = []
+    improved = noise = 0
+    refused = False
+    for cand_name, candidate in loaded[1:]:
+        cand_fp = fingerprint(candidate)
+        if not comparable(base_fp, cand_fp):
+            msg = (
+                f"regress: REFUSING {base_name} vs {cand_name} — environment "
+                f"fingerprints differ ({fingerprint_label(base_fp)} vs "
+                f"{fingerprint_label(cand_fp)}); a hardware change is not a "
+                "perf change"
+            )
+            out_lines.append(msg)
+            result["refusals"].append({
+                "baseline": base_name, "candidate": cand_name,
+                "baseline_env": base_fp, "candidate_env": cand_fp,
+            })
+            refused = True
+            continue
+        verdicts = compare_metrics(baseline, candidate, tolerance=tolerance)
+        out_lines.extend(_format_comparison(base_name, cand_name, cand_fp, verdicts))
+        result["comparisons"].append({
+            "baseline": base_name, "candidate": cand_name,
+            "env": cand_fp, "verdicts": verdicts,
+        })
+        for v in verdicts:
+            if v["verdict"] == REGRESSION:
+                regressions.append(v["metric"])
+            elif v["verdict"] == IMPROVED:
+                improved += 1
+            else:
+                noise += 1
+
+    if refused:
+        rc = 2
+        summary = "regress verdict: REFUSED (mismatched environment fingerprints)"
+    elif regressions:
+        rc = 1
+        summary = (
+            f"regress verdict: REGRESSION — {len(regressions)} metric(s): "
+            + ", ".join(sorted(set(regressions)))
+        )
+    else:
+        rc = 0
+        summary = (
+            f"regress verdict: OK — {improved} improved, {noise} within noise"
+        )
+    out_lines.append(summary)
+    result["verdict"] = summary
+    result["exit_code"] = rc
+    print(json.dumps(result, indent=2) if as_json else "\n".join(out_lines))
+    return rc
+
+
+def add_parser(sub) -> None:
+    """Attach the ``regress`` subcommand to the telemetry CLI's subparsers."""
+    p = sub.add_parser(
+        "regress",
+        help="compare bench payloads: NOISE/IMPROVED/REGRESSION with exit codes",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="payload files; first is the baseline")
+    p.add_argument("--scan", metavar="DIR",
+                   help="compare the two newest BENCH_*.json payloads in DIR")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="override every spec's relative noise tolerance")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the structured comparison dict")
+
+
+def run_from_args(args) -> int:
+    if not args.paths and not args.scan:
+        print("regress: pass payload files or --scan DIR")
+        return 2
+    return run_regress(args.paths, tolerance=args.tolerance,
+                       as_json=args.as_json, scan=args.scan)

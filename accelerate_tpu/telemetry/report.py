@@ -44,6 +44,8 @@ from typing import Iterable, Optional
 # THE percentile/histogram implementations live in telemetry.metrics — the
 # report re-exports `percentile` for its callers but owns no private math
 # (tests/test_observability.py ratchets that across the repo)
+from . import goodput as _goodput
+from . import regress as _regress
 from .metrics import hist_dist, percentile
 
 PERCENTILES = (50, 90, 99)
@@ -876,6 +878,7 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         ),
         "restarts": _restarts_section(events),
         "compile_cache": _compile_cache_section(events),
+        "goodput": _goodput.build_ledger(events, by_rank=by_rank),
     }
     if by_rank:
         report["ranks"] = _rank_section(events, file_rank, paths)
@@ -891,23 +894,21 @@ def _restarts_section(events: "list[dict]") -> dict:
     elastic = [e for e in events if e.get("kind") == "elastic"]
     reshards = [e for e in elastic if e.get("phase") == "reshard"]
     chaos = [e for e in events if e.get("kind") == "chaos_fault"]
-    causes: dict = {}
     dumps: "list[str]" = []
     for r in restarts:
-        cause = str(r.get("cause", "?"))
-        causes[cause] = causes.get(cause, 0) + 1
         if r.get("dump"):
             dumps.append(str(r["dump"]))
     gave_up = next((r for r in restarts if r.get("gave_up")), None)
+    # THE downtime/cause computation is goodput.restart_stats — shared with
+    # the goodput ledger so the two sections agree by construction
+    stats = _goodput.restart_stats(events)
     section = {
-        "count": sum(1 for r in restarts if not r.get("gave_up")),
+        "count": stats["count"],
         "generations": max(
             [int(r.get("generation", 0)) for r in restarts + elastic] or [0]
         ),
-        "downtime_s": round(
-            sum(float(r.get("downtime_s", 0.0)) for r in restarts), 3
-        ),
-        "causes": dict(sorted(causes.items())),
+        "downtime_s": stats["downtime_s"],
+        "causes": stats["causes"],
         "dumps": dumps,
         "reshards": [
             {"saved_mesh": r.get("saved_mesh"), "current_mesh": r.get("current_mesh")}
@@ -1054,6 +1055,9 @@ def format_report(report: dict) -> str:
     ccache = report.get("compile_cache")
     if ccache:
         lines.append(format_compile_cache_section(ccache))
+    gp = report.get("goodput")
+    if gp:
+        lines.append(format_goodput_section(gp))
     m = report["memory"]
     lines.append(
         "memory peaks: device "
@@ -1079,6 +1083,76 @@ def _fmt_flops(n: float) -> str:
             return f"{n:.1f} {unit}FLOP" if unit else f"{n:.0f} FLOP"
         n /= 1000.0
     return f"{n:.1f} PFLOP"
+
+
+def format_goodput_section(gp: dict) -> str:
+    """Human rendering of the fleet goodput/badput ledger
+    (:mod:`~accelerate_tpu.telemetry.goodput`)."""
+    lines = [f"goodput: {gp.get('verdict', '')}"]
+    good = gp.get("good_by_category") or {}
+    if good:
+        lines.append(
+            "  good: " + ", ".join(f"{c} {v:.2f}s" for c, v in good.items())
+        )
+    wall = gp.get("wall_s") or 0.0
+    bad = gp.get("badput_s") or {}
+    if bad:
+        parts = [
+            f"{c} {v:.2f}s ({v / wall * 100:.1f}%)" if wall else f"{c} {v:.2f}s"
+            for c, v in sorted(bad.items(), key=lambda kv: -kv[1])
+        ]
+        lines.append("  badput: " + ", ".join(parts))
+    ua = gp.get("unattributed_s") or 0.0
+    uf = gp.get("unattributed_fraction")
+    lines.append(
+        f"  unattributed: {ua:.2f}s"
+        + (f" ({uf * 100:.1f}%)" if uf is not None else "")
+    )
+    if gp.get("overattributed"):
+        lines.append(
+            "  WARNING: attributed seconds exceed wall-clock — overlapping "
+            "records; fractions are approximate"
+        )
+    gens = gp.get("by_generation") or {}
+    if len(gens) > 1 or any(g.get("restart_downtime_s") for g in gens.values()):
+        for gen, g in gens.items():
+            frac = g["good_s"] / g["wall_s"] * 100 if g.get("wall_s") else 0.0
+            down = (
+                f", restart downtime {g['restart_downtime_s']:.2f}s"
+                if g.get("restart_downtime_s")
+                else ""
+            )
+            lines.append(
+                f"  gen {gen}: wall {g['wall_s']:.2f}s, good {frac:.1f}%{down}"
+            )
+    ranks = gp.get("by_rank") or {}
+    if ranks:
+        skew = gp.get("rank_skew")
+        skew_s = f" (goodput skew {skew * 100:.1f}pp)" if skew is not None else ""
+        lines.append(
+            "  by rank" + skew_s + ": "
+            + ", ".join(
+                f"rank{r}={v['goodput_fraction'] * 100:.1f}%"
+                for r, v in ranks.items()
+            )
+        )
+    tok = gp.get("tokens")
+    if tok:
+        frac = tok.get("token_goodput_fraction")
+        frac_s = f" ({frac * 100:.1f}%)" if frac is not None else ""
+        lines.append(
+            f"  tokens: computed {tok['computed_tokens']}, "
+            f"useful {tok['useful_tokens']}{frac_s}"
+        )
+        waste = {
+            c: n for c, n in (tok.get("waste_by_cause") or {}).items() if n
+        }
+        if waste or tok.get("shed_requests"):
+            parts = [f"{c} {n}" for c, n in sorted(waste.items(), key=lambda kv: -kv[1])]
+            if tok.get("shed_requests"):
+                parts.append(f"shed {tok['shed_requests']} request(s)")
+            lines.append("    waste: " + ", ".join(parts))
+    return "\n".join(lines)
 
 
 def format_performance_section(perf: dict) -> str:
@@ -1790,6 +1864,16 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("disaggregated serving", False, f"{type(exc).__name__}: {exc}")
 
+        # 18. goodput ledger (ISSUE 17): a supervised toy run under a seeded
+        # SIGKILL + slow-data chaos schedule — the ledger must attribute the
+        # injected badput to restart_downtime and data_wait, leave <5% of
+        # fleet wall-clock unattributed, agree with the restarts section
+        # (one shared restart_stats computation), and render with a verdict
+        try:
+            _doctor_goodput(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("goodput ledger", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -2417,6 +2501,76 @@ def _doctor_disagg(tmp: str, _check) -> None:
     )
 
 
+def _doctor_goodput(tmp: str, _check) -> None:
+    """Doctor check 18 body: a supervised toy training run under a seeded
+    chaos schedule — a SIGKILL at train_step 4 in generation 0 (restart
+    downtime) plus persistent slow faults at the prefetch point (data-wait
+    stalls). The goodput ledger over the run's event streams must attribute
+    the injected badput to its causes (restart_downtime > 0, data_wait
+    evidence), leave <5% of fleet wall-clock unattributed, agree with the
+    report's restarts section by construction (shared restart_stats), and
+    render as the report's ``goodput`` section with a verdict line."""
+    import subprocess as _subprocess
+    import sys
+
+    from . import goodput as _goodput
+    from ..resilience.chaos import ChaosSchedule, Fault
+    from ..resilience.supervisor import RestartPolicy, Supervisor
+
+    sup_dir = os.path.join(tmp, "goodput")
+    os.makedirs(sup_dir, exist_ok=True)
+    schedule = ChaosSchedule(faults=[
+        Fault(kind="sigkill", point="train_step", step=4, generation=0),
+        Fault(kind="slow", point="prefetch", duration_s=0.1, once=False),
+    ])
+    env = dict(os.environ)
+    env.update({
+        "ACCELERATE_TELEMETRY": "1",
+        "ACCELERATE_TELEMETRY_DIR": sup_dir,
+        "JAX_PLATFORMS": "cpu",
+        "ACCELERATE_CHAOS_SCHEDULE": schedule.to_json(),
+    })
+    sup = Supervisor(
+        [[sys.executable, "-m", "accelerate_tpu.resilience._toy_train",
+          "--project-dir", os.path.join(sup_dir, "project"),
+          "--steps", "20", "--save-every", "8"]],
+        env=env,
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.05, grace_period_s=1.0),
+        telemetry_dir=sup_dir,
+    )
+    rc = sup.run()
+    rep = build_report([sup_dir])
+    gp = rep.get("goodput") or {}
+    badput = gp.get("badput_s") or {}
+    unattr = gp.get("unattributed_fraction")
+    # the unified-computation satellite, asserted: the ledger's restart
+    # stats and the restarts section are the same restart_stats() output
+    rs = rep.get("restarts") or {}
+    agree = (
+        (gp.get("restarts") or {}).get("count") == rs.get("count")
+        and (gp.get("restarts") or {}).get("downtime_s") == rs.get("downtime_s")
+    )
+    text = format_report(rep)
+    ok = (
+        rc == 0
+        and sup.restarts_used == 1
+        and badput.get("restart_downtime", 0.0) > 0
+        and badput.get("data_wait", 0.0) > 0.04
+        and unattr is not None and unattr < 0.05
+        and agree
+        and "goodput: goodput " in text
+        and "restart_downtime" in text
+    )
+    _check(
+        "goodput ledger",
+        ok,
+        f"rc={rc} restarts={sup.restarts_used} "
+        f"downtime={badput.get('restart_downtime')} "
+        f"data_wait={badput.get('data_wait')} unattributed={unattr} "
+        f"agree={agree}",
+    )
+
+
 def _doctor_fused_zero1(_check) -> None:
     """Doctor check 9 body: jaxlint R3/R4 over the fused-update module +
     accelerator, then a subprocess self_check compiling the fused step and
@@ -2541,9 +2695,12 @@ def main(argv: Optional["list[str]"] = None) -> int:
         "that request only; alone: every recorded trace)",
     )
     sub.add_parser("doctor", help="self-check the watchdog/flight-recorder/report pipeline")
+    _regress.add_parser(sub)
     args = parser.parse_args(argv)
     if args.command == "doctor":
         return run_doctor()
+    if args.command == "regress":
+        return _regress.run_from_args(args)
     if args.command != "report":
         parser.print_help()
         return 2

@@ -214,17 +214,23 @@ class _StepContext:
             if cost.roofline is not None:
                 fields["roofline"] = cost.roofline
             fields["perf_fn"] = cost.name
+        drained_wait = drain_data_wait()
+        execute_s = max(0.0, wall - compile_s)
         tel.emit(
             "step",
             name=prof.name,
             dur_s=round(wall, 6),
-            data_wait_s=round(drain_data_wait(), 6),
+            data_wait_s=round(drained_wait, 6),
             compile_s=round(compile_s, 6),
-            execute_s=round(max(0.0, wall - compile_s), 6),
+            execute_s=round(execute_s, 6),
             compiles=compiles,
             recompiles=max(0, recompiles),
             **fields,
         )
+        from . import goodput as _goodput
+
+        _goodput.note_step(execute_s, compile_s, drained_wait)
+        _goodput.maybe_emit()
         if prof.memory_every and prof.step_index % prof.memory_every == 0:
             from .memory import MemoryMonitor
 

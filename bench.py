@@ -1338,6 +1338,14 @@ def _headline_payload(result: dict, vs_baseline, configs: dict, partial: bool) -
         **({"flight_records": sorted(set(_FLIGHT_RECORDS))} if _FLIGHT_RECORDS else {}),
         "configs": configs,  # _emit sanitizes the whole payload
     }
+    try:
+        # THE fingerprint helper (benchmarks/_common.py): the regression
+        # sentinel refuses to compare payloads from different environments
+        from benchmarks._common import env_fingerprint
+
+        payload["env"] = env_fingerprint()
+    except Exception:
+        pass
     if partial:
         payload["partial"] = True  # superseded by a later cumulative line
     return payload

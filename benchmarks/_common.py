@@ -30,7 +30,59 @@ def detect_backend(probe_timeout: int = 120) -> bool:
     return ok
 
 
+_FINGERPRINT = None
+
+
+def env_fingerprint() -> dict:
+    """THE environment fingerprint stamped into every bench payload (key
+    ``env``): git sha, host, device kind/count, jax/jaxlib versions, python,
+    nproc. The regression sentinel (``telemetry.regress``) groups payloads by
+    this and REFUSES cross-environment comparisons — a v5 number vs a CPU
+    number is not a regression, it is a different machine. Cached per
+    process; device fields stay None until jax is already imported (probing
+    here could hang on a dead TPU tunnel — ``detect_backend`` owns that)."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        import platform
+        import subprocess
+
+        fp = {
+            "git_sha": None,
+            "host": platform.node(),
+            "python": platform.python_version(),
+            "nproc": os.cpu_count(),
+            "jax": None,
+            "jaxlib": None,
+            "device_kind": None,
+            "device_count": None,
+        }
+        try:
+            out = subprocess.run(
+                ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            )
+            fp["git_sha"] = out.stdout.strip() or None
+        except Exception:
+            pass
+        if "jax" in sys.modules:
+            try:
+                import jax
+                import jaxlib
+
+                fp["jax"] = jax.__version__
+                fp["jaxlib"] = getattr(jaxlib, "__version__", None)
+                devices = jax.devices()
+                fp["device_kind"] = devices[0].device_kind
+                fp["device_count"] = len(devices)
+            except Exception:
+                pass
+        _FINGERPRINT = fp
+    return dict(_FINGERPRINT)
+
+
 def emit(entry: dict) -> None:
+    entry = dict(entry)
+    entry.setdefault("env", env_fingerprint())
     print(json.dumps(entry), flush=True)
 
 

@@ -221,6 +221,50 @@ def test_compile_time_restart_benchmark_smoke():
     assert leg["warm_cache_events"].get("miss", 0) == 0
 
 
+def _regress_cli(tmp_path, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.telemetry", "regress", *args],
+        capture_output=True, text=True, timeout=120, env=env, cwd=str(REPO),
+    )
+
+
+def _bench_payload(value):
+    return {
+        "metric": "tok_per_sec", "value": value, "mfu": 0.4,
+        "env": {"device_kind": "cpu", "device_count": 1, "jaxlib": "x"},
+    }
+
+
+def test_bench_check_flags_synthetic_regression(tmp_path):
+    """The `make bench-check` gate, tier-1: a synthetic 20% tok/s regression
+    must exit nonzero and NAME the regressed metric."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_bench_payload(100.0)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_bench_payload(80.0)))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout and "tok_per_sec" in res.stdout
+
+
+def test_bench_check_accepts_identical_payloads(tmp_path):
+    for name in ("BENCH_r01.json", "BENCH_r02.json"):
+        (tmp_path / name).write_text(json.dumps(_bench_payload(100.0)))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "regress verdict: OK" in res.stdout
+
+
+def test_bench_check_refuses_cross_fingerprint(tmp_path):
+    a = _bench_payload(100.0)
+    b = _bench_payload(100.0)
+    b["env"] = {"device_kind": "TPU v5 lite", "device_count": 8, "jaxlib": "x"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(a))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(b))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 2, res.stdout + res.stderr
+    assert "REFUSING" in res.stdout
+
+
 def test_benchmark_dirs_are_documented():
     dirs = [p for p in (REPO / "benchmarks").iterdir() if p.is_dir() and p.name != "__pycache__"]
     assert len(dirs) >= 5

@@ -408,8 +408,10 @@ def test_doctor_self_checks(capsys):
     #   check landed; fixed here)
     # + observability plane (ISSUE 15)
     # + disaggregated serving (ISSUE 16)
-    assert out.count("PASS") == 17 and "FAIL" not in out
+    # + goodput ledger (ISSUE 17)
+    assert out.count("PASS") == 18 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
+    assert "goodput ledger" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out
     assert "replicated serving router" in out

@@ -1,0 +1,460 @@
+"""Fleet goodput/badput ledger + perf-regression sentinel tests (ISSUE 17).
+
+The acceptance lines these tests hold:
+
+- every wall-clock second of a hand-authored run with KNOWN attribution
+  (a chaos-killed generation; a serving run with failover re-prefills)
+  lands in the right taxonomy bucket, with the remainder reported honestly
+  as ``unattributed``;
+- the report's restarts section and the ledger's restart stats are ONE
+  computation (``goodput.restart_stats``) — they agree by construction;
+- the rendered ``goodput`` report section is byte-deterministic (golden);
+- the sentinel's verdict matrix: noise inside tolerance, regression and
+  improvement outside it, hard bars, and the cross-environment REFUSAL
+  (exit code 2, never a fake verdict);
+- disabled path: with telemetry off the live meter is a no-op — no state,
+  no files, no threads.
+"""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry import events as tel
+from accelerate_tpu.telemetry import goodput, metrics, regress
+from accelerate_tpu.telemetry.report import (
+    build_report,
+    format_goodput_section,
+    format_report,
+)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+@pytest.fixture(autouse=True)
+def _clean_goodput_state():
+    goodput._reset_for_tests()
+    metrics.disable()
+    tel.disable()
+    yield
+    goodput._reset_for_tests()
+    metrics.disable()
+    tel.disable()
+
+
+# ---------------------------------------------------------------------------
+# hand-authored fixtures with known attribution
+
+
+def _training_events() -> "list[dict]":
+    """A chaos-killed rank-0 stream: generation 0 does two steps (one
+    carrying a 1.2s compile, one behind a 0.5s loader stall) and a 0.4s
+    blocking checkpoint, then dies; generation 1 reruns clean. The
+    supervisor measured 2.0s of downtime over a 2-process cohort."""
+    rank = [
+        # --- generation 0 (meta ordinal 0) ---
+        {"kind": "meta", "process_index": 0, "t": 100.0},
+        # first step starts at t0 exactly: no init time
+        {"kind": "step", "t": 102.0, "dur_s": 2.0, "compile_s": 1.2,
+         "execute_s": 0.8, "data_wait_s": 0.0},
+        # starts at 102.5 — the 0.5s gap is the loader stall it drained
+        {"kind": "step", "t": 103.5, "dur_s": 1.0, "compile_s": 0.0,
+         "execute_s": 1.0, "data_wait_s": 0.5},
+        {"kind": "checkpoint", "t": 104.0, "phase": "snapshot",
+         "dur_s": 0.4, "hidden": False},
+        {"kind": "checkpoint", "t": 104.0, "phase": "write",
+         "dur_s": 0.3, "hidden": True},  # async writer time: NOT a stall
+        # --- generation 1 (meta ordinal 1, post-restart) ---
+        {"kind": "meta", "process_index": 0, "t": 110.0},
+        {"kind": "step", "t": 111.0, "dur_s": 1.0, "compile_s": 0.0,
+         "execute_s": 1.0, "data_wait_s": 0.0},
+        {"kind": "step", "t": 112.0, "dur_s": 1.0, "compile_s": 0.0,
+         "execute_s": 1.0, "data_wait_s": 0.0},
+    ]
+    sup = [
+        {"kind": "meta", "role": "supervisor", "t": 100.0},
+        {"kind": "restart", "t": 108.0, "generation": 1, "attempt": 1,
+         "cause": "killed", "downtime_s": 2.0, "processes": 2},
+    ]
+    for e in rank:
+        e["_file"] = "events-rank0.jsonl"
+    for e in sup:
+        e["_file"] = "events-supervisor.jsonl"
+    return rank + sup
+
+
+def _serving_events() -> "list[dict]":
+    """A serving stream with every token-waste cause represented: a warmup,
+    two engine steps (one carrying preemption re-prefills, one carrying a
+    failover resume re-prefill), an evidenced idle gap, an abandoned
+    request, a shed request, and a dropped KV handoff."""
+    evs = [
+        {"kind": "meta", "process_index": 0, "t": 200.0},
+        {"kind": "serving", "phase": "warmup", "t": 201.0, "dur_s": 0.8},
+        {"kind": "serving", "phase": "step", "t": 201.5, "dur_s": 0.4,
+         "prefill_tokens": 100, "decode_tokens": 50,
+         "preempt_reprefill_tokens": 20, "resume_reprefill_tokens": 0},
+        {"kind": "serving", "phase": "idle", "t": 202.0, "dur_s": 0.5},
+        {"kind": "serving", "phase": "step", "t": 202.5, "dur_s": 0.4,
+         "prefill_tokens": 60, "decode_tokens": 40,
+         "preempt_reprefill_tokens": 0, "resume_reprefill_tokens": 30},
+        {"kind": "router", "phase": "request", "rid": "r1",
+         "outcome": "finished", "prompt_tokens": 50, "new_tokens": 10},
+        # dispatched (has a replica) then failed: its compute is abandoned
+        {"kind": "router", "phase": "request", "rid": "r2",
+         "outcome": "failed", "replica": "rep0",
+         "prompt_tokens": 40, "new_tokens": 5},
+        # shed before dispatch: zero compute wasted, counted separately
+        {"kind": "router", "phase": "request", "rid": "r3",
+         "outcome": "shed", "replica": None,
+         "prompt_tokens": 30, "new_tokens": 0},
+        {"kind": "kv_handoff", "rid": "r1", "outcome": "dropped",
+         "t": 202.2, "blocks": 4},
+    ]
+    for e in evs:
+        e["_file"] = "events-rank0.jsonl"
+    return evs
+
+
+class TestLedgerAttribution:
+    def test_chaos_killed_training_run_attributes_every_cause(self):
+        ledger = goodput.build_ledger(_training_events(), by_rank=True)
+        # gen0 wall 4.0 + gen1 wall 2.0 + 2.0s downtime x 2 processes
+        assert ledger["wall_s"] == pytest.approx(10.0)
+        assert ledger["good_s"] == pytest.approx(3.8)  # 0.8 + 1.0 + 1.0 + 1.0
+        assert ledger["goodput_fraction"] == pytest.approx(0.38)
+        bad = ledger["badput_s"]
+        assert bad["compile"] == pytest.approx(1.2)
+        assert bad["data_wait"] == pytest.approx(0.5)  # charged to the gap
+        assert bad["checkpoint_stall"] == pytest.approx(0.4)  # hidden excluded
+        assert bad["restart_downtime"] == pytest.approx(4.0)  # chip-seconds
+        assert ledger["top_badput"]["cause"] == "restart_downtime"
+        assert ledger["top_badput"]["fraction"] == pytest.approx(0.4)
+        # only the 0.1s the fixture deliberately leaves dark is unattributed
+        assert ledger["unattributed_s"] == pytest.approx(0.1)
+        assert ledger["unattributed_fraction"] < 0.05
+        assert not ledger["overattributed"]
+
+    def test_by_generation_attributes_downtime_to_the_generation_it_spawned(self):
+        ledger = goodput.build_ledger(_training_events())
+        gens = ledger["by_generation"]
+        assert gens["0"]["restart_downtime_s"] == 0.0
+        assert gens["0"]["good_s"] == pytest.approx(1.8)
+        assert gens["1"]["restart_downtime_s"] == pytest.approx(4.0)
+        assert gens["1"]["wall_s"] == pytest.approx(6.0)  # 2.0 run + 4.0 down
+
+    def test_data_wait_is_charged_in_step_when_there_is_no_gap(self):
+        """Back-to-back steps (no inter-step gap): the drained wait must come
+        out of execute time, not inflate productive seconds."""
+        evs = [
+            {"kind": "meta", "process_index": 0, "t": 0.0,
+             "_file": "events-rank0.jsonl"},
+            {"kind": "step", "t": 1.0, "dur_s": 1.0, "compile_s": 0.0,
+             "execute_s": 1.0, "data_wait_s": 0.0,
+             "_file": "events-rank0.jsonl"},
+            {"kind": "step", "t": 2.0, "dur_s": 1.0, "compile_s": 0.0,
+             "execute_s": 1.0, "data_wait_s": 0.3,
+             "_file": "events-rank0.jsonl"},
+        ]
+        ledger = goodput.build_ledger(evs)
+        assert ledger["badput_s"]["data_wait"] == pytest.approx(0.3)
+        assert ledger["good_s"] == pytest.approx(1.7)
+
+    def test_cold_compile_is_distinguished_by_cache_evidence(self):
+        evs = [
+            {"kind": "meta", "process_index": 0, "t": 0.0,
+             "_file": "events-rank0.jsonl"},
+            {"kind": "compile_cache", "event": "miss", "t": 0.5,
+             "_file": "events-rank0.jsonl"},
+            {"kind": "step", "t": 2.0, "dur_s": 2.0, "compile_s": 1.5,
+             "execute_s": 0.5, "data_wait_s": 0.0,
+             "_file": "events-rank0.jsonl"},
+        ]
+        ledger = goodput.build_ledger(evs)
+        assert ledger["badput_s"]["compile_cold"] == pytest.approx(1.5)
+        assert "compile" not in ledger["badput_s"]
+
+    def test_serving_run_attributes_wall_and_tokens(self):
+        ledger = goodput.build_ledger(_serving_events())
+        assert ledger["wall_s"] == pytest.approx(2.5)
+        bad = ledger["badput_s"]
+        assert bad["warmup"] == pytest.approx(0.8)
+        assert bad["idle"] == pytest.approx(0.5)
+        assert bad["init"] == pytest.approx(0.2)  # meta -> warmup start
+        assert ledger["good_by_category"]["serving_execute"] == pytest.approx(0.8)
+        tok = ledger["tokens"]
+        assert tok["computed_tokens"] == 250
+        waste = tok["waste_by_cause"]
+        assert waste["preemption_reprefill"] == 20
+        assert waste["failover_reprefill"] == 30
+        assert waste["abandoned"] == 45  # r2: 40 prompt + 5 generated
+        assert waste["handoff_rerun"] == 50  # r1's prompt re-prefilled
+        assert tok["wasted_tokens"] == 145
+        assert tok["useful_tokens"] == 105
+        assert tok["token_goodput_fraction"] == pytest.approx(0.42)
+        assert tok["shed_requests"] == 1
+        assert tok["handoff_reruns"] == 1
+
+    def test_no_evidence_means_no_ledger(self):
+        assert goodput.build_ledger([]) is None
+        # a supervisor-only stream has no rank wall-clock and no restarts
+        sup = [{"kind": "meta", "role": "supervisor", "t": 0.0,
+                "_file": "events-supervisor.jsonl"}]
+        assert goodput.build_ledger(sup) is None
+
+    def test_by_rank_skew(self):
+        evs = _training_events()
+        straggler = [
+            {"kind": "meta", "process_index": 1, "t": 100.0},
+            {"kind": "step", "t": 104.0, "dur_s": 4.0, "compile_s": 0.0,
+             "execute_s": 4.0, "data_wait_s": 3.0},  # 3s behind the loader
+        ]
+        for e in straggler:
+            e["_file"] = "events-rank1.jsonl"
+        ledger = goodput.build_ledger(evs + straggler, by_rank=True)
+        assert set(ledger["by_rank"]) == {"0", "1"}
+        assert ledger["rank_skew"] > 0.3  # rank1 is mostly data_wait
+
+
+class TestRestartStatsUnification:
+    def test_report_restarts_and_ledger_agree_by_construction(self, tmp_path):
+        """The satellite: ONE downtime/cause computation. The report's
+        restarts section and the ledger's restart stats must be numerically
+        identical on the same stream."""
+        events = _training_events()
+        for e in events:
+            path = tmp_path / e.pop("_file")
+            with open(path, "a") as f:
+                f.write(json.dumps(e) + "\n")
+        rep = build_report([str(tmp_path)])
+        rs = rep["restarts"]
+        gp = rep["goodput"]
+        assert rs["count"] == gp["restarts"]["count"] == 1
+        assert rs["downtime_s"] == gp["restarts"]["downtime_s"] == 2.0
+        assert rs["causes"] == gp["restarts"]["causes"] == {"killed": 1}
+        # and the ledger's fleet wall carries the chip-weighted variant
+        assert gp["restarts"]["chip_downtime_s"] == 4.0
+        text = format_report(rep)
+        assert "restarts: 1 restart(s)" in text
+        assert "goodput: goodput " in text
+
+
+class TestGoodputSectionRender:
+    def test_goodput_section_matches_golden(self):
+        events = _training_events() + _serving_events()
+        ledger = goodput.build_ledger(events, by_rank=True)
+        section = format_goodput_section(ledger) + "\n"
+        golden = open(os.path.join(GOLDEN, "goodput_report.txt")).read()
+        assert section == golden
+
+
+class TestServingRunAttribution:
+    def test_router_driven_run_accounts_95_percent_of_wall(self, tmp_path):
+        """The serving-side acceptance bar: a real router-driven run's event
+        stream (warmup + steps + evidenced idle, all carrying dur_s) must
+        leave <5% of wall-clock unattributed in the ledger."""
+        import dataclasses
+
+        from accelerate_tpu.models import LlamaConfig
+        from accelerate_tpu.serving import (
+            AdmissionController,
+            LocalReplica,
+            ReplicaSpec,
+            RouterRequestStatus,
+            ServingRouter,
+        )
+
+        tel.enable(out_dir=str(tmp_path), run_id="gp-serve")
+        spec = ReplicaSpec(
+            model=dataclasses.asdict(LlamaConfig.tiny()), num_blocks=33,
+            block_size=8, max_slots=2, slot_buckets=(2,), block_buckets=(6,),
+            prefill_buckets=(16,),
+        )
+        router = ServingRouter(
+            [LocalReplica("r0", spec)],
+            admission=AdmissionController(max_queue=8),
+            health_timeout_s=300.0,
+        )
+        try:
+            router.wait_ready(timeout_s=600)
+            reqs = [
+                router.submit(np.arange(1, 8, dtype=np.int32), 4, rng_seed=i)
+                for i in range(3)
+            ]
+            router.run(timeout_s=600)
+        finally:
+            router.close()
+        tel.disable()
+        from accelerate_tpu.telemetry.report import load_events
+
+        ledger = goodput.build_ledger(load_events([str(tmp_path)]))
+        assert all(r.status is RouterRequestStatus.FINISHED for r in reqs)
+        assert ledger is not None
+        assert ledger["good_by_category"].get("serving_execute", 0.0) > 0
+        assert ledger["unattributed_fraction"] < 0.05, ledger
+        assert ledger["tokens"]["computed_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# live meter
+
+
+class TestLiveMeter:
+    def test_disabled_path_is_zero_cost(self, tmp_path):
+        """Telemetry off: notes are dropped, nothing is emitted, no files or
+        threads appear."""
+        before = set(glob.glob(str(tmp_path / "*")))
+        threads_before = threading.active_count()
+        goodput.note("data_wait", 1.0)
+        goodput.note_step(1.0, 0.5, 0.1)
+        goodput.note_serving_step(0.3, computed_tokens=10, wasted_tokens=2)
+        assert goodput.maybe_emit() is False
+        assert goodput.emit_now() is None
+        assert goodput._SECONDS == {}
+        assert goodput._TOKENS == {"computed": 0, "wasted": 0}
+        assert set(glob.glob(str(tmp_path / "*"))) == before
+        assert threading.active_count() == threads_before
+
+    def test_emit_now_writes_record_and_gauges(self, tmp_path):
+        tel.enable(out_dir=str(tmp_path), run_id="gp")
+        reg = metrics.enable()
+        goodput.note_step(execute_s=2.0, compile_s=0.5, data_wait_s=0.5)
+        goodput.note_serving_step(1.0, computed_tokens=100, wasted_tokens=25)
+        goodput.note("checkpoint_stall", 0.25)
+        fields = goodput.emit_now(final=True)
+        tel.disable()
+        assert fields["good_s"] == pytest.approx(2.5)  # 1.5 exec + 1.0 serve
+        assert fields["badput_s"] == pytest.approx(1.25)
+        assert fields["token_goodput_fraction"] == pytest.approx(0.75)
+        assert fields["final"] is True
+        recs = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+        snaps = [r for r in recs if r["kind"] == "goodput"]
+        assert len(snaps) == 1
+        assert snaps[0]["by_category"]["checkpoint_stall"] == 0.25
+        text = reg.render()
+        assert metrics.GOODPUT_FRACTION_GAUGE in text
+        assert metrics.TOKEN_GOODPUT_FRACTION_GAUGE in text
+        assert metrics.BADPUT_SECONDS_GAUGE in text
+
+    def test_maybe_emit_is_throttled(self, tmp_path):
+        tel.enable(out_dir=str(tmp_path), run_id="gp")
+        goodput.note("compile", 1.0)
+        assert goodput.maybe_emit(now=1e9) is True
+        assert goodput.maybe_emit(now=1e9 + 1.0) is False  # inside interval
+        assert goodput.maybe_emit(now=1e9 + goodput._EMIT_INTERVAL_S + 1) is True
+        tel.disable()
+
+
+# ---------------------------------------------------------------------------
+# perf-regression sentinel
+
+
+def _payload(value=100.0, mfu=0.5, kind="cpu", count=1, **configs):
+    return {
+        "metric": "throughput", "value": value, "mfu": mfu,
+        "env": {"device_kind": kind, "device_count": count, "jaxlib": "x"},
+        "configs": {k: {"value": v} for k, v in configs.items()},
+    }
+
+
+class TestSentinelVerdicts:
+    def test_noise_inside_tolerance(self):
+        # cpu fingerprint doubles the 5% catch-all to 10%; -3% is noise
+        vs = regress.compare_metrics(_payload(100.0), _payload(97.0))
+        v = next(v for v in vs if v["metric"] == "throughput")
+        assert v["verdict"] == regress.NOISE
+
+    def test_regression_and_improvement_outside_tolerance(self):
+        vs = regress.compare_metrics(_payload(100.0), _payload(80.0))
+        v = next(v for v in vs if v["metric"] == "throughput")
+        assert v["verdict"] == regress.REGRESSION
+        vs = regress.compare_metrics(_payload(100.0), _payload(130.0))
+        v = next(v for v in vs if v["metric"] == "throughput")
+        assert v["verdict"] == regress.IMPROVED
+
+    def test_lower_is_better_metrics_invert(self):
+        base = _payload(100.0, ckpt_stall_s=1.0)
+        cand = _payload(100.0, ckpt_stall_s=2.0)  # stall doubled: regression
+        vs = regress.compare_metrics(base, cand)
+        v = next(v for v in vs if v["metric"] == "configs.ckpt_stall_s")
+        assert v["direction"] == "lower"
+        assert v["verdict"] == regress.REGRESSION
+
+    def test_dead_run_trips_the_hard_bar_even_vs_dead_baseline(self):
+        base = {"metric": "x y", "value": 0.0,
+                "env": {"device_kind": "cpu", "device_count": 1}}
+        cand = {"metric": "x y", "value": 0.0,
+                "env": {"device_kind": "cpu", "device_count": 1}}
+        vs = regress.compare_metrics(base, cand)
+        v = next(v for v in vs if v["metric"] == "headline")
+        assert v["verdict"] == regress.REGRESSION
+        assert "hard bar" in v["reason"]
+
+    def test_cpu_noise_doubling(self):
+        # -8% on a TPU fingerprint: past the 5% band -> REGRESSION;
+        # the same delta on CPU sits inside the doubled 10% band -> NOISE
+        tpu = regress.compare_metrics(
+            _payload(100.0, kind="TPU v5"), _payload(92.0, kind="TPU v5"))
+        cpu = regress.compare_metrics(_payload(100.0), _payload(92.0))
+        assert next(v for v in tpu if v["metric"] == "throughput")["verdict"] \
+            == regress.REGRESSION
+        assert next(v for v in cpu if v["metric"] == "throughput")["verdict"] \
+            == regress.NOISE
+
+    def test_fingerprint_refusal(self):
+        a = regress.fingerprint(_payload(kind="cpu"))
+        b = regress.fingerprint(_payload(kind="TPU v5 lite"))
+        assert not regress.comparable(a, b)
+        # unknown on either side is also a refusal, never a guess
+        assert not regress.comparable(a, {"device_kind": None})
+
+    def test_fingerprint_falls_back_to_payload_device_fields(self):
+        fp = regress.fingerprint({"device_kind": "TPU v4", "n_chips": 8})
+        assert fp == {"device_kind": "TPU v4", "device_count": 8,
+                      "jaxlib": None}
+
+
+class TestSentinelCLI:
+    def _write(self, tmp_path, name, payload):
+        p = tmp_path / name
+        p.write_text(json.dumps(payload))
+        return str(p)
+
+    def test_identical_payloads_exit_clean(self, tmp_path, capsys):
+        a = self._write(tmp_path, "BENCH_r01.json", _payload(100.0))
+        b = self._write(tmp_path, "BENCH_r02.json", _payload(100.0))
+        assert regress.run_regress([a, b]) == 0
+        assert "verdict: OK" in capsys.readouterr().out
+
+    def test_synthetic_regression_exits_one_and_names_the_metric(
+            self, tmp_path, capsys):
+        self._write(tmp_path, "BENCH_r01.json", _payload(100.0))
+        self._write(tmp_path, "BENCH_r02.json", _payload(80.0))  # -20% tok/s
+        assert regress.run_regress([], scan=str(tmp_path)) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "throughput" in out
+
+    def test_cross_fingerprint_exits_two_with_refusal(self, tmp_path, capsys):
+        a = self._write(tmp_path, "BENCH_r01.json", _payload(kind="cpu"))
+        b = self._write(tmp_path, "BENCH_r02.json",
+                        _payload(kind="TPU v5 lite", count=8))
+        assert regress.run_regress([a, b]) == 2
+        assert "REFUSING" in capsys.readouterr().out
+
+    def test_driver_wrapper_payloads_unwrap(self, tmp_path):
+        wrapped = {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": "",
+                   "parsed": _payload(50.0)}
+        p = self._write(tmp_path, "BENCH_r03.json", wrapped)
+        loaded = regress.load_payload(p)
+        assert loaded["value"] == 50.0
+
+    def test_scan_skips_unusable_payloads(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text("not json at all")
+        self._write(tmp_path, "BENCH_r02.json", _payload(100.0))
+        self._write(tmp_path, "BENCH_r03.json", _payload(101.0))
+        assert regress.run_regress([], scan=str(tmp_path)) == 0
+        assert "skipping" in capsys.readouterr().out

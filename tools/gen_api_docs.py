@@ -244,11 +244,18 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
          ("accelerate_tpu.telemetry.slo",
           ["SLObjective", "SLOMonitor", "serving_slos",
            "step_latency_slo_from_env", "restart_downtime_slo_from_env"]),
+         ("accelerate_tpu.telemetry.goodput",
+          ["build_ledger", "verdict_line", "restart_stats", "note",
+           "note_step", "note_serving_step", "maybe_emit", "emit_now"]),
+         ("accelerate_tpu.telemetry.regress",
+          ["MetricSpec", "register", "spec_for", "load_payload", "fingerprint",
+           "comparable", "extract_metrics", "compare_metrics", "scan_dir",
+           "run_regress"]),
          ("accelerate_tpu.telemetry.report",
           ["build_report", "format_report", "format_rank_section",
            "format_serving_section", "format_router_section",
-           "format_slo_section", "render_request", "find_request_trace",
-           "load_events", "run_doctor", "main"]),
+           "format_slo_section", "format_goodput_section", "render_request",
+           "find_request_trace", "load_events", "run_doctor", "main"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "compile_cache": (
