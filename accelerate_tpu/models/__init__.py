@@ -4,6 +4,8 @@ from .transformer import (
     bert_forward,
     bert_loss,
     bert_shard_rules,
+    draft_config,
+    draft_params,
     init_bert,
     init_llama,
     llama_forward,

@@ -21,7 +21,7 @@ north-star workload.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 import jax
@@ -105,6 +105,9 @@ class LlamaConfig:
     # layer boundaries and skips the stacked-residual dynamic-slices; measured
     # 1.5× fwd+bwd on v5e for BERT-base. False → O(1)-in-depth compile time.
     unroll_layers: bool = True
+    # default attention implementation for forwards that don't pass one
+    # explicitly: "auto" | "xla" | "flash" | "fused" (ops.attention impls)
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -248,7 +251,7 @@ def llama_forward(
     params: dict,
     input_ids: jax.Array,  # [B, S]
     config: LlamaConfig,
-    attention_impl: str = "auto",
+    attention_impl: Optional[str] = None,  # default: config.attn_impl
     attention_fn=None,
     remat: bool | str = False,
     mesh=None,
@@ -273,6 +276,8 @@ def llama_forward(
     attend only within their segment (still causally), rope positions restart
     per segment, and id 0 marks padding. Not combinable with ``attention_fn``
     (the CP/SP rings don't carry segment info)."""
+    if attention_impl is None:
+        attention_impl = config.attn_impl
     cos, sin = rope_frequencies(config.head_dim, config.max_seq_len, config.rope_theta)
     cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     if segment_ids is not None:
@@ -395,6 +400,36 @@ def llama_shard_rules():
 
 
 # ---------------------------------------------------------------------------
+# Self-draft construction (speculative decoding)
+
+
+def draft_config(config: LlamaConfig, n_layers: int) -> LlamaConfig:
+    """Config for a truncated-layer self-draft: the verifier's config with
+    only its first ``n_layers`` decoder layers (``serving/engine.py``'s
+    speculative-decoding draft). Everything else — vocab, dims, heads, rope —
+    is inherited, so the draft reads/writes the SAME paged KV layout as the
+    verifier's first ``n_layers`` layers."""
+    if not (0 < n_layers <= config.n_layers):
+        raise ValueError(
+            f"draft_layers must be in 1..{config.n_layers}, got {n_layers}"
+        )
+    return replace(config, n_layers=n_layers)
+
+
+def draft_params(params: dict, n_layers: int) -> dict:
+    """Truncated-layer self-draft params: slice the stacked-layer pytree to
+    the first ``n_layers`` layers and SHARE embeddings / final norm / lm head
+    with the verifier (no copy — the stacked-layer layout makes the slice a
+    view-cheap ``x[:n]`` per leaf). Because draft layer i *is* verifier layer
+    i, KV the verifier's prefill/verify steps land in the paged pool is
+    byte-valid for the draft — the draft needs no pool, no prefill, and no
+    extra memory of its own."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = jax.tree_util.tree_map(lambda x: x[:n_layers], params["layers"])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # BERT-style encoder + classifier (north-star MRPC workload)
 
 
@@ -411,6 +446,8 @@ class BertConfig:
     norm_eps: float = 1e-12
     # see LlamaConfig.unroll_layers — same measured win applies here
     unroll_layers: bool = True
+    # see LlamaConfig.attn_impl — the config-level attention knob
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -455,9 +492,14 @@ def init_bert(config: BertConfig, key) -> dict:
     }
 
 
-def bert_forward(params: dict, batch: dict, config: BertConfig, attention_impl: str = "auto") -> jax.Array:
+def bert_forward(
+    params: dict, batch: dict, config: BertConfig, attention_impl: Optional[str] = None
+) -> jax.Array:
     """Return classification logits [B, num_labels]. batch: input_ids,
-    attention_mask, token_type_ids (all [B, S])."""
+    attention_mask, token_type_ids (all [B, S]). ``attention_impl`` defaults
+    to ``config.attn_impl`` (the config-level knob)."""
+    if attention_impl is None:
+        attention_impl = config.attn_impl
     ids = batch["input_ids"]
     B, S = ids.shape
     emb = params["embeddings"]

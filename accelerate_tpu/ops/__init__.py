@@ -1,3 +1,7 @@
 from .attention import dot_product_attention, make_padding_mask, segment_mask
-from .flash_attention import flash_attention
+from .flash_attention import (
+    flash_attention,
+    paged_attention_decode,
+    paged_attention_prefill,
+)
 from .fused_attention import fused_attention

@@ -237,33 +237,179 @@ def paged_attention_decode(
     return out[:, None]  # [B, 1, H, D], the caller's BSHD contract
 
 
+def _paged_prefill_kernel(
+    tables_ref,  # [B, W] int32 scalar-prefetch (drives the k/v index maps)
+    qpos_ref,    # [B, S] int32 scalar-prefetch: absolute position of each query
+    q_ref,       # [1, S, H, D]             this row's chunk of queries
+    k_ref,       # [1, block_size, Hkv, D]  the block the index map selected
+    v_ref,       # [1, block_size, Hkv, D]
+    o_ref,       # [1, S, H, D]
+    acc_ref,     # VMEM [H, S, D] f32   online-softmax accumulators,
+    m_ref,       # VMEM [H, S, 1] f32   carried across the W grid steps
+    l_ref,       # VMEM [H, S, 1] f32
+    *,
+    block_size: int,
+    groups: int,
+    scale: float,
+):
+    """One (row, logical-block) grid step of paged chunked-prefill attention.
+
+    Same shape of walk as :func:`_paged_decode_kernel` — grid ``(B, W)``,
+    block axis innermost, BlockSpec index maps DMA physical block
+    ``tables[b, w]`` into VMEM — but with ``S > 1`` queries per row, so the
+    score/PV contractions are real ``[H, S, d] x [H, d, bs]`` matmuls on the
+    MXU (``dot_general`` batched over heads) instead of the decode kernel's
+    VPU broadcast-reduce. Causality inside the chunk and raggedness against
+    previously-landed KV collapse into ONE predicate: the engine scatter-
+    writes the chunk's own KV into the pool *before* attention, so every KV
+    position — old blocks and the chunk's own tokens alike — is live in the
+    walked blocks, and masking ``kv_pos <= q_position`` per query reproduces
+    the gather reference exactly (null-padded table entries sit at positions
+    past every query and are silenced by the same predicate)."""
+    from jax.experimental import pallas as pl  # deferred with pallas_call's
+
+    b, w = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale           # [S, H, D]
+    k = k_ref[0].astype(jnp.float32)                   # [bs, Hkv, D]
+    v = v_ref[0].astype(jnp.float32)
+    if groups > 1:  # GQA: every q head in a group reads its kv head's block
+        bs, hkv, d = k.shape
+        k = jnp.broadcast_to(k[:, :, None, :], (bs, hkv, groups, d)).reshape(bs, -1, d)
+        v = jnp.broadcast_to(v[:, :, None, :], (bs, hkv, groups, d)).reshape(bs, -1, d)
+    qh = q.transpose(1, 0, 2)                          # [H, S, D]
+    kh = k.transpose(1, 0, 2)                          # [H, bs, D]
+    vh = v.transpose(1, 0, 2)                          # [H, bs, D]
+    # s[h, i, j] = q[i, h] . k[j, h] — an MXU matmul batched over heads (the
+    # chunk gives the systolic array S real rows, unlike decode's single one)
+    s = jax.lax.dot_general(
+        qh, kh, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )                                                  # [H, S, bs]
+    pos = w * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(pos <= qpos_ref[b][None, :, None], s, -jnp.inf)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))  # [H, S, 1]
+    # a fully-masked prefix of blocks keeps m at -inf: exp(-inf - -inf) would
+    # be NaN, so clamp the shift (everything is 0-weighted anyway)
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(m_prev - shift)                    # [H, S, 1]
+    p = jnp.exp(s - shift)                             # [H, S, bs], masked -> 0
+    l_new = l_prev * alpha + jnp.sum(p, axis=2, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, vh, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(w == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_attention_prefill(
+    q, k_pool, v_pool, block_tables, q_positions, scale=None, *, interpret=False
+):
+    """Pallas paged chunked-prefill attention: q ``[B, S, H, D]`` (``S > 1``)
+    against per-layer pools ``[num_blocks, block_size, Hkv, D]`` through
+    ``block_tables [B, W]``, with per-query absolute positions
+    ``q_positions [B, S]``. The engine has already scatter-written the
+    chunk's own KV into the pool, so one walk over each row's block table
+    covers both the previously-landed KV and the in-chunk causal part; the
+    per-query position mask is what makes the online softmax match the
+    gather reference's causal masking bit for bit. The gathered
+    ``[B, W*block_size]`` cache the XLA reference materializes per layer
+    never exists. ``interpret=True`` runs the identical kernel through the
+    Pallas interpreter (the CPU parity path in tier-1 CI)."""
+    from jax.experimental import pallas as pl_  # deferred: CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    if S < 2:
+        raise ValueError(f"prefill kernel wants S>1 queries, got S={S}")
+    num_blocks, block_size, Hkv, _ = k_pool.shape
+    W = block_tables.shape[1]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    sm_scale = (1.0 / math.sqrt(D)) if scale is None else float(scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block tables + per-query positions
+        grid=(B, W),
+        in_specs=[
+            pl_.BlockSpec((1, S, H, D), lambda b, w, tables, qpos: (b, 0, 0, 0)),
+            pl_.BlockSpec(
+                (1, block_size, Hkv, D),
+                lambda b, w, tables, qpos: (tables[b, w], 0, 0, 0),
+            ),
+            pl_.BlockSpec(
+                (1, block_size, Hkv, D),
+                lambda b, w, tables, qpos: (tables[b, w], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl_.BlockSpec((1, S, H, D), lambda b, w, tables, qpos: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, S, D), jnp.float32),
+            pltpu.VMEM((H, S, 1), jnp.float32),
+            pltpu.VMEM((H, S, 1), jnp.float32),
+        ],
+    )
+    kernel = partial(
+        _paged_prefill_kernel,
+        block_size=block_size,
+        groups=H // Hkv,
+        scale=sm_scale,
+    )
+    return pl_.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        jnp.asarray(q_positions, jnp.int32).reshape(B, S),
+        q,
+        k_pool,
+        v_pool,
+    )
+
+
 def paged_attention(q, k_pool, v_pool, block_tables, q_positions, scale=None):
-    """Paged decode attention for the serving engine (kernel dispatch point).
+    """Paged attention for the serving engine (kernel dispatch point).
 
     q ``[B, S, H, D]``; per-layer pools ``[num_blocks, block_size, Hkv, D]``;
     ``block_tables [B, W]`` (physical block ids, null-padded); ``q_positions
-    [B, S]``. Single-token decode (``S == 1``) dispatches to the Pallas
-    paged-attention kernel (:func:`paged_attention_decode`) on the TPU
-    backend — block-table walk + VMEM block streaming + online softmax, no
-    materialized gathered KV per layer. Everywhere else — prefill chunks
-    (``S > 1``), non-TPU backends, and the ``ACCELERATE_PAGED_KERNEL=0``
+    [B, S]``. On the TPU backend BOTH serving shapes dispatch to Pallas
+    paged kernels: single-token decode (``S == 1``) to
+    :func:`paged_attention_decode` and chunked prefill / multi-token verify
+    (``S > 1``) to :func:`paged_attention_prefill` — block-table walk + VMEM
+    block streaming + online softmax, no materialized gathered KV per layer.
+    Everywhere else — non-TPU backends and the ``ACCELERATE_PAGED_KERNEL=0``
     kill switch — runs the XLA reference path (``serving.kv_pager.
     paged_attention``: gather blocks by table, shared masked-attention core
     — bitwise-identical to contiguous decode), exactly like
     :func:`flash_attention`'s pallas-vs-xla split.
-    ``ACCELERATE_PAGED_KERNEL=interpret`` forces the kernel (interpreter
+    ``ACCELERATE_PAGED_KERNEL=interpret`` forces the kernels (interpreter
     mode) on any backend so CPU CI can drive the kernel dataflow through
     the full engine."""
     mode = paged_kernel_mode()
-    if q.shape[1] == 1 and mode != "off":
-        if mode == "interpret":
-            return paged_attention_decode(
-                q, k_pool, v_pool, block_tables, q_positions[:, 0] + 1,
-                scale, interpret=True,
-            )
-        if jax.default_backend() == "tpu":
-            return paged_attention_decode(
-                q, k_pool, v_pool, block_tables, q_positions[:, 0] + 1, scale
+    if mode != "off":
+        interpret = mode == "interpret"
+        if interpret or jax.default_backend() == "tpu":
+            if q.shape[1] == 1:
+                return paged_attention_decode(
+                    q, k_pool, v_pool, block_tables, q_positions[:, 0] + 1,
+                    scale, interpret=interpret,
+                )
+            return paged_attention_prefill(
+                q, k_pool, v_pool, block_tables, q_positions,
+                scale, interpret=interpret,
             )
     from ..serving.kv_pager import paged_attention as _xla_paged
 

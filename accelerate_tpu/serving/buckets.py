@@ -115,11 +115,17 @@ class BucketLattice:
         """Total compile points (the warmed jit-cache budget)."""
         return len(self.decode_points()) + len(self.prefill_points())
 
-    def warmup_points(self, prefix_cache: bool = False) -> int:
+    def warmup_points(self, prefix_cache: bool = False, spec_decode: bool = False) -> int:
         """Total shapes :meth:`~accelerate_tpu.serving.engine.ServingEngine.
         warmup` visits: the lattice, plus the single copy-on-write block-copy
         shape when prefix caching is enabled (the COW copy is one fixed-shape
         program — ``(pool, src, dst)`` scalars — so it adds exactly one point
-        and no churn-driven shapes). This is the number the compile-cache
-        hit/miss counters and the frozen-jit-cache oracle compare against."""
-        return self.size() + (1 if prefix_cache else 0)
+        and no churn-driven shapes), plus — with speculative decoding on —
+        the draft and k-verify families: one draft point and one verify point
+        per (slot, block) decode point (the draft is an S=1 step over the
+        truncated model; verify is ONE batched S=k+1 step whose static width
+        k+1 makes it exactly one extra warmed shape per decode point, not a
+        new lattice axis). This is the number the compile-cache hit/miss
+        counters and the frozen-jit-cache oracle compare against."""
+        extra = 2 * len(self.decode_points()) if spec_decode else 0
+        return self.size() + (1 if prefix_cache else 0) + extra

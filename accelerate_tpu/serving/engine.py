@@ -40,7 +40,13 @@ import jax
 import jax.numpy as jnp
 
 from ..generation import _project_qkv, sample_token_logits, serving_shardings
-from ..models.transformer import LlamaConfig, rms_norm, rope_frequencies
+from ..models.transformer import (
+    LlamaConfig,
+    draft_config,
+    draft_params,
+    rms_norm,
+    rope_frequencies,
+)
 from ..ops.flash_attention import paged_attention
 from ..telemetry import events as tel
 from ..telemetry import goodput as _goodput
@@ -136,6 +142,15 @@ class ServingEngine:
     functions — per-request knobs would multiply the compile lattice);
     ``temperature=0`` is greedy. Emits ``serving`` / ``serving_request``
     telemetry records when telemetry is enabled.
+
+    ``spec_tokens=k`` (with ``draft_layers=n``) turns on speculative
+    decoding: a truncated-layer self-draft (the verifier's first n layers +
+    its head, sharing params AND the paged pool) proposes k tokens per step
+    and one batched S=k+1 verify step accepts the longest prefix matching
+    the verifier's own per-slot fold-stream emissions — so the output stream
+    stays bitwise-identical to non-speculative decode while a good draft
+    collapses up to k+1 tokens into one model step (see
+    ``docs/serving.md``).
     """
 
     def __init__(
@@ -159,12 +174,24 @@ class ServingEngine:
         heartbeat_name: str = "serving_decode",
         compile_cache_dir: Optional[str] = None,
         prefix_cache: bool = True,
+        spec_tokens: int = 0,
+        draft_layers: Optional[int] = None,
     ):
         self.params = params
         self.config = config
         self.block_size = block_size
         self.max_slots = max_slots
         self.mesh = mesh
+        # speculative decoding: a truncated-layer self-draft proposes
+        # ``spec_tokens`` tokens per step and ONE batched S=k+1 verify step
+        # accepts the longest prefix that matches the verifier's own
+        # fold-stream emissions (bitwise-accept — see _spec_decode_batch)
+        self.spec_tokens = int(spec_tokens)
+        self.draft_layers = draft_layers
+        if self.spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if self.spec_tokens > 0 and draft_layers is None:
+            raise ValueError("spec_tokens > 0 requires draft_layers (the self-draft depth)")
         # watchdog heartbeat source for the decode loop: a hang inside a
         # batched decode produces a stall dump naming this engine (replicas
         # suffix their name so a stuck replica is attributable)
@@ -246,6 +273,58 @@ class ServingEngine:
         self.prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
         self.decode_fn = jax.jit(_decode, donate_argnums=(1,))
         self.cow_fn = jax.jit(_cow, donate_argnums=(0,))
+
+        if self.spec_tokens > 0:
+            n_draft = int(draft_layers)
+            d_cfg = draft_config(config, n_draft)
+            # truncated-layer self-draft: layer i IS verifier layer i (shared
+            # leaves, no copy), so the verifier's landed KV is valid draft KV
+            # and the draft needs no pool/prefill/warmup of its own
+            self.draft_params = draft_params(params, n_draft)
+
+            def _draft(dparams, pool, last_tok, tables, positions, keys, token_idx):
+                # one S=1 step of the truncated model over the SHARED pool's
+                # first n layers. Its KV writes let draft step j+1 attend to
+                # draft step j's candidate; the verify step recomputes the
+                # same layer-i KV for accepted tokens (identical math), so
+                # the overwrite is value-exact, and rejected positions are
+                # re-written before any later read (scatter-then-attend).
+                dpool = {"k": pool["k"][:n_draft], "v": pool["v"][:n_draft]}
+                logits, dpool = paged_forward(
+                    dparams, last_tok[:, None], dpool, tables, positions[:, None],
+                    d_cfg, block_size,
+                )
+                pool = {
+                    "k": pool["k"].at[:n_draft].set(dpool["k"]),
+                    "v": pool["v"].at[:n_draft].set(dpool["v"]),
+                }
+                folded = jax.vmap(jax.random.fold_in)(keys, token_idx)
+                tok = jax.vmap(select_one)(logits[:, -1], folded)
+                return pool, tok.astype(jnp.int32)
+
+            def _verify(params, pool, cand, tables, positions, keys, token_idx):
+                # cand [B, k+1]: column 0 = the last confirmed token, columns
+                # 1..k = draft proposals. ONE batched S=k+1 forward scatter-
+                # writes KV for every candidate position and then selects —
+                # per (row, column) — the token the NON-speculative stream
+                # would emit at fold index token_idx + j. The host accepts
+                # the longest prefix where the draft matched those selections
+                # exactly, so the emitted stream is bitwise the single-stream
+                # one in greedy AND sampled modes.
+                B, S = cand.shape
+                pos = positions[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+                logits, pool = paged_forward(
+                    params, cand, pool, tables, pos, config, block_size
+                )
+                idx = token_idx[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+                folded = jax.vmap(jax.vmap(jax.random.fold_in, in_axes=(None, 0)))(
+                    keys, idx
+                )
+                sel = jax.vmap(jax.vmap(select_one))(logits, folded)
+                return pool, sel.astype(jnp.int32)
+
+            self.draft_fn = jax.jit(_draft, donate_argnums=(1,))
+            self.verify_fn = jax.jit(_verify, donate_argnums=(1,))
         # Persistent-compile-cache warm boot: when a cache dir is configured
         # (replacement replicas get it via ReplicaSpec.compile_cache_dir),
         # warmup AOT-compiles every lattice point through the cache — hits
@@ -277,6 +356,12 @@ class ServingEngine:
         self.max_running = 0
         self._occupancy_sum = 0.0
         self._occupancy_steps = 0
+        #: speculative decoding: draft tokens proposed / accepted, and the
+        #: accepted-per-step histogram (index = draft tokens accepted that
+        #: slot-step, 0..k) the report's serving section renders
+        self.draft_proposed_tokens = 0
+        self.draft_accepted_tokens = 0
+        self.spec_accept_hist = np.zeros(max(self.spec_tokens, 0) + 1, np.int64)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -391,6 +476,48 @@ class ServingEngine:
                     self._aot[("decode", Bb, W)] = executable
                     continue
             self.pool, tok = self.decode_fn(*args)
+        if self.spec_tokens > 0:
+            # the draft + k-verify families: one point per decode point each
+            # (verify's S=k+1 width is static, so it is one extra warmed
+            # shape per (slots, width), not a new lattice axis)
+            for Bb, W in self.lattice.decode_points():
+                last = np.zeros((Bb,), np.int32)
+                tables = np.full((Bb, W), NULL_BLOCK, np.int32)
+                positions = np.zeros((Bb,), np.int32)
+                keys = np.zeros((Bb, 2), np.uint32)
+                token_idx = np.zeros((Bb,), np.int32)
+                args = (
+                    self.draft_params, self.pool, last, tables, positions,
+                    keys, token_idx,
+                )
+                done = False
+                if cache is not None:
+                    executable, outcome = _ccache.aot_compile(
+                        f"serving_draft[{Bb}x{W}]", self.draft_fn, args,
+                        mesh=self.mesh, cache=cache,
+                    )
+                    self.cache_stats[outcome] = self.cache_stats.get(outcome, 0) + 1
+                    if executable is not None:
+                        self._aot[("draft", Bb, W)] = executable
+                        done = True
+                if not done:
+                    self.pool, tok = self.draft_fn(*args)
+                cand = np.zeros((Bb, self.spec_tokens + 1), np.int32)
+                args = (
+                    self.params, self.pool, cand, tables, positions, keys, token_idx
+                )
+                done = False
+                if cache is not None:
+                    executable, outcome = _ccache.aot_compile(
+                        f"serving_verify[{Bb}x{W}]", self.verify_fn, args,
+                        mesh=self.mesh, cache=cache,
+                    )
+                    self.cache_stats[outcome] = self.cache_stats.get(outcome, 0) + 1
+                    if executable is not None:
+                        self._aot[("verify", Bb, W)] = executable
+                        done = True
+                if not done:
+                    self.pool, tok = self.verify_fn(*args)
         if self.prefix_cache:
             # the COW copy is one more lattice point (a single shape): warm it
             # here — copying the null block onto itself writes nothing live
@@ -434,6 +561,13 @@ class ServingEngine:
             out["cow_compiles"] = int(self.cow_fn._cache_size()) + (
                 1 if ("cow",) in self._aot else 0
             )
+        if self.spec_tokens > 0:
+            out["draft_compiles"] = int(self.draft_fn._cache_size()) + sum(
+                1 for k in self._aot if k[0] == "draft"
+            )
+            out["verify_compiles"] = int(self.verify_fn._cache_size()) + sum(
+                1 for k in self._aot if k[0] == "verify"
+            )
         return out
 
     # -- the step loop -------------------------------------------------------
@@ -457,6 +591,10 @@ class ServingEngine:
         prefix_cached_before = self.prefix_cached_tokens
         preempt_before = self.preempt_prefill_tokens
         resume_before = self.resume_prefill_tokens
+        decode_before = self.decode_tokens
+        proposed_before = self.draft_proposed_tokens
+        accepted_before = self.draft_accepted_tokens
+        hist_before = self.spec_accept_hist.copy()
         admitted = self.scheduler.admissions()
         while self.scheduler.rejected:
             req = self.scheduler.rejected.pop()
@@ -480,15 +618,32 @@ class ServingEngine:
 
         running = [r for r in self.scheduler.running()]
         if running:
-            # reserve the next KV slot for every live sequence FIRST: a grow
-            # may preempt the youngest, and the decode batch must be built
-            # from the survivors
+            # reserve the next KV slot(s) for every live sequence FIRST: a
+            # grow may preempt the youngest, and the decode batch must be
+            # built from the survivors. Speculative decoding reserves up to
+            # k+1 positions (the verify step's write span), clamped to the
+            # request's remaining budget so admission's worst-case bound
+            # still covers the peak; leftover reservations from a short
+            # accept are reused, so the per-step delta is what LAST step
+            # actually emitted.
             for req in list(running):
                 if req.slot is not None:
-                    self.scheduler.grow(req)
+                    if self.spec_tokens > 0:
+                        remaining = req.max_new_tokens - len(req.generated)
+                        target = (req.prefix_len - 1) + min(
+                            self.spec_tokens + 1, remaining
+                        )
+                        self.scheduler.grow(
+                            req, target - self.allocator.tokens(req.rid)
+                        )
+                    else:
+                        self.scheduler.grow(req)
             running = self.scheduler.running()
         if running:
-            self._decode_batch(running)
+            if self.spec_tokens > 0:
+                self._spec_decode_batch(running)
+            else:
+                self._decode_batch(running)
             for req in running:
                 if req.done:
                     self.scheduler.complete(req, now)
@@ -523,16 +678,24 @@ class ServingEngine:
                              buckets=_metrics.OCCUPANCY_BUCKETS)
             _metrics.observe("accelerate_block_pool_occupancy", alloc_occ,
                              buckets=_metrics.OCCUPANCY_BUCKETS)
-            _metrics.inc("accelerate_decode_tokens_total", len(running))
+            _metrics.inc("accelerate_decode_tokens_total",
+                         self.decode_tokens - decode_before)
             _metrics.inc("accelerate_prefill_tokens_total",
                          self.prefill_tokens - prefill_tokens_before)
             _metrics.inc("accelerate_prefix_hit_tokens_total",
                          self.prefix_cached_tokens - prefix_cached_before)
             if running:
-                # per-token latency: every live request earned exactly one
-                # token this step, so the step wall IS its token interval
-                _metrics.observe("accelerate_per_token_latency_seconds",
-                                 time.monotonic() - step_t0)
+                # per-token latency: without speculation every live request
+                # earned exactly one token this step, so the step wall IS its
+                # token interval; with speculation a request earned
+                # (emitted / batch) tokens on average, so divide the wall by
+                # that per-request yield
+                decode_delta = self.decode_tokens - decode_before
+                _metrics.observe(
+                    "accelerate_per_token_latency_seconds",
+                    (time.monotonic() - step_t0) * len(running)
+                    / max(decode_delta, 1),
+                )
             _metrics.maybe_snapshot()
         if tel.is_enabled():
             alloc = self.allocator.stats()
@@ -540,6 +703,22 @@ class ServingEngine:
             prefill_delta = self.prefill_tokens - prefill_tokens_before
             preempt_delta = self.preempt_prefill_tokens - preempt_before
             resume_delta = self.resume_prefill_tokens - resume_before
+            decode_delta = self.decode_tokens - decode_before
+            spec_fields = {}
+            rejected_delta = 0
+            if self.spec_tokens > 0:
+                proposed_delta = self.draft_proposed_tokens - proposed_before
+                accepted_delta = self.draft_accepted_tokens - accepted_before
+                rejected_delta = proposed_delta - accepted_delta
+                spec_fields = dict(
+                    draft_proposed_tokens=proposed_delta,
+                    draft_accepted_tokens=accepted_delta,
+                    draft_rejected_tokens=rejected_delta,
+                    # per-step accepted-count histogram delta (index = draft
+                    # tokens accepted for one slot-step, 0..k) — the report's
+                    # serving section sums these elementwise
+                    spec_accept_hist=(self.spec_accept_hist - hist_before).tolist(),
+                )
             tel.emit(
                 "serving",
                 phase="step",
@@ -552,17 +731,20 @@ class ServingEngine:
                 prefix_hit_tokens=self.prefix_cached_tokens - prefix_cached_before,
                 preempt_reprefill_tokens=preempt_delta,
                 resume_reprefill_tokens=resume_delta,
-                decode_tokens=len(running),
+                decode_tokens=decode_delta,
                 preemptions=self.scheduler.preemption_count,
                 free_blocks=alloc["free_blocks"],
                 live_tokens=alloc["live_tokens"],
                 block_occupancy=alloc["occupancy"],
                 fragmentation=alloc["fragmentation"],
+                **spec_fields,
             )
             _goodput.note_serving_step(
                 step_dur,
-                computed_tokens=prefill_delta + len(running),
-                wasted_tokens=preempt_delta + resume_delta,
+                # rejected verify rows were computed but never emitted: they
+                # count as computed AND as waste (cause "draft_rejected")
+                computed_tokens=prefill_delta + decode_delta + rejected_delta,
+                wasted_tokens=preempt_delta + resume_delta + rejected_delta,
             )
             _goodput.maybe_emit()
         return finished
@@ -724,6 +906,109 @@ class ServingEngine:
                     )
         self.decode_tokens += len(running)
 
+    def _spec_decode_batch(self, running: "list[Request]") -> None:
+        """One speculative decode round for every live slot: k sequential S=1
+        steps of the truncated self-draft propose candidates, ONE batched
+        S=k+1 verify forward (which dispatches to the chunked-prefill paged
+        kernel) scatter-writes their KV and computes — per candidate row —
+        the token the non-speculative fold stream would emit there, and the
+        host accepts the longest candidate prefix matching those emissions
+        EXACTLY (bitwise accept: greedy argmax or sampled rejection off the
+        per-slot fold streams, both byte-equal to single-stream decode).
+
+        Every request emits at least the verifier's own token (row 0), so a
+        0%-accept workload degrades to one-token-per-step decode, never
+        stalls. KV safety: rejected rows' pool writes sit past the emitted
+        prefix and are position-masked out of every read until the next
+        step's scatter overwrites them."""
+        k = self.spec_tokens
+        Bb = self.lattice.slot_bucket(len(running))
+        W = self.lattice.block_bucket(
+            max(self.allocator.num_seq_blocks(r.rid) for r in running)
+        )
+        last = np.zeros((Bb,), np.int32)
+        tables = np.full((Bb, W), NULL_BLOCK, np.int32)
+        positions = np.zeros((Bb,), np.int32)
+        keys = np.zeros((Bb, 2), np.uint32)
+        token_idx = np.zeros((Bb,), np.int32)
+        rows = np.ones((Bb,), np.int32)
+        for i, req in enumerate(running):
+            last[i] = req.generated[-1]
+            tables[i] = self.allocator.block_table(req.rid, pad_to=W)
+            positions[i] = req.prefix_len - 1
+            keys[i] = self._request_key(req)
+            token_idx[i] = len(req.generated)
+            # emit at most as many rows as the grow phase reserved KV room
+            # for (clamped by the request's remaining new-token budget)
+            rows[i] = self.allocator.tokens(req.rid) - (req.prefix_len - 1)
+        decode_t0 = (
+            _tracing.now_ns()
+            if any(r.trace is not None and r.trace.get("sampled") for r in running)
+            else 0
+        )
+        cand = np.zeros((Bb, k + 1), np.int32)
+        cand[:, 0] = last
+        dfn = self._aot.get(("draft", Bb, W), self.draft_fn)
+        d_last, d_pos, d_idx = last, positions, token_idx
+        for j in range(k):
+            self.pool, d_tok = dfn(
+                self.draft_params, self.pool, d_last, tables, d_pos, keys, d_idx
+            )
+            d_tok = np.asarray(jax.device_get(d_tok)).astype(np.int32)
+            cand[:, j + 1] = d_tok
+            d_last, d_pos, d_idx = d_tok, d_pos + 1, d_idx + 1
+        vfn = self._aot.get(("verify", Bb, W), self.verify_fn)
+        self.pool, sel = vfn(
+            self.params, self.pool, cand, tables, positions, keys, token_idx
+        )
+        sel = np.asarray(jax.device_get(sel))
+        emitted = 0
+        accepted_by_req: "list[int]" = []
+        for i, req in enumerate(running):
+            r_i = int(min(rows[i], k + 1))
+            before = req.prefix_len - 1
+            n_acc = 0
+            for j in range(r_i):
+                tok = int(sel[i, j])
+                req.generated.append(tok)
+                emitted += 1
+                if req.done:
+                    break
+                if j + 1 < r_i and int(cand[i, j + 1]) == tok:
+                    n_acc += 1
+                    continue
+                break
+            accepted_by_req.append(n_acc)
+            self.draft_proposed_tokens += max(r_i - 1, 0)
+            self.draft_accepted_tokens += n_acc
+            self.spec_accept_hist[n_acc] += 1
+            if _metrics.is_enabled():
+                _metrics.observe(
+                    "accelerate_spec_accepted_tokens", float(n_acc),
+                    buckets=tuple(float(b) for b in range(k + 1)),
+                )
+            if self.prefix_cache:
+                written = req.prefix_len - 1
+                if written // self.block_size > before // self.block_size:
+                    # a multi-token accept can cross MORE than one block
+                    # boundary in one step; registration is incremental, so
+                    # one call covers them all
+                    self.allocator.register_full_blocks(
+                        req.rid, req.output_ids()[:-1]
+                    )
+        if decode_t0:
+            decode_t1 = _tracing.now_ns()
+            for i, req in enumerate(running):
+                if req.trace is not None and req.trace.get("sampled"):
+                    req.trace_spans.append(_tracing.make_span(
+                        req.trace, "decode_step", decode_t0, decode_t1,
+                        parent_id=req._span_root["span_id"], component="engine",
+                        step=int(self.steps), batch=len(running),
+                        token_idx=int(token_idx[i]),
+                        k_accepted=int(accepted_by_req[i]),
+                    ))
+        self.decode_tokens += emitted
+
     def _close_trace(self, req: Request, outcome: str) -> None:
         """Close the request's open spans with the terminal ``outcome``; the
         trace's OWNER emits — this engine when it rooted the trace, the
@@ -785,6 +1070,22 @@ class ServingEngine:
             **self.jit_cache_sizes(),
             **self.allocator.stats(),
         }
+        if self.spec_tokens > 0:
+            out.update(
+                spec_tokens=self.spec_tokens,
+                draft_layers=self.draft_layers,
+                draft_proposed_tokens=self.draft_proposed_tokens,
+                draft_accepted_tokens=self.draft_accepted_tokens,
+                draft_rejected_tokens=(
+                    self.draft_proposed_tokens - self.draft_accepted_tokens
+                ),
+                spec_accept_rate=round(
+                    self.draft_accepted_tokens / self.draft_proposed_tokens, 6
+                )
+                if self.draft_proposed_tokens
+                else 0.0,
+                spec_accept_hist=self.spec_accept_hist.tolist(),
+            )
         if self.prefix_cache:
             # hit rate over PROMPT tokens: cached / (cached + actually
             # prefilled) — the fraction of prefill work the cache deleted

@@ -100,6 +100,11 @@ class ReplicaSpec:
     # (lands handoffs, gates admission on them). The router dispatches by
     # this role: "prefill" replicas never see decode work and vice versa.
     role: str = "serving"
+    # speculative decoding (monolithic "serving" role only): k draft tokens
+    # per step from a truncated-layer self-draft of depth draft_layers —
+    # output streams stay bitwise-identical to non-speculative decode
+    spec_tokens: int = 0
+    draft_layers: Optional[int] = None
 
     def config(self):
         from ..models.transformer import LlamaConfig
@@ -137,6 +142,11 @@ class ReplicaSpec:
         else:
             from .engine import ServingEngine as engine_cls
 
+        extra = {}
+        if self.role not in ("prefill", "decode") and self.spec_tokens:
+            # the disagg engines don't take the speculative knobs (decode
+            # tiers verify against handed-off KV they don't re-prefill)
+            extra = dict(spec_tokens=self.spec_tokens, draft_layers=self.draft_layers)
         return engine_cls(
             self.build_params(),
             self.config(),
@@ -150,6 +160,7 @@ class ReplicaSpec:
             top_p=self.top_p,
             heartbeat_name=heartbeat_name,
             compile_cache_dir=self.compile_cache_dir,
+            **extra,
         )
 
     def to_json(self) -> str:

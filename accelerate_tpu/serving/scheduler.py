@@ -268,14 +268,17 @@ class Scheduler:
 
     # -- progress ------------------------------------------------------------
 
-    def grow(self, request: Request) -> None:
-        """Reserve pool room for the request's next token, preempting other
+    def grow(self, request: Request, n_tokens: int = 1) -> None:
+        """Reserve pool room for the request's next ``n_tokens`` tokens
+        (speculative decoding grows by up to k+1 per step), preempting other
         sequences (LIFO) if the pool is dry. Raises :class:`SchedulingError`
         only when the request cannot fit even with every other sequence
         evicted."""
+        if n_tokens <= 0:
+            return
         while True:
             try:
-                self.allocator.append(request.rid, 1)
+                self.allocator.append(request.rid, n_tokens)
                 return
             except BlockPoolExhausted:
                 if not self._preempt_one(exclude=request):

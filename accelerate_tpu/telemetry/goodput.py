@@ -77,6 +77,7 @@ TOKEN_WASTE_CAUSES = (
     "failover_reprefill",    # replica-death resume re-prefills (PR 12)
     "handoff_rerun",         # corrupt/dropped KV handoff -> prefill re-run (PR 16)
     "abandoned",             # dispatched but failed/expired: all its tokens
+    "draft_rejected",        # speculative-decode verify rows past the accept point
 )
 
 
@@ -245,7 +246,10 @@ def _token_ledger(events: "list[dict]") -> Optional[dict]:
     if not serving_steps:
         return None
     computed = sum(
+        # decode_tokens counts EMITTED tokens; speculative-decode verify rows
+        # past the accept point were computed too, so add them back here
         int(s.get("prefill_tokens", 0)) + int(s.get("decode_tokens", 0))
+        + int(s.get("draft_rejected_tokens", 0))
         for s in serving_steps
     )
     waste = {c: 0 for c in TOKEN_WASTE_CAUSES}
@@ -254,6 +258,9 @@ def _token_ledger(events: "list[dict]") -> Optional[dict]:
     )
     waste["failover_reprefill"] = sum(
         int(s.get("resume_reprefill_tokens", 0)) for s in serving_steps
+    )
+    waste["draft_rejected"] = sum(
+        int(s.get("draft_rejected_tokens", 0)) for s in serving_steps
     )
     routed = [
         e for e in events if e.get("kind") == "router" and e.get("phase") == "request"

@@ -57,6 +57,15 @@ DEFAULT_SPECS: "list[MetricSpec]" = [
     MetricSpec("*ttft*", "lower", 0.10),
     MetricSpec("*stall*", "lower", 0.15),
     MetricSpec("*compile*", "lower", 0.15),
+    # speculative decoding + paged prefill kernel (bench serving config):
+    # accept rate and the spec-on/off tok/s ratio are higher-better (wider
+    # band — they move with the synthetic workload mix); the prefill-kernel
+    # microbench is a per-token time, lower-better. Latency-named spec
+    # metrics (e.g. spec_decode per-token p50/p99) are caught by *latency*
+    # above.
+    MetricSpec("*accept_rate*", "higher", 0.15),
+    MetricSpec("*spec_decode*", "higher", 0.10),
+    MetricSpec("*prefill_kernel*", "lower", 0.15),
     MetricSpec("*seconds*", "lower", 0.10),
     MetricSpec("*_s", "lower", 0.10),
     MetricSpec("*_ms", "lower", 0.10),
@@ -146,8 +155,11 @@ def comparable(a: dict, b: dict) -> bool:
 def extract_metrics(payload: dict) -> "dict[str, float]":
     """Flatten a payload into comparable named numbers: the headline value
     (named by its ``metric`` string when that is a bare identifier, else
-    ``headline``), ``mfu``, and every ``configs.<name>`` sub-benchmark
-    value."""
+    ``headline``), ``mfu``, every ``configs.<name>`` sub-benchmark value, and
+    every entry of a config's optional ``guarded`` dict — the contract for a
+    sub-benchmark to put MORE than its headline under regression guard
+    (``configs.<name>.<metric>``; the serving config guards its spec-decode
+    accept rate / tok-s ratio and the prefill-kernel microbench this way)."""
     out: "dict[str, float]" = {}
 
     def _num(v) -> Optional[float]:
@@ -168,6 +180,12 @@ def extract_metrics(payload: dict) -> "dict[str, float]":
                 v = _num(entry.get("value"))
                 if v is not None:
                     out[f"configs.{cfg}"] = v
+                guarded = entry.get("guarded")
+                if isinstance(guarded, dict):
+                    for gname, gval in sorted(guarded.items()):
+                        gv = _num(gval)
+                        if gv is not None:
+                            out[f"configs.{cfg}.{gname}"] = gv
     return out
 
 

@@ -434,6 +434,34 @@ def _performance_section(events: "list[dict]", steps: "list[dict]") -> Optional[
     }
 
 
+def _spec_decode_dist(steps: "list[dict]") -> Optional[dict]:
+    """Aggregate the speculative-decoding fields of ``serving`` step records
+    (``serving/engine.py``): draft proposed/accepted token totals, the accept
+    rate, and the per-slot-step accepted-count histogram (index = draft
+    tokens accepted, summed elementwise over the per-step deltas). ``None``
+    when no step carried spec-decode fields (the engine ran without it)."""
+    proposed = sum(int(s.get("draft_proposed_tokens", 0)) for s in steps)
+    accepted = sum(int(s.get("draft_accepted_tokens", 0)) for s in steps)
+    hist: "list[int]" = []
+    for s in steps:
+        h = s.get("spec_accept_hist")
+        if not isinstance(h, list):
+            continue
+        if len(h) > len(hist):
+            hist += [0] * (len(h) - len(hist))
+        for i, c in enumerate(h):
+            hist[i] += int(c)
+    if not hist and not proposed:
+        return None
+    return {
+        "draft_proposed_tokens": proposed,
+        "draft_accepted_tokens": accepted,
+        "draft_rejected_tokens": proposed - accepted,
+        "accept_rate": round(accepted / proposed, 6) if proposed else 0.0,
+        "accept_hist": hist,
+    }
+
+
 def _serving_section(events: "list[dict]") -> Optional[dict]:
     """Aggregate the serving engine's per-step ``serving`` records and
     per-completion ``serving_request`` records (``serving/engine.py``):
@@ -468,6 +496,7 @@ def _serving_section(events: "list[dict]") -> Optional[dict]:
         ),
         "tokens_per_s": round(decode_tokens / span, 2) if span > 0 else None,
         "preemptions": max((int(s.get("preemptions", 0)) for s in steps), default=0),
+        "spec_decode": _spec_decode_dist(steps),
         "requests": {
             "completed": len(completed),
             "rejected": sum(1 for r in reqs if r.get("error")),
@@ -1249,6 +1278,15 @@ def format_serving_section(serving: dict) -> str:
             f"  prefix cache: {serving['prefill_tokens_saved']} prefill token(s) "
             f"saved (hit rate {serving['prefix_hit_rate']:.1%})"
         )
+    spec = serving.get("spec_decode") or {}
+    if spec.get("accept_hist"):
+        hist = spec["accept_hist"]
+        bars = " ".join(f"{i}:{c}" for i, c in enumerate(hist))
+        lines.append(
+            f"  spec decode: accept rate {spec['accept_rate']:.1%} "
+            f"({spec['draft_accepted_tokens']}/{spec['draft_proposed_tokens']} "
+            f"draft token(s)), accepted-per-step histogram [{bars}]"
+        )
     if serving.get("preemptions"):
         lines.append(f"  preemptions: {serving['preemptions']} (pool pressure evictions)")
     reqs = serving.get("requests") or {}
@@ -1874,6 +1912,17 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("goodput ledger", False, f"{type(exc).__name__}: {exc}")
 
+        # 19. speculative decoding (ISSUE 18): the CPU engine with a
+        # truncated-layer self-draft proposing k tokens per step — every
+        # completion must stay bitwise-equal to the non-speculative
+        # single-stream reference, the jit caches must freeze at the warmed
+        # counts (draft + k-verify lattice points included), and the
+        # accept-rate histogram must render in the report's serving section
+        try:
+            _doctor_spec_decode(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("speculative decoding", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -2056,6 +2105,77 @@ def _doctor_serving(tmp: str, _check) -> None:
         ok,
         f"mismatched={mismatched} max_running={stats['max_running']} "
         f"caches={engine.jit_cache_sizes()} warmed={warmed}",
+    )
+
+
+def _doctor_spec_decode(tmp: str, _check) -> None:
+    """Doctor check 19 body: the serving engine with speculative decoding on
+    (k=3 draft tokens from a 1-layer truncated self-draft) must (a) complete
+    every staggered greedy request bitwise-equal to the single-stream
+    ``greedy_generate`` reference — the bitwise-accept contract, (b) keep the
+    jit caches frozen at the warmed counts with the draft and k-verify
+    lattice points included, and (c) render the accept-rate histogram in the
+    report's serving section."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..generation import greedy_generate
+    from ..models import LlamaConfig, init_llama
+    from ..serving import BucketLattice, ServingEngine
+    from . import events as tel_events
+
+    config = LlamaConfig.tiny()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    serve_dir = os.path.join(tmp, "spec_decode")
+    tel_events.enable(out_dir=serve_dir, run_id="doctor-spec-decode")
+    try:
+        engine = ServingEngine(
+            params, config, num_blocks=33, block_size=8, max_slots=4,
+            lattice=BucketLattice(
+                slot_buckets=(2, 4), block_buckets=(4,), prefill_buckets=(32,)
+            ),
+            spec_tokens=3, draft_layers=1,
+        )
+        warmed = engine.warmup()
+        rng = np.random.default_rng(0)
+        specs = [(5, 7), (13, 11), (21, 5), (9, 9), (12, 6)]
+        prompts = [rng.integers(0, config.vocab_size, (s,)).astype(np.int32) for s, _ in specs]
+        reqs = [engine.submit(prompts[i], specs[i][1], rng_seed=i) for i in range(2)]
+        for i in range(2, len(specs)):
+            engine.step()
+            reqs.append(engine.submit(prompts[i], specs[i][1], rng_seed=i))
+        engine.run()
+    finally:
+        tel_events.disable()
+    mismatched = []
+    for i, ((_, max_new), req) in enumerate(zip(specs, reqs)):
+        ref = greedy_generate(params, prompts[i][None], config, max_new_tokens=max_new)
+        if not np.array_equal(np.asarray(ref[0]), req.output_ids()):
+            mismatched.append(i)
+    stats = engine.stats()
+    report = build_report([serve_dir])
+    serving = report.get("serving") or {}
+    spec = serving.get("spec_decode") or {}
+    text = format_report(report)
+    caches = engine.jit_cache_sizes()
+    ok = (
+        not mismatched
+        and caches == warmed
+        and "verify_compiles" in warmed
+        and "draft_compiles" in warmed
+        and stats["draft_proposed_tokens"] > 0
+        and sum(spec.get("accept_hist") or []) > 0
+        and "spec decode: accept rate" in text
+    )
+    _check(
+        "speculative decoding",
+        ok,
+        f"mismatched={mismatched} caches={caches} warmed={warmed} "
+        f"accept_rate={stats.get('spec_accept_rate')}",
     )
 
 

@@ -828,6 +828,7 @@ def run_bench_serving(on_tpu: bool) -> dict:
     spec.loader.exec_module(mod)
     out = mod.run_bench_serving(on_tpu)
     replicated = mod.run_bench_replicated(on_tpu)
+    spec_decode = mod.run_bench_spec_decode(on_tpu)
     return {
         "metric": "serving throughput ratio (continuous/static batching)",
         "value": out["value"],
@@ -843,6 +844,21 @@ def run_bench_serving(on_tpu: bool) -> dict:
         "replicated": replicated["replicated"],
         "replica_kill": replicated["replica_kill"],
         "kill_outputs_match_unkilled": replicated["kill_outputs_match_unkilled"],
+        # ISSUE 18 speculative-decoding leg: bitwise-accept self-draft vs the
+        # plain decode loop over one workload, plus the prefill-kernel chunk
+        # microbench
+        "spec_decode": spec_decode,
+        # regression-guarded (telemetry/regress.py flattens these under
+        # configs.serving.* with the *accept_rate* / *spec_decode* /
+        # *prefill_kernel* specs): accept-rate and step-reduction drops or a
+        # gather-path latency regression fail `make bench-check`
+        "guarded": {
+            "spec_decode_accept_rate": spec_decode["spec_accept_rate"],
+            "spec_decode_tokens_per_s_ratio": spec_decode["tokens_per_s_ratio"],
+            "prefill_kernel_gather_us_per_token": (
+                spec_decode["prefill_kernel"]["gather_us_per_token"]
+            ),
+        },
     }
 
 

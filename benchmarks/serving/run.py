@@ -600,6 +600,207 @@ def run_bench_disagg(
     }
 
 
+def run_spec_leg(params, config, workload, *, spec_tokens, draft_layers,
+                 max_slots, num_blocks, block_size, lattice):
+    """One speculation setting over the shared workload; returns the leg
+    metrics, every request's output tokens (for the cross-leg bitwise parity
+    check — bitwise-accept means speculation may change HOW FAST tokens come
+    out, never WHICH) and the post-warmup recompile count across all four
+    jit functions (draft + verify are warmed at every decode point)."""
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry.step_profiler import RecompileWatcher
+
+    kw = {}
+    if spec_tokens:
+        kw = dict(spec_tokens=spec_tokens, draft_layers=draft_layers)
+    engine = ServingEngine(
+        params, config, num_blocks=num_blocks, block_size=block_size,
+        max_slots=max_slots, lattice=lattice, **kw,
+    )
+    engine.warmup()
+    watcher = RecompileWatcher()
+    watcher.register("prefill", engine.prefill_fn)
+    watcher.register("decode", engine.decode_fn)
+    if spec_tokens:
+        watcher.register("draft", engine.draft_fn)
+        watcher.register("verify", engine.verify_fn)
+    completed, rejected, wall = _drive(engine, workload)
+    tokens = sum(len(r.generated) for r in completed)
+    # per-token decode latency: the metric speculation exists to cut —
+    # first-token to finish divided by the tokens decoded in that span
+    per_tok = [
+        (r.finish_t - r.first_token_t) / max(len(r.generated) - 1, 1)
+        for r in completed if r.first_token_t and len(r.generated) > 1
+    ]
+    stats = engine.stats()
+    outputs = {r.rid: [int(t) for t in r.output_ids()] for r in completed}
+    leg = {
+        "spec_tokens": spec_tokens,
+        "draft_layers": draft_layers if spec_tokens else None,
+        "completed": len(completed),
+        "rejected": len(rejected),
+        "tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / max(wall, 1e-9), 2),
+        "engine_steps": stats["steps"],
+        "p50_per_token_ms": round(_percentile(per_tok, 50) * 1e3, 3),
+        "p99_per_token_ms": round(_percentile(per_tok, 99) * 1e3, 3),
+        "recompiles": sum(watcher.poll(emit=False).values()),
+    }
+    if spec_tokens:
+        leg["draft_proposed_tokens"] = stats["draft_proposed_tokens"]
+        leg["draft_accepted_tokens"] = stats["draft_accepted_tokens"]
+        leg["spec_accept_rate"] = stats["spec_accept_rate"]
+        leg["spec_accept_hist"] = stats["spec_accept_hist"]
+    return leg, [outputs[k] for k in sorted(outputs)]
+
+
+def _prefill_kernel_microbench(on_tpu: bool, *, iters: int = 20):
+    """Paged-attention prefill chunk: XLA gather path vs the Pallas kernel.
+    On TPU both run compiled and the ratio is the ISSUE 18 kernel win; on
+    CPU the kernel only runs under the Pallas interpreter (a correctness
+    vehicle, orders of magnitude slower by construction), so the kernel
+    column is timed once and flagged — the gather column is still an honest
+    CPU baseline for the chunk shape."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.flash_attention import paged_attention_prefill
+    from accelerate_tpu.serving.kv_pager import paged_attention as gather_ref
+
+    if on_tpu:
+        B, S, H, Hkv, D, bs, nb, W = 8, 64, 16, 8, 128, 16, 256, 24
+    else:
+        B, S, H, Hkv, D, bs, nb, W = 2, 8, 4, 2, 32, 8, 16, 4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((nb, bs, Hkv, D)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((nb, bs, Hkv, D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, nb))[: B * W].reshape(B, W), jnp.int32
+    )
+    # the chunk sits at the very end of the table: every earlier block is
+    # landed-prefix KV, the max-work shape for a chunk of S queries
+    qpos = jnp.asarray(
+        (W * bs - S) + np.arange(S)[None, :] + np.zeros((B, 1), np.int32),
+        jnp.int32,
+    )
+    n_tok = B * S
+
+    def _time(fn, reps):
+        fn().block_until_ready()  # warm (compile / first trace)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn().block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    import jax
+
+    gather_jit = jax.jit(gather_ref)
+    gather_s = _time(lambda: gather_jit(q, k_pool, v_pool, tables, qpos), iters)
+    if on_tpu:
+        kernel_s = _time(
+            lambda: paged_attention_prefill(q, k_pool, v_pool, tables, qpos),
+            iters,
+        )
+        kernel_mode = "compiled"
+    else:
+        t0 = time.perf_counter()
+        paged_attention_prefill(
+            q, k_pool, v_pool, tables, qpos, interpret=True
+        ).block_until_ready()
+        kernel_s = time.perf_counter() - t0
+        kernel_mode = "interpret"
+    return {
+        "shape": {"B": B, "S": S, "H": H, "Hkv": Hkv, "D": D,
+                  "block_size": bs, "table_width": W},
+        "gather_us_per_token": round(gather_s * 1e6 / n_tok, 3),
+        "kernel_us_per_token": round(kernel_s * 1e6 / n_tok, 3),
+        "kernel_mode": kernel_mode,
+        # only meaningful when both columns are compiled (TPU)
+        "kernel_speedup": (
+            round(gather_s / max(kernel_s, 1e-12), 3) if on_tpu else None
+        ),
+    }
+
+
+def run_bench_spec_decode(
+    on_tpu: bool,
+    requests: int = 12,
+    rate: float = 2.0,
+    seed: int = 0,
+    spec_tokens: int = 3,
+    draft_layers: int = 1,
+    max_slots: int = 4,
+    num_blocks: int = 49,
+    block_size: int = 8,
+) -> dict:
+    """The speculative-decoding leg (ISSUE 18): ONE seeded Poisson workload
+    replayed with speculation off and with a k-token truncated-layer
+    self-draft on. Bitwise-accept makes the comparison exact: outputs must
+    match token-for-token, so the legs differ only in steps taken. Reports
+    the per-token latency improvement at the measured accept rate, the
+    engine-step reduction, bitwise parity, and the zero-recompile line
+    (draft + verify included); plus the prefill-kernel chunk microbench."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig, init_llama
+    from accelerate_tpu.serving import BucketLattice
+
+    if on_tpu:
+        config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+                             n_kv_heads=8, max_seq_len=512)
+        prompt_lens, new_tokens = (16, 96), (16, 64)
+        max_slots, num_blocks, block_size = max(max_slots, 8), 160, 16
+        draft_layers = max(draft_layers, 2)
+    else:
+        config = LlamaConfig.tiny()
+        # decode-heavy: long completions are where accepted drafts compound
+        prompt_lens, new_tokens = (4, 16), (8, 40)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), init_llama(config, jax.random.PRNGKey(0))
+    )
+    max_len = prompt_lens[1] + new_tokens[1]
+    lattice = BucketLattice.from_limits(
+        max_slots, -(-max_len // block_size) + 1, prompt_lens[1]
+    )
+    workload = build_workload(
+        requests, seed, prompt_lens, new_tokens, rate, config.vocab_size
+    )
+    kw = dict(max_slots=max_slots, num_blocks=num_blocks,
+              block_size=block_size, lattice=lattice)
+    spec, spec_out = run_spec_leg(params, config, workload,
+                                  spec_tokens=spec_tokens,
+                                  draft_layers=draft_layers, **kw)
+    plain, plain_out = run_spec_leg(params, config, workload,
+                                    spec_tokens=0, draft_layers=None, **kw)
+    return {
+        "bench": "serving_spec_decode",
+        "unit": "per_token_latency_ratio(spec/off)",
+        "value": round(
+            spec["p50_per_token_ms"] / max(plain["p50_per_token_ms"], 1e-9), 3
+        ),
+        "speculative": spec,
+        "baseline": plain,
+        "spec_accept_rate": spec["spec_accept_rate"],
+        "tokens_per_s_ratio": round(
+            spec["tokens_per_s"] / max(plain["tokens_per_s"], 1e-9), 3
+        ),
+        "engine_step_ratio": round(
+            spec["engine_steps"] / max(plain["engine_steps"], 1), 3
+        ),
+        "outputs_match": spec_out == plain_out,
+        "zero_recompiles": spec["recompiles"] == 0 and plain["recompiles"] == 0,
+        "prefill_kernel": _prefill_kernel_microbench(on_tpu),
+        "requests": requests,
+        "spec_tokens": spec_tokens,
+        "draft_layers": draft_layers,
+        "on_tpu": on_tpu,
+    }
+
+
 def run_bench_serving(
     on_tpu: bool,
     requests: int = 32,
@@ -676,6 +877,10 @@ if __name__ == "__main__":
                     help="workload size for the shared-prefix leg (0 skips it)")
     ap.add_argument("--disagg-requests", type=int, default=16,
                     help="workload size for the disaggregated leg (0 skips it)")
+    ap.add_argument("--spec-requests", type=int, default=12,
+                    help="workload size for the spec-decode leg (0 skips it)")
+    ap.add_argument("--spec-tokens", type=int, default=3)
+    ap.add_argument("--draft-layers", type=int, default=1)
     args = ap.parse_args()
     on_tpu = detect_backend()
     out = run_bench_serving(
@@ -709,5 +914,14 @@ if __name__ == "__main__":
             on_tpu=on_tpu,
             requests=args.disagg_requests,
             seed=args.seed,
+        )
+    if args.spec_requests > 0:
+        out["spec_decode"] = run_bench_spec_decode(
+            on_tpu=on_tpu,
+            requests=args.spec_requests,
+            rate=args.rate,
+            seed=args.seed,
+            spec_tokens=args.spec_tokens,
+            draft_layers=args.draft_layers,
         )
     emit(out)

@@ -188,3 +188,43 @@ class TestFlashParityTPU:
         gx = jax.grad(lambda *a: loss("xla", *a), argnums=(0, 1, 2))(q, k, v)
         for a, b_ in zip(gf, gx):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-2, rtol=1e-2)
+
+
+class TestAttnImplConfigKnob:
+    """`LlamaConfig.attn_impl` (ISSUE 18 satellite): the config knob feeds
+    `llama_forward`'s default attention implementation, and an explicit
+    `attention_impl=` argument still wins over the config."""
+
+    def _setup(self):
+        from dataclasses import replace
+
+        from accelerate_tpu.models import LlamaConfig, init_llama, llama_forward
+
+        cfg = LlamaConfig.tiny()
+        params = init_llama(cfg, jax.random.PRNGKey(0))
+        ids = jnp.asarray(np.arange(2 * 16).reshape(2, 16) % cfg.vocab_size)
+        return replace, cfg, params, ids, llama_forward
+
+    def test_config_default_is_auto_and_round_trips(self):
+        replace, cfg, _, _, _ = self._setup()
+        assert cfg.attn_impl == "auto"
+        assert replace(cfg, attn_impl="fused").attn_impl == "fused"
+        assert cfg.attn_impl == "auto"  # frozen original untouched
+
+    def test_fused_knob_matches_xla_off_tpu(self):
+        """impl='fused' falls back to the xla mask path off TPU, so wiring
+        the knob through the config must reproduce attn_impl='xla' exactly."""
+        replace, cfg, params, ids, llama_forward = self._setup()
+        out_fused = llama_forward(params, ids, replace(cfg, attn_impl="fused"))
+        out_xla = llama_forward(params, ids, replace(cfg, attn_impl="xla"))
+        np.testing.assert_allclose(
+            np.asarray(out_fused), np.asarray(out_xla), atol=1e-6
+        )
+
+    def test_explicit_argument_overrides_config(self):
+        replace, cfg, params, ids, llama_forward = self._setup()
+        out_arg = llama_forward(
+            params, ids, replace(cfg, attn_impl="fused"), attention_impl="xla"
+        )
+        out_xla = llama_forward(params, ids, replace(cfg, attn_impl="xla"))
+        assert np.array_equal(np.asarray(out_arg), np.asarray(out_xla))

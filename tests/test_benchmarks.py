@@ -126,7 +126,7 @@ def test_serving_benchmark_smoke():
         "benchmarks/serving/run.py",
         "--requests", "12", "--rate", "2.0", "--max-slots", "4",
         "--replicated-requests", "8", "--prefix-requests", "10",
-        "--disagg-requests", "8",
+        "--disagg-requests", "8", "--spec-requests", "8",
         timeout=600,
     )
     assert out["bench"] == "serving"
@@ -200,6 +200,28 @@ def test_serving_benchmark_smoke():
         tr[ph]["completed"] > 0 and tr[ph]["p99_ttft_ms"] > 0
         for ph in ("pre_scale", "post_scale")
     )
+    # speculative-decoding leg (ISSUE 18): no latency bar on CPU (the
+    # truncated-layer draft only pays on TPU, where draft+verify beat k+1
+    # sequential decode steps), but bitwise-accept makes the correctness
+    # invariants absolute — outputs identical to the plain decode loop,
+    # zero post-warmup recompiles with draft+verify watched, and the step
+    # count must not grow (accepted drafts can only shorten the run)
+    sd = out["spec_decode"]
+    assert sd["bench"] == "serving_spec_decode"
+    assert sd["outputs_match"] is True
+    assert sd["zero_recompiles"] is True
+    assert sd["speculative"]["completed"] == sd["baseline"]["completed"] == 8
+    assert sd["speculative"]["rejected"] == sd["baseline"]["rejected"] == 0
+    assert sd["speculative"]["tokens"] == sd["baseline"]["tokens"]
+    assert sd["speculative"]["engine_steps"] <= sd["baseline"]["engine_steps"]
+    assert sd["speculative"]["draft_proposed_tokens"] > 0
+    assert 0.0 <= sd["spec_accept_rate"] <= 1.0
+    assert sum(sd["speculative"]["spec_accept_hist"]) > 0
+    # prefill-kernel chunk microbench rode along: gather column is always
+    # compiled; the kernel column is compiled on TPU, interpreted on CPU
+    pk = sd["prefill_kernel"]
+    assert pk["gather_us_per_token"] > 0 and pk["kernel_us_per_token"] > 0
+    assert pk["kernel_mode"] == ("compiled" if sd["on_tpu"] else "interpret")
 
 
 def test_compile_time_restart_benchmark_smoke():

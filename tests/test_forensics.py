@@ -409,9 +409,11 @@ def test_doctor_self_checks(capsys):
     # + observability plane (ISSUE 15)
     # + disaggregated serving (ISSUE 16)
     # + goodput ledger (ISSUE 17)
-    assert out.count("PASS") == 18 and "FAIL" not in out
+    # + speculative decoding (ISSUE 18)
+    assert out.count("PASS") == 19 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "goodput ledger" in out
+    assert "speculative decoding" in out
     assert "perf cost capture" in out and "xplane trace parse" in out
     assert "serving engine" in out
     assert "replicated serving router" in out

@@ -1,0 +1,229 @@
+"""Speculative decoding with bitwise-accept verification (ISSUE 18).
+
+The engine's spec-decode path (``spec_tokens=k, draft_layers=m``) proposes
+k tokens per slot-step from a truncated-layer self-draft (the first m
+verifier layers, sharing the verifier's KV pool) and verifies them with ONE
+batched S=k+1 forward whose acceptance rule is BITWISE: position j is
+accepted only if the draft token equals the exact token the non-speculative
+stream would have selected there (same fold_in(rng_seed, token_idx) key,
+same select_one). So the output stream is identical to ``spec_tokens=0``
+token-for-token in BOTH greedy and sampled modes — speculation may only
+change how many steps it takes, never what comes out. These tests hold that
+line end to end, plus the jit-cache freeze (draft + verify warmed at every
+decode point), the accept-rate accounting, and the config surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.generation import greedy_generate
+from accelerate_tpu.models import LlamaConfig, init_llama
+from accelerate_tpu.models.transformer import draft_config, draft_params
+from accelerate_tpu.serving import BucketLattice, ReplicaSpec, ServingEngine
+
+CONFIG = LlamaConfig.tiny()
+LATTICE = BucketLattice(slot_buckets=(2, 4), block_buckets=(4,),
+                        prefill_buckets=(32,))
+
+
+def _engine(params, **kw):
+    kw.setdefault("lattice", LATTICE)
+    return ServingEngine(
+        params, CONFIG, num_blocks=33, block_size=8, max_slots=4,
+        cache_dtype=jnp.float32, **kw,
+    )
+
+
+def _drive(engine, prompts, specs, *, seeds=None):
+    reqs = [engine.submit(p, n, rng_seed=(seeds[i] if seeds else i))
+            for i, (p, (_, n)) in enumerate(zip(prompts, specs))]
+    engine.run()
+    return [r.output_ids() for r in reqs]
+
+
+@pytest.mark.smoke
+def test_greedy_spec_decode_is_bitwise_identical():
+    """The acceptance-criteria line: greedy output streams with speculation
+    on are token-for-token identical to both the non-speculative engine and
+    the single-stream ``greedy_generate`` reference — while actually
+    accepting draft tokens (fewer engine steps than baseline)."""
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    specs = [(5, 7), (13, 11), (21, 5), (9, 9)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+
+    base = _engine(params)
+    base.warmup()
+    out_base = _drive(base, prompts, specs)
+
+    spec = _engine(params, spec_tokens=3, draft_layers=1)
+    spec.warmup()
+    out_spec = _drive(spec, prompts, specs)
+
+    for i, (b, s) in enumerate(zip(out_base, out_spec)):
+        assert np.array_equal(b, s), f"request {i} diverged under speculation"
+        ref = greedy_generate(params, prompts[i][None], CONFIG,
+                              max_new_tokens=specs[i][1])
+        assert np.array_equal(np.asarray(ref[0]), s), f"request {i} vs reference"
+    st = spec.stats()
+    assert st["draft_proposed_tokens"] > 0
+    assert st["draft_accepted_tokens"] > 0  # self-draft layer 0 agrees sometimes
+    assert spec.steps < base.steps  # accepted drafts shortened the run
+
+
+def test_sampled_spec_decode_is_bitwise_identical():
+    """Bitwise-accept is sampling-safe: the verify step recomputes the exact
+    fold_in key the non-speculative stream would use at each position, so
+    temperature/top-k sampling with speculation matches the non-speculative
+    engine stream-for-stream."""
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(10)
+    specs = [(7, 8), (15, 6), (4, 10)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+    sample_kw = dict(temperature=0.8, top_k=20)
+
+    base = _engine(params, **sample_kw)
+    base.warmup()
+    out_base = _drive(base, prompts, specs, seeds=[11, 12, 13])
+
+    spec = _engine(params, spec_tokens=2, draft_layers=1, **sample_kw)
+    spec.warmup()
+    out_spec = _drive(spec, prompts, specs, seeds=[11, 12, 13])
+
+    for i, (b, s) in enumerate(zip(out_base, out_spec)):
+        assert np.array_equal(b, s), f"sampled request {i} diverged"
+
+
+def test_full_depth_draft_accepts_everything():
+    """draft_layers == n_layers makes the draft the verifier itself: every
+    greedy proposal must be accepted (accept rate 1.0) — the self-draft
+    correctness canary (pool sharing, positions, fold indices all line up)."""
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    eng = _engine(params, spec_tokens=2, draft_layers=CONFIG.n_layers)
+    eng.warmup()
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, CONFIG.vocab_size, (6,)).astype(np.int32)]
+    _drive(eng, prompts, [(6, 8)])
+    st = eng.stats()
+    assert st["draft_proposed_tokens"] > 0
+    assert st["spec_accept_rate"] == 1.0
+
+
+def test_spec_decode_jit_caches_freeze_after_warmup():
+    """Warmup covers draft + verify at every decode point: a full serve
+    afterwards must add ZERO compiles to any cache (the no-recompile
+    acceptance line, including the two new speculative functions)."""
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    eng = _engine(params, spec_tokens=3, draft_layers=1)
+    warmed = eng.warmup()
+    before = eng.jit_cache_sizes()
+    assert before == warmed
+    assert before["draft_compiles"] == len(LATTICE.decode_points())
+    assert before["verify_compiles"] == len(LATTICE.decode_points())
+    rng = np.random.default_rng(12)
+    specs = [(5, 7), (13, 11), (21, 5), (9, 9), (12, 6)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+    _drive(eng, prompts, specs)
+    assert eng.jit_cache_sizes() == before, "post-warmup recompile"
+
+
+def test_spec_decode_through_interpreted_kernels(monkeypatch):
+    """Both ISSUE 18 features on at once: the draft's S=1 steps run the
+    decode kernel and the S=k+1 verify runs the chunked-prefill kernel
+    (interpreter mode on CPU — the same dataflow the TPU compiles). Outputs
+    must still match the non-speculative engine bitwise and the jit caches
+    must stay frozen after warmup."""
+    monkeypatch.setenv("ACCELERATE_PAGED_KERNEL", "interpret")
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    specs = [(5, 7), (13, 11), (21, 5)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+
+    base = _engine(params)
+    base.warmup()
+    out_base = _drive(base, prompts, specs)
+
+    spec = _engine(params, spec_tokens=3, draft_layers=1)
+    frozen = spec.warmup()
+    out_spec = _drive(spec, prompts, specs)
+
+    for i, (b, s) in enumerate(zip(out_base, out_spec)):
+        assert np.array_equal(b, s), f"request {i} diverged under kernels"
+    assert spec.jit_cache_sizes() == frozen, "post-warmup recompile"
+    assert spec.stats()["draft_proposed_tokens"] > 0
+
+
+def test_spec_accept_accounting():
+    """proposed == accepted + rejected; the accept histogram's per-step
+    counts weight-sum back to the accepted-token total; stats carries the
+    config knobs."""
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    k = 3
+    eng = _engine(params, spec_tokens=k, draft_layers=1)
+    eng.warmup()
+    rng = np.random.default_rng(13)
+    specs = [(8, 9), (14, 12)]
+    prompts = [rng.integers(0, CONFIG.vocab_size, (s,)).astype(np.int32)
+               for s, _ in specs]
+    _drive(eng, prompts, specs)
+    st = eng.stats()
+    assert st["spec_tokens"] == k and st["draft_layers"] == 1
+    assert (st["draft_proposed_tokens"]
+            == st["draft_accepted_tokens"] + st["draft_rejected_tokens"])
+    hist = st["spec_accept_hist"]
+    assert len(hist) == k + 1
+    assert sum(i * c for i, c in enumerate(hist)) == st["draft_accepted_tokens"]
+    assert st["spec_accept_rate"] == pytest.approx(
+        st["draft_accepted_tokens"] / st["draft_proposed_tokens"], abs=1e-6)
+
+
+def test_spec_config_validation():
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="spec_tokens"):
+        _engine(params, spec_tokens=-1)
+    with pytest.raises(ValueError, match="draft_layers"):
+        _engine(params, spec_tokens=2)  # no draft_layers given
+    with pytest.raises(ValueError, match="draft_layers"):
+        _engine(params, spec_tokens=2, draft_layers=CONFIG.n_layers + 1)
+
+
+def test_draft_params_and_config_truncate_layers():
+    params = init_llama(CONFIG, jax.random.PRNGKey(0))
+    d_cfg = draft_config(CONFIG, 1)
+    assert d_cfg.n_layers == 1 and CONFIG.n_layers > 1  # original untouched
+    dp = draft_params(params, 1)
+    for leaf, full in zip(jax.tree_util.tree_leaves(dp["layers"]),
+                          jax.tree_util.tree_leaves(params["layers"])):
+        assert leaf.shape[0] == 1
+        assert np.array_equal(np.asarray(leaf), np.asarray(full[:1]))
+    assert dp["embed_tokens"] is params["embed_tokens"]  # shared, not copied
+    with pytest.raises(ValueError, match="draft_layers"):
+        draft_config(CONFIG, 0)
+    with pytest.raises(ValueError, match="draft_layers"):
+        draft_config(CONFIG, CONFIG.n_layers + 1)
+
+
+def test_lattice_warmup_points_count_spec_functions():
+    assert LATTICE.warmup_points() == LATTICE.size()
+    assert (LATTICE.warmup_points(spec_decode=True)
+            == LATTICE.size() + 2 * len(LATTICE.decode_points()))
+    assert (LATTICE.warmup_points(prefix_cache=True, spec_decode=True)
+            == LATTICE.size() + 1 + 2 * len(LATTICE.decode_points()))
+
+
+def test_replica_spec_threads_spec_knobs_to_the_engine():
+    spec = ReplicaSpec(
+        model=dict(CONFIG.__dict__), num_blocks=33, block_size=8, max_slots=4,
+        slot_buckets=(2, 4), block_buckets=(4,), prefill_buckets=(32,),
+        param_dtype="float32", spec_tokens=2, draft_layers=1,
+    )
+    eng = spec.build_engine()
+    assert eng.spec_tokens == 2 and eng.draft_layers == 1
+    assert "draft_compiles" in eng.jit_cache_sizes()

@@ -101,22 +101,28 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
         "allocator, watermark/LIFO preemption with persisted resume, and a "
         "static bucket lattice so admission churn never recompiles — "
         "with automatic prefix caching (content-addressed refcounted block "
-        "sharing + copy-on-write) and a Pallas paged-attention decode "
-        "kernel on TPU — replicated behind a health-checked router with "
-        "token-exact failover, deadlines, and graceful overload shedding. "
+        "sharing + copy-on-write) and Pallas paged-attention decode + "
+        "chunked-prefill kernels on TPU — replicated behind a health-checked "
+        "router with token-exact failover, deadlines, and graceful overload "
+        "shedding. Speculative decoding (a truncated-layer self-draft with "
+        "bitwise-accept verification) emits multiple tokens per step without "
+        "changing a single output token. "
         "The fleet can be split into disaggregated prefill/decode tiers "
         "(content-addressed KV handoff, bitwise parity with the monolith) "
         "with SLO-burn-driven autoscaling and warm pre-shipped scale-up. "
         "See `docs/serving.md` for the guide and `benchmarks/serving/` "
         "(`make bench-serve`) for the continuous-vs-static, replicated, "
-        "shared-prefix and disaggregated benchmarks.",
+        "shared-prefix, disaggregated and speculative-decoding benchmarks.",
         [("accelerate_tpu.serving.engine", ["ServingEngine", "paged_forward"]),
          ("accelerate_tpu.serving.kv_pager",
           ["BlockAllocator", "BlockAllocatorError", "BlockPoolExhausted",
            "PrefixPlan", "PrefixAllocation", "init_block_pool",
            "paged_attention"]),
          ("accelerate_tpu.ops.flash_attention",
-          ["paged_attention", "paged_attention_decode", "paged_kernel_mode"]),
+          ["paged_attention", "paged_attention_decode",
+           "paged_attention_prefill", "paged_kernel_mode"]),
+         ("accelerate_tpu.models.transformer",
+          ["draft_config", "draft_params"]),
          ("accelerate_tpu.serving.scheduler",
           ["Request", "RequestStatus", "Scheduler", "SchedulingError"]),
          ("accelerate_tpu.serving.buckets", ["BucketLattice"]),
