@@ -137,7 +137,11 @@ bench-check:
 # dropped KV handoff: exactly-once + bitwise parity across the handoff),
 # and the goodput ledger (a supervised chaos run whose injected SIGKILL
 # and slow-data badput the ledger must attribute to cause, <5% of
-# wall-clock unattributed) against synthetic inputs
+# wall-clock unattributed), and the live observability plane (a
+# supervised restart tailed live across a torn line with exactly one
+# anomaly episode, a seeded canary corruption drained with the
+# mismatching token named, and `top --once` rendering the post-hoc
+# report's sections string-exact) against synthetic inputs
 # (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor

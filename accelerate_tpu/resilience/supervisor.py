@@ -131,6 +131,7 @@ class Supervisor:
         available_fn: Optional[Callable[[], "list[int]"]] = None,
         axis_sizes: "Optional[dict[str, int]]" = None,
         spawn_fn: Optional[Callable[..., "subprocess.Popen"]] = None,
+        status_interval_s: float = 5.0,
     ):
         if not commands:
             raise ValueError("supervisor needs at least one child command")
@@ -151,6 +152,12 @@ class Supervisor:
         self._spawned_at = 0.0
         self._events_path = os.path.join(self.telemetry_dir, "events-supervisor.jsonl")
         self._events_opened = False
+        # throttled ``supervisor`` status records from the watch loop: the
+        # live hub (telemetry/hub.py) tails these for supervisor liveness,
+        # current generation, and the restart budget without having to
+        # infer them from restart records that may never come
+        self.status_interval_s = float(status_interval_s)
+        self._last_status_t = float("-inf")
         self._seen_dumps: "dict[str, float]" = {}  # path -> mtime (ranks reuse names)
         # Training-side SLO (telemetry/slo.py): ACCELERATE_SLO_RESTART_DOWNTIME_S
         # arms a restart-downtime objective — every restart's downtime_s is one
@@ -488,10 +495,26 @@ class Supervisor:
                         self._emit("slo_violation", generation=spec.generation,
                                    **{k: v for k, v in rec.items() if k != "entered"})
 
+    def _maybe_emit_status(self) -> None:
+        """Throttled liveness record for the hub's live plane: the current
+        generation, how many children are alive, and the restart budget."""
+        now = time.monotonic()
+        if now - self._last_status_t < self.status_interval_s:
+            return
+        self._last_status_t = now
+        self._emit(
+            "supervisor",
+            generation=self.generation,
+            processes=sum(1 for p in self._children.values() if p.poll() is None),
+            restarts_used=self.restarts_used,
+            max_restarts=self.policy.max_restarts,
+        )
+
     def _watch(self) -> "Optional[_Incident]":
         """Block until the cohort finishes (returns None) or something dies /
         goes silent (returns the incident)."""
         while True:
+            self._maybe_emit_status()
             for rank, proc in self._children.items():
                 rc = proc.poll()
                 if rc is None:

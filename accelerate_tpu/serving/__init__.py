@@ -27,7 +27,13 @@ single-stream decode (ROADMAP item 1). Five pillars:
   (:class:`~accelerate_tpu.serving.disagg.DisaggRouter`), and an
   SLO-burn-driven :class:`~accelerate_tpu.serving.autoscaler.
   AutoscalerPolicy` whose scale-ups join warm via compile-cache
-  pre-shipping.
+  pre-shipping;
+- :mod:`~accelerate_tpu.serving.canary` — bitwise correctness canaries:
+  golden requests precomputed from the single-stream reference at startup
+  and periodically injected by the router
+  (:class:`~accelerate_tpu.serving.canary.CanaryProbe`); a mismatching
+  replica emits ``canary_failure`` and counts toward DRAINING pressure
+  exactly like an SLO-burning one.
 
 See ``docs/serving.md`` for the guide and ``benchmarks/serving/`` for the
 continuous-vs-static and replicated Poisson-load benchmarks
@@ -54,6 +60,7 @@ from .kv_pager import (
     paged_attention,
 )
 from .autoscaler import AutoscalerPolicy, lattice_fns
+from .canary import CanaryGolden, CanaryProbe, precompute_goldens
 from .disagg import (
     DecodeEngine,
     DisaggRouter,
@@ -102,4 +109,7 @@ __all__ = [
     "DisaggRouter",
     "AutoscalerPolicy",
     "lattice_fns",
+    "CanaryGolden",
+    "CanaryProbe",
+    "precompute_goldens",
 ]

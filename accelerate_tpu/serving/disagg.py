@@ -576,6 +576,13 @@ class DisaggRouter(ServingRouter):
       prefill from scratch.
     - **tracing**: one trace_id spans prefill-hop → handoff → decode-hop;
       each hop is a ``dispatch`` span tagged ``hop=prefill|decode``.
+
+    Correctness canaries (serving/canary.py) target only unified
+    ``serving``-role replicas: a tier member runs half a request by
+    construction, so there is no single replica a golden probe could hold
+    to the single-stream reference — on a pure disagg fleet the canary
+    plane is a no-op (the end-to-end bitwise invariant is covered by
+    tests/test_disagg.py instead).
     """
 
     def __init__(self, prefill_replicas: "list", decode_replicas: "list",

@@ -120,6 +120,11 @@ class RouterRequest:
     handoff_t: Optional[float] = None
     _handoff: Optional[dict] = field(default=None, repr=False)
     _dispatch_t: float = field(default=0.0, repr=False)
+    # correctness canary (serving/canary.py): probe requests bypass
+    # admission, SLO observation, failover, and every user-facing counter —
+    # their only job is the bitwise verdict on ONE replica
+    canary: bool = False
+    _golden: Optional[Any] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -168,6 +173,7 @@ class ServingRouter:
         slo_monitor: Optional[Any] = None,
         slo_eval_interval_s: float = 1.0,
         autoscaler: Optional[Any] = None,
+        canary: Optional[Any] = None,
     ):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -227,6 +233,12 @@ class ServingRouter:
         # optional serving/autoscaler.py policy, consulted once per poll
         # right after the burn-rate evaluation it keys off
         self.autoscaler = autoscaler
+        # optional serving/canary.py probe: periodic golden requests whose
+        # bitwise verdict feeds the same DRAINING pressure as SLO burn
+        self.canary = canary
+        self._canary_failed: "set[str]" = set()
+        self._canary_cursor = 0
+        self.canary_inconclusive = 0
         for n in self.replicas:
             _watchdog.register(f"serving_replica:{n}")
 
@@ -317,6 +329,8 @@ class ServingRouter:
                 )
         if self.autoscaler is not None:
             activity |= bool(self.autoscaler.maybe_act(self, now))
+        if self.canary is not None:
+            activity |= self._canary_tick(now)
         activity |= self._dispatch(now)
         if activity and _metrics.is_enabled():
             _metrics.set_gauge("accelerate_router_queue_depth", self.admission.depth)
@@ -449,6 +463,12 @@ class ServingRouter:
                     if req is None or req.replica != name:
                         continue  # stale: this request was failed over already
                     del self._inflight[req.rid]
+                    if req.canary:
+                        # probe verdict path: no counters, no SLO, no trace —
+                        # only the bitwise comparison against the golden
+                        self._canary_result(req, ev, now)
+                        activity = True
+                        continue
                     if req.trace is not None:
                         # the engine's spans ride home in the done event; the
                         # router is the trace's single writer
@@ -586,6 +606,15 @@ class ServingRouter:
         _metrics.inc("accelerate_replica_deaths_total", replica=rep.name)
         for req in self._outstanding(rep.name):
             del self._inflight[req.rid]
+            if req.canary:
+                # a probe's job was to test THIS replica — never failed over
+                # (retrying elsewhere would launder the evidence), and a
+                # death before the verdict is inconclusive, not a mismatch
+                req.status = RouterRequestStatus.FAILED
+                req.error = f"canary dropped: {reason}"
+                req.finish_t = now
+                self.canary_inconclusive += 1
+                continue
             req.replica = None
             req.retries += 1
             self.failovers += 1
@@ -612,6 +641,109 @@ class ServingRouter:
             else:
                 req.status = RouterRequestStatus.QUEUED
                 self.admission.requeue_front(req)
+
+    # -- correctness canaries (serving/canary.py) ----------------------------
+
+    def _canary_tick(self, now: float) -> bool:
+        """Inject the next due golden probe into one healthy replica.
+
+        Probes round-robin across the dispatchable fleet so every replica
+        gets its turn under the bitwise lens. They bypass admission (a
+        saturated queue must not starve correctness checking) but respect
+        replica capacity — a probe that has to wait simply retries next
+        poll, with the schedule advancing only on injection."""
+        probe = self.canary
+        if not probe.due(now):
+            return False
+        # probes only target unified "serving"-role replicas: a disaggregated
+        # tier member runs half a request by construction (prefill-only or
+        # handoff-fed decode), so a direct golden submit is not well-formed
+        # there — on a pure disagg fleet the canary plane is a no-op
+        # (see DisaggRouter's docstring)
+        targets = sorted(
+            r.name for r in self.replicas.values()
+            if r.state is ReplicaState.HEALTHY
+            and getattr(r, "role", "serving") == "serving"
+            and len(self._outstanding(r.name)) < self._replica_capacity(r)
+        )
+        if not targets:
+            return False
+        name = targets[self._canary_cursor % len(targets)]
+        self._canary_cursor += 1
+        probe.schedule(now)
+        golden = probe.next_golden()
+        req = RouterRequest(
+            prompt=np.asarray(golden.prompt, np.int32),
+            max_new_tokens=golden.max_new_tokens,
+            rid=f"canary-{self._canary_cursor}",
+            rng_seed=golden.rng_seed,
+            arrival_t=now,
+        )
+        req.canary = True
+        req._golden = golden
+        req.replica = name
+        req._dispatch_t = now
+        req.status = RouterRequestStatus.DISPATCHED
+        self._inflight[req.rid] = req
+        self.replicas[name].submit({
+            "rid": req.rid,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new": req.max_new_tokens,
+            "eos": req.eos_token_id,
+            "rng_seed": req.rng_seed,
+            "generated": [],
+        })
+        return True
+
+    def _canary_result(self, req: RouterRequest, ev: dict, now: float) -> None:
+        """Bitwise verdict on a returned probe. Every probe emits a
+        ``canary`` record; a mismatch additionally emits ``canary_failure``
+        naming the first differing token, joins the DRAINING-pressure set,
+        and (by default) drains the replica outright — wrong tokens are a
+        harder failure than a burning SLO."""
+        probe = self.canary
+        golden = req._golden
+        name = req.replica
+        req.finish_t = now
+        if ev.get("status") != "finished":
+            # the engine rejected the probe (pool/lattice cap): that says
+            # nothing about token correctness — inconclusive, no verdict
+            self.canary_inconclusive += 1
+            req.status = RouterRequestStatus.FAILED
+            req.error = str(ev.get("error") or "rejected by engine")
+            if tel.is_enabled():
+                tel.emit(
+                    "canary", replica=name, rid=req.rid, golden=golden.name,
+                    result="inconclusive", error=req.error,
+                )
+            return
+        tokens = [int(t) for t in ev.get("tokens", [])]
+        req.generated = tokens
+        req.status = RouterRequestStatus.FINISHED
+        mismatch = probe.check(golden, tokens)
+        ok = mismatch is None
+        probe.record_result(name, ok)
+        result = "match" if ok else "mismatch"
+        if tel.is_enabled():
+            tel.emit("canary", replica=name, rid=req.rid, golden=golden.name,
+                     result=result)
+        if _metrics.is_enabled():
+            _metrics.inc("accelerate_canary_probes_total",
+                         replica=name, result=result)
+        if ok:
+            return
+        self._canary_failed.add(name)
+        drained = False
+        rep = self.replicas.get(name)
+        if probe.drain_on_failure and rep is not None:
+            if rep.state in (ReplicaState.STARTING, ReplicaState.HEALTHY):
+                self.drain(name)
+                drained = True
+        if tel.is_enabled():
+            tel.emit("canary_failure", replica=name, rid=req.rid,
+                     drained=drained, **mismatch)
+        if _metrics.is_enabled():
+            _metrics.inc("accelerate_canary_failures_total", replica=name)
 
     def _replica_capacity(self, rep) -> int:
         if self.max_outstanding_per_replica is not None:
@@ -671,13 +803,15 @@ class ServingRouter:
                 activity = True
                 continue
             # a replica burning its fast SLO window (self._burning_replicas)
-            # counts toward DRAINING pressure: it loses ties and is only
-            # chosen when every ready replica is burning — never a deadlock,
-            # always a lean away from the replica missing its objective
+            # or carrying a canary mismatch (self._canary_failed) counts
+            # toward DRAINING pressure: it loses ties and is only chosen
+            # when every ready replica is suspect — never a deadlock,
+            # always a lean away from the replica under a cloud
             target = min(
                 ready,
                 key=lambda r: (
-                    r.name in self._burning_replicas,
+                    r.name in self._burning_replicas
+                    or r.name in self._canary_failed,
                     self.outstanding_tokens(r.name),
                 ),
             )
@@ -889,4 +1023,11 @@ class ServingRouter:
             "failovers": self.failovers,
             "respawns": self.respawns,
             "per_replica": {n: dict(v) for n, v in self._per_replica.items()},
+            "canary": (
+                dict(self.canary.stats(),
+                     inconclusive=self.canary_inconclusive,
+                     failed_replicas=sorted(self._canary_failed))
+                if self.canary is not None
+                else None
+            ),
         }

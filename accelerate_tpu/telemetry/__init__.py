@@ -59,8 +59,18 @@ to a loss-curve tracker. Layout:
   aggregation CLI (percentiles, recompile totals, memory peaks, comms bytes;
   ``--request <id>`` renders one request's span timeline, ``--trace-out``
   exports it as a Chrome trace; ``--by-rank`` adds cross-rank
-  straggler/heartbeat/flight forensics) and the ``doctor`` self-check
-  subcommand.
+  straggler/heartbeat/flight forensics; ``--follow`` streams it) and the
+  ``doctor`` self-check subcommand.
+- :mod:`.hub` — the live fleet hub: a stdlib-only file tailer over the
+  event streams (rotation/truncation/torn-line safe) folding into one
+  ``FleetModel``, the ``python -m accelerate_tpu.telemetry top`` dashboard
+  rendering through the report CLI's own section formatters, and
+  ``report --follow``.
+- :mod:`.anomaly` — online anomaly detectors over the live streams:
+  EWMA z-scores (step latency, ttft, spec accept rate, heartbeat gaps)
+  and a block-pool-leak trend detector, hysteresis one ``anomaly`` record
+  per episode with a cause hypothesis, plus an
+  ``accelerate_anomalies_total`` counter.
 - :mod:`.tracker_bridge` — mirrors report summaries into ``tracking.py``
   trackers so the metrics land wherever users already log.
 

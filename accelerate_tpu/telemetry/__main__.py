@@ -1,6 +1,8 @@
 """``python -m accelerate_tpu.telemetry <command>`` entry point: ``report``
-(event-stream aggregation), ``doctor`` (self-check), and ``regress`` (the
-perf-regression sentinel over bench payloads — ``make bench-check``)."""
+(event-stream aggregation; ``--follow`` streams it), ``top`` (the live
+fleet dashboard; ``--once`` for a single pipe-safe frame), ``doctor``
+(self-check), and ``regress`` (the perf-regression sentinel over bench
+payloads — ``make bench-check``)."""
 
 import sys
 

@@ -1,0 +1,318 @@
+"""Online anomaly detection over the live telemetry streams.
+
+The post-hoc report can tell you a run was slow; an operator watching a
+fleet needs the detector to fire WHILE the regression is happening, with a
+cause hypothesis attached. This module is the streaming half of that story:
+
+- :class:`EwmaDetector` — exponentially-weighted mean/variance over one
+  scalar series with a z-score trigger. An observation ``z_enter`` standard
+  deviations out (in the detector's bad direction) ENTERS an episode;
+  the episode re-arms only when the series falls back under ``z_exit``
+  (hysteresis: one record per episode, the same contract as
+  ``slo_violation`` in :mod:`.slo`).
+- :class:`TrendDetector` — EWMA of successive deltas, for series whose bad
+  failure mode is sustained drift rather than a spike (block-pool
+  occupancy: a leak is allocated-minus-freed creeping up forever, which a
+  z-score on the level never pages on until the pool is nearly gone).
+- :class:`AnomalyEngine` — wires the stock detectors to the record kinds
+  the hub tails (:mod:`.hub`): step latency, request ttft, speculative
+  accept rate, replica heartbeat gaps, and block-pool occupancy trend.
+  Every episode entry yields a typed ``anomaly`` record with a cause
+  hypothesis derived from the triggering record's own fields (e.g. a slow
+  step whose ``data_wait_s`` dominates is attributed to the input
+  pipeline, not to the device), plus a Prometheus counter when the
+  metrics registry is armed. Disabled cost is a single boolean check —
+  no state is touched (``tests/test_anomaly.py`` holds that).
+
+The detectors are deliberately clock-free: they consume whatever scalar the
+caller feeds them, in stream order, so tests drive them with synthetic
+series and the hub drives them with tailed records.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+from . import events as tel
+from . import metrics as _metrics
+
+__all__ = ["EwmaDetector", "TrendDetector", "AnomalyEngine"]
+
+#: Prometheus counter bumped once per anomaly episode (labelled by detector)
+ANOMALIES_TOTAL = "accelerate_anomalies_total"
+
+
+class EwmaDetector:
+    """Streaming z-score over an EWMA mean/variance estimate.
+
+    ``direction`` names the bad side: ``"high"`` (latency-like — only
+    upward excursions fire), ``"low"`` (rate-like — collapses fire), or
+    ``"both"``. The first ``min_samples`` observations only train the
+    estimate (a detector must never page off its own cold start), and
+    ``min_std`` floors the variance so a perfectly flat warmup series
+    doesn't turn the first jitter into an infinite z-score.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        alpha: float = 0.1,
+        z_enter: float = 4.0,
+        z_exit: float = 2.0,
+        min_samples: int = 16,
+        direction: str = "high",
+        cause: str = "",
+        min_std: float = 1e-9,
+    ):
+        if direction not in ("high", "low", "both"):
+            raise ValueError(f"unknown direction {direction!r}")
+        if z_exit > z_enter:
+            raise ValueError(f"z_exit ({z_exit}) must not exceed z_enter ({z_enter})")
+        self.name = name
+        self.alpha = float(alpha)
+        self.z_enter = float(z_enter)
+        self.z_exit = float(z_exit)
+        self.min_samples = int(min_samples)
+        self.direction = direction
+        self.cause = cause
+        self.min_std = float(min_std)
+        self.count = 0
+        self.mean = 0.0
+        self.var = 0.0
+        self.in_episode = False
+        self.episodes = 0
+
+    def _signed_z(self, value: float) -> float:
+        """The z-score in the detector's BAD direction (positive == worse)."""
+        std = max(math.sqrt(max(self.var, 0.0)), self.min_std)
+        z = (value - self.mean) / std
+        if self.direction == "low":
+            return -z
+        if self.direction == "both":
+            return abs(z)
+        return z
+
+    def observe(
+        self,
+        value: float,
+        *,
+        source: Optional[str] = None,
+        hypothesis: Optional[str] = None,
+    ) -> Optional[dict]:
+        """Feed one observation; returns the anomaly record's fields on
+        episode ENTRY, None otherwise (training, in-band, or mid-episode)."""
+        value = float(value)
+        fired: Optional[dict] = None
+        if self.count >= self.min_samples:
+            z = self._signed_z(value)
+            if self.in_episode:
+                if z < self.z_exit:
+                    self.in_episode = False  # recovery re-arms the episode
+            elif z >= self.z_enter:
+                self.in_episode = True
+                self.episodes += 1
+                std = max(math.sqrt(max(self.var, 0.0)), self.min_std)
+                fired = {
+                    "detector": self.name,
+                    "value": round(value, 6),
+                    "mean": round(self.mean, 6),
+                    "std": round(std, 6),
+                    "z": round(z, 3),
+                    "direction": self.direction,
+                    "samples": self.count,
+                    "episode": self.episodes,
+                    "cause": hypothesis or self.cause,
+                    "source": source,
+                }
+        # update AFTER scoring: an outlier must be judged against the
+        # estimate it did not itself contaminate. It still feeds the
+        # estimate, so a persistent level shift becomes the new normal and
+        # the episode closes on its own (one record per episode).
+        diff = value - self.mean
+        incr = self.alpha * diff
+        self.mean += incr
+        self.var = (1.0 - self.alpha) * (self.var + diff * incr)
+        self.count += 1
+        return fired
+
+
+class TrendDetector:
+    """Sustained-drift detector: EWMA of successive deltas with hysteresis.
+
+    Fires when the smoothed per-observation slope stays at or above
+    ``slope_enter`` after ``min_samples`` observations — the leak signature
+    (block-pool occupancy only ever creeping up means allocated minus freed
+    is drifting). Re-arms when the slope falls back to ``slope_exit``
+    (default ``slope_enter / 2``)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        alpha: float = 0.1,
+        min_samples: int = 30,
+        slope_enter: float = 0.002,
+        slope_exit: Optional[float] = None,
+        cause: str = "",
+    ):
+        self.name = name
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self.slope_enter = float(slope_enter)
+        self.slope_exit = (
+            float(slope_exit) if slope_exit is not None else self.slope_enter / 2.0
+        )
+        self.cause = cause
+        self.count = 0
+        self.slope = 0.0
+        self._prev: Optional[float] = None
+        self.in_episode = False
+        self.episodes = 0
+
+    def observe(
+        self,
+        value: float,
+        *,
+        source: Optional[str] = None,
+        hypothesis: Optional[str] = None,
+    ) -> Optional[dict]:
+        value = float(value)
+        if self._prev is None:
+            self._prev = value
+            self.count = 1
+            return None
+        delta = value - self._prev
+        self._prev = value
+        self.slope = (1.0 - self.alpha) * self.slope + self.alpha * delta
+        self.count += 1
+        if self.count <= self.min_samples:
+            return None
+        if self.in_episode:
+            if self.slope <= self.slope_exit:
+                self.in_episode = False
+            return None
+        if self.slope < self.slope_enter:
+            return None
+        self.in_episode = True
+        self.episodes += 1
+        return {
+            "detector": self.name,
+            "value": round(value, 6),
+            "slope": round(self.slope, 6),
+            "slope_enter": self.slope_enter,
+            "samples": self.count,
+            "episode": self.episodes,
+            "cause": hypothesis or self.cause,
+            "source": source,
+        }
+
+
+class AnomalyEngine:
+    """The stock detector set, dispatched over tailed telemetry records.
+
+    One engine per hub: :meth:`observe_record` routes each record to the
+    detectors that understand its kind and returns the anomaly records
+    fired (usually none). When ``emit_records`` and the event log / metrics
+    registry are armed, each episode also lands as a typed ``anomaly``
+    record and a labelled :data:`ANOMALIES_TOTAL` bump — the same
+    one-record-per-episode contract as ``slo_violation``."""
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        emit_records: bool = True,
+        step_latency: Optional[EwmaDetector] = None,
+        ttft: Optional[EwmaDetector] = None,
+        spec_accept: Optional[EwmaDetector] = None,
+        heartbeat: Optional[EwmaDetector] = None,
+        block_leak: Optional[TrendDetector] = None,
+    ):
+        self.enabled = bool(enabled)
+        self.emit_records = bool(emit_records)
+        self.step_latency = step_latency if step_latency is not None else EwmaDetector(
+            "step_latency", cause="straggler or contended host (execute inflated)",
+        )
+        self.ttft = ttft if ttft is not None else EwmaDetector(
+            "ttft", cause="queueing or prefill backlog on the serving path",
+        )
+        self.spec_accept = spec_accept if spec_accept is not None else EwmaDetector(
+            "spec_accept_rate", direction="low",
+            cause="draft/verifier divergence (speculative accept rate collapsed)",
+        )
+        self.heartbeat = heartbeat if heartbeat is not None else EwmaDetector(
+            "heartbeat_gap",
+            cause="replica wedged or starved (heartbeat gap widening)",
+        )
+        self.block_leak = block_leak if block_leak is not None else TrendDetector(
+            "block_pool_leak",
+            cause="block-pool leak: allocated-minus-freed occupancy drifting up",
+        )
+        self.observed = 0
+        self.anomalies: "list[dict]" = []
+
+    def detectors(self) -> "list[Any]":
+        return [self.step_latency, self.ttft, self.spec_accept,
+                self.heartbeat, self.block_leak]
+
+    @staticmethod
+    def _step_hypothesis(rec: dict) -> Optional[str]:
+        """Name the slow step's dominant internal cost, when it tells us."""
+        dur = float(rec.get("dur_s", 0.0) or 0.0)
+        if dur <= 0:
+            return None
+        if float(rec.get("compile_s", 0.0) or 0.0) > 0:
+            return "recompilation (compile_s > 0 inside the slow step)"
+        if float(rec.get("data_wait_s", 0.0) or 0.0) >= 0.5 * dur:
+            return "input pipeline stall (data_wait dominates the step)"
+        return None
+
+    def observe_record(self, rec: dict) -> "list[dict]":
+        """Route one tailed record; returns the anomaly records fired."""
+        if not self.enabled:
+            return []
+        kind = rec.get("kind")
+        fired: "list[dict]" = []
+
+        def _feed(detector, value, *, source=None, hypothesis=None):
+            self.observed += 1
+            out = detector.observe(float(value), source=source, hypothesis=hypothesis)
+            if out is not None:
+                fired.append(out)
+
+        if kind == "step" and rec.get("dur_s") is not None:
+            _feed(self.step_latency, rec["dur_s"], source=rec.get("_file"),
+                  hypothesis=self._step_hypothesis(rec))
+        elif kind == "router" and rec.get("phase") == "request":
+            if rec.get("outcome") == "finished" and rec.get("ttft_s") is not None:
+                _feed(self.ttft, rec["ttft_s"], source=rec.get("replica"))
+        elif kind == "serving" and rec.get("phase") == "step":
+            if rec.get("block_occupancy") is not None:
+                _feed(self.block_leak, rec["block_occupancy"],
+                      source=rec.get("_file"))
+            proposed = int(rec.get("draft_proposed_tokens", 0) or 0)
+            if proposed > 0:
+                accepted = int(rec.get("draft_accepted_tokens", 0) or 0)
+                _feed(self.spec_accept, accepted / proposed,
+                      source=rec.get("_file"))
+        elif kind == "serving_replica" and rec.get("heartbeat_age_s") is not None:
+            _feed(self.heartbeat, rec["heartbeat_age_s"],
+                  source=rec.get("replica"))
+
+        for record in fired:
+            self.anomalies.append(record)
+            if self.emit_records:
+                if tel.is_enabled():
+                    tel.emit("anomaly", **record)
+                if _metrics.is_enabled():
+                    _metrics.inc(ANOMALIES_TOTAL, detector=record["detector"])
+        return fired
+
+    def stats(self) -> dict:
+        return {
+            "observed": self.observed,
+            "anomalies": len(self.anomalies),
+            "episodes": {d.name: d.episodes for d in self.detectors()},
+        }

@@ -1,0 +1,369 @@
+"""Live fleet observability hub: tail the telemetry streams while they grow.
+
+Every other observability surface in this package is post-hoc — the report
+CLI, the goodput ledger, and the regress sentinel all read finished JSONL
+streams. The hub is the live plane over the SAME streams: a stdlib-only
+file-tailing collector (no new wire protocol — the telemetry files already
+are the fleet's bus) that follows ``events-rank*.jsonl``,
+``events-supervisor.jsonl``, and the router/replica record streams
+incrementally, and folds every record into one :class:`FleetModel`.
+
+Three design rules keep the live and post-hoc views honest with each other:
+
+- **One fold.** ``FleetModel.snapshot_report()`` runs the accumulated
+  records through :func:`~.report.build_report_from_events` — the exact
+  function ``report`` uses — and :func:`render_top` renders sections with
+  the report CLI's own ``format_*_section`` formatters. ``top --once`` and
+  ``report`` over the same stream print the same numbers because they are
+  the same code (``make doctor`` check 20 asserts the strings match).
+- **Tailing must survive the writer.** :class:`FileTail` keeps a byte
+  offset, the file's identity (inode), and the trailing partial line; a
+  rotated file (identity changed) or a truncated one (size shrank under
+  the offset) restarts from zero, and a torn final line is buffered until
+  its newline arrives — records are parsed exactly once, whole.
+- **Detection happens on the way in.** An
+  :class:`~.anomaly.AnomalyEngine` observes every tailed record; fired
+  episodes are folded back into the model (kind ``anomaly``, synthetic
+  stream :data:`HUB_STREAM`) so the dashboard pages with a cause
+  hypothesis while the run is still degrading.
+
+Entry points: ``python -m accelerate_tpu.telemetry top <dir>`` (ANSI live
+dashboard; ``--once`` renders a single frame for tests/CI) and
+``python -m accelerate_tpu.telemetry report --follow <dir>`` (append-only
+streaming report). Both take an injectable clock/sleep for determinism.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from . import anomaly as _anomaly
+from . import goodput as _goodput
+from . import report as _report
+
+__all__ = ["FileTail", "FleetModel", "EventHub", "render_top", "run_top", "run_follow"]
+
+#: ``_file`` stamp for records the hub synthesizes (fired anomalies) —
+#: a name no real rank stream can collide with, and one the goodput
+#: ledger's per-stream segmenting ignores (it carries no ``meta`` line).
+HUB_STREAM = "<hub>"
+
+#: ANSI: clear screen + home cursor, printed between live frames.
+ANSI_CLEAR = "\x1b[2J\x1b[H"
+
+
+class FileTail:
+    """Incremental reader for one growing JSONL stream.
+
+    ``poll()`` returns the complete records appended since the last poll,
+    each stamped with ``_file`` (basename) exactly like
+    :func:`~.report.load_events` does. Rotation (same path, new file
+    identity) and truncation (size shrank below our offset) reset the tail
+    to byte 0; a partial trailing line is held in a buffer until the writer
+    finishes it; unparseable lines are skipped, matching ``load_events``'s
+    torn-line tolerance."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.resets = 0
+        self._identity: Optional["tuple[int, int]"] = None
+        self._buf = b""
+
+    def poll(self) -> "list[dict]":
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        identity = (st.st_dev, st.st_ino)
+        if self._identity is not None and identity != self._identity:
+            # rotation: a new file moved in under the same name
+            self.offset = 0
+            self._buf = b""
+            self.resets += 1
+        self._identity = identity
+        if st.st_size < self.offset:
+            # truncation: the writer restarted the file in place
+            self.offset = 0
+            self._buf = b""
+            self.resets += 1
+        if st.st_size == self.offset and not self._buf:
+            return []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read()
+                self.offset = f.tell()
+        except OSError:
+            return []
+        data = self._buf + chunk
+        lines = data.split(b"\n")
+        # the final element is the bytes after the last newline: a torn
+        # trailing line (or b""). Hold it until the writer completes it.
+        self._buf = lines.pop()
+        base = os.path.basename(self.path)
+        records: "list[dict]" = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict):
+                rec["_file"] = base
+                records.append(rec)
+        return records
+
+
+class FleetModel:
+    """The live fold: accumulated records plus cheap per-record state for
+    the dashboard header (replica health, queue depth, supervisor status,
+    episode tallies). The heavy aggregation is NOT duplicated here —
+    :meth:`snapshot_report` defers to the report CLI's
+    :func:`~.report.build_report_from_events` over ``self.records``."""
+
+    def __init__(self):
+        self.records: "list[dict]" = []
+        self.kinds: "dict[str, int]" = {}
+        self.replicas: "dict[str, dict]" = {}
+        self.router_poll: Optional[dict] = None
+        self.supervisor: Optional[dict] = None
+        self.generation = 0
+        self.restarts = 0
+        self.slo_violations = 0
+        self.anomaly_episodes = 0
+        self.canary_probes = 0
+        self.canary_failures = 0
+        self.last_t: Optional[float] = None
+
+    def fold(self, rec: dict) -> None:
+        self.records.append(rec)
+        kind = str(rec.get("kind", "?"))
+        self.kinds[kind] = self.kinds.get(kind, 0) + 1
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            self.last_t = max(self.last_t or 0.0, float(t))
+        if kind == "serving_replica" and rec.get("replica"):
+            self.replicas[str(rec["replica"])] = rec
+        elif kind == "router" and rec.get("phase") == "poll":
+            self.router_poll = rec
+        elif kind == "supervisor":
+            self.supervisor = rec
+            self.generation = max(self.generation, int(rec.get("generation", 0)))
+        elif kind in ("restart", "elastic"):
+            if kind == "restart":
+                self.restarts += 1
+            self.generation = max(self.generation, int(rec.get("generation", 0)))
+        elif kind == "slo_violation":
+            self.slo_violations += 1
+        elif kind == "anomaly":
+            self.anomaly_episodes += 1
+        elif kind == "canary":
+            self.canary_probes += 1
+            if rec.get("result") == "mismatch":
+                self.canary_failures += 1
+
+    def replica_states(self) -> "dict[str, int]":
+        out: "dict[str, int]" = {}
+        for rec in self.replicas.values():
+            state = str(rec.get("state", "?"))
+            out[state] = out.get(state, 0) + 1
+        return dict(sorted(out.items()))
+
+    def snapshot_report(self, by_rank: bool = False) -> dict:
+        return _report.build_report_from_events(list(self.records), by_rank=by_rank)
+
+
+class EventHub:
+    """Tail every stream under ``paths`` into one :class:`FleetModel`.
+
+    ``poll()`` discovers new ``*.jsonl`` files (replicas spawn mid-run),
+    drains each tail, folds the records, and runs them through the anomaly
+    engine; fired episodes are folded back as synthetic ``anomaly``
+    records on :data:`HUB_STREAM`. Returns the newly folded records."""
+
+    def __init__(
+        self,
+        paths: Iterable[str],
+        *,
+        model: Optional[FleetModel] = None,
+        anomaly: Optional[_anomaly.AnomalyEngine] = None,
+    ):
+        self.paths = list(paths)
+        self.model = model if model is not None else FleetModel()
+        self.anomaly = anomaly
+        self._tails: "dict[str, FileTail]" = {}
+        self.polls = 0
+
+    def _discover(self) -> None:
+        for path in _report.iter_event_files(self.paths):
+            if path not in self._tails:
+                self._tails[path] = FileTail(path)
+
+    def poll(self) -> "list[dict]":
+        self._discover()
+        new: "list[dict]" = []
+        for path in sorted(self._tails):
+            for rec in self._tails[path].poll():
+                self.model.fold(rec)
+                new.append(rec)
+                if self.anomaly is None:
+                    continue
+                for fired in self.anomaly.observe_record(rec):
+                    synthetic = dict(fired)
+                    synthetic["kind"] = "anomaly"
+                    synthetic["_file"] = HUB_STREAM
+                    self.model.fold(synthetic)
+                    new.append(synthetic)
+        self.polls += 1
+        return new
+
+
+def render_top(model: FleetModel, *, frame: Optional[int] = None) -> str:
+    """One dashboard frame over the FleetModel.
+
+    The header lines come from the model's cheap fold state; every section
+    body is the report CLI's own formatter over
+    :meth:`FleetModel.snapshot_report` — live and post-hoc views are the
+    same code, so their numbers cannot drift apart."""
+    report = model.snapshot_report()
+    runs = ", ".join(report.get("runs") or []) or "<none>"
+    frame_s = f", frame {frame}" if frame is not None else ""
+    lines = [
+        f"fleet top — run(s): {runs}, {report.get('processes') or 0} process(es), "
+        f"{report['events']} record(s){frame_s}"
+    ]
+    if model.replicas:
+        states = ", ".join(f"{k}={v}" for k, v in model.replica_states().items())
+        lines.append(f"  replicas: {len(model.replicas)} ({states})")
+    if model.router_poll is not None:
+        rp = model.router_poll
+        lines.append(
+            f"  router: queued={rp.get('queued', 0)} inflight={rp.get('inflight', 0)} "
+            f"completed={rp.get('completed', 0)} shed={rp.get('shed', 0)} "
+            f"failovers={rp.get('failovers', 0)}"
+        )
+    if model.supervisor is not None:
+        sup = model.supervisor
+        lines.append(
+            f"  supervisor: generation {int(sup.get('generation', 0))}, "
+            f"{int(sup.get('processes', 0))} process(es), "
+            f"restarts {int(sup.get('restarts_used', 0))}/{sup.get('max_restarts', '?')}"
+        )
+    if model.anomaly_episodes or model.slo_violations or model.canary_failures:
+        lines.append(
+            f"  ALERTS: {model.anomaly_episodes} anomaly episode(s), "
+            f"{model.slo_violations} slo violation(s), "
+            f"{model.canary_failures} canary failure(s)"
+        )
+    s = report["steps"]
+    if s["count"]:
+        d = s["wall_s"]
+        lines.append(
+            f"steps: {s['count']}  p50={d['p50'] * 1e3:.2f}ms  "
+            f"p99={d['p99'] * 1e3:.2f}ms  max={d['max'] * 1e3:.2f}ms"
+        )
+    serving = report.get("serving")
+    if serving:
+        lines.append(_report.format_serving_section(serving))
+    router = report.get("router")
+    if router:
+        lines.append(_report.format_router_section(router))
+    autoscaler = report.get("autoscaler")
+    if autoscaler:
+        lines.append(_report.format_autoscaler_section(autoscaler))
+    slo = report.get("slo")
+    if slo:
+        lines.append(_report.format_slo_section(slo))
+    anomalies = report.get("anomalies")
+    if anomalies and anomalies.get("episodes"):
+        lines.append(_report.format_anomaly_section(anomalies))
+    canary = report.get("canary")
+    if canary and canary.get("probes"):
+        lines.append(_report.format_canary_section(canary))
+    rs = report.get("restarts") or {}
+    if rs.get("count") or rs.get("chaos_faults"):
+        lines.append(
+            f"restarts: {rs.get('count', 0)} over {rs.get('generations', 0) + 1} "
+            f"generation(s), downtime {rs.get('downtime_s', 0.0):.1f}s"
+        )
+    ccache = report.get("compile_cache")
+    if ccache:
+        lines.append(_report.format_compile_cache_section(ccache))
+    gp = report.get("goodput")
+    if gp:
+        lines.append(_goodput.verdict_line(gp))
+    return "\n".join(lines)
+
+
+def run_top(
+    paths: Iterable[str],
+    *,
+    once: bool = False,
+    interval_s: float = 2.0,
+    max_ticks: Optional[int] = None,
+    sleep: Optional[Callable[[float], Any]] = None,
+    out=None,
+    anomaly: Optional[_anomaly.AnomalyEngine] = None,
+) -> int:
+    """The ``telemetry top`` loop: poll, render, clear, repeat.
+
+    ``once`` renders a single frame with no ANSI clear (tests, CI, piping
+    into files); ``max_ticks`` bounds a live run; ``sleep`` is injectable
+    so tests run at machine speed."""
+    out = out if out is not None else sys.stdout
+    sleep_fn = sleep if sleep is not None else time.sleep
+    engine = anomaly if anomaly is not None else _anomaly.AnomalyEngine()
+    hub = EventHub(paths, anomaly=engine)
+    frame = 0
+    while True:
+        hub.poll()
+        frame += 1
+        if once:
+            out.write(render_top(hub.model) + "\n")
+            out.flush()
+            return 0
+        out.write(ANSI_CLEAR + render_top(hub.model, frame=frame) + "\n")
+        out.flush()
+        if max_ticks is not None and frame >= max_ticks:
+            return 0
+        sleep_fn(interval_s)
+
+
+def run_follow(
+    paths: Iterable[str],
+    *,
+    by_rank: bool = False,
+    interval_s: float = 2.0,
+    max_ticks: Optional[int] = None,
+    sleep: Optional[Callable[[float], Any]] = None,
+    out=None,
+    anomaly: Optional[_anomaly.AnomalyEngine] = None,
+) -> int:
+    """``report --follow``: re-render the full post-hoc report whenever the
+    tailed streams grow — the streaming flavor of the same aggregation."""
+    out = out if out is not None else sys.stdout
+    sleep_fn = sleep if sleep is not None else time.sleep
+    engine = anomaly if anomaly is not None else _anomaly.AnomalyEngine()
+    hub = EventHub(paths, anomaly=engine)
+    ticks = 0
+    while True:
+        new = hub.poll()
+        ticks += 1
+        if new:
+            report = hub.model.snapshot_report(by_rank=by_rank)
+            out.write(
+                f"\n==== follow: +{len(new)} record(s), "
+                f"{len(hub.model.records)} total ====\n"
+            )
+            out.write(_report.format_report(report) + "\n")
+            out.flush()
+        if max_ticks is not None and ticks >= max_ticks:
+            return 0
+        sleep_fn(interval_s)

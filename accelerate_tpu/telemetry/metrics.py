@@ -416,6 +416,7 @@ class MetricsRegistry:
 
 _ACTIVE: Optional[MetricsRegistry] = None
 _SERVER = None  # (http.server instance, thread)
+_SHUTTING_DOWN = False  # /healthz readiness: flipped before the socket dies
 _LAST_SNAPSHOT = 0.0
 _SNAPSHOT_LOCK = threading.Lock()
 #: snapshot throttle, parsed ONCE at enable() (the hot loops call
@@ -517,7 +518,8 @@ def serve(port: int, host: str = "127.0.0.1"):
     (``EADDRINUSE`` — e.g. a child process inheriting the parent's
     ``ACCELERATE_METRICS_PORT``) degrades to registry-only with a warning
     instead of killing engine construction."""
-    global _SERVER
+    global _SERVER, _SHUTTING_DOWN
+    _SHUTTING_DOWN = False
     if _SERVER is not None:
         bound = _SERVER[0].server_address[1]
         if int(port) not in (0, bound):
@@ -533,7 +535,21 @@ def serve(port: int, host: str = "127.0.0.1"):
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (http.server API)
-            if self.path.split("?")[0] not in ("/metrics", "/"):
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                # readiness, not content: external probes (k8s, the fleet
+                # supervisor) ask this instead of scraping-and-parsing.
+                # 200 while the registry is live, 503 once shutdown began
+                # so load balancers stop routing before the socket dies.
+                ok = _ACTIVE is not None and not _SHUTTING_DOWN
+                body = (b"ok\n" if ok else b"shutting down\n")
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path not in ("/metrics", "/"):
                 self.send_response(404)
                 self.end_headers()
                 return
@@ -569,7 +585,10 @@ def server_port() -> Optional[int]:
 
 
 def stop_server() -> None:
-    global _SERVER
+    global _SERVER, _SHUTTING_DOWN
+    # flip readiness FIRST: a /healthz probe racing the shutdown sees 503
+    # and stops routing before the socket actually closes
+    _SHUTTING_DOWN = True
     if _SERVER is None:
         return
     server, thread = _SERVER

@@ -22,7 +22,16 @@ The **metric registry** (:data:`DEFAULT_SPECS`) gives each metric family a
 direction (higher/lower is better), a relative noise tolerance (doubled on
 CPU fingerprints — CI boxes are loud), and an optional hard bar that flags a
 candidate regardless of the baseline (a 0.0 headline is a dead run, not a
-slow one). :func:`register` prepends project-specific specs."""
+slow one). :func:`register` prepends project-specific specs.
+
+**Waivers** let a justified, documented exception ride without editing
+committed payloads: ``--waive METRIC[=reason]`` (repeatable, fnmatch
+patterns allowed), ``--waiver-file PATH``, or — in ``--scan`` mode — an
+auto-discovered :data:`WAIVER_FILENAME` file next to the payloads (one
+``metric  # reason`` per line). A waived regression still prints its full
+REGRESSION row plus a loud ``WAIVED`` marker and is named again in the
+verdict line; it just stops failing the gate (exit 0 when every regression
+is waived). Silence is the one thing a waiver must never buy."""
 
 from __future__ import annotations
 
@@ -258,6 +267,12 @@ def _format_comparison(base_name: str, cand_name: str, fp: dict,
             f"({delta}, tol {v['tolerance_pct']:g}%, "
             f"{v['direction']} is better{extra})"
         )
+        if v.get("waived"):
+            # a waiver buys the exit code, never silence: the REGRESSION
+            # row above stays, and the waiver justifies itself here
+            lines.append(
+                f"  ^ WAIVED   {v['metric']:<{width}}  {v['waiver_reason']}"
+            )
     return lines
 
 
@@ -267,13 +282,79 @@ def scan_dir(directory: str) -> "list[str]":
     return sorted(glob.glob(os.path.join(directory, "BENCH_*.json")))
 
 
+# ---------------------------------------------------------------------------
+# waivers
+
+#: auto-discovered next to the payloads in --scan mode
+WAIVER_FILENAME = "BENCH_WAIVERS"
+
+
+def parse_waiver_line(line: str) -> "Optional[tuple[str, str]]":
+    """``metric  # reason`` -> (metric, reason); None for blanks/comments."""
+    body, _, comment = line.partition("#")
+    body = body.strip()
+    if not body:
+        return None
+    metric = body.split()[0]
+    return metric, (comment.strip() or "no reason recorded")
+
+
+def load_waiver_file(path: str) -> "dict[str, str]":
+    waivers: "dict[str, str]" = {}
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return waivers
+    for line in lines:
+        parsed = parse_waiver_line(line)
+        if parsed is not None:
+            waivers[parsed[0]] = parsed[1]
+    return waivers
+
+
+def waiver_for(metric: str, waivers: "dict[str, str]") -> Optional[str]:
+    """The waiver reason covering ``metric``, or None. Keys are fnmatch
+    patterns (case-insensitive, like the metric registry); an exact name
+    is its own pattern."""
+    low = metric.lower()
+    for pattern, reason in waivers.items():
+        if fnmatch.fnmatch(low, pattern.lower()):
+            return reason
+    return None
+
+
 def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
-                as_json: bool = False, scan: Optional[str] = None) -> int:
+                as_json: bool = False, scan: Optional[str] = None,
+                waive: Optional["list[str]"] = None,
+                waiver_file: Optional[str] = None) -> int:
     """CLI body. With ``scan``, compares the two newest usable payloads in
     the directory; with explicit paths, the first is the baseline and every
-    later payload is compared against it."""
+    later payload is compared against it. ``waive`` entries are
+    ``METRIC[=reason]``; ``waiver_file`` (and, in scan mode, an
+    auto-discovered ``BENCH_WAIVERS`` next to the payloads) add more."""
     out_lines: "list[str]" = []
     result: dict = {"comparisons": [], "refusals": []}
+
+    waivers: "dict[str, str]" = {}
+    if scan:
+        auto = os.path.join(scan, WAIVER_FILENAME)
+        loaded_auto = load_waiver_file(auto)
+        if loaded_auto:
+            out_lines.append(
+                f"regress: loaded {len(loaded_auto)} waiver(s) from {auto}"
+            )
+            waivers.update(loaded_auto)
+    if waiver_file:
+        loaded_file = load_waiver_file(waiver_file)
+        if not loaded_file:
+            out_lines.append(
+                f"regress: waiver file {waiver_file} has no usable entries"
+            )
+        waivers.update(loaded_file)
+    for entry in waive or []:
+        metric, _, reason = entry.partition("=")
+        waivers[metric.strip()] = reason.strip() or "waived on the command line"
 
     if scan:
         paths = scan_dir(scan)
@@ -294,6 +375,7 @@ def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
     base_name, baseline = loaded[0]
     base_fp = fingerprint(baseline)
     regressions: "list[str]" = []
+    waived: "dict[str, str]" = {}
     improved = noise = 0
     refused = False
     for cand_name, candidate in loaded[1:]:
@@ -313,6 +395,12 @@ def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
             refused = True
             continue
         verdicts = compare_metrics(baseline, candidate, tolerance=tolerance)
+        for v in verdicts:
+            if v["verdict"] == REGRESSION:
+                reason = waiver_for(v["metric"], waivers)
+                if reason is not None:
+                    v["waived"] = True
+                    v["waiver_reason"] = reason
         out_lines.extend(_format_comparison(base_name, cand_name, cand_fp, verdicts))
         result["comparisons"].append({
             "baseline": base_name, "candidate": cand_name,
@@ -320,12 +408,16 @@ def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
         })
         for v in verdicts:
             if v["verdict"] == REGRESSION:
-                regressions.append(v["metric"])
+                if v.get("waived"):
+                    waived[v["metric"]] = v["waiver_reason"]
+                else:
+                    regressions.append(v["metric"])
             elif v["verdict"] == IMPROVED:
                 improved += 1
             else:
                 noise += 1
 
+    waived_s = "; ".join(f"{m} ({r})" for m, r in sorted(waived.items()))
     if refused:
         rc = 2
         summary = "regress verdict: REFUSED (mismatched environment fingerprints)"
@@ -334,6 +426,14 @@ def run_regress(paths: "list[str]", tolerance: Optional[float] = None,
         summary = (
             f"regress verdict: REGRESSION — {len(regressions)} metric(s): "
             + ", ".join(sorted(set(regressions)))
+        )
+        if waived:
+            summary += f"; {len(waived)} more WAIVED: {waived_s}"
+    elif waived:
+        rc = 0
+        summary = (
+            f"regress verdict: OK with {len(waived)} regression(s) WAIVED: "
+            f"{waived_s} — {improved} improved, {noise} within noise"
         )
     else:
         rc = 0
@@ -361,6 +461,13 @@ def add_parser(sub) -> None:
                    help="override every spec's relative noise tolerance")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the structured comparison dict")
+    p.add_argument("--waive", action="append", metavar="METRIC[=REASON]",
+                   help="waive a regressing metric (repeatable; fnmatch "
+                        "patterns allowed; waivers print loudly)")
+    p.add_argument("--waiver-file", metavar="PATH",
+                   help="file of 'metric  # reason' lines to waive; in "
+                        "--scan mode a BENCH_WAIVERS file next to the "
+                        "payloads is picked up automatically")
 
 
 def run_from_args(args) -> int:
@@ -368,4 +475,6 @@ def run_from_args(args) -> int:
         print("regress: pass payload files or --scan DIR")
         return 2
     return run_regress(args.paths, tolerance=args.tolerance,
-                       as_json=args.as_json, scan=args.scan)
+                       as_json=args.as_json, scan=args.scan,
+                       waive=getattr(args, "waive", None),
+                       waiver_file=getattr(args, "waiver_file", None))

@@ -775,6 +775,21 @@ def _autoscaler_section(events: "list[dict]") -> Optional[dict]:
 
 def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
     events = load_events(paths)
+    return build_report_from_events(events, by_rank=by_rank, paths=paths)
+
+
+def build_report_from_events(
+    events: "list[dict]", by_rank: bool = False, paths: Optional[Iterable[str]] = None
+) -> dict:
+    """Build the report from already-loaded records.
+
+    This is THE aggregation path: :func:`build_report` is ``load_events``
+    plus this, and the live hub (:mod:`.hub`) feeds its tailed stream
+    through the same function — the shared-formatter invariant (live and
+    post-hoc views render the same numbers for the same records) holds
+    because there is only one fold. Records must be in per-file order
+    (``load_events`` and the hub's tailing both guarantee that; sections
+    only rely on within-file ordering)."""
     metas = [e for e in events if e.get("kind") == "meta"]
     steps = [e for e in events if e.get("kind") == "step"]
     misses = [e for e in events if e.get("kind") == "jit_cache_miss"]
@@ -907,11 +922,65 @@ def build_report(paths: Iterable[str], by_rank: bool = False) -> dict:
         ),
         "restarts": _restarts_section(events),
         "compile_cache": _compile_cache_section(events),
+        "anomalies": _anomaly_section(events),
+        "canary": _canary_section(events),
         "goodput": _goodput.build_ledger(events, by_rank=by_rank),
     }
     if by_rank:
-        report["ranks"] = _rank_section(events, file_rank, paths)
+        report["ranks"] = _rank_section(events, file_rank, paths or [])
     return report
+
+
+def _anomaly_section(events: "list[dict]") -> dict:
+    """Fold the online detectors' ``anomaly`` records (:mod:`.anomaly`):
+    episode counts per detector plus the most recent episode's cause
+    hypothesis — the post-hoc trace of what the live plane paged on."""
+    recs = [e for e in events if e.get("kind") == "anomaly"]
+    by_det: dict = {}
+    for r in recs:
+        det = str(r.get("detector", "?"))
+        ent = by_det.setdefault(det, {"episodes": 0, "last": None})
+        ent["episodes"] += 1
+        ent["last"] = {
+            "value": r.get("value"),
+            "z": r.get("z"),
+            "slope": r.get("slope"),
+            "cause": r.get("cause"),
+            "source": r.get("source"),
+        }
+    return {"episodes": len(recs), "by_detector": dict(sorted(by_det.items()))}
+
+
+def _canary_section(events: "list[dict]") -> dict:
+    """Fold the router's ``canary`` / ``canary_failure`` records
+    (:mod:`accelerate_tpu.serving.canary`): per-replica probe pass/fail
+    tallies and the named bitwise mismatches."""
+    probes = [e for e in events if e.get("kind") == "canary"]
+    failures = [e for e in events if e.get("kind") == "canary_failure"]
+    by_replica: dict = {}
+    for p in probes:
+        name = str(p.get("replica", "?"))
+        ent = by_replica.setdefault(name, {"probes": 0, "failures": 0})
+        ent["probes"] += 1
+        if p.get("result") == "mismatch":
+            ent["failures"] += 1
+    return {
+        "probes": len(probes),
+        "failures": len(failures),
+        "by_replica": dict(sorted(by_replica.items())),
+        "mismatches": [
+            {
+                "replica": f.get("replica"),
+                "rid": f.get("rid"),
+                "golden": f.get("golden"),
+                "mismatch_index": f.get("mismatch_index"),
+                "expected_token": f.get("expected_token"),
+                "got_token": f.get("got_token"),
+                "drained": bool(f.get("drained")),
+            }
+            for f in failures
+        ],
+    }
 
 
 def _restarts_section(events: "list[dict]") -> dict:
@@ -1076,6 +1145,12 @@ def format_report(report: dict) -> str:
     slo = report.get("slo")
     if slo:
         lines.append(format_slo_section(slo))
+    anomalies = report.get("anomalies")
+    if anomalies and anomalies.get("episodes"):
+        lines.append(format_anomaly_section(anomalies))
+    canary = report.get("canary")
+    if canary and canary.get("probes"):
+        lines.append(format_canary_section(canary))
     if report.get("traces"):
         lines.append(
             f"traces: {report['traces']} request trace(s) recorded — "
@@ -1336,6 +1411,47 @@ def format_compile_cache_section(ccache: dict) -> str:
         lines.append(
             f"  WARNING: supervisor pre-touch found the cache {parts} — "
             "those generations cold-started"
+        )
+    return "\n".join(lines)
+
+
+def format_anomaly_section(anomalies: dict) -> str:
+    """Human rendering of the online detectors' episode fold
+    (:mod:`~accelerate_tpu.telemetry.anomaly`)."""
+    lines = [f"anomalies: {anomalies.get('episodes', 0)} episode(s)"]
+    for det, ent in (anomalies.get("by_detector") or {}).items():
+        last = ent.get("last") or {}
+        detail = []
+        if last.get("z") is not None:
+            detail.append(f"z={last['z']:.1f}")
+        if last.get("slope") is not None:
+            detail.append(f"slope={last['slope']:.4f}")
+        if last.get("source"):
+            detail.append(f"source={last['source']}")
+        suffix = f" ({', '.join(detail)})" if detail else ""
+        lines.append(f"  {det}: {ent.get('episodes', 0)} episode(s){suffix}")
+        if last.get("cause"):
+            lines.append(f"    hypothesis: {last['cause']}")
+    return "\n".join(lines)
+
+
+def format_canary_section(canary: dict) -> str:
+    """Human rendering of the bitwise correctness-canary fold
+    (:mod:`~accelerate_tpu.serving.canary`)."""
+    failures = canary.get("failures", 0)
+    verdict = "ALL BITWISE" if not failures else f"{failures} MISMATCH(ES)"
+    lines = [f"canaries: {canary.get('probes', 0)} probe(s), {verdict}"]
+    for name, ent in (canary.get("by_replica") or {}).items():
+        lines.append(
+            f"  {name}: {ent.get('probes', 0)} probe(s), "
+            f"{ent.get('failures', 0)} failure(s)"
+        )
+    for m in canary.get("mismatches") or []:
+        drained = ", replica drained" if m.get("drained") else ""
+        lines.append(
+            f"  MISMATCH on {m.get('replica')}: golden {m.get('golden')} token "
+            f"{m.get('mismatch_index')} expected {m.get('expected_token')} "
+            f"got {m.get('got_token')}{drained}"
         )
     return "\n".join(lines)
 
@@ -1922,6 +2038,20 @@ def run_doctor() -> int:
             _doctor_spec_decode(tmp, _check)
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("speculative decoding", False, f"{type(exc).__name__}: {exc}")
+
+        # 20. live observability plane (ISSUE 19): a supervised fleet under
+        # seeded chaos (one SIGKILL restart, one injected slow fault) tailed
+        # LIVE by the hub while its streams grow — the step-latency detector
+        # must fire exactly one episode with a cause hypothesis, a seeded
+        # canary corruption (one replica built from different param_seed)
+        # must drain the bad replica with the bitwise mismatch named and
+        # zero false positives on the healthy one, and `top --once` must
+        # render the degraded fleet through the report CLI's own section
+        # formatters (the shared-formatter invariant, asserted string-exact)
+        try:
+            _doctor_live_plane(tmp, _check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("live observability plane", False, f"{type(exc).__name__}: {exc}")
 
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
@@ -2787,6 +2917,172 @@ def _doctor_performance_section(tmp: str, _check) -> None:
     _check("performance report section", ok, f"performance={perf_section}")
 
 
+def _doctor_live_plane(tmp: str, _check) -> None:
+    """Doctor check 20 body: the live observability plane end to end.
+
+    Four sub-scenarios share one telemetry dir: (a) a supervised child is
+    SIGKILLed in generation 0 and completes in generation 1, streaming
+    live ``supervisor`` status records; (b) the hub tails a rank stream
+    WHILE it grows — across a slow-step burst and a torn trailing line —
+    and the step-latency detector fires exactly one episode, live, with a
+    cause hypothesis; (c) a two-replica CPU fleet under a seeded slow
+    fault runs bitwise canaries where one replica's params come from a
+    different seed (genuinely corrupt weights): the bad replica must
+    drain on its first mismatch with the differing token named, and the
+    healthy replica must show zero false positives; (d) ``top --once``
+    over the same dir must contain the post-hoc report's router and
+    canary sections string-exact — the shared-formatter invariant."""
+    import dataclasses
+    import io
+    import sys
+    import time
+
+    from ..models import LlamaConfig
+    from ..resilience import chaos
+    from ..resilience.chaos import ChaosSchedule, Fault
+    from ..resilience.supervisor import RestartPolicy, Supervisor
+    from ..serving import (
+        CanaryProbe,
+        LocalReplica,
+        ReplicaSpec,
+        ReplicaState,
+        ServingRouter,
+        precompute_goldens,
+    )
+    from . import events as tel_events
+    from .anomaly import AnomalyEngine
+    from .hub import EventHub, run_top
+
+    live_dir = os.path.join(tmp, "live")
+    os.makedirs(live_dir, exist_ok=True)
+
+    # (a) supervised fleet under seeded SIGKILL: generation 0 kills itself,
+    # generation 1 completes; status_interval_s=0 streams a `supervisor`
+    # status record every watch iteration for the hub to fold live.
+    child = (
+        "import os, signal\n"
+        "if os.environ.get('ACCELERATE_RESTART_GENERATION', '0') == '0':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    sup = Supervisor(
+        [[sys.executable, "-c", child]],
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.05, grace_period_s=1.0),
+        telemetry_dir=live_dir,
+        status_interval_s=0.0,
+    )
+    sup_rc = sup.run()
+
+    # (b) tail a stream WHILE it grows: three installments with a hub poll
+    # between each — warmup steps, then a slow burst ending in a torn
+    # line, then the torn line's completion. The burst must fire exactly
+    # one live episode; the torn record must parse exactly once, whole.
+    hub = EventHub([live_dir], anomaly=AnomalyEngine(emit_records=False))
+    hub.poll()
+    sup_folded = hub.model.supervisor is not None and hub.model.generation == 1
+    rank_path = os.path.join(live_dir, "events-rank7.jsonl")
+    with open(rank_path, "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "doctor-live",
+                            "process_index": 7, "num_processes": 8}) + "\n")
+        for s in range(30):
+            f.write(json.dumps({"kind": "step", "step": s, "t": float(s),
+                                "dur_s": 0.01, "execute_s": 0.01}) + "\n")
+    n1 = len(hub.poll())
+    episodes_warm = hub.anomaly.step_latency.episodes
+    with open(rank_path, "a") as f:
+        for s in range(30, 36):
+            f.write(json.dumps({"kind": "step", "step": s, "t": float(s),
+                                "dur_s": 0.2, "execute_s": 0.2}) + "\n")
+        f.write('{"kind": "step", "step": 36, "t"')  # torn mid-record
+    n2 = len(hub.poll())  # 6 slow steps + 1 synthetic anomaly record
+    episodes_live = hub.anomaly.step_latency.episodes
+    with open(rank_path, "a") as f:
+        f.write(': 36.0, "dur_s": 0.01}\n')  # the writer finishes the line
+    n3 = len(hub.poll())
+    first_anomaly = hub.anomaly.anomalies[0] if hub.anomaly.anomalies else {}
+    tail_ok = (
+        sup_folded
+        and n1 == 31 and episodes_warm == 0
+        and n2 == 7 and episodes_live == 1
+        and n3 == 1
+        and hub.anomaly.step_latency.episodes == 1  # hysteresis held
+        and "straggler" in str(first_anomaly.get("cause"))
+        and first_anomaly.get("source") == "events-rank7.jsonl"
+    )
+
+    # (c) bitwise canaries against a seeded corruption: the bad replica
+    # shares the fleet spec but builds its params from a different seed —
+    # init is deterministic, so its weights are genuinely wrong and its
+    # canary answers diverge bitwise while the healthy replica's match,
+    # even with a seeded slow fault injected into the decode path.
+    config = LlamaConfig.tiny()
+    spec = ReplicaSpec(
+        model=dataclasses.asdict(config), num_blocks=33, block_size=8,
+        max_slots=2, slot_buckets=(2,), block_buckets=(4,), prefill_buckets=(16,),
+    )
+    bad_spec = dataclasses.replace(spec, param_seed=1234)
+    goldens = precompute_goldens(spec, max_new_tokens=6)
+    probe = CanaryProbe(goldens, interval_s=0.05)
+    tel_events.enable(out_dir=live_dir, run_id="doctor-live")
+    router = None
+    try:
+        chaos.arm(ChaosSchedule(
+            faults=[Fault(kind="slow", point="serving_decode", step=4,
+                          duration_s=0.2, once=True)]
+        ))
+        router = ServingRouter(
+            [LocalReplica("good", spec), LocalReplica("bad", bad_spec)],
+            canary=probe,
+            health_timeout_s=10.0,
+        )
+        router.wait_ready(timeout_s=300)
+        deadline = time.monotonic() + 300
+        while (probe.by_replica.get("bad", {}).get("failures", 0) < 1
+               or probe.by_replica.get("good", {}).get("probes", 0) < 1
+               or router._inflight):
+            router.poll()
+            if time.monotonic() > deadline:
+                raise RuntimeError("canary scenario timed out")
+            time.sleep(0.002)
+    finally:
+        chaos.arm(None)
+        if router is not None:
+            router.close()
+        tel_events.disable()
+
+    # (d) the shared-formatter invariant: `top --once` must render the
+    # degraded fleet through the report CLI's own section formatters, so
+    # the post-hoc report's router and canary sections appear in the live
+    # frame string-exact.
+    post = build_report([live_dir])
+    canary_sec = post.get("canary") or {}
+    mismatches = canary_sec.get("mismatches") or []
+    buf = io.StringIO()
+    rc_top = run_top([live_dir], once=True, out=buf)
+    frame = buf.getvalue()
+    shared_ok = (
+        rc_top == 0
+        and format_router_section(post.get("router") or {}) in frame
+        and format_canary_section(canary_sec) in frame
+        and "bad: draining" in frame
+    )
+    canary_ok = (
+        router.replicas["bad"].state is ReplicaState.DRAINING
+        and probe.by_replica.get("good", {}).get("failures") == 0
+        and probe.by_replica.get("bad", {}).get("failures", 0) >= 1
+        and bool(mismatches)
+        and mismatches[0].get("replica") == "bad"
+        and mismatches[0].get("mismatch_index") is not None
+    )
+    ok = sup_rc == 0 and sup.restarts_used == 1 and tail_ok and canary_ok and shared_ok
+    _check(
+        "live observability plane",
+        ok,
+        f"sup_rc={sup_rc} restarts={sup.restarts_used} tail_ok={tail_ok} "
+        f"(n1={n1} n2={n2} n3={n3} episodes={hub.anomaly.step_latency.episodes}) "
+        f"canary_ok={canary_ok} (probe={probe.stats()}) shared_ok={shared_ok}",
+    )
+
+
 def main(argv: Optional["list[str]"] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m accelerate_tpu.telemetry",
@@ -2814,6 +3110,42 @@ def main(argv: Optional["list[str]"] = None) -> int:
         help="write the span records as a Chrome trace.json (with --request: "
         "that request only; alone: every recorded trace)",
     )
+    rep.add_argument(
+        "--follow",
+        action="store_true",
+        help="stream: tail the event files live and re-render the report "
+        "whenever they grow (telemetry/hub.py)",
+    )
+    rep.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="poll interval for --follow / top (seconds, default 2)",
+    )
+    rep.add_argument(
+        "--follow-ticks",
+        type=int,
+        default=None,
+        metavar="N",
+        help="stop --follow after N polls (tests/CI; default: run forever)",
+    )
+    top = sub.add_parser(
+        "top",
+        help="live fleet dashboard over the tailed event streams "
+        "(telemetry/hub.py): replica health, queues, SLO burn, anomalies, "
+        "canaries",
+    )
+    top.add_argument("paths", nargs="+", help="telemetry dir(s) or .jsonl file(s)")
+    top.add_argument(
+        "--once",
+        action="store_true",
+        help="render a single frame with no ANSI clear and exit (tests/CI)",
+    )
+    top.add_argument("--interval", type=float, default=2.0, metavar="S",
+                     help="refresh interval (seconds, default 2)")
+    top.add_argument("--ticks", type=int, default=None, metavar="N",
+                     help="stop after N frames (default: run until ^C)")
     sub.add_parser("doctor", help="self-check the watchdog/flight-recorder/report pipeline")
     _regress.add_parser(sub)
     args = parser.parse_args(argv)
@@ -2821,9 +3153,25 @@ def main(argv: Optional["list[str]"] = None) -> int:
         return run_doctor()
     if args.command == "regress":
         return _regress.run_from_args(args)
+    if args.command == "top":
+        # lazy import: hub imports this module — the CLI edge must not
+        # turn that into an import cycle at load time
+        from . import hub as _hub
+
+        return _hub.run_top(
+            args.paths, once=args.once, interval_s=args.interval,
+            max_ticks=args.ticks,
+        )
     if args.command != "report":
         parser.print_help()
         return 2
+    if args.follow:
+        from . import hub as _hub
+
+        return _hub.run_follow(
+            args.paths, by_rank=args.by_rank, interval_s=args.interval,
+            max_ticks=args.follow_ticks,
+        )
     if args.request is not None:
         rc, text = render_request(args.paths, args.request, trace_out=args.trace_out)
         print(text)

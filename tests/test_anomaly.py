@@ -1,0 +1,251 @@
+"""Online anomaly detectors (telemetry/anomaly.py): EWMA z-score math,
+hysteresis (one record per episode), directionality, trend/leak detection,
+record routing through the AnomalyEngine, anomaly record + counter emission,
+and the disabled path touching zero state."""
+
+import json
+
+import pytest
+
+from accelerate_tpu.telemetry import events as tel_events
+from accelerate_tpu.telemetry import metrics
+from accelerate_tpu.telemetry.anomaly import (
+    ANOMALIES_TOTAL,
+    AnomalyEngine,
+    EwmaDetector,
+    TrendDetector,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    tel_events.disable()
+    metrics.disable()
+
+
+# ------------------------------------------------------------ EwmaDetector --
+
+
+def test_ewma_warmup_never_fires():
+    """The first min_samples observations only train the estimate — a
+    detector must never page off its own cold start, even on a wild series."""
+    det = EwmaDetector("d", min_samples=16)
+    fired = [det.observe(v) for v in [0.01, 100.0, -50.0, 0.01] * 4]
+    assert all(f is None for f in fired)
+    assert det.episodes == 0 and det.count == 16
+
+
+def test_ewma_fires_on_high_outlier_with_context():
+    det = EwmaDetector("lat", min_samples=16)
+    for _ in range(30):
+        assert det.observe(0.01) is None
+    rec = det.observe(0.5, source="events-rank3.jsonl")
+    assert rec is not None
+    assert rec["detector"] == "lat" and rec["episode"] == 1
+    assert rec["z"] >= det.z_enter and rec["value"] == 0.5
+    assert rec["samples"] == 30 and rec["source"] == "events-rank3.jsonl"
+
+
+def test_ewma_hysteresis_one_record_per_episode():
+    """A sustained excursion is ONE episode: the entry fires, the plateau
+    stays silent, recovery re-arms, and a second excursion fires again."""
+    det = EwmaDetector("lat", min_samples=16, alpha=0.1)
+    for _ in range(30):
+        det.observe(0.01)
+    fired = [det.observe(0.5) for _ in range(6)]          # excursion
+    assert sum(f is not None for f in fired) == 1
+    for _ in range(40):                                    # recovery re-arms
+        det.observe(0.01)
+    assert not det.in_episode
+    fired2 = [det.observe(0.5) for _ in range(6)]          # second excursion
+    assert sum(f is not None for f in fired2) == 1
+    assert det.episodes == 2
+
+
+def test_ewma_level_shift_becomes_the_new_normal():
+    """The outlier feeds the estimate AFTER being scored, so a persistent
+    level shift converges and the episode closes on its own."""
+    det = EwmaDetector("lat", min_samples=16, alpha=0.2)
+    for _ in range(30):
+        det.observe(0.01)
+    for _ in range(60):
+        det.observe(0.5)
+    assert det.episodes == 1 and not det.in_episode
+    assert det.mean == pytest.approx(0.5, rel=0.05)
+
+
+def test_ewma_direction_low_and_both():
+    low = EwmaDetector("rate", min_samples=16, direction="low")
+    for _ in range(40):
+        low.observe(0.9)
+    assert low.observe(0.0) is not None      # collapse fires
+    spike = EwmaDetector("rate2", min_samples=16, direction="low")
+    for _ in range(40):
+        spike.observe(0.9)
+    assert spike.observe(5.0) is None        # high excursion is fine for "low"
+    both = EwmaDetector("skew", min_samples=16, direction="both")
+    for _ in range(30):
+        both.observe(0.0)
+        both.observe(0.02)
+    assert both.observe(-5.0) is not None    # either side fires
+    with pytest.raises(ValueError):
+        EwmaDetector("bad", direction="sideways")
+    with pytest.raises(ValueError):
+        EwmaDetector("bad", z_enter=2.0, z_exit=3.0)
+
+
+def test_ewma_min_std_floors_flat_series():
+    """A perfectly flat warmup must not turn the first jitter into an
+    infinite z-score — min_std floors the variance, and the cause falls
+    back to the detector's configured hypothesis."""
+    det = EwmaDetector("flat", min_samples=4, min_std=0.05, cause="stock cause")
+    for _ in range(60):
+        det.observe(1.0)  # long enough for the EWMA variance to decay flat
+    assert det.observe(1.01) is None         # 0.01 / 0.05 = z 0.2, in band
+    rec = det.observe(2.0)                   # 1.0 / 0.05 = z 20, fires
+    assert rec is not None and rec["cause"] == "stock cause"
+    assert rec["std"] >= 0.05
+
+
+def test_ewma_hypothesis_overrides_stock_cause():
+    det = EwmaDetector("lat", min_samples=4, cause="stock cause")
+    for _ in range(10):
+        det.observe(0.01)
+    rec = det.observe(9.0, hypothesis="recompilation")
+    assert rec is not None and rec["cause"] == "recompilation"
+
+
+# ----------------------------------------------------------- TrendDetector --
+
+
+def test_trend_fires_on_sustained_drift_not_on_noise():
+    """Block-pool leak signature: occupancy creeping up forever fires; a
+    stationary noisy series never does."""
+    leak = TrendDetector("leak", min_samples=30, slope_enter=0.002)
+    fired = [leak.observe(0.3 + 0.005 * i) for i in range(60)]
+    assert sum(f is not None for f in fired) == 1
+    assert leak.episodes == 1 and leak.in_episode
+    flat = TrendDetector("flat", min_samples=30, slope_enter=0.002)
+    fired = [flat.observe(0.3 + 0.01 * (i % 2)) for i in range(120)]
+    assert all(f is None for f in fired)
+
+
+def test_trend_hysteresis_rearms_after_plateau():
+    det = TrendDetector("leak", min_samples=10, slope_enter=0.01)
+    for i in range(40):
+        det.observe(0.1 + 0.02 * i)          # drift: one episode
+    assert det.episodes == 1
+    for _ in range(60):
+        det.observe(0.9)                     # plateau: slope decays, re-arms
+    assert not det.in_episode
+    for i in range(40):
+        det.observe(0.9 + 0.02 * i)          # second drift: second episode
+    assert det.episodes == 2
+
+
+# ----------------------------------------------------------- AnomalyEngine --
+
+
+def _steps(n, dur, start=0):
+    return [{"kind": "step", "step": start + i, "t": float(start + i),
+             "dur_s": dur, "execute_s": dur} for i in range(n)]
+
+
+def test_engine_routes_step_latency_with_hypothesis():
+    eng = AnomalyEngine(emit_records=False)
+    for rec in _steps(30, 0.01):
+        assert eng.observe_record(rec) == []
+    slow = {"kind": "step", "step": 30, "t": 30.0, "dur_s": 0.4,
+            "execute_s": 0.1, "compile_s": 0.3, "_file": "events-rank0.jsonl"}
+    fired = eng.observe_record(slow)
+    assert len(fired) == 1
+    assert fired[0]["detector"] == "step_latency"
+    assert "recompilation" in fired[0]["cause"]
+    assert fired[0]["source"] == "events-rank0.jsonl"
+
+
+def test_engine_step_hypothesis_data_wait_and_fallback():
+    eng = AnomalyEngine()
+    stall = {"kind": "step", "dur_s": 0.4, "data_wait_s": 0.3}
+    assert "input pipeline" in eng._step_hypothesis(stall)
+    opaque = {"kind": "step", "dur_s": 0.4, "data_wait_s": 0.01}
+    assert eng._step_hypothesis(opaque) is None  # falls back to stock cause
+
+
+def test_engine_routes_ttft_spec_accept_heartbeat_and_leak():
+    eng = AnomalyEngine(emit_records=False)
+    # ttft: only finished router requests with a ttft feed the detector
+    for _ in range(30):
+        eng.observe_record({"kind": "router", "phase": "request",
+                            "outcome": "finished", "ttft_s": 0.05,
+                            "replica": "r0"})
+    eng.observe_record({"kind": "router", "phase": "request",
+                        "outcome": "failed", "ttft_s": 90.0})  # not routed
+    fired = eng.observe_record({"kind": "router", "phase": "request",
+                                "outcome": "finished", "ttft_s": 2.0,
+                                "replica": "r1"})
+    assert [f["detector"] for f in fired] == ["ttft"]
+    assert fired[0]["source"] == "r1"
+    # spec accept rate collapse (direction="low")
+    for _ in range(30):
+        eng.observe_record({"kind": "serving", "phase": "step",
+                            "draft_proposed_tokens": 10,
+                            "draft_accepted_tokens": 8})
+    fired = eng.observe_record({"kind": "serving", "phase": "step",
+                                "draft_proposed_tokens": 10,
+                                "draft_accepted_tokens": 0})
+    assert [f["detector"] for f in fired] == ["spec_accept_rate"]
+    # heartbeat gap widening
+    for _ in range(30):
+        eng.observe_record({"kind": "serving_replica", "replica": "r0",
+                            "heartbeat_age_s": 0.1})
+    fired = eng.observe_record({"kind": "serving_replica", "replica": "r0",
+                                "heartbeat_age_s": 6.0})
+    assert [f["detector"] for f in fired] == ["heartbeat_gap"]
+    # block-pool occupancy drifting up = leak
+    fired_all = []
+    for i in range(60):
+        fired_all += eng.observe_record({"kind": "serving", "phase": "step",
+                                         "block_occupancy": 0.2 + 0.005 * i})
+    assert [f["detector"] for f in fired_all] == ["block_pool_leak"]
+    assert eng.stats()["episodes"]["block_pool_leak"] == 1
+
+
+def test_engine_emits_record_and_counter_per_episode(tmp_path):
+    tel_events.enable(out_dir=str(tmp_path), run_id="anom")
+    metrics.enable()
+    eng = AnomalyEngine()
+    for rec in _steps(30, 0.01):
+        eng.observe_record(rec)
+    for rec in _steps(6, 0.5, start=30):     # one sustained excursion
+        eng.observe_record(rec)
+    tel_events.disable()
+    recs = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    anoms = [r for r in recs if r["kind"] == "anomaly"]
+    assert len(anoms) == 1                   # hysteresis: one record
+    assert anoms[0]["detector"] == "step_latency"
+    reg = metrics.get_registry()
+    fams = metrics.parse_prometheus_text(reg.render())
+    samples = fams[ANOMALIES_TOTAL]["samples"]
+    assert [(lab, val) for _, lab, val in samples] == [
+        ({"detector": "step_latency"}, 1)
+    ]
+
+
+def test_engine_disabled_path_touches_no_state():
+    eng = AnomalyEngine(enabled=False)
+    for rec in _steps(50, 0.01) + _steps(10, 9.0, start=50):
+        assert eng.observe_record(rec) == []
+    assert eng.observed == 0 and eng.anomalies == []
+    assert all(d.count == 0 and d.episodes == 0 for d in eng.detectors())
+
+
+def test_engine_emit_records_off_still_detects():
+    """The hub's in-process engines run with emit_records=False: episodes
+    must still fire and accumulate without needing an armed event log."""
+    eng = AnomalyEngine(emit_records=False)
+    for rec in _steps(30, 0.01) + _steps(3, 0.5, start=30):
+        eng.observe_record(rec)
+    assert eng.stats()["anomalies"] == 1
+    assert eng.step_latency.episodes == 1

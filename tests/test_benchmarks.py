@@ -268,6 +268,46 @@ def test_bench_check_flags_synthetic_regression(tmp_path):
     assert "REGRESSION" in res.stdout and "tok_per_sec" in res.stdout
 
 
+def test_bench_check_waiver_buys_exit_code_not_silence(tmp_path):
+    """`--waive` flips the exit code for a known regression, but the
+    REGRESSION row still prints, the WAIVED marker carries the reason, and
+    the verdict line names the waiver again — silence is the one thing a
+    waiver must never buy."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_bench_payload(100.0)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_bench_payload(80.0)))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path),
+                       "--waive", "*tok_per_sec*=cpu runner flake")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout          # the row survives the waiver
+    assert "^ WAIVED" in res.stdout and "cpu runner flake" in res.stdout
+    assert "regress verdict: OK with 1 regression(s) WAIVED" in res.stdout
+
+
+def test_bench_check_waiver_file_autoloads_in_scan_mode(tmp_path):
+    """Scan mode picks up BENCH_WAIVERS next to the payloads (the committed
+    path `make bench-check` uses) and announces the load."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_bench_payload(100.0)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_bench_payload(80.0)))
+    (tmp_path / "BENCH_WAIVERS").write_text(
+        "# known CPU variance\n*tok_per_sec*  # runner variance at boundary\n"
+    )
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "regress: loaded 1 waiver(s)" in res.stdout
+    assert "runner variance at boundary" in res.stdout
+
+
+def test_bench_check_unmatched_waiver_does_not_apply(tmp_path):
+    """A waiver that names some OTHER metric must not buy this regression's
+    exit code."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_bench_payload(100.0)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_bench_payload(80.0)))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path),
+                       "--waive", "configs.some_other_bench=nope")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout and "^ WAIVED" not in res.stdout
+
+
 def test_bench_check_accepts_identical_payloads(tmp_path):
     for name in ("BENCH_r01.json", "BENCH_r02.json"):
         (tmp_path / name).write_text(json.dumps(_bench_payload(100.0)))
@@ -285,6 +325,36 @@ def test_bench_check_refuses_cross_fingerprint(tmp_path):
     res = _regress_cli(tmp_path, "--scan", str(tmp_path))
     assert res.returncode == 2, res.stdout + res.stderr
     assert "REFUSING" in res.stdout
+
+
+def test_hub_dashboard_render_stays_under_overhead_budget(tmp_path):
+    """Tier-1 guard for the live plane (ISSUE 19): tailing + folding a
+    ~2000-record stream and rendering one `top` frame — detectors armed —
+    must finish well inside a fixed budget. The dashboard watches the
+    fleet; it must never cost like one."""
+    import time
+
+    from accelerate_tpu.telemetry.anomaly import AnomalyEngine
+    from accelerate_tpu.telemetry.hub import EventHub, render_top
+
+    path = tmp_path / "events-rank0.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "run_id": "bench",
+                            "process_index": 0, "num_processes": 1}) + "\n")
+        for i in range(2000):
+            f.write(json.dumps({"kind": "step", "step": i, "t": float(i),
+                                "dur_s": 0.01 + 0.0001 * (i % 7),
+                                "execute_s": 0.01}) + "\n")
+    hub = EventHub([str(tmp_path)], anomaly=AnomalyEngine(emit_records=False))
+    t0 = time.perf_counter()
+    hub.poll()
+    frame = render_top(hub.model)
+    elapsed = time.perf_counter() - t0
+    assert len(hub.model.records) >= 2001
+    assert "steps: 2000" in frame
+    # generous for a loaded single-core CI box; a regression that makes the
+    # live plane quadratic or per-record-expensive blows straight past it
+    assert elapsed < 3.0, f"hub poll+fold+render took {elapsed:.2f}s"
 
 
 def test_benchmark_dirs_are_documented():

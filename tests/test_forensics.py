@@ -410,7 +410,8 @@ def test_doctor_self_checks(capsys):
     # + disaggregated serving (ISSUE 16)
     # + goodput ledger (ISSUE 17)
     # + speculative decoding (ISSUE 18)
-    assert out.count("PASS") == 19 and "FAIL" not in out
+    # + live observability plane (ISSUE 19)
+    assert out.count("PASS") == 20 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "goodput ledger" in out
     assert "speculative decoding" in out
@@ -423,6 +424,7 @@ def test_doctor_self_checks(capsys):
     assert "persistent compile cache" in out
     assert "prefix cache + COW" in out
     assert "observability plane" in out
+    assert "live observability plane" in out
 
 
 # ------------------------------------------------------- integration hookups

@@ -1,0 +1,265 @@
+"""Live observability hub (telemetry/hub.py): incremental file tailing
+across growth / rotation / truncation / torn trailing lines, FleetModel
+folding, the `top` dashboard rendering through the report CLI's own section
+formatters (the shared-formatter invariant), and `report --follow`."""
+
+import io
+import json
+import os
+
+from accelerate_tpu.telemetry.anomaly import AnomalyEngine
+from accelerate_tpu.telemetry.hub import (
+    ANSI_CLEAR,
+    HUB_STREAM,
+    EventHub,
+    FileTail,
+    FleetModel,
+    run_follow,
+    run_top,
+)
+from accelerate_tpu.telemetry.report import (
+    build_report,
+    format_canary_section,
+    format_report,
+    main as report_main,
+)
+
+
+def _w(path, records, mode="a"):
+    with open(path, mode) as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _meta(run_id="hubtest", rank=0, n=1):
+    return {"kind": "meta", "schema": 1, "run_id": run_id,
+            "process_index": rank, "num_processes": n}
+
+
+def _step(i, dur=0.01):
+    return {"kind": "step", "step": i, "t": float(i), "dur_s": dur,
+            "execute_s": dur}
+
+
+# ---------------------------------------------------------------- FileTail --
+
+
+def test_filetail_incremental_growth_and_torn_line(tmp_path):
+    path = str(tmp_path / "events-rank0.jsonl")
+    _w(path, [_meta(), _step(0)], mode="w")
+    tail = FileTail(path)
+    recs = tail.poll()
+    assert [r["kind"] for r in recs] == ["meta", "step"]
+    assert all(r["_file"] == "events-rank0.jsonl" for r in recs)
+    assert tail.poll() == []                      # nothing new
+    # a torn trailing line is buffered, not parsed and not lost
+    with open(path, "a") as f:
+        f.write(json.dumps(_step(1)) + "\n")
+        f.write('{"kind": "step", "step": 2, "t"')
+    recs = tail.poll()
+    assert [r["step"] for r in recs] == [1]
+    with open(path, "a") as f:
+        f.write(': 2.0, "dur_s": 0.01}\n')        # the writer finishes it
+    recs = tail.poll()
+    assert [r["step"] for r in recs] == [2]       # parsed exactly once, whole
+    assert tail.resets == 0
+
+
+def test_filetail_rotation_detected_by_identity_not_size(tmp_path):
+    path = str(tmp_path / "events-rank0.jsonl")
+    _w(path, [_meta(run_id="old-run!!")], mode="w")
+    tail = FileTail(path)
+    assert tail.poll()[0]["run_id"] == "old-run!!"
+    # rotate in a NEW file of the same byte length: only the inode changed
+    side = str(tmp_path / "side.jsonl")
+    _w(side, [_meta(run_id="new-run!!")], mode="w")
+    assert os.path.getsize(side) == os.path.getsize(path)
+    os.replace(side, path)
+    recs = tail.poll()
+    assert tail.resets == 1
+    assert [r["run_id"] for r in recs] == ["new-run!!"]
+
+
+def test_filetail_truncation_restarts_from_zero(tmp_path):
+    path = str(tmp_path / "events-rank0.jsonl")
+    _w(path, [_meta()] + [_step(i) for i in range(5)], mode="w")
+    tail = FileTail(path)
+    assert len(tail.poll()) == 6
+    _w(path, [_meta(run_id="restarted")], mode="w")   # in-place truncation
+    recs = tail.poll()
+    assert tail.resets == 1
+    assert [r["run_id"] for r in recs] == ["restarted"]
+
+
+def test_filetail_skips_garbage_and_missing_file(tmp_path):
+    path = str(tmp_path / "events-rank0.jsonl")
+    tail = FileTail(path)
+    assert tail.poll() == []                      # not written yet: no error
+    with open(path, "w") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps(_step(0)) + "\n")
+        f.write("[1, 2, 3]\n")                    # parseable but not a dict
+        f.write("\n")
+    recs = tail.poll()
+    assert [r["step"] for r in recs] == [0]
+
+
+# -------------------------------------------------------------- FleetModel --
+
+
+def test_fleet_model_folds_fixture_records():
+    m = FleetModel()
+    for rec in [
+        _meta(),
+        {"kind": "serving_replica", "replica": "r0", "state": "healthy", "t": 1.0},
+        {"kind": "serving_replica", "replica": "r1", "state": "healthy", "t": 1.0},
+        {"kind": "serving_replica", "replica": "r1", "state": "draining", "t": 2.0},
+        {"kind": "router", "phase": "poll", "queued": 3, "inflight": 2,
+         "completed": 7, "shed": 1, "failovers": 1, "t": 2.5},
+        {"kind": "supervisor", "generation": 1, "processes": 2,
+         "restarts_used": 1, "max_restarts": 2, "t": 3.0},
+        {"kind": "restart", "generation": 1, "t": 3.1},
+        {"kind": "slo_violation", "slo": "ttft_p95_s", "t": 3.2},
+        {"kind": "anomaly", "detector": "step_latency", "t": 3.3},
+        {"kind": "canary", "replica": "r0", "result": "match", "t": 3.4},
+        {"kind": "canary", "replica": "r1", "result": "mismatch", "t": 3.5},
+    ]:
+        m.fold(rec)
+    assert m.replicas["r1"]["state"] == "draining"     # last record wins
+    assert m.replica_states() == {"draining": 1, "healthy": 1}
+    assert m.router_poll["completed"] == 7
+    assert m.supervisor["restarts_used"] == 1 and m.generation == 1
+    assert m.restarts == 1 and m.slo_violations == 1
+    assert m.anomaly_episodes == 1
+    assert m.canary_probes == 2 and m.canary_failures == 1
+    assert m.last_t == 3.5
+    assert m.kinds["canary"] == 2
+    # the snapshot defers to the report CLI's aggregation over the same fold
+    snap = m.snapshot_report()
+    assert snap["events"] == len(m.records)
+
+
+def test_hub_discovers_streams_mid_run_and_injects_anomalies(tmp_path):
+    """Replicas spawn mid-run: a stream that appears between polls must be
+    picked up, and episodes fired by the engine must fold back as synthetic
+    `anomaly` records on the hub's own stream marker."""
+    d = str(tmp_path)
+    _w(os.path.join(d, "events-rank0.jsonl"),
+       [_meta()] + [_step(i) for i in range(30)], mode="w")
+    hub = EventHub([d], anomaly=AnomalyEngine(emit_records=False))
+    assert len(hub.poll()) == 31
+    # a second stream appears after the first poll
+    _w(os.path.join(d, "events-rank1.jsonl"),
+       [_meta(rank=1, n=2)] + [_step(i, dur=0.9) for i in range(30, 33)],
+       mode="w")
+    new = hub.poll()
+    kinds = [r["kind"] for r in new]
+    assert kinds.count("step") == 3 and kinds.count("anomaly") == 1
+    synth = [r for r in new if r["kind"] == "anomaly"]
+    assert synth[0]["_file"] == HUB_STREAM
+    assert synth[0]["detector"] == "step_latency"
+    assert hub.model.anomaly_episodes == 1
+
+
+# ------------------------------------------------------------ top / follow --
+
+
+def _degraded_fleet_dir(tmp_path):
+    d = str(tmp_path)
+    recs = [_meta()] + [_step(i) for i in range(30)]
+    recs += [_step(i, dur=0.3) for i in range(30, 34)]       # slow burst
+    recs += [
+        {"kind": "serving_replica", "replica": "good", "state": "healthy",
+         "t": 40.0},
+        {"kind": "serving_replica", "replica": "bad", "state": "draining",
+         "t": 41.0},
+        {"kind": "router", "phase": "poll", "queued": 0, "inflight": 0,
+         "completed": 5, "shed": 0, "failovers": 0, "t": 41.5},
+        {"kind": "supervisor", "generation": 1, "processes": 2,
+         "restarts_used": 1, "max_restarts": 2, "t": 42.0},
+        {"kind": "canary", "replica": "good", "rid": "canary-1",
+         "golden": "golden0", "result": "match", "t": 43.0},
+        {"kind": "canary", "replica": "bad", "rid": "canary-2",
+         "golden": "golden1", "result": "mismatch", "t": 44.0},
+        {"kind": "canary_failure", "replica": "bad", "rid": "canary-2",
+         "golden": "golden1", "mismatch_index": 2, "expected_token": 17,
+         "got_token": 4, "expected_len": 6, "got_len": 6, "drained": True,
+         "t": 44.0},
+    ]
+    _w(os.path.join(d, "events-rank0.jsonl"), recs, mode="w")
+    return d
+
+
+def test_top_once_renders_degraded_fleet_via_shared_formatters(tmp_path):
+    d = _degraded_fleet_dir(tmp_path)
+    buf = io.StringIO()
+    assert run_top([d], once=True, out=buf) == 0
+    frame = buf.getvalue()
+    assert ANSI_CLEAR not in frame                 # --once is pipe-safe
+    assert "fleet top — run(s): hubtest" in frame
+    assert "replicas: 2 (draining=1, healthy=1)" in frame
+    assert "supervisor: generation 1, 2 process(es), restarts 1/2" in frame
+    assert "ALERTS: 1 anomaly episode(s), 0 slo violation(s), " \
+           "1 canary failure(s)" in frame
+    assert "steps: 34" in frame
+    # the live detector fired on the slow burst, with the cause attached
+    assert "step_latency: 1 episode(s)" in frame
+    assert "straggler or contended host" in frame
+    # the shared-formatter invariant: the post-hoc report's canary section
+    # appears in the live frame STRING-EXACT — same records, same code
+    post = build_report([d])
+    assert format_canary_section(post["canary"]) in frame
+    assert "MISMATCH on bad: golden golden1 token 2 expected 17 got 4" in frame
+
+
+def test_top_live_frames_clear_and_count(tmp_path):
+    d = _degraded_fleet_dir(tmp_path)
+    buf = io.StringIO()
+    naps = []
+    rc = run_top([d], max_ticks=2, interval_s=0.5, sleep=naps.append, out=buf)
+    assert rc == 0
+    out = buf.getvalue()
+    assert out.count(ANSI_CLEAR) == 2
+    assert "frame 1" in out and "frame 2" in out
+    assert naps == [0.5]                           # no sleep after the last tick
+
+
+def test_follow_mode_streams_report_increments(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "events-rank0.jsonl")
+    _w(path, [_meta()] + [_step(i) for i in range(3)], mode="w")
+
+    def grow(_interval):                           # the writer races the tail
+        _w(path, [_step(3), _step(4)])
+
+    buf = io.StringIO()
+    rc = run_follow([d], max_ticks=2, sleep=grow, out=buf)
+    assert rc == 0
+    out = buf.getvalue()
+    assert "==== follow: +4 record(s), 4 total ====" in out
+    assert "==== follow: +2 record(s), 6 total ====" in out
+    # each increment re-renders the full post-hoc report text
+    assert out.count("steps:") == 2
+    assert format_report(build_report([d])) in out  # final render is exact
+
+
+def test_follow_quiet_tick_prints_nothing(tmp_path):
+    d = str(tmp_path)
+    _w(os.path.join(d, "events-rank0.jsonl"), [_meta(), _step(0)], mode="w")
+    buf = io.StringIO()
+    rc = run_follow([d], max_ticks=3, sleep=lambda s: None, out=buf)
+    assert rc == 0
+    assert buf.getvalue().count("==== follow:") == 1  # ticks 2 & 3 were quiet
+
+
+def test_cli_top_once_and_report_follow(tmp_path, capsys):
+    d = _degraded_fleet_dir(tmp_path)
+    assert report_main(["top", str(d), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet top" in out and "canaries:" in out
+    assert report_main(
+        ["report", str(d), "--follow", "--follow-ticks", "1",
+         "--interval", "0.01"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "==== follow:" in out and "canaries:" in out

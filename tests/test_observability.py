@@ -214,6 +214,42 @@ class TestMetricsExporter:
             metrics.disable()
         assert metrics.server_port() is None
 
+    def test_healthz_readiness_endpoint(self):
+        """``/healthz`` answers 200 while the exporter is live and 503 the
+        moment shutdown begins — the readiness flag flips BEFORE the socket
+        dies, so a probe racing stop_server() sees not-ready instead of a
+        connection reset, and a re-serve() re-arms readiness."""
+        metrics.enable()
+        try:
+            metrics.serve(0)
+            port = metrics.server_port()
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            )
+            assert resp.status == 200 and resp.read() == b"ok\n"
+            # the shutdown window: readiness flips first, socket still up
+            metrics._SHUTTING_DOWN = True
+            try:
+                with pytest.raises(urllib.error.HTTPError) as excinfo:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=10
+                    )
+                assert excinfo.value.code == 503
+                assert excinfo.value.read() == b"shutting down\n"
+            finally:
+                metrics._SHUTTING_DOWN = False
+            metrics.stop_server()
+            assert metrics.server_port() is None
+            # a fresh serve() must not inherit the stale shutdown flag
+            metrics.serve(0)
+            port = metrics.server_port()
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            )
+            assert resp.status == 200
+        finally:
+            metrics.disable()
+
     def test_snapshot_record_lands_in_telemetry(self, tmp_path):
         tel.enable(out_dir=str(tmp_path), run_id="m")
         metrics.enable()

@@ -20,6 +20,7 @@ real SIGKILL / wedge-forever chaos in the slow-marked e2e.
 """
 
 import dataclasses
+import json
 import time
 from types import SimpleNamespace
 
@@ -34,6 +35,8 @@ from accelerate_tpu.serving import (
     PRIORITY_BATCH,
     PRIORITY_INTERACTIVE,
     AdmissionController,
+    CanaryGolden,
+    CanaryProbe,
     LocalReplica,
     ProcessReplica,
     ReplicaSpec,
@@ -41,6 +44,7 @@ from accelerate_tpu.serving import (
     RouterRequestStatus,
     ServingRouter,
     TokenBucket,
+    precompute_goldens,
 )
 
 CONFIG = LlamaConfig.tiny()
@@ -781,3 +785,191 @@ def test_router_self_heal_never_resurrects_drained_replica():
     req = router.submit(np.arange(4, dtype=np.int32), 4)
     router.poll()
     assert req.status is RouterRequestStatus.DISPATCHED
+
+
+# ---------------------------------------------------------------------------
+# bitwise correctness canaries (ISSUE 19, serving/canary.py)
+
+
+def _canary_probe(**kw):
+    golden = CanaryGolden(name="g0", prompt=(1, 2, 3), max_new_tokens=3,
+                          expected=(7, 8, 9), rng_seed=5)
+    kw.setdefault("interval_s", 1000.0)
+    return CanaryProbe([golden], **kw)
+
+
+def test_canary_probe_check_names_first_mismatch():
+    g = CanaryGolden("g", (1,), 4, expected=(7, 8, 9, 10))
+    assert CanaryProbe.check(g, [7, 8, 9, 10]) is None
+    m = CanaryProbe.check(g, [7, 99, 9, 10])
+    assert (m["mismatch_index"], m["expected_token"], m["got_token"]) == (1, 8, 99)
+    short = CanaryProbe.check(g, [7, 8, 9])     # wrong length IS a mismatch
+    assert short["mismatch_index"] == 3 and short["got_token"] is None
+    assert (short["expected_len"], short["got_len"]) == (4, 3)
+
+
+def test_canary_mismatch_drains_replica_and_match_does_not(tmp_path):
+    """A scripted fleet: 'bad' answers the golden with a corrupted token,
+    'good' answers bitwise-exact. The mismatch must emit canary +
+    canary_failure records naming the differing token, drain the bad
+    replica, and leave zero false positives on the healthy one — all
+    invisible to the user-facing request counters."""
+    from accelerate_tpu.telemetry import events as tel
+    from accelerate_tpu.telemetry.report import build_report, format_report
+
+    clock = FakeClock()
+    bad, good = FakeReplica("bad"), FakeReplica("good")
+    probe = _canary_probe()
+    tel.enable(out_dir=str(tmp_path), run_id="canary")
+    try:
+        router = ServingRouter([bad, good], canary=probe, clock=clock)
+        router.poll()
+        # round-robin over sorted targets: the first probe lands on 'bad'
+        assert bad.submitted and bad.submitted[0]["rid"] == "canary-1"
+        assert bad.submitted[0]["prompt"] == [1, 2, 3]
+        assert bad.submitted[0]["rng_seed"] == 5
+        bad.push(event="done", rid="canary-1", status="finished",
+                 tokens=[7, 99, 9])
+        router.poll()
+        assert bad.state is ReplicaState.DRAINING
+        # next due probe can only target the healthy survivor
+        clock.t += 1001.0
+        router.poll()
+        assert good.submitted and good.submitted[0]["rid"] == "canary-2"
+        good.push(event="done", rid="canary-2", status="finished",
+                  tokens=[7, 8, 9])
+        router.poll()
+        assert good.state is ReplicaState.HEALTHY
+    finally:
+        tel.disable()
+
+    assert probe.stats() == {
+        "probes": 2, "failures": 1,
+        "by_replica": {"bad": {"probes": 1, "failures": 1},
+                       "good": {"probes": 1, "failures": 0}},
+    }
+    stats = router.stats()
+    assert stats["canary"]["failed_replicas"] == ["bad"]
+    # canaries are invisible to the user-facing ledgers
+    assert stats["completed"] == 0 and stats["shed"] == 0 and stats["failed"] == 0
+    assert router.admission.depth == 0
+    report = build_report([str(tmp_path)])
+    sec = report["canary"]
+    assert sec["probes"] == 2 and sec["failures"] == 1
+    (mm,) = sec["mismatches"]
+    assert mm["replica"] == "bad" and mm["mismatch_index"] == 1
+    assert mm["expected_token"] == 8 and mm["got_token"] == 99 and mm["drained"]
+    text = format_report(report)
+    assert "canaries: 2 probe(s), 1 MISMATCH(ES)" in text
+    assert "MISMATCH on bad: golden g0 token 1 expected 8 got 99" in text
+    # router section shows the drained replica
+    assert any("bad: draining" in line for line in text.splitlines())
+
+
+def test_canary_failed_replica_loses_dispatch_ties():
+    """With drain_on_failure=False the failed replica stays HEALTHY but
+    joins the DRAINING-pressure set: user work prefers clean replicas at
+    equal load, exactly like an SLO-burning replica."""
+    clock = FakeClock()
+    bad, good = FakeReplica("a-bad"), FakeReplica("b-good")
+    probe = _canary_probe(drain_on_failure=False)
+    router = ServingRouter([bad, good], canary=probe, clock=clock)
+    router.poll()
+    bad.push(event="done", rid="canary-1", status="finished", tokens=[0, 0, 0])
+    router.poll()
+    assert bad.state is ReplicaState.HEALTHY        # kept serving...
+    req = router.submit(np.asarray([1, 2], np.int32), 2)
+    router.poll()
+    assert req.replica == "b-good"                  # ...but loses the tie
+    assert router.stats()["canary"]["failed_replicas"] == ["a-bad"]
+
+
+def test_canary_dropped_not_failed_over_on_replica_death(tmp_path):
+    """A probe's job is to test THIS replica: when the replica dies with the
+    probe inflight, the probe is dropped as inconclusive — never re-dispatched
+    (failover would launder the evidence) and never counted as a mismatch."""
+    from accelerate_tpu.telemetry import events as tel
+
+    clock = FakeClock()
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    probe = _canary_probe()
+    tel.enable(out_dir=str(tmp_path), run_id="canary-drop")
+    try:
+        router = ServingRouter([r0, r1], canary=probe, clock=clock)
+        router.poll()
+        assert r0.submitted and r0.submitted[0]["rid"] == "canary-1"
+        r0.die()
+        router.poll()
+        assert r0.state is ReplicaState.DEAD
+    finally:
+        tel.disable()
+    assert router.failovers == 0 and r1.submitted == []
+    assert router.canary_inconclusive == 1
+    assert probe.stats()["probes"] == 0             # no verdict recorded
+    recs = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    assert not [r for r in recs if r["kind"] == "canary_failure"]
+
+
+def test_canary_engine_rejection_is_inconclusive(tmp_path):
+    """A probe the engine rejects (pool/lattice cap) says nothing about
+    token correctness: inconclusive, no verdict against the replica."""
+    from accelerate_tpu.telemetry import events as tel
+
+    clock = FakeClock()
+    r0 = FakeReplica("r0")
+    probe = _canary_probe()
+    tel.enable(out_dir=str(tmp_path), run_id="canary-rej")
+    try:
+        router = ServingRouter([r0], canary=probe, clock=clock)
+        router.poll()
+        r0.push(event="done", rid="canary-1", status="rejected",
+                error="prompt too long")
+        router.poll()
+    finally:
+        tel.disable()
+    assert r0.state is ReplicaState.HEALTHY
+    assert router.canary_inconclusive == 1
+    assert probe.stats()["probes"] == 0
+    recs = [json.loads(l) for l in open(tmp_path / "events-rank0.jsonl")]
+    (canary_rec,) = [r for r in recs if r["kind"] == "canary"]
+    assert canary_rec["result"] == "inconclusive"
+
+
+def test_canary_real_fleet_corrupt_weights_drained_bitwise(tmp_path):
+    """End to end against real thread-backed engines: the bad replica shares
+    the spec but builds its params from a different seed — deterministic
+    init makes that genuinely corrupt weights, so its canary answers diverge
+    bitwise while the healthy replica's match (zero false positives)."""
+    from accelerate_tpu.telemetry import events as tel
+
+    spec = _spec()
+    goldens = precompute_goldens(spec, max_new_tokens=4)
+    assert goldens and all(len(g.expected) == 4 for g in goldens)
+    probe = CanaryProbe(goldens, interval_s=0.05)
+    tel.enable(out_dir=str(tmp_path), run_id="canary-real")
+    router = None
+    try:
+        router = ServingRouter(
+            [
+                LocalReplica("good", spec),
+                LocalReplica("bad", dataclasses.replace(spec, param_seed=1234)),
+            ],
+            canary=probe,
+            health_timeout_s=10.0,
+        )
+        router.wait_ready(timeout_s=300)
+        deadline = time.monotonic() + 300
+        while (probe.by_replica.get("bad", {}).get("failures", 0) < 1
+               or probe.by_replica.get("good", {}).get("probes", 0) < 1
+               or router._inflight):
+            router.poll()
+            if time.monotonic() > deadline:
+                raise AssertionError(f"canary probes stalled: {probe.stats()}")
+            time.sleep(0.002)
+    finally:
+        if router is not None:
+            router.close()
+        tel.disable()
+    assert router.replicas["bad"].state is ReplicaState.DRAINING
+    assert probe.by_replica["bad"]["failures"] >= 1
+    assert probe.by_replica["good"]["failures"] == 0

@@ -136,7 +136,9 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
           ["PrefillEngine", "DecodeEngine", "DisaggRouter", "KVHandoff",
            "KVTransport", "LocalBlockCopyTransport"]),
          ("accelerate_tpu.serving.autoscaler",
-          ["AutoscalerPolicy", "lattice_fns"])],
+          ["AutoscalerPolicy", "lattice_fns"]),
+         ("accelerate_tpu.serving.canary",
+          ["CanaryGolden", "CanaryProbe", "precompute_goldens"])],
     ),
     "analysis": (
         "Static analysis (jaxlint)",
@@ -258,10 +260,17 @@ PAGES: "dict[str, tuple[str, str, list]]" = {
            "comparable", "extract_metrics", "compare_metrics", "scan_dir",
            "run_regress"]),
          ("accelerate_tpu.telemetry.report",
-          ["build_report", "format_report", "format_rank_section",
-           "format_serving_section", "format_router_section",
-           "format_slo_section", "format_goodput_section", "render_request",
+          ["build_report", "build_report_from_events", "format_report",
+           "format_rank_section", "format_serving_section",
+           "format_router_section", "format_slo_section",
+           "format_goodput_section", "format_anomaly_section",
+           "format_canary_section", "render_request",
            "find_request_trace", "load_events", "run_doctor", "main"]),
+         ("accelerate_tpu.telemetry.hub",
+          ["FileTail", "FleetModel", "EventHub", "render_top", "run_top",
+           "run_follow"]),
+         ("accelerate_tpu.telemetry.anomaly",
+          ["EwmaDetector", "TrendDetector", "AnomalyEngine"]),
          ("accelerate_tpu.telemetry.tracker_bridge", None)],
     ),
     "compile_cache": (
