@@ -19,7 +19,7 @@
 .PHONY: test test_smoke test_core test_slow test_cli test_big_modeling \
         test_examples test_models test_multihost test_checkpoint quality bench \
         bench-input bench-ckpt bench-zero1 bench-serve bench-compile \
-        bench-check doctor lint profile chaos
+        bench-attn bench-check doctor lint profile chaos
 
 PYTEST := python -m pytest -q
 
@@ -108,6 +108,13 @@ bench-zero1:
 bench-serve:
 	python benchmarks/serving/run.py
 
+# attention kernel grid (benchmarks/attention): fwd+bwd µs/token and
+# fraction-of-roofline over impl × seq × dtype × sparsity — the measurement
+# behind ops.attention.ATTN_CROSSOVER_S — plus the fp8-vs-bf16 llama
+# train-step leg (dtype_recipe="fp8" through fp8_dot)
+bench-attn:
+	python benchmarks/attention/run.py
+
 # zero-cold-start recovery (benchmarks/compile_time, compile_cache/):
 # restart-to-first-step and replica-boot-to-first-token, cold vs warm
 # through the persistent AOT executable cache, with hit/miss counts from
@@ -141,7 +148,10 @@ bench-check:
 # supervised restart tailed live across a torn line with exactly one
 # anomaly episode, a seeded canary corruption drained with the
 # mismatching token named, and `top --once` rendering the post-hoc
-# report's sections string-exact) against synthetic inputs
+# report's sections string-exact), and fp8 through fused ZeRO-1 (an fp8
+# train step on 8 virtual devices keeping the fused bucketed path engaged
+# with fp8 metadata as passthrough slots, 1/N opt-state sharding, stage-0
+# loss parity, and a frozen jit cache) against synthetic inputs
 # (telemetry/report.py run_doctor)
 doctor:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.telemetry doctor

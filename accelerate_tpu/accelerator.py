@@ -822,12 +822,31 @@ class Accelerator:
             # histories instead of being "optimized" (reference: TE recipe wrap,
             # utils/transformer_engine.py apply_fp8_autowrap)
             wrap_accumulation = True
+            fused_inner_tx = None
             if self.mixed_precision == PrecisionType.FP8 and self._models:
                 from .ops.fp8 import has_fp8_meta, make_fp8_optimizer
 
                 if has_fp8_meta(self._models[-1]):
-                    # accumulation handled INSIDE the partition so meta
-                    # histories roll every micro-step (see make_fp8_optimizer)
+                    # the fused ZeRO-1 path never sees the label-routed
+                    # partition: the bucket plan carries meta leaves as
+                    # passthrough slots (replace-with-cotangent applied by the
+                    # fused update itself), so the BUCKETED transform is the
+                    # plain inner optimizer — MultiSteps-wrapped to keep the
+                    # same accumulation boundaries as the partition's default
+                    # branch
+                    inner_tx = optimizer
+                    if self.gradient_accumulation_steps > 1:
+                        import optax
+
+                        inner_tx = optax.MultiSteps(
+                            inner_tx,
+                            every_k_schedule=self.gradient_accumulation_steps,
+                        )
+                    fused_inner_tx = inner_tx
+                    # annotation/eager paths keep the partition: meta leaves
+                    # replaced by their updated histories, accumulation INSIDE
+                    # the partition so histories roll every micro-step (see
+                    # make_fp8_optimizer)
                     optimizer = make_fp8_optimizer(
                         optimizer,
                         self._models[-1],
@@ -839,10 +858,8 @@ class Accelerator:
                 accumulation_steps=self.gradient_accumulation_steps,
                 wrap_accumulation=wrap_accumulation,
             )
-            if not wrap_accumulation:
-                # fp8 partition routes updates by MODEL-tree labels; the fused
-                # ZeRO-1 bucketing would re-key the tree out from under it
-                optimizer._allow_fused_zero1 = False
+            if fused_inner_tx is not None:
+                optimizer._fused_inner_tx = fused_inner_tx
         optimizer.accelerator_state = self.state
         self._optimizers.append(optimizer)
         return optimizer
@@ -1202,6 +1219,11 @@ class Accelerator:
                 self._models[model_slot] = new_params
             return new_params, new_opt_state, metrics
 
+        if hasattr(step_fn, "_cache_size"):
+            # surface the jitted step's cache counter through the tracking
+            # wrapper (the serving engine's jit_cache_sizes idiom) so callers
+            # can assert frozen caches post-warmup
+            step_and_track._cache_size = step_fn._cache_size
         return step_and_track
 
     def prepare_train_step(

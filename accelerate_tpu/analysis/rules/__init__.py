@@ -13,6 +13,9 @@ shipped or autopsied (see ``docs/static_analysis.md`` for the lineage):
   collective reached by only some ranks deadlocks the fleet.
 - **R5** nondeterminism in traced code — trace-time values baked into the
   compiled program that differ per run/rank.
+- **R6** accumulator precision — a bare ``dot_general`` in kernel code
+  accumulates in the operand dtype (bf16/fp8), discarding the MXU's f32
+  accumulator; the drift only surfaces at scale (the ISSUE 20 kernels).
 
 ``RuleContext`` carries the package index and traced region, plus the
 cross-rule helpers (jit call sites, collective-containment fixpoint) that
@@ -239,6 +242,13 @@ def test_is_rank_divergent(node: ast.AST) -> bool:
 
 def load_all_rules() -> "dict[str, Rule]":
     """Import every rule module (registration is an import side effect)."""
-    from . import collectives, donation, host_sync, nondeterminism, recompile  # noqa: F401
+    from . import (  # noqa: F401
+        collectives,
+        donation,
+        host_sync,
+        nondeterminism,
+        precision,
+        recompile,
+    )
 
     return RULES

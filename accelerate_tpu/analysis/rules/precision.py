@@ -1,0 +1,96 @@
+"""R6 — accumulator precision at explicit kernel matmuls.
+
+Born with the in-tree flash-attention and fp8 kernels (ISSUE 20): a bare
+``lax.dot_general`` on bf16/fp8 operands accumulates in the *operand* dtype
+unless ``preferred_element_type`` says otherwise. On the MXU that is the
+difference between a f32 accumulator (free — the systolic array carries
+one anyway) and a silently quantized partial sum: online-softmax
+renormalization and fp8 dequantization both amplify that rounding into
+visible loss drift, and the failure only shows at scale, never in a tiny
+parity test. Every hand-written ``dot_general`` in a kernel module must
+pin its accumulator.
+
+Flags ``dot_general`` calls missing ``preferred_element_type`` when the
+call is (a) inside a traced function — the jit region is where operand
+dtypes go bf16/fp8 — or (b) anywhere in an ``ops/`` module, where Pallas
+kernel bodies live (kernel fns are called by ``pallas_call``, not wrapped
+by ``jax.jit``, so traced-region discovery cannot see them).
+
+Operator matmuls (``a @ b``, ``jnp.einsum``) are *not* flagged: policy for
+those lives in ``jax.default_matmul_precision``; this rule is about the
+explicit-``dot_general`` spelling that kernels use precisely because they
+need the accumulator pinned.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..callgraph import _module_level_nodes, dotted, iter_own_nodes
+from ..findings import Severity
+from . import Rule, RuleContext, register
+
+_MSG = (
+    "dot_general without preferred_element_type accumulates in the operand "
+    "dtype — on bf16/fp8 inputs the MXU's f32 accumulator is discarded; "
+    "pass preferred_element_type=jnp.float32"
+)
+
+
+def _is_dot_general(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    return name is not None and name.rsplit(".", 1)[-1] == "dot_general"
+
+
+def _has_accum_dtype(node: ast.Call) -> bool:
+    return any(kw.arg == "preferred_element_type" for kw in node.keywords)
+
+
+def check(ctx: RuleContext) -> list:
+    findings = []
+    seen = set()  # (path, line): traced fns in ops/ would double-report
+
+    def flag(module, node, fn):
+        key = (module.path, getattr(node, "lineno", 0))
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(
+            ctx.finding("R6", Severity.WARNING, module, node, _MSG, fn=fn)
+        )
+
+    for fn in ctx.region.traced.values():
+        module = ctx.pkg.modules[fn.module]
+        for node in iter_own_nodes(fn):
+            if isinstance(node, ast.Call) and _is_dot_general(node) and not _has_accum_dtype(node):
+                flag(module, node, fn)
+
+    for module in ctx.pkg.modules.values():
+        if "ops" not in os.path.normpath(module.path).split(os.sep):
+            continue
+        for scope in [None] + list(module.functions.values()):
+            nodes = (
+                iter_own_nodes(scope) if scope is not None
+                else _module_level_nodes(module)
+            )
+            for node in nodes:
+                if isinstance(node, ast.Call) and _is_dot_general(node) and not _has_accum_dtype(node):
+                    flag(module, node, scope)
+    return findings
+
+
+register(
+    Rule(
+        id="R6",
+        name="accumulator-precision",
+        severity=Severity.WARNING,
+        description=(
+            "Explicit dot_general calls in kernel code (traced regions and "
+            "ops/ modules) must pin their accumulator via "
+            "preferred_element_type — bf16/fp8 operands otherwise accumulate "
+            "in the operand dtype and the rounding only surfaces at scale."
+        ),
+        check=check,
+    )
+)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import dot_product_attention
+from ..ops.fp8 import META_KEY, fp8_dot, init_fp8_meta
 
 
 # ---------------------------------------------------------------------------
@@ -38,6 +39,30 @@ from ..ops.attention import dot_product_attention
 def _dense_init(key, in_dim, out_dim, scale=None):
     scale = scale if scale is not None else 1.0 / np.sqrt(in_dim)
     return (jax.random.normal(key, (in_dim, out_dim)) * scale).astype(jnp.float32)
+
+
+def _proj(entry: dict, x: jax.Array) -> jax.Array:
+    """``x @ entry["kernel"]``, through :func:`ops.fp8.fp8_dot` when the entry
+    carries fp8 meta (``dtype_recipe="fp8"`` threads the delayed-scaling state
+    into the param tree at init; its cotangent is the updated meta — see
+    ``ops/fp8.py``)."""
+    if META_KEY in entry:  # dict-key membership: static at trace time  # jaxlint: disable=R1
+        return fp8_dot(x, entry["kernel"], entry[META_KEY])
+    return x @ entry["kernel"]
+
+
+def _stacked_fp8_meta(n_layers: int):
+    """Per-layer fp8 meta stacked on the layer axis, so it rides the same
+    ``lax.scan`` as the stacked projection kernels (the test_fp8
+    meta-under-scan pattern)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[init_fp8_meta() for _ in range(n_layers)]
+    )
+
+
+def _check_dtype_recipe(recipe):
+    if recipe not in (None, "fp8"):
+        raise ValueError(f"dtype_recipe must be None or 'fp8', got {recipe!r}")
 
 
 def rms_norm(x, scale, eps=1e-6):
@@ -108,6 +133,11 @@ class LlamaConfig:
     # default attention implementation for forwards that don't pass one
     # explicitly: "auto" | "xla" | "flash" | "fused" (ops.attention impls)
     attn_impl: str = "auto"
+    # None → matmuls in the param dtype; "fp8" → QKV/O and MLP projections run
+    # through ops.fp8.fp8_dot (delayed scaling, e4m3 fwd / e5m2 bwd) with the
+    # per-site amax histories living IN the param tree (embeddings and the lm
+    # head stay high-precision — the standard first/last-layer exclusion)
+    dtype_recipe: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -125,7 +155,13 @@ class LlamaConfig:
 
 
 def init_llama(config: LlamaConfig, key) -> dict:
-    """Stacked-layer param pytree: every per-layer tensor has leading dim L."""
+    """Stacked-layer param pytree: every per-layer tensor has leading dim L.
+    ``dtype_recipe="fp8"`` adds a stacked ``fp8_meta`` subtree to every
+    projection entry (QKV/O + SwiGLU) — state the forward reads and whose
+    gradient-side cotangent is the rolled amax histories."""
+    _check_dtype_recipe(config.dtype_recipe)
+    if config.dtype_recipe == "fp8" and config.moe_experts > 0:
+        raise ValueError("dtype_recipe='fp8' does not support MoE layers yet")
     keys = jax.random.split(key, 9)
     L, D, H = config.n_layers, config.dim, config.hidden_dim
     Dq = config.n_heads * config.head_dim
@@ -162,6 +198,9 @@ def init_llama(config: LlamaConfig, key) -> dict:
         },
         "final_norm": {"scale": jnp.ones(D)},
     }
+    if config.dtype_recipe == "fp8":
+        for name in ("wq", "wk", "wv", "wo", "w1", "w3", "w2"):
+            params["layers"][name][META_KEY] = _stacked_fp8_meta(L)
     if not config.tie_embeddings:
         params["lm_head"] = {"kernel": _dense_init(keys[8], D, config.vocab_size, scale=0.02)}
     return params
@@ -242,9 +281,9 @@ def llama_ffn(layer_params: dict, x: jax.Array, config: LlamaConfig, mesh=None,
             ),
             mesh=mesh,  # ep-axis dispatch/expert activation constraints
         )
-    gate = jax.nn.silu(x @ layer_params["w1"]["kernel"])
-    up = x @ layer_params["w3"]["kernel"]
-    return (gate * up) @ layer_params["w2"]["kernel"], jnp.float32(0.0)
+    gate = jax.nn.silu(_proj(layer_params["w1"], x))
+    up = _proj(layer_params["w3"], x)
+    return _proj(layer_params["w2"], gate * up), jnp.float32(0.0)
 
 
 def llama_forward(
@@ -302,9 +341,9 @@ def llama_forward(
 
     def layer(h, layer_params):
         x = rms_norm(h, layer_params["attn_norm"]["scale"], config.norm_eps)
-        q = (x @ layer_params["wq"]["kernel"]).reshape(B, S, config.n_heads, config.head_dim)
-        k = (x @ layer_params["wk"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
-        v = (x @ layer_params["wv"]["kernel"]).reshape(B, S, config.n_kv_heads, config.head_dim)
+        q = _proj(layer_params["wq"], x).reshape(B, S, config.n_heads, config.head_dim)
+        k = _proj(layer_params["wk"], x).reshape(B, S, config.n_kv_heads, config.head_dim)
+        v = _proj(layer_params["wv"], x).reshape(B, S, config.n_kv_heads, config.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
         if attention_fn is not None:
@@ -313,7 +352,7 @@ def llama_forward(
             attn = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids, impl=attention_impl
             )
-        h = h + attn.reshape(B, S, -1) @ layer_params["wo"]["kernel"]
+        h = h + _proj(layer_params["wo"], attn.reshape(B, S, -1))
         h = _constrain(h, mesh, _batch_axes, "cp", None)
         x = rms_norm(h, layer_params["mlp_norm"]["scale"], config.norm_eps)
         y, aux = llama_ffn(layer_params, x, config, mesh=mesh)
@@ -385,6 +424,8 @@ def llama_shard_rules():
 
     return ShardingRules(
         [
+            # fp8 scaling metadata: tiny f32 history buffers, always replicated
+            (r"fp8_meta", P()),
             (r"layers/(wq|wk|wv|w1|w3)/kernel", P(None, None, "tp")),  # column-parallel
             (r"layers/(wo|w2)/kernel", P(None, "tp", None)),  # row-parallel
             # MoE: leading dims are [layer, expert]; experts over ep, the
@@ -448,6 +489,9 @@ class BertConfig:
     unroll_layers: bool = True
     # see LlamaConfig.attn_impl — the config-level attention knob
     attn_impl: str = "auto"
+    # see LlamaConfig.dtype_recipe — None (native) or "fp8" (delayed-scaling
+    # projections + MLP matmuls through ops.fp8.fp8_dot)
+    dtype_recipe: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -463,6 +507,7 @@ class BertConfig:
 
 
 def init_bert(config: BertConfig, key) -> dict:
+    _check_dtype_recipe(config.dtype_recipe)
     keys = jax.random.split(key, 12)
     L, D, F = config.n_layers, config.dim, config.ffn_dim
 
@@ -470,7 +515,7 @@ def init_bert(config: BertConfig, key) -> dict:
         ks = jax.random.split(k, L)
         return jnp.stack([_dense_init(ks[i], a, b, scale=0.02) for i in range(L)])
 
-    return {
+    params = {
         "embeddings": {
             "word": {"embedding": _dense_init(keys[0], config.vocab_size, D, 0.02)},
             "position": {"embedding": _dense_init(keys[1], config.max_seq_len, D, 0.02)},
@@ -490,6 +535,13 @@ def init_bert(config: BertConfig, key) -> dict:
         "pooler": {"kernel": _dense_init(keys[9], D, D, 0.02), "bias": jnp.zeros(D)},
         "classifier": {"kernel": _dense_init(keys[10], D, config.num_labels, 0.02), "bias": jnp.zeros(config.num_labels)},
     }
+    if config.dtype_recipe == "fp8":
+        # per-layer delayed-scaling state for every projection that routes
+        # through fp8_dot in bert_forward (pooler/classifier stay native —
+        # first/last-matmul exclusion, same as llama's embed/lm_head)
+        for name in ("wq", "wk", "wv", "wo", "fc1", "fc2"):
+            params["layers"][name][META_KEY] = _stacked_fp8_meta(L)
+    return params
 
 
 def bert_forward(
@@ -516,19 +568,20 @@ def bert_forward(
     seg_ids = attn_mask.astype(jnp.int32) if attn_mask is not None else None
 
     def layer(h, lp):
-        q = (h @ lp["wq"]["kernel"] + lp["wq"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
-        k = (h @ lp["wk"]["kernel"] + lp["wk"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
-        v = (h @ lp["wv"]["kernel"] + lp["wv"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        # bias adds stay outside _proj — fp8_dot quantizes the matmul only
+        q = (_proj(lp["wq"], h) + lp["wq"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        k = (_proj(lp["wk"], h) + lp["wk"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
+        v = (_proj(lp["wv"], h) + lp["wv"]["bias"]).reshape(B, S, config.n_heads, config.head_dim)
         attn = dot_product_attention(q, k, v, segment_ids=seg_ids, impl=attention_impl).reshape(B, S, -1)
         h = layer_norm(
-            h + attn @ lp["wo"]["kernel"] + lp["wo"]["bias"],
+            h + _proj(lp["wo"], attn) + lp["wo"]["bias"],
             lp["attn_norm"]["scale"],
             lp["attn_norm"]["bias"],
             config.norm_eps,
         )
-        x = jax.nn.gelu(h @ lp["fc1"]["kernel"] + lp["fc1"]["bias"])
+        x = jax.nn.gelu(_proj(lp["fc1"], h) + lp["fc1"]["bias"])
         h = layer_norm(
-            h + x @ lp["fc2"]["kernel"] + lp["fc2"]["bias"],
+            h + _proj(lp["fc2"], x) + lp["fc2"]["bias"],
             lp["mlp_norm"]["scale"],
             lp["mlp_norm"]["bias"],
             config.norm_eps,
@@ -554,6 +607,8 @@ def bert_shard_rules():
 
     return ShardingRules(
         [
+            # fp8 scaling metadata: tiny f32 history buffers, always replicated
+            (r"fp8_meta", P()),
             (r"layers/(wq|wk|wv|fc1)/kernel", P(None, None, "tp")),
             (r"layers/(wo|fc2)/kernel", P(None, "tp", None)),
             (r"embeddings/word/embedding", P("tp", None)),

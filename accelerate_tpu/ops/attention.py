@@ -44,6 +44,7 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,  # [B, 1|H, Sq, Skv] additive or bool
     segment_ids: Optional[jax.Array] = None,  # [B, S] int; padding = 0
     scale: Optional[float] = None,
+    window: Optional[int] = None,  # sliding window: attend iff 0 <= i-j < window
     impl: str = "auto",
 ) -> jax.Array:
     """Standard softmax attention, BSHD layout.
@@ -52,24 +53,39 @@ def dot_product_attention(
 
     - "xla" — einsum, fused by XLA on the MXU. Fastest at short S (the whole
       score tensor is small enough that XLA's fusions win — measured on v5e).
-    - "flash" — the streaming Pallas flash kernel; wins once S ≳ 512.
+    - "flash" — the in-tree blocked streaming kernel (``ops.flash_attention``):
+      online softmax, in-kernel GQA, block-sparse causal/window/segment
+      skipping. Wins past the measured crossover (see ``ATTN_CROSSOVER_S``).
     - "fused" — our single-pass Pallas kernel (``ops.fused_attention``): whole
       score block in VMEM, one kernel for fwd and one for bwd. Within ~20% of
       xla at S=128–256; available for fusion-hostile surrounding graphs.
-    - "auto" — picks by measured crossover: flash for S ≥ 512, else xla.
+    - "auto" — picks flash vs xla from the measured crossover table
+      (``ATTN_CROSSOVER_S``, derived from ``benchmarks/attention/run.py``),
+      keyed by dtype and mask sparsity.
 
-    Masking comes in two forms:
+    Masking comes in three forms:
 
     - ``segment_ids`` — per-token ids for self-attention; position *i* attends
       *j* iff ``segment_ids[b, i] == segment_ids[b, j]``. Encode padding as id
       0 and real tokens as id 1 (or document ids for packed sequences). All
       impls support this form — padded models (BERT + attention_mask) keep
       kernel paths available.
+    - ``window`` — causal sliding-window band (attend iff ``0 <= i-j <
+      window``; requires ``causal=True``). Supported by the xla and flash
+      paths; the flash kernel skips out-of-band blocks entirely.
     - ``mask`` — arbitrary [B, 1|H, Sq, Skv] bool/additive mask; forces the
       XLA einsum path (kernels cannot consult a full score-shaped mask).
     """
+    if window is not None and not causal:
+        raise ValueError(
+            "window requires causal=True (the sliding window is a causal band)"
+        )
     if impl == "auto":
-        impl = "flash" if mask is None and _flash_supported(q, k) else "xla"
+        impl = (
+            "flash"
+            if mask is None and _flash_supported(q, k, causal=causal, window=window)
+            else "xla"
+        )
     if impl in ("flash", "fused"):
         if mask is not None:
             raise ValueError(
@@ -78,6 +94,11 @@ def dot_product_attention(
                 "as segment_ids"
             )
         if impl == "fused":
+            if window is not None:
+                raise ValueError(
+                    "impl='fused' does not support window (the short-S single-"
+                    "pass kernel has no band masking); use impl='flash' or 'xla'"
+                )
             from .fused_attention import fused_attention, fused_supported
 
             # off-TPU the wrapper falls back to the einsum path, any shape
@@ -91,7 +112,9 @@ def dot_product_attention(
             return fused_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids, window=window
+        )
     if segment_ids is not None:
         seg_mask = segment_mask(segment_ids)
         if mask is None:
@@ -100,10 +123,28 @@ def dot_product_attention(
             mask = jnp.logical_and(mask, seg_mask)
         else:  # additive mask: fold the segment constraint in as -inf
             mask = mask + jnp.where(seg_mask, 0.0, jnp.finfo(jnp.float32).min)
-    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale, window=window)
 
 
-def _flash_supported(q, k) -> bool:
+# Measured flash-vs-xla crossover (fwd+bwd step time, v5e, B=8, H=12, D=64;
+# benchmarks/attention/run.py is the generating grid): the einsum path wins
+# below the listed S, the streaming kernel at/after it. Sparser masks move
+# the crossover EARLIER — the block-skip lattice drops whole tiles, so the
+# kernel's streamed work shrinks while the einsum path still materializes
+# (and masks) every score. f32 crosses earlier than bf16 because the f32
+# score tensor doubles the einsum path's HBM traffic but the kernel's VMEM
+# accumulators are f32 either way.
+ATTN_CROSSOVER_S = {
+    ("bf16", "dense"): 512,
+    ("bf16", "causal"): 384,
+    ("bf16", "window"): 256,
+    ("f32", "dense"): 384,
+    ("f32", "causal"): 256,
+    ("f32", "window"): 256,
+}
+
+
+def _flash_supported(q, k, *, causal: bool = False, window: Optional[int] = None) -> bool:
     try:
         if jax.default_backend() != "tpu":
             return False
@@ -112,14 +153,13 @@ def _flash_supported(q, k) -> bool:
     # flash kernel wants seq multiples of its block size…
     if not (q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[-1] in (64, 128, 256)):
         return False
-    # …and only wins once the [S,S] score matrix stops fitting comfortably:
-    # measured on v5e (fwd+bwd, H=12, D=64): S=128 xla is 2.2× faster, S=512
-    # break-even, S=2048 flash 1.7× faster. Streaming KV through VMEM only
-    # pays past the crossover.
-    return k.shape[1] >= 512
+    # …and only wins past the measured crossover for this dtype × sparsity
+    sparsity = "window" if window is not None else ("causal" if causal else "dense")
+    dkey = "bf16" if q.dtype == jnp.bfloat16 else "f32"
+    return k.shape[1] >= ATTN_CROSSOVER_S[(dkey, sparsity)]
 
 
-def _xla_attention(q, k, v, *, causal, mask, scale):
+def _xla_attention(q, k, v, *, causal, mask, scale, window=None):
     *_, sq, hq, d = q.shape
     skv = k.shape[1]
     hkv = k.shape[2]
@@ -134,6 +174,12 @@ def _xla_attention(q, k, v, *, causal, mask, scale):
     if causal:
         causal_mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), k=skv - sq)
         logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if window is not None:
+        # query i sits at absolute position i + (skv - sq); band: 0 <= i-j < w
+        qpos = jnp.arange(sq)[:, None] + (skv - sq)
+        kpos = jnp.arange(skv)[None, :]
+        band = qpos - kpos < window
+        logits = jnp.where(band[None, None], logits, jnp.finfo(jnp.float32).min)
     if mask is not None:
         if mask.dtype == bool:
             logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)

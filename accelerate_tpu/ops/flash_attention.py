@@ -1,12 +1,30 @@
-"""Flash attention for TPU: Pallas-kernel path with XLA fallback.
+"""Flash attention for TPU: in-tree blocked streaming Pallas kernel.
 
 The reference reaches flash/SDPA CUDA kernels through transformers + torch
-(SURVEY.md §2.3 "flash attention / SDPA kernels"); the TPU-native equivalent is
-the Pallas flash kernel that ships with JAX
-(``jax.experimental.pallas.ops.tpu.flash_attention``) — blocked online-softmax
-attention that streams KV through VMEM instead of materializing the [S, S]
-score matrix in HBM. We wrap it behind the framework's BSHD layout and GQA
-conventions so models/CP kernels can swap implementations freely.
+(SURVEY.md §2.3 "flash attention / SDPA kernels"). Earlier rounds wrapped the
+stock JAX kernel (``jax.experimental.pallas.ops.tpu.flash_attention``); that
+wrapper materialized repeated KV in HBM for GQA, supported no sliding-window
+or block-sparse masking, and had no interpret mode, so tier-1 never exercised
+its dataflow. This module replaces it with an in-tree blocked online-softmax
+kernel (fwd + custom_vjp bwd with recompute-from-logsumexp, the pattern
+``ops/fused_attention.py`` demonstrates at short S):
+
+- grid ``(B·H, q_blocks, kv_blocks)`` with the kv axis innermost; f32 online
+  softmax carried in VMEM scratch across kv steps;
+- **in-kernel GQA**: the k/v BlockSpec index maps address the kv-head pool
+  directly (``g → b·Hkv + h // groups``), so repeated KV never exists in HBM;
+- a **block-sparse mask lattice**: causal, sliding-window and segment/packing
+  masks are collapsed into a per-``(q_block, kv_block)`` skip map built at
+  trace time (scalar-prefetch, like the paged kernels' block tables). The kv
+  index map *clamps* skipped steps onto the previous active block — a repeated
+  block index elides the DMA — and ``pl.when`` skips their compute, so fully
+  masked blocks are never streamed: long-context cost scales with the lattice
+  density, not S².
+
+Dispatch follows the same env contract as the paged serving kernels
+(:func:`flash_kernel_mode`, ``ACCELERATE_FLASH_KERNEL``): the kill switch is
+the einsum reference (byte-identical to ``impl="xla"``), and interpret mode
+drives the exact kernel dataflow through CPU tier-1.
 """
 
 from __future__ import annotations
@@ -14,10 +32,501 @@ from __future__ import annotations
 import math
 import os
 from functools import partial
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def flash_kernel_mode() -> str:
+    """Dispatch mode for :func:`flash_attention`, read once per trace (step
+    functions bake it in at compile time — flipping the env var mid-run does
+    not retrace warm jit entries):
+
+    - ``"on"`` (default): the in-tree Pallas kernel when the backend is TPU,
+      einsum reference everywhere else;
+    - ``"off"`` (``ACCELERATE_FLASH_KERNEL=0``): einsum reference always —
+      the kill switch, byte-identical to ``impl="xla"``;
+    - ``"interpret"`` (``ACCELERATE_FLASH_KERNEL=interpret``): the Pallas
+      kernel in interpreter mode on ANY backend — how CPU CI drives the
+      kernel's exact dataflow (including the backward) in tier-1."""
+    raw = os.environ.get("ACCELERATE_FLASH_KERNEL", "1").strip().lower()
+    if raw in ("0", "off", "false"):
+        return "off"
+    if raw == "interpret":
+        return "interpret"
+    return "on"
+
+
+class _FlashConfig(NamedTuple):
+    """Static kernel configuration (hashable: rides custom_vjp nondiff)."""
+
+    scale: float
+    causal: bool
+    window: Optional[int]
+    block_q: int
+    block_kv: int
+    h: int
+    hkv: int
+    use_seg: bool
+    interpret: bool
+
+    @property
+    def groups(self) -> int:
+        return self.h // self.hkv
+
+
+def _block_lattice(seg: jax.Array, cfg: _FlashConfig):
+    """Per-``(q_block, kv_block)`` active map → (ids, counts) in both
+    orientations.
+
+    ``ids[b, qi, :counts[b, qi]]`` lists the kv blocks q block ``qi`` must
+    stream, in ascending order; the transposed pair drives the dk/dv kernel.
+    Causal and sliding-window activity are pure block-coordinate bands;
+    segment activity is an interval-overlap test on per-block id min/max —
+    exact for contiguous packing, never-false-negative in general (a q and kv
+    block sharing id ``x`` both bracket ``x``). The diagonal block is active
+    under every mask (every token attends itself), so counts ≥ 1 and the
+    clamped index maps below always have a real block to land on."""
+    B, S = seg.shape
+    nq, nkv = S // cfg.block_q, S // cfg.block_kv
+    qlo = jnp.arange(nq, dtype=jnp.int32) * cfg.block_q
+    qhi = qlo + cfg.block_q - 1
+    klo = jnp.arange(nkv, dtype=jnp.int32) * cfg.block_kv
+    khi = klo + cfg.block_kv - 1
+    active = jnp.ones((B, nq, nkv), bool)
+    if cfg.causal:
+        active &= klo[None, None, :] <= qhi[None, :, None]
+    if cfg.window is not None:
+        active &= qlo[None, :, None] - khi[None, None, :] < cfg.window
+    if cfg.use_seg:
+        sq = seg.reshape(B, nq, cfg.block_q)
+        skv = seg.reshape(B, nkv, cfg.block_kv)
+        qmin, qmax = sq.min(-1), sq.max(-1)
+        kmin, kmax = skv.min(-1), skv.max(-1)
+        active &= (qmin[:, :, None] <= kmax[:, None, :]) & (
+            kmin[:, None, :] <= qmax[:, :, None]
+        )
+
+    def order(act):
+        # actives first, each side in ascending block order, no stable-sort
+        # dependence: inactive keys are offset past every active key
+        n = act.shape[-1]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        key = jnp.where(act, 0, n).astype(jnp.int32) + pos
+        return jnp.argsort(key, axis=-1).astype(jnp.int32)
+
+    activeT = active.transpose(0, 2, 1)
+    return (
+        order(active),
+        active.sum(-1).astype(jnp.int32),
+        order(activeT),
+        activeT.sum(-1).astype(jnp.int32),
+    )
+
+
+def _allow_mask(cfg: _FlashConfig, shape, qi, blk, segq, segkv):
+    """Element mask for one (q_block, kv_block) score tile, or None (dense)."""
+    preds = []
+    if cfg.causal or cfg.window is not None:
+        qpos = qi * cfg.block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        kpos = blk * cfg.block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        if cfg.causal:
+            preds.append(kpos <= qpos)
+        if cfg.window is not None:
+            preds.append(qpos - kpos < cfg.window)
+    if cfg.use_seg:
+        preds.append(segq[:, None] == segkv[None, :])
+    if not preds:
+        return None
+    allow = preds[0]
+    for p in preds[1:]:
+        allow = jnp.logical_and(allow, p)
+    return allow
+
+
+def _dot_nt2(a, b):  # [M, K] × [N, K] → [M, N], f32 accumulate
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_nn2(a, b):  # [M, K] × [K, N] → [M, N], f32 accumulate
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot_tn2(a, b):  # [K, M] × [K, N] → [M, N], f32 accumulate
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _flash_fwd_kernel(
+    ids_ref,     # [B, nq, nkv] int32 scalar-prefetch: active kv blocks per q block
+    counts_ref,  # [B, nq]      int32 scalar-prefetch: how many are active
+    q_ref,       # [1, bq, D]       this (head, q-block) tile
+    k_ref,       # [1, bkv, D]      the kv block the clamped index map selected
+    v_ref,       # [1, bkv, D]
+    segq_ref,    # [1, bq] int32
+    segkv_ref,   # [1, bkv] int32
+    o_ref,       # [1, bq, D]
+    lse_ref,     # [1, bq] f32
+    acc_ref,     # VMEM [bq, D] f32   online-softmax accumulators,
+    m_ref,       # VMEM [bq, 1] f32   carried across the kv grid steps
+    l_ref,       # VMEM [bq, 1] f32
+    *,
+    cfg: _FlashConfig,
+):
+    """One (head, q_block, kv_step) grid step of blocked streaming flash.
+
+    The kv axis is innermost; ``t`` walks this q block's *active-block list*
+    (``ids[b, qi, t]``), not the raw kv range. Steps past ``counts[b, qi]``
+    repeat the last active block (the index map clamps, so the DMA is elided)
+    and skip their compute via ``pl.when`` — that is the whole block-sparsity
+    mechanism. Within an active block, causal/window/segment masking is
+    recomputed per element from positions and the streamed segment-id tiles;
+    masked lanes go to ``-inf`` and the running max's shift is clamped so a
+    fully masked prefix never turns into NaN (same trick as the paged
+    kernels)."""
+    from jax.experimental import pallas as pl  # deferred with pallas_call's
+
+    g, qi, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    b = g // cfg.h
+    count = counts_ref[b, qi]
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(t < count)
+    def _step():
+        blk = ids_ref[b, qi, t]
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot_nt2(q, k) * cfg.scale  # [bq, bkv] f32
+        allow = _allow_mask(cfg, s.shape, qi, blk, segq_ref[0], segkv_ref[0])
+        if allow is not None:
+            s = jnp.where(allow, s, -jnp.inf)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # a fully-masked prefix keeps m at -inf: exp(-inf - -inf) would be
+        # NaN, so clamp the shift (everything is 0-weighted anyway)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(m_prev - shift)
+        p = jnp.exp(s - shift)  # [bq, bkv] f32, masked -> 0
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + _dot_nn2(p.astype(v.dtype), v)
+        m_ref[...] = m_new
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+
+
+def _flash_dq_kernel(
+    ids_ref, counts_ref,
+    q_ref,      # [1, bq, D]
+    k_ref,      # [1, bkv, D]
+    v_ref,      # [1, bkv, D]
+    segq_ref, segkv_ref,
+    lse_ref,    # [1, bq] f32
+    delta_ref,  # [1, bq] f32: sum(do * o) per row, precomputed
+    do_ref,     # [1, bq, D]
+    dq_ref,     # [1, bq, D]
+    dq_acc_ref,  # VMEM [bq, D] f32
+    *,
+    cfg: _FlashConfig,
+):
+    """dq kernel: same grid and lattice walk as the forward, recomputing
+    probabilities from the saved logsumexp (``p = exp(s - lse)``) instead of
+    re-running the online softmax — the fused_attention recompute pattern,
+    blocked."""
+    from jax.experimental import pallas as pl
+
+    g, qi, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    b = g // cfg.h
+    count = counts_ref[b, qi]
+
+    @pl.when(t == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(t < count)
+    def _step():
+        blk = ids_ref[b, qi, t]
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = _dot_nt2(q, k) * cfg.scale
+        allow = _allow_mask(cfg, s.shape, qi, blk, segq_ref[0], segkv_ref[0])
+        if allow is not None:
+            s = jnp.where(allow, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bkv] f32, masked -> 0
+        dp = _dot_nt2(do, v)                  # [bq, bkv] f32
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc_ref[...] += _dot_nn2(ds.astype(k.dtype), k) * cfg.scale
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_dkdv_kernel(
+    idsT_ref,     # [B, nkv, nq] int32: active q blocks per kv block
+    countsT_ref,  # [B, nkv]     int32
+    q_ref,        # [1, bq, D]   q block of group member r = t % groups
+    do_ref,       # [1, bq, D]
+    k_ref,        # [1, bkv, D]  this kv head's block
+    v_ref,        # [1, bkv, D]
+    segq_ref, segkv_ref,
+    lse_ref,      # [1, bq] f32
+    delta_ref,    # [1, bq] f32
+    dk_ref,       # [1, bkv, D]
+    dv_ref,       # [1, bkv, D]
+    dk_acc_ref,   # VMEM [bkv, D] f32
+    dv_acc_ref,   # VMEM [bkv, D] f32
+    *,
+    cfg: _FlashConfig,
+):
+    """dk/dv kernel: grid ``(B·Hkv, kv_blocks, q_steps·groups)`` — one program
+    per *kv head*, streaming every (active q block × GQA group member) pair
+    through the transposed lattice and accumulating the group-summed dk/dv in
+    VMEM. The GQA reduction happens here, in-kernel: per-q-head dk/dv and
+    repeated KV never exist in HBM."""
+    from jax.experimental import pallas as pl
+
+    a, j, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    b = a // cfg.hkv
+    qidx = t // cfg.groups
+    count = countsT_ref[b, j]
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(qidx < count)
+    def _step():
+        qb = idsT_ref[b, j, qidx]
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot_nt2(q, k) * cfg.scale  # [bq, bkv] f32
+        allow = _allow_mask(cfg, s.shape, qb, j, segq_ref[0], segkv_ref[0])
+        if allow is not None:
+            s = jnp.where(allow, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bkv] f32
+        dv_acc_ref[...] += _dot_tn2(p.astype(do.dtype), do)   # pᵀ do
+        dp = _dot_nt2(do, v)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc_ref[...] += _dot_tn2(ds.astype(q.dtype), q) * cfg.scale
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _clamped_block(ids, counts, b, qi, t):
+    """Index-map helper: step t of q block qi, clamped onto the last active
+    block once t runs past the active count — the repeated block index is what
+    lets Mosaic elide the DMA for skipped steps."""
+    return ids[b, qi, jnp.minimum(t, jnp.maximum(counts[b, qi] - 1, 0))]
+
+
+def _flash_pallas_call(kernel, cfg, grid, in_specs, out_specs, out_shape, scratch):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # lattice ids + counts
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=cfg.interpret
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash_call(q3, k3, v3, seg, cfg):
+    out, _ = _flash_call_fwd(q3, k3, v3, seg, cfg)
+    return out
+
+
+def _flash_call_fwd(q3, k3, v3, seg, cfg):
+    """q3 [B·H, S, D]; k3/v3 [B·Hkv, S, D]; seg [B, S] int32."""
+    from jax.experimental import pallas as pl
+
+    BH, S, D = q3.shape
+    H, Hkv, groups = cfg.h, cfg.hkv, cfg.groups
+    bq, bkv = cfg.block_q, cfg.block_kv
+    nq, nkv = S // bq, S // bkv
+    ids, counts, _, _ = _block_lattice(seg, cfg)
+
+    def kv_batch(g):
+        return (g // H) * Hkv + (g % H) // groups
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda g, qi, t, ids, cnt: (g, qi, 0)),
+        pl.BlockSpec(
+            (1, bkv, D),
+            lambda g, qi, t, ids, cnt: (
+                kv_batch(g), _clamped_block(ids, cnt, g // H, qi, t), 0),
+        ),
+        pl.BlockSpec(
+            (1, bkv, D),
+            lambda g, qi, t, ids, cnt: (
+                kv_batch(g), _clamped_block(ids, cnt, g // H, qi, t), 0),
+        ),
+        pl.BlockSpec((1, bq), lambda g, qi, t, ids, cnt: (g // H, qi)),
+        pl.BlockSpec(
+            (1, bkv),
+            lambda g, qi, t, ids, cnt: (
+                g // H, _clamped_block(ids, cnt, g // H, qi, t)),
+        ),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bq, D), lambda g, qi, t, ids, cnt: (g, qi, 0)),
+        pl.BlockSpec((1, bq), lambda g, qi, t, ids, cnt: (g, qi)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        jax.ShapeDtypeStruct((BH, S), jnp.float32),
+    ]
+    from jax.experimental.pallas import tpu as pltpu
+
+    scratch = [
+        pltpu.VMEM((bq, D), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+    ]
+    out, lse = _flash_pallas_call(
+        partial(_flash_fwd_kernel, cfg=cfg),
+        cfg, (BH, nq, nkv), in_specs, out_specs, out_shape, scratch,
+    )(ids, counts, q3, k3, v3, seg, seg)
+    return out, (q3, k3, v3, seg, lse, out)
+
+
+def _flash_call_bwd(cfg, res, do):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q3, k3, v3, seg, lse, out = res
+    BH, S, D = q3.shape
+    H, Hkv, groups = cfg.h, cfg.hkv, cfg.groups
+    bq, bkv = cfg.block_q, cfg.block_kv
+    nq, nkv = S // bq, S // bkv
+    B = BH // H
+    ids, counts, idsT, countsT = _block_lattice(seg, cfg)
+    # delta = Σ_d do·o per row: elementwise, O(S·D) — no score-shaped tensor
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    def kv_batch(g):
+        return (g // H) * Hkv + (g % H) // groups
+
+    q_spec = pl.BlockSpec((1, bq, D), lambda g, qi, t, ids, cnt: (g, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, bkv, D),
+        lambda g, qi, t, ids, cnt: (
+            kv_batch(g), _clamped_block(ids, cnt, g // H, qi, t), 0),
+    )
+    row_spec = pl.BlockSpec((1, bq), lambda g, qi, t, ids, cnt: (g, qi))
+    dq = _flash_pallas_call(
+        partial(_flash_dq_kernel, cfg=cfg),
+        cfg,
+        (BH, nq, nkv),
+        [
+            q_spec,
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, bq), lambda g, qi, t, ids, cnt: (g // H, qi)),
+            pl.BlockSpec(
+                (1, bkv),
+                lambda g, qi, t, ids, cnt: (
+                    g // H, _clamped_block(ids, cnt, g // H, qi, t)),
+            ),
+            row_spec,
+            row_spec,
+            q_spec,
+        ],
+        [q_spec],
+        [jax.ShapeDtypeStruct((BH, S, D), q3.dtype)],
+        [pltpu.VMEM((bq, D), jnp.float32)],
+    )(ids, counts, q3, k3, v3, seg, seg, lse, delta, do)[0]
+
+    # transposed walk: per kv head, stream (active q block × group member)
+    # pairs; t enumerates them with the member index fastest
+    def q_batch(a, t):
+        return (a // Hkv) * H + (a % Hkv) * groups + t % groups
+
+    qT_spec = pl.BlockSpec(
+        (1, bq, D),
+        lambda a, j, t, ids, cnt: (
+            q_batch(a, t),
+            _clamped_block(ids, cnt, a // Hkv, j, t // groups),
+            0,
+        ),
+    )
+    rowT_spec = pl.BlockSpec(
+        (1, bq),
+        lambda a, j, t, ids, cnt: (
+            q_batch(a, t),
+            _clamped_block(ids, cnt, a // Hkv, j, t // groups),
+        ),
+    )
+    kvT_spec = pl.BlockSpec((1, bkv, D), lambda a, j, t, ids, cnt: (a, j, 0))
+    dk, dv = _flash_pallas_call(
+        partial(_flash_dkdv_kernel, cfg=cfg),
+        cfg,
+        (B * Hkv, nkv, nq * groups),
+        [
+            qT_spec,
+            qT_spec,
+            kvT_spec,
+            kvT_spec,
+            pl.BlockSpec(
+                (1, bq),
+                lambda a, j, t, ids, cnt: (
+                    a // Hkv,
+                    _clamped_block(ids, cnt, a // Hkv, j, t // groups),
+                ),
+            ),
+            pl.BlockSpec((1, bkv), lambda a, j, t, ids, cnt: (a // Hkv, j)),
+            rowT_spec,
+            rowT_spec,
+        ],
+        [kvT_spec, kvT_spec],
+        [
+            jax.ShapeDtypeStruct((B * Hkv, S, D), k3.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), v3.dtype),
+        ],
+        [pltpu.VMEM((bkv, D), jnp.float32), pltpu.VMEM((bkv, D), jnp.float32)],
+    )(idsT, countsT, q3, do, k3, v3, seg, seg, lse, delta)
+    return dq, dk, dv, None
+
+
+_flash_call.defvjp(_flash_call_fwd, _flash_call_bwd)
+
+
+def _reference_attention(q, k, v, *, causal, scale, segment_ids, window):
+    """The einsum reference: the ``"off"`` kill switch and the off-TPU path.
+    Byte-identical to ``dot_product_attention(..., impl="xla")`` — both call
+    :func:`ops.attention._xla_attention` with the same mask construction."""
+    from .attention import _xla_attention, segment_mask
+
+    mask = segment_mask(segment_ids) if segment_ids is not None else None
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale, window=window)
 
 
 def flash_attention(
@@ -28,59 +537,63 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,  # [B, S] int; padding = 0
-    block_q: int = 512,
-    block_kv: int = 512,
+    window: Optional[int] = None,  # sliding window: attend iff 0 <= i-j < window
+    block_q: int = 128,
+    block_kv: int = 128,
 ) -> jax.Array:
-    """Pallas flash attention (TPU), BSHD in/out. Falls back to the XLA einsum
-    path off-TPU or for unsupported shapes.
+    """Blocked streaming flash attention (BSHD in/out), fwd + bwd.
 
     ``segment_ids`` gates attention to same-id pairs — the kernel-native form
-    of padding/packing masks (``pallas...flash_attention`` ``SegmentIds``), so
-    masked models need not fall back to the einsum path (round-2 verdict: the
-    headline bench ran with the flash kernel idle because of this)."""
-    if jax.default_backend() != "tpu":
-        from .attention import _xla_attention, segment_mask
+    of padding/packing masks; ``window`` adds a causal sliding-window band
+    (requires ``causal=True``). Both feed the block-skip lattice, so fully
+    masked (q_block, kv_block) tiles cost nothing. Dispatch is governed by
+    :func:`flash_kernel_mode`; shapes the blocked kernel cannot tile
+    (cross-attention, S not a multiple of the block size) fall back to the
+    einsum reference."""
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window requires causal=True (the sliding window is a causal band)"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    B, Sq, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    sm_scale = 1.0 / math.sqrt(D) if scale is None else float(scale)
 
-        mask = segment_mask(segment_ids) if segment_ids is not None else None
-        return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+    mode = flash_kernel_mode()
+    use_kernel = mode == "interpret" or (mode == "on" and jax.default_backend() == "tpu")
+    bq, bkv = min(block_q, Sq), min(block_kv, Skv)
+    tileable = Sq == Skv and Sq % bq == 0 and Skv % bkv == 0
+    if not (use_kernel and tileable):
+        return _reference_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids, window=window
+        )
 
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes,
-        SegmentIds,
-        flash_attention as pallas_flash,
+    cfg = _FlashConfig(
+        scale=sm_scale,
+        causal=causal,
+        window=window,
+        block_q=bq,
+        block_kv=bkv,
+        h=H,
+        hkv=Hkv,
+        use_seg=segment_ids is not None,
+        interpret=mode == "interpret",
     )
-
-    orig_dtype = q.dtype
-    hq, hkv = q.shape[2], k.shape[2]
-    if hq != hkv:
-        from .attention import _repeat_kv
-
-        k = _repeat_kv(k, hq // hkv)
-        v = _repeat_kv(v, hq // hkv)
-    # BSHD -> BHSD
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    sq, skv = qt.shape[2], kt.shape[2]
-    block_sizes = BlockSizes(
-        block_q=min(block_q, sq),
-        block_k_major=min(block_kv, skv),
-        block_k=min(block_kv, skv),
-        block_b=1,
-        block_q_major_dkv=min(block_q, sq),
-        block_k_major_dkv=min(block_kv, skv),
-        block_k_dkv=min(block_kv, skv),
-        block_q_dkv=min(block_q, sq),
-        block_k_major_dq=min(block_kv, skv),
-        block_k_dq=min(block_kv, skv),
-        block_q_dq=min(block_q, sq),
+    seg = (
+        segment_ids.astype(jnp.int32)
+        if segment_ids is not None
+        else jnp.zeros((B, Sq), jnp.int32)
     )
-    seg = None
-    if segment_ids is not None:
-        seg = SegmentIds(q=segment_ids.astype(jnp.int32), kv=segment_ids.astype(jnp.int32))
-    out = pallas_flash(
-        qt, kt, vt, segment_ids=seg, causal=causal, sm_scale=sm_scale, block_sizes=block_sizes
-    )
-    return out.transpose(0, 2, 1, 3).astype(orig_dtype)
+    # BSHD → flat [B·H, S, D]; layout-only, no repeated KV
+    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    k3 = k.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
+    v3 = v.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
+    out = _flash_call(q3, k3, v3, seg, cfg)
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
 
 def paged_kernel_mode() -> str:

@@ -79,7 +79,12 @@ class AcceleratedOptimizer:
         self._param_specs = None
         self._plan = None  # ShardingPlan consumed by init (the single spec surface)
         self._fused_update = None  # fused ZeRO-1 update fn (parallel/weight_update.py)
-        self._allow_fused_zero1 = True  # cleared for label-routed transforms (fp8 meta)
+        self._allow_fused_zero1 = True  # cleared to force the annotation path
+        # fused-compatible inner transform for the BUCKETED update when
+        # self.optimizer is label-routed over the model tree (fp8 partition):
+        # the bucket plan carries meta leaves as passthrough slots, so the
+        # bucketed tx is the plain inner optimizer (set by prepare_optimizer)
+        self._fused_inner_tx = None
         self._fp16_scaler_config = None  # set by Accelerator.prepare_train_step (fp16)
         self._accelerate_step_called = False  # set by patch_optimizer_step wrappers
         self.accelerator_state = None  # set by Accelerator.prepare
@@ -116,12 +121,13 @@ class AcceleratedOptimizer:
             self._param_specs = plan.param_specs
             fused = None
             if self._allow_fused_zero1:
-                fused = plan.init_fused_optimizer_state(self.optimizer, params)
+                tx = self._fused_inner_tx if self._fused_inner_tx is not None else self.optimizer
+                fused = plan.init_fused_optimizer_state(tx, params)
             elif plan.fused_zero1:
-                # label-routed transforms (fp8 meta partition) cannot be
-                # bucketed: demote the plan so annotation-mode ZeRO-1 still
-                # shards the state below AND the per-step compiled-collective
-                # accounting never reports the fused path's (absent) traffic
+                # explicit opt-out: demote the plan so annotation-mode ZeRO-1
+                # still shards the state below AND the per-step compiled-
+                # collective accounting never reports the fused path's
+                # (absent) traffic
                 plan.zero1 = None
             if fused is not None:
                 self.opt_state, self._fused_update = fused

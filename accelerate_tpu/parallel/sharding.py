@@ -493,9 +493,16 @@ def make_sharding_plan(
         return plan  # composite mesh: ZeRO-1 annotations compose with FSDP/TP
     from .weight_update import build_bucket_plan
 
+    # fp8 delayed-scaling meta leaves are replace-with-cotangent side state,
+    # not optimized params: they bypass the buckets (and the optimizer tx)
+    # as passthrough slots, so dtype_recipe="fp8" keeps the fused path
+    # engaged instead of demoting to the annotation path
+    from ..ops.fp8 import META_KEY
+
     try:
         plan.zero1 = build_bucket_plan(
-            params, zero1_axis, axis_size, bucket_bytes=zero1_bucket_bytes
+            params, zero1_axis, axis_size, bucket_bytes=zero1_bucket_bytes,
+            passthrough=lambda path: META_KEY in path.split("/"),
         )
     except ValueError:
         plan.zero1 = None  # non-floating leaves: annotation path

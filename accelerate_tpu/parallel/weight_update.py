@@ -92,7 +92,11 @@ class Zero1BucketPlan:
     slots: "list[_LeafSlot]"
     bucket_sizes: "dict[str, int]"  # padded element counts
     bucket_dtypes: "dict[str, Any]"  # np.dtype per bucket
-    n_elements: int = 0  # total unpadded param elements
+    n_elements: int = 0  # total unpadded bucketed param elements
+    # leaf indices (tree-flatten order) excluded from buckets and carried
+    # alongside them: replace-with-cotangent leaves (fp8 delayed-scaling meta)
+    # whose "gradient" IS the new value, never touched by the optimizer tx
+    passthrough_indices: tuple = ()
 
     # ------------------------------------------------------------ properties --
     @property
@@ -127,10 +131,11 @@ class Zero1BucketPlan:
         import jax.numpy as jnp
 
         leaves = jax.tree_util.tree_leaves(tree)
-        if len(leaves) != len(self.slots):
+        planned = len(self.slots) + len(self.passthrough_indices)
+        if len(leaves) != planned:
             raise ValueError(
                 f"tree has {len(leaves)} leaves but the bucket plan was built "
-                f"for {len(self.slots)} — not the planned param structure"
+                f"for {planned} — not the planned param structure"
             )
         parts: "dict[str, list]" = {name: [] for name in self.bucket_sizes}
         filled: "dict[str, int]" = {name: 0 for name in self.bucket_sizes}
@@ -145,15 +150,33 @@ class Zero1BucketPlan:
             out[name] = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
         return out
 
-    def unbucket_tree(self, buckets):
-        """Rebuild the param-shaped pytree from ``{bucket_name: 1-D array}``."""
+    def passthrough_leaves(self, tree) -> "list":
+        """The tree's passthrough leaves, in ``passthrough_indices`` order."""
         import jax
 
-        leaves: "list" = [None] * len(self.slots)
+        leaves = jax.tree_util.tree_leaves(tree)
+        return [leaves[i] for i in self.passthrough_indices]
+
+    def unbucket_tree(self, buckets, passthrough=None):
+        """Rebuild the param-shaped pytree from ``{bucket_name: 1-D array}``.
+        Plans with passthrough slots need ``passthrough``: the leaf values (in
+        ``passthrough_indices`` order) to splice back in."""
+        import jax
+
+        n_leaves = len(self.slots) + len(self.passthrough_indices)
+        leaves: "list" = [None] * n_leaves
         for slot in self.slots:
             flat = buckets[slot.bucket]
             piece = jax.lax.slice(flat, (slot.offset,), (slot.offset + slot.size,))
             leaves[slot.leaf_index] = piece.reshape(slot.shape)
+        if self.passthrough_indices:
+            if passthrough is None or len(passthrough) != len(self.passthrough_indices):
+                raise ValueError(
+                    f"plan has {len(self.passthrough_indices)} passthrough leaves; "
+                    "unbucket_tree needs their values (see passthrough_leaves)"
+                )
+            for i, val in zip(self.passthrough_indices, passthrough):
+                leaves[i] = val
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
     # ---------------------------------------------------------------- specs ----
@@ -195,11 +218,24 @@ class Zero1BucketPlan:
         return jax.tree_util.tree_map_with_path(_spec, state)
 
 
+def _leaf_path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(getattr(p, "name", p)))
+    return "/".join(parts)
+
+
 def build_bucket_plan(
     params,
     axis: str,
     axis_size: int,
     bucket_bytes: Optional[int] = None,
+    passthrough: "Optional[Callable[[str], bool]]" = None,
 ) -> Zero1BucketPlan:
     """Assign every param leaf to a dtype-homogeneous, size-bounded bucket.
 
@@ -208,20 +244,31 @@ def build_bucket_plan(
     ``bucket_bytes``. Each bucket is padded to a multiple of ``axis_size``.
     Raises ``ValueError`` for non-floating leaves (their ``jax.grad`` cotangent
     is ``float0`` — callers should gate the fused path off instead).
+
+    ``passthrough`` (a predicate over '/'-joined leaf paths) marks leaves that
+    bypass the buckets entirely — replace-with-cotangent side state (fp8
+    delayed-scaling meta) whose "gradient" is its updated value. Passthrough
+    leaves never enter the optimizer transform or the collectives; the fused
+    update installs their cotangents directly (the fused twin of
+    ``ops.fp8._meta_replace_transform``).
     """
     import jax
     import jax.numpy as jnp
 
     if bucket_bytes is None:
         bucket_bytes = bucket_bytes_from_env()
-    leaves, treedef = jax.tree_util.tree_flatten(params)
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
     slots: "list[_LeafSlot]" = []
+    passthrough_indices: "list[int]" = []
     bucket_sizes: "dict[str, int]" = {}
     bucket_dtypes: "dict[str, Any]" = {}
     open_bucket: "dict[str, str]" = {}  # dtype str -> open bucket name
     fill: "dict[str, int]" = {}  # bucket name -> unpadded elements
     total = 0
-    for i, leaf in enumerate(leaves):
+    for i, (path, leaf) in enumerate(path_leaves):
+        if passthrough is not None and passthrough(_leaf_path_str(path)):
+            passthrough_indices.append(i)
+            continue
         dtype = np.dtype(leaf.dtype)
         # np's .kind can't see extension floats (bfloat16 reports 'V')
         if not jnp.issubdtype(dtype, jnp.floating):
@@ -261,6 +308,7 @@ def build_bucket_plan(
         bucket_sizes=bucket_sizes,
         bucket_dtypes=bucket_dtypes,
         n_elements=total,
+        passthrough_indices=tuple(passthrough_indices),
     )
 
 
@@ -339,7 +387,12 @@ def make_fused_zero1_update(tx, plan: Zero1BucketPlan, mesh, state_specs) -> Cal
         gb = plan.bucket_tree(grads)
         pb = plan.bucket_tree(params)
         new_pb, new_state = sharded(gb, opt_state, pb)
-        return plan.unbucket_tree(new_pb), new_state
+        # passthrough leaves (fp8 delayed-scaling meta) ride OUTSIDE the
+        # shard_map: tiny, replicated, and their cotangent IS the new value
+        # (the fused twin of ops.fp8._meta_replace_transform) — so the new
+        # leaf is the grad leaf verbatim, every micro-step
+        pt = plan.passthrough_leaves(grads) if plan.passthrough_indices else None
+        return plan.unbucket_tree(new_pb, pt), new_state
 
     return update_fn
 

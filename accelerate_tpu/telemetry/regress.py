@@ -75,10 +75,17 @@ DEFAULT_SPECS: "list[MetricSpec]" = [
     MetricSpec("*accept_rate*", "higher", 0.15),
     MetricSpec("*spec_decode*", "higher", 0.10),
     MetricSpec("*prefill_kernel*", "lower", 0.15),
+    # attention kernel grid + fp8 train step (bench attention config, ISSUE
+    # 20): per-token kernel time and the fp8 step ms are lower-better; the
+    # best fraction-of-roofline across the grid is higher-better. Must sit
+    # before the generic time specs — *attn_kernel* names end in *_token and
+    # the mfu fraction would otherwise fall through to the catch-all.
+    MetricSpec("*attn_kernel*", "lower", 0.10),
+    MetricSpec("*fp8*step*", "lower", 0.10),
+    MetricSpec("*mfu*", "higher", 0.05),
     MetricSpec("*seconds*", "lower", 0.10),
     MetricSpec("*_s", "lower", 0.10),
     MetricSpec("*_ms", "lower", 0.10),
-    MetricSpec("mfu", "higher", 0.05),
     # a zero/absent headline is a dead run — flag it even vs a dead baseline
     MetricSpec("headline", "higher", 0.10, hard_min=1e-9),
     MetricSpec("*", "higher", 0.05),

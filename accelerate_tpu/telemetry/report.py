@@ -2053,6 +2053,18 @@ def run_doctor() -> int:
         except Exception as exc:  # pragma: no cover - doctor must not crash
             _check("live observability plane", False, f"{type(exc).__name__}: {exc}")
 
+        # 21. fp8 through fused ZeRO-1 (ISSUE 20): an fp8 train step on an
+        # 8-virtual-device mesh must KEEP the fused bucketed path engaged —
+        # the delayed-scaling meta leaves ride as passthrough slots, the
+        # optimizer state shards 1/N per replica, losses match the
+        # replicated stage-0 baseline, and the compiled step's jit cache is
+        # frozen after warmup (run in a subprocess — the device count is
+        # fixed at backend init, which already happened in this process)
+        try:
+            _doctor_fp8_train_step(_check)
+        except Exception as exc:  # pragma: no cover - doctor must not crash
+            _check("fp8 fused zero1 train step", False, f"{type(exc).__name__}: {exc}")
+
     print("doctor: all checks passed" if not failures
           else f"doctor: {failures} check(s) FAILED")
     return 1 if failures else 0
@@ -2873,6 +2885,52 @@ def _doctor_fused_zero1(_check) -> None:
         except Exception as exc:
             detail = f"unparseable self_check output: {exc}"
     _check("fused zero1 compiled collectives", ok, detail)
+
+
+def _doctor_fp8_train_step(_check) -> None:
+    """Doctor check 21 body: subprocess ``ops.fp8.self_check`` — the fp8
+    train step through the FUSED ZeRO-1 path on 8 virtual devices. The
+    payload must show the fused path engaged (not demoted by the meta
+    leaves), meta riding as passthrough slots, 1/N opt-state sharding,
+    loss parity with the replicated stage-0 baseline, rolled amax
+    histories, and a jit cache frozen after the warmup compile."""
+    import subprocess
+    import sys
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # self_check sets the virtual device count
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import json; from accelerate_tpu.ops.fp8 import "
+            "self_check; print(json.dumps(self_check()))",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(pkg_dir),
+    )
+    ok = False
+    detail = f"exit {proc.returncode}: {proc.stderr[-300:]}"
+    if proc.returncode == 0:
+        try:
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            ok = (
+                payload["fused_engaged"] is True
+                and payload["plan_fused"] is True
+                and payload["passthrough_leaves"] > 0
+                and payload["opt_state_shard_fraction"] == 1.0 / payload["n_devices"]
+                and payload["loss_parity_max_rel_delta"] < 1.5e-7
+                and payload["meta_histories_rolled"] is True
+                and payload["jit_cache_at_end"] == payload["jit_cache_after_warmup"] == 1
+            )
+            detail = f"payload={payload}"
+        except Exception as exc:
+            detail = f"unparseable self_check output: {exc}"
+    _check("fp8 fused zero1 train step", ok, detail)
 
 
 def _doctor_performance_section(tmp: str, _check) -> None:

@@ -862,6 +862,23 @@ def run_bench_serving(on_tpu: bool) -> dict:
     }
 
 
+def run_bench_attention(on_tpu: bool) -> dict:
+    """Attention kernel config (ISSUE 20): fwd+bwd µs/token and
+    fraction-of-roofline over the (impl × seq × dtype × sparsity) grid — the
+    measurement behind ``ops.attention.ATTN_CROSSOVER_S`` — plus the
+    fp8-vs-bf16 llama train-step leg. Delegates to
+    ``benchmarks/attention/run.py`` (same grid ``make bench-attn`` runs)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "attention", "run.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_attention_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_bench_attention(on_tpu)
+
+
 def run_bench_checkpoint_stall(on_tpu: bool) -> dict:
     """Checkpoint-stall config (ISSUE 5 acceptance): exposed-stall ratio of
     async vs sync ``save_state`` around a fixed-cadence step loop — how much
@@ -1435,6 +1452,7 @@ def main():
         ("checkpoint_stall", run_bench_checkpoint_stall),
         ("weight_update", run_bench_weight_update),
         ("serving", run_bench_serving),
+        ("attention", run_bench_attention),
     ):
         if _remaining() < 120:
             configs[name] = {

@@ -44,3 +44,14 @@ def call_with_varying_static(x):
     for width in (8, 16, 32, 64):
         outs.append(compiled_static(x, width))  # R2: loop-varying static arg
     return outs
+
+
+@jax.jit
+def kernel_loop_over_kv_blocks(q, kv_blocks):
+    # R2: the streaming-attention mistake — python-looping over a traced
+    # [nkv, bs, d] array unrolls one matmul per block and recompiles per
+    # block count (the kernel grid, not python, should walk the blocks)
+    acc = jnp.zeros((q.shape[0], kv_blocks.shape[2]))
+    for block in kv_blocks:
+        acc = acc + q @ block
+    return acc

@@ -34,3 +34,15 @@ def build_sharding_specs(axis_names):
     for axis in set(axis_names):  # R5: unordered axes feeding sharding specs
         specs[axis] = ("data", axis)
     return specs
+
+
+@jax.jit
+def kernel_block_permutation(q, kv):
+    # R5: trace-time numpy entropy picks the block visit order — every rank
+    # compiles a DIFFERENT schedule (the block lattice must be derived from
+    # traced inputs, not host randomness)
+    order = np.random.permutation(4)
+    total = jnp.zeros(())
+    for i in order:
+        total = total + jnp.sum(q[i] @ kv[i])
+    return total

@@ -1,0 +1,32 @@
+"""jaxlint fixture: R6 clean near-miss twins — every explicit dot_general
+pins its accumulator; operator matmuls and einsum are out of scope (their
+policy lives in ``jax.default_matmul_precision``)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def attn_scores_f32_accum(q, k):
+    return jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@jax.jit
+def mlp_block_f32_accum(x, w):
+    h = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return jax.nn.relu(h)
+
+
+@jax.jit
+def operator_matmul_out_of_scope(x, w):
+    # `@` and einsum are governed by default_matmul_precision, not R6
+    return jnp.einsum("bi,io->bo", x, w) + x @ w @ jnp.eye(w.shape[1], dtype=w.dtype)
+
+
+def eager_helper_out_of_scope(x, w):
+    # not traced, not an ops/ module: R6 stays quiet
+    return jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))

@@ -1,0 +1,25 @@
+"""jaxlint fixture: R6 seeded violations — accumulator precision."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def attn_scores_default_accum(q, k):
+    # R6: bf16 q/k accumulate in bf16 — the online-softmax drift source
+    return jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+
+
+@jax.jit
+def mlp_block_default_accum(x, w):
+    h = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))  # R6
+    return jax.nn.relu(h)
+
+
+@jax.jit
+def partial_fix_second_dot(x, w1, w2):
+    h = jax.lax.dot_general(
+        x, w1, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # R6: the second dot dropped the annotation the first one carries
+    return jax.lax.dot_general(h, w2, (((1,), (0,)), ((), ())))

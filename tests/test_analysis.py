@@ -179,6 +179,7 @@ def test_r2_zero_false_negatives():
         "step_mutable_global",
         "call_with_unhashable",
         "call_with_varying_static",
+        "kernel_loop_over_kv_blocks",
     }
 
 
@@ -236,12 +237,35 @@ def test_r5_zero_false_negatives():
         "step_with_python_random",
         "step_with_set_iteration",
         "build_sharding_specs",
+        "kernel_block_permutation",
     }
+
+
+def test_r6_zero_false_negatives():
+    """Every bare dot_general in the traced fixtures is flagged — including
+    the partially-fixed function where only the FIRST dot carries the
+    annotation (the review-pressure shape: the fix that only lands once)."""
+    result = _lint("r6_precision.py")
+    assert _symbols(result, "R6") == {
+        "attn_scores_default_accum",
+        "mlp_block_default_accum",
+        "partial_fix_second_dot",
+    }
+    assert all(
+        f.severity == Severity.WARNING
+        for f in result.new_findings
+        if f.rule == "R6"
+    )
+    # the partially-fixed fn yields exactly ONE finding (the annotated dot
+    # must not be flagged)
+    partial = [f for f in result.new_findings if f.symbol == "partial_fix_second_dot"]
+    assert len(partial) == 1
 
 
 @pytest.mark.parametrize(
     "twin",
-    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py", "r5_clean.py"],
+    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py", "r5_clean.py",
+     "r6_clean.py"],
 )
 def test_clean_twins_produce_zero_findings(twin):
     result = _lint(twin)
@@ -488,7 +512,7 @@ def test_cli_json_schema():
             "suppressed",
             "baselined",
         } <= set(f)
-        assert f["rule"] in {"R1", "R2", "R3", "R4", "R5"}
+        assert f["rule"] in {"R1", "R2", "R3", "R4", "R5", "R6"}
         assert f["severity"] in {"error", "warning", "note"}
 
 
@@ -500,7 +524,7 @@ def test_cli_exit_codes():
 def test_cli_rules_catalog():
     res = _run_cli("rules")
     assert res.returncode == 0
-    for rule_id in ("R1", "R2", "R3", "R4", "R5"):
+    for rule_id in ("R1", "R2", "R3", "R4", "R5", "R6"):
         assert rule_id in res.stdout
 
 

@@ -224,6 +224,31 @@ def test_serving_benchmark_smoke():
     assert pk["kernel_mode"] == ("compiled" if sd["on_tpu"] else "interpret")
 
 
+def test_attention_benchmark_smoke():
+    """Fast tier-1 smoke for `make bench-attn` (ISSUE 20): the kernel grid
+    runs on CPU shapes (xla path — interpret mode is a correctness tool, not
+    a perf signal), every cell lands without error, and the payload carries
+    the roofline numbers plus the regression-guarded block. The fp8 leg's
+    loss parity is absolute even at CPU scale; step-time margins are TPU
+    facts and asserted nowhere here."""
+    out = run_script("benchmarks/attention/run.py", "--steps", "2", timeout=600)
+    assert out["unit"] == "us/token" and out["value"] > 0
+    assert out["grid"] and all("error" not in g for g in out["grid"])
+    for g in out["grid"]:
+        assert g["us_per_token"] > 0
+        assert g["achieved_tflops"] > 0
+        assert 0 < g["fraction_of_peak"]
+    # every sparsity leg actually ran (the block-skip comparison needs all 3)
+    assert {g["sparsity"] for g in out["grid"]} == {"dense", "causal", "window"}
+    fp8 = out["fp8_train_step"]
+    assert fp8["bf16_step_ms"] > 0 and fp8["fp8_step_ms"] > 0
+    assert fp8["loss_rel_delta"] < 0.05  # fp8 recipe parity envelope
+    g = out["guarded"]
+    assert g["attn_kernel_us_per_token"] == out["value"]
+    assert g["fp8_step_ms"] == fp8["fp8_step_ms"]
+    assert 0 < g["attn_mfu_best_fraction"]
+
+
 def test_compile_time_restart_benchmark_smoke():
     """Fast tier-1 smoke for `make bench-compile` (ISSUE 13): the train leg
     only (two subprocess generations against one cache) — the payload must
@@ -306,6 +331,50 @@ def test_bench_check_unmatched_waiver_does_not_apply(tmp_path):
                        "--waive", "configs.some_other_bench=nope")
     assert res.returncode == 1, res.stdout + res.stderr
     assert "REGRESSION" in res.stdout and "^ WAIVED" not in res.stdout
+
+
+def _attn_guarded_payload(us=100.0, fp8_ms=30.0, mfu=0.4):
+    p = _bench_payload(100.0)
+    p["configs"] = {
+        "attention": {
+            "metric": "attention fwd+bwd µs/token", "value": us,
+            "guarded": {
+                "attn_kernel_us_per_token": us,
+                "fp8_step_ms": fp8_ms,
+                "attn_mfu_best_fraction": mfu,
+            },
+        }
+    }
+    return p
+
+
+@pytest.mark.parametrize(
+    "kwargs, name",
+    [
+        ({"us": 130.0}, "attn_kernel_us_per_token"),      # 30% slower kernel
+        ({"fp8_ms": 39.0}, "fp8_step_ms"),                # 30% slower fp8 step
+        ({"mfu": 0.28}, "attn_mfu_best_fraction"),        # 30% roofline drop
+    ],
+)
+def test_bench_check_flags_attention_guarded_regressions(tmp_path, kwargs, name):
+    """ISSUE 20 acceptance: a synthetic regression on each guarded attention
+    metric (30% — past the 10% spec band even after the 2x CPU-fingerprint
+    widening) must fail `make bench-check` and NAME the metric — the specs
+    give the kernel time and fp8 step ms lower-is-better direction and the
+    mfu fraction higher-is-better (a generic catch-all would read a slower
+    kernel as an 'improvement')."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_attn_guarded_payload()))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_attn_guarded_payload(**kwargs)))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout and name in res.stdout
+
+
+def test_bench_check_accepts_unchanged_attention_guarded_payload(tmp_path):
+    for fname in ("BENCH_r01.json", "BENCH_r02.json"):
+        (tmp_path / fname).write_text(json.dumps(_attn_guarded_payload()))
+    res = _regress_cli(tmp_path, "--scan", str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
 
 
 def test_bench_check_accepts_identical_payloads(tmp_path):

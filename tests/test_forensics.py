@@ -411,7 +411,8 @@ def test_doctor_self_checks(capsys):
     # + goodput ledger (ISSUE 17)
     # + speculative decoding (ISSUE 18)
     # + live observability plane (ISSUE 19)
-    assert out.count("PASS") == 20 and "FAIL" not in out
+    # + fp8 fused zero1 train step (ISSUE 20)
+    assert out.count("PASS") == 21 and "FAIL" not in out
     assert "static analyzer (jaxlint)" in out and "collective divergence" in out
     assert "goodput ledger" in out
     assert "speculative decoding" in out
@@ -425,6 +426,7 @@ def test_doctor_self_checks(capsys):
     assert "prefix cache + COW" in out
     assert "observability plane" in out
     assert "live observability plane" in out
+    assert "fp8 fused zero1 train step" in out
 
 
 # ------------------------------------------------------- integration hookups
